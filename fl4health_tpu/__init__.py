@@ -1,0 +1,16 @@
+"""fl4health_tpu — a TPU-native federated-learning framework.
+
+A ground-up JAX/XLA re-design of the capabilities of VectorInstitute/FL4Health
+(reference layer map in SURVEY.md §1). Instead of a gRPC client/server process
+model (Flower), the core runtime is an in-process SPMD simulator: simulated
+clients are entries along a ``clients`` mesh axis, one federated round is a
+single jit-compiled program
+
+    broadcast -> shard_map/vmap(local_train_steps) -> weighted psum aggregate
+
+and server "strategies" are pure functions over stacked client updates. A thin
+host-level transport (``fl4health_tpu.transport``) retains a wire contract for
+genuinely distributed (cross-silo) deployment.
+"""
+
+__version__ = "0.1.0"

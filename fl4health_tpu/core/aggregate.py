@@ -1,0 +1,84 @@
+"""Aggregation kernels — the server-side reduction over the clients axis.
+
+Parity target: /root/reference/fl4health/strategies/aggregate_utils.py:8,35
+(weighted + unweighted averaging of client NDArrays) and the deterministic
+summation-order property of utils/functions.py:84 (decode_and_pseudo_sort).
+
+TPU-first design: client updates arrive as ONE pytree whose leaves carry a
+leading ``clients`` axis (possibly sharded over a mesh axis named "clients").
+Aggregation is a masked weighted mean along axis 0, compiled by XLA into a
+reduce(+collective when sharded) — no per-client Python loop, and the reduction
+order is fixed by the stacked layout, giving determinism by construction.
+
+All functions accept an optional boolean ``mask`` (shape [clients]) so a
+partially-sampled cohort (Poisson sampling can even be empty,
+client_managers/poisson_sampling_manager.py:11) is handled inside jit with
+static shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core.types import PyTree, StackedParams
+
+
+def _expand(w: jax.Array, leaf: jax.Array) -> jax.Array:
+    """Reshape [clients] weights to broadcast against [clients, ...] leaf."""
+    return w.reshape((-1,) + (1,) * (leaf.ndim - 1))
+
+
+def effective_weights(
+    sample_counts: jax.Array,
+    mask: jax.Array | None = None,
+    weighted: bool = True,
+) -> jax.Array:
+    """Normalized aggregation weights over the clients axis.
+
+    weighted=True  -> w_i = n_i / sum(n)   (aggregate_results weighted path)
+    weighted=False -> w_i = 1 / |S|        (unweighted average)
+    A zero-mask (empty cohort) yields all-zero weights rather than NaN.
+    """
+    counts = jnp.asarray(sample_counts, dtype=jnp.float32)
+    m = jnp.ones_like(counts) if mask is None else jnp.asarray(mask, jnp.float32)
+    raw = counts * m if weighted else m
+    total = jnp.sum(raw)
+    return jnp.where(total > 0, raw / jnp.maximum(total, 1e-12), jnp.zeros_like(raw))
+
+
+def weighted_mean(stacked: StackedParams, weights: jax.Array) -> PyTree:
+    """sum_i w_i * leaf_i along the clients axis; weights already normalized.
+
+    Accumulates in float32 regardless of leaf dtype (bf16 params would lose
+    ~1e-3 per round otherwise), and hard-zeroes weight-0 rows so a NaN/Inf in
+    an unsampled client's slot cannot poison the aggregate (0 * NaN = NaN).
+    """
+
+    def _agg(leaf: jax.Array) -> jax.Array:
+        w = _expand(weights.astype(jnp.float32), leaf)
+        contrib = jnp.where(w > 0, leaf.astype(jnp.float32), 0.0) * w
+        return jnp.sum(contrib, axis=0).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map(_agg, stacked)
+
+
+def aggregate(
+    stacked: StackedParams,
+    sample_counts: jax.Array,
+    mask: jax.Array | None = None,
+    weighted: bool = True,
+) -> PyTree:
+    """Drop-in equivalent of the reference's aggregate_results."""
+    return weighted_mean(stacked, effective_weights(sample_counts, mask, weighted))
+
+
+def aggregate_losses(
+    losses: jax.Array,
+    sample_counts: jax.Array,
+    mask: jax.Array | None = None,
+    weighted: bool = True,
+) -> jax.Array:
+    """Scalar version (aggregate_utils.py:35)."""
+    w = effective_weights(sample_counts, mask, weighted)
+    return jnp.sum(jnp.asarray(losses, jnp.float32) * w)

@@ -1,0 +1,47 @@
+"""Core type vocabulary for the framework.
+
+The reference's wire currency is ``NDArrays`` (lists of NumPy arrays) shipped
+over gRPC (/root/reference/fl4health/parameter_exchange/parameter_exchanger_base.py:8).
+Here the currency is JAX pytrees: a client's model is a ``Params`` pytree, a
+cohort of simulated clients is the same pytree with a leading ``clients`` axis
+stacked onto every leaf ("client-stacked" trees), and aggregation is a jit
+reduction over that axis.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+# A pytree of jnp arrays holding model parameters (or any model-shaped state).
+Params = Any
+# A pytree with a leading clients axis on every leaf.
+StackedParams = Any
+PyTree = Any
+PRNGKey = jax.Array
+# Scalar metrics dictionary (values are 0-d arrays or python floats).
+Metrics = Mapping[str, Any]
+Config = Mapping[str, Any]
+
+# A loss function ``(preds, targets) -> scalar``.
+Criterion = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+class LoggingMode(enum.Enum):
+    """Mirror of the reference's logging modes (utils/logging.py:4)."""
+
+    TRAIN = "Training"
+    VALIDATION = "Validation"
+    TEST = "Testing"
+    EARLY_STOP_VALIDATION = "Early Stop Validation"
+
+
+def num_params(params: Params) -> int:
+    return sum(int(jnp.size(x)) for x in jax.tree_util.tree_leaves(params))
+
+
+def tree_zeros_like(tree: PyTree) -> PyTree:
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)

@@ -1,0 +1,196 @@
+"""Parameter exchangers — which part of the model crosses the exchange boundary.
+
+Reference surface (/root/reference/fl4health/parameter_exchange/):
+- ParameterExchanger ABC: push_parameters / pull_parameters (parameter_exchanger_base.py:8)
+- FullParameterExchanger (full_exchanger.py:10)
+- FixedLayerExchanger / LayerExchangerWithExclusions (layer_exchanger.py:17,56)
+- DynamicLayerExchanger — drift-norm threshold / top-% selection (layer_exchanger.py:119,
+  selection criteria parameter_selection_criteria.py:74-199)
+- SparseCooParameterExchanger — scored parameter subsets (sparse_coo_parameter_exchanger.py:18)
+
+TPU-native design: an exchanger is a pair of pure functions over pytrees.
+``push(local_params, initial_params)`` produces the payload sent "up";
+``pull(payload, local_params)`` merges a received payload into local params.
+Partial exchange is expressed with boolean leaf masks (static structure) so
+push/pull jit-compile; dynamic selection computes the mask from drift norms
+inside jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.core.types import Params, PyTree
+from fl4health_tpu.exchange.packer import LayerMaskPacket, SparseMaskPacket
+
+
+class FullExchanger:
+    """Exchange every leaf (full_exchanger.py:10).
+
+    All exchangers share one protocol: ``push(params, initial_params=None)``
+    and ``pull(payload, local)`` — callers can swap exchangers polymorphically
+    like the reference's ParameterExchanger ABC.
+    """
+
+    def push(self, params: Params, initial_params: Params | None = None) -> Params:
+        del initial_params
+        return params
+
+    def pull(self, payload: Params, local: Params) -> Params:
+        del local
+        return payload
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedLayerExchanger:
+    """Exchange only leaves whose dotted path satisfies ``include``.
+
+    Covers FixedLayerExchanger (model.layers_to_exchange()) and
+    LayerExchangerWithExclusions (e.g. FedBN excluding norm layers,
+    layer_exchanger.py:56) — exclusion is just the negated predicate.
+    """
+
+    include: Callable[[str], bool]
+
+    def mask(self, params: Params) -> PyTree:
+        return ptu.select_by_path(params, self.include)
+
+    def push(self, params: Params, initial_params: Params | None = None) -> Params:
+        # Non-exchanged leaves are zeroed; pull() never reads them. Keeping the
+        # full structure keeps stacked shapes static across clients.
+        del initial_params
+        mask = self.mask(params)
+        return jax.tree_util.tree_map(
+            lambda m, p: p if m else jnp.zeros_like(p), mask, params
+        )
+
+    def pull(self, payload: Params, local: Params) -> Params:
+        mask = self.mask(local)
+        return ptu.merge_by_mask(mask, payload, local)
+
+
+def fixed_exchanger_excluding(excluded: Sequence[str]) -> FixedLayerExchanger:
+    """Exchange all leaves except those whose path contains an excluded marker."""
+    excluded = tuple(excluded)
+    return FixedLayerExchanger(
+        include=lambda path: not any(s in path for s in excluded)
+    )
+
+
+def fixed_exchanger_including(included: Sequence[str]) -> FixedLayerExchanger:
+    """Exchange only leaves whose path contains one of the markers."""
+    included = tuple(included)
+    return FixedLayerExchanger(include=lambda path: any(s in path for s in included))
+
+
+def norm_exclusion_exchanger() -> FixedLayerExchanger:
+    """FedBN: exchange everything except normalization statistics/params.
+
+    Reference: clients/fedbn_client.py:7 + LayerExchangerWithExclusions.
+    Matches flax naming conventions (BatchNorm/LayerNorm/GroupNorm modules and
+    batch_stats collections).
+    """
+    exact = {"bn", "norm", "batch_stats", "batchnorm", "layernorm", "groupnorm"}
+    prefixes = ("BatchNorm", "LayerNorm", "GroupNorm", "bn_", "norm_")
+
+    def _is_norm_segment(seg: str) -> bool:
+        return seg.lower() in exact or seg.startswith(prefixes)
+
+    # Match whole path segments, not raw substrings — 'subnet.kernel' must NOT
+    # be excluded just because 'bn' appears inside 'subnet'.
+    return FixedLayerExchanger(
+        include=lambda path: not any(_is_norm_segment(s) for s in path.split("."))
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicLayerExchanger:
+    """Per-round leaf selection by drift norm (layer_exchanger.py:119).
+
+    Selection criteria mirror parameter_selection_criteria.py:
+    - threshold mode: select leaf if ||local - initial||_2 (optionally
+      normalized by sqrt(n)) exceeds ``threshold`` (:74,114)
+    - top-k mode: select the ceil(exchange_fraction * n_leaves) largest-drift
+      leaves (:143-199)
+    Output is a LayerMaskPacket; FedAvgDynamicLayer aggregates per-leaf over
+    senders only.
+    """
+
+    mode: str = "threshold"  # "threshold" | "topk"
+    threshold: float = 0.1
+    exchange_fraction: float = 0.5
+    normalized: bool = True
+
+    def push(self, params: Params, initial_params: Params) -> LayerMaskPacket:
+        drift = ptu.tree_sub(params, initial_params)
+        norms = jax.tree_util.tree_map(
+            lambda d: jnp.linalg.norm(d.reshape(-1))
+            / (jnp.sqrt(jnp.float32(d.size)) if self.normalized else 1.0),
+            drift,
+        )
+        flat_norms, treedef = jax.tree_util.tree_flatten(norms)
+        scores = jnp.stack(flat_norms)
+        if self.mode == "threshold":
+            sel = (scores > self.threshold).astype(jnp.float32)
+        else:
+            k = max(1, int(jnp.ceil(self.exchange_fraction * len(flat_norms))))
+            top = jnp.argsort(-scores)[:k]
+            sel = jnp.zeros((len(flat_norms),), jnp.float32).at[top].set(1.0)
+        leaf_mask = jax.tree_util.tree_unflatten(
+            treedef, [sel[i] for i in range(len(flat_norms))]
+        )
+        masked = jax.tree_util.tree_map(lambda m, p: m * p, leaf_mask, params)
+        return LayerMaskPacket(params=masked, leaf_mask=leaf_mask)
+
+    def pull(self, payload: LayerMaskPacket, local: Params) -> Params:
+        return jax.tree_util.tree_map(
+            lambda m, srv, loc: m * srv + (1.0 - m) * loc,
+            payload.leaf_mask,
+            payload.params,
+            local,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseExchanger:
+    """Scored element-subset exchange (sparse_coo_parameter_exchanger.py:18).
+
+    ``score_fn(params, initial_params) -> score tree`` (same shapes); the top
+    ``sparsity_level`` fraction of ALL elements (global top-k over the flat
+    vector, matching largest_final_magnitude_scores-style criteria) is sent.
+    """
+
+    sparsity_level: float = 0.1
+    score_fn: Callable[[Params, Params], PyTree] = None  # type: ignore[assignment]
+
+    def _scores(self, params: Params, initial: Params) -> PyTree:
+        if self.score_fn is not None:
+            return self.score_fn(params, initial)
+        # Default: largest final magnitude (parameter_selection_criteria.py)
+        return jax.tree_util.tree_map(jnp.abs, params)
+
+    def push(self, params: Params, initial_params: Params) -> SparseMaskPacket:
+        scores = self._scores(params, initial_params)
+        flat_scores, unravel = ptu.ravel(scores)
+        n = flat_scores.shape[0]
+        k = max(1, min(n, int(round(self.sparsity_level * n))))
+        # Exact top-k (ties broken by index) — a >=threshold test over-selects
+        # when scores tie, e.g. mostly-zero weights would degrade to full exchange.
+        _, top_idx = jax.lax.top_k(flat_scores, k)
+        mask_flat = jnp.zeros((n,), jnp.float32).at[top_idx].set(1.0)
+        mask = unravel(mask_flat)
+        masked = jax.tree_util.tree_map(lambda m, p: m * p, mask, params)
+        return SparseMaskPacket(params=masked, element_mask=mask)
+
+    def pull(self, payload: SparseMaskPacket, local: Params) -> Params:
+        return jax.tree_util.tree_map(
+            lambda m, srv, loc: m * srv + (1.0 - m) * loc,
+            payload.element_mask,
+            payload.params,
+            local,
+        )

@@ -1,0 +1,109 @@
+"""Parameter packers — structured payloads riding the exchange boundary.
+
+Reference behavior (/root/reference/fl4health/parameter_exchange/parameter_packer.py:13-142):
+packers concatenate auxiliary state (control variates, clipping bits, adaptive
+losses, layer names, sparse COO components) onto the flat NumPy weight list and
+split it back on the far side, because Flower's wire format is an opaque list.
+
+TPU-native design: the "wire" is a pytree, so a packed payload is simply a
+typed container (flax.struct dataclass) whose fields keep their structure —
+pack/unpack become field access and the whole payload can be client-stacked,
+sharded, and consumed by jit aggregation without any index bookkeeping.
+A flat-list codec for the cross-silo transport lives in
+``fl4health_tpu.transport.codec``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Generic, TypeVar
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.core.types import Params, PyTree
+
+T = TypeVar("T")
+
+
+@struct.dataclass
+class Packet:
+    """Generic exchange payload: model params + optional auxiliary pytree."""
+
+    params: Params
+    aux: Any = None
+
+
+@struct.dataclass
+class ControlVariatesPacket:
+    """SCAFFOLD payload: weights (or deltas) + control-variate updates.
+
+    Reference: ParameterPackerWithControlVariates (parameter_packer.py:23),
+    split at size_of_model_params.
+    """
+
+    params: Params
+    control_variates: Params
+
+
+@struct.dataclass
+class ClippingBitPacket:
+    """Client-level DP payload: clipped update + clipping-indicator bit.
+
+    Reference: ParameterPackerWithClippingBit (parameter_packer.py:45).
+    """
+
+    params: Params
+    clipping_bit: jax.Array  # scalar float (0/1)
+
+
+@struct.dataclass
+class AdaptiveConstraintPacket:
+    """FedProx-family payload: weights + train loss for mu adaptation.
+
+    Reference: ParameterPackerAdaptiveConstraint (parameter_packer.py:57).
+    """
+
+    params: Params
+    loss_for_adaptation: jax.Array  # scalar
+
+
+@struct.dataclass
+class LayerMaskPacket:
+    """Dynamic-layer payload: full-shaped params + per-leaf selection mask.
+
+    The reference ships (tensors, names) for an arbitrary layer subset
+    (ParameterPackerWithLayerNames, parameter_packer.py:72). Under SPMD we keep
+    static shapes: every leaf is present, ``leaf_mask`` is a pytree of scalar
+    0/1 floats marking which leaves this client actually "sent". Aggregation
+    averages each leaf only over senders (strategies/fedavg_dynamic_layer.py:17).
+    """
+
+    params: Params
+    leaf_mask: PyTree  # same structure, scalar 0/1 per leaf
+
+
+@struct.dataclass
+class SparseMaskPacket:
+    """Sparse payload: params + dense 0/1 element mask per leaf.
+
+    The reference ships COO (values, indices, shapes, names)
+    (SparseCooParameterPacker, parameter_packer.py:94). A dense mask is the
+    XLA-friendly encoding with identical semantics; the transport codec can
+    convert to real COO at the host boundary for wire compactness.
+    """
+
+    params: Params
+    element_mask: PyTree  # same structure/shape, 0/1
+
+
+def packet_like(params: Params) -> Packet:
+    return Packet(params=params, aux=None)
+
+
+def full_leaf_mask(params: Params) -> PyTree:
+    return jax.tree_util.tree_map(lambda _: jnp.ones((), jnp.float32), params)
+
+
+def full_element_mask(params: Params) -> PyTree:
+    return jax.tree_util.tree_map(lambda x: jnp.ones_like(x, jnp.float32), params)

@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Mirrors the reference's smoke-test strategy of spawning N client processes
+(/root/reference/tests/smoke_tests/run_smoke_test.py:294-329) — here simulated
+clients share one process and are sharded over 8 virtual CPU devices instead.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs
+
+
+@pytest.fixture
+def tolerance():
+    # Reference widens 5e-4 (CPU) to 5e-3 (CUDA); TPU bf16 paths use the wide one.
+    # (/root/reference/tests/smoke_tests/conftest.py:5-9)
+    return 5e-4

@@ -1,0 +1,107 @@
+"""Aggregation parity tests.
+
+Mirrors /root/reference/tests/strategies (aggregate_utils behavior): weighted
+and unweighted averaging, empty-cohort safety, mask handling, determinism.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fl4health_tpu.core import aggregate, pytree as ptu
+
+
+def _make_client_trees(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": jnp.asarray(rng.normal(size=(3, 2)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(2,)), jnp.float32),
+        }
+        for _ in range(n)
+    ]
+
+
+def test_weighted_average_matches_numpy():
+    trees = _make_client_trees()
+    counts = jnp.asarray([10.0, 20.0, 30.0, 40.0])
+    stacked = ptu.stack_clients(trees)
+    out = aggregate.aggregate(stacked, counts, weighted=True)
+    expected_w = sum(
+        float(c) * np.asarray(t["w"]) for c, t in zip(counts, trees)
+    ) / float(jnp.sum(counts))
+    np.testing.assert_allclose(np.asarray(out["w"]), expected_w, rtol=1e-6)
+
+
+def test_unweighted_average():
+    trees = _make_client_trees()
+    counts = jnp.asarray([10.0, 20.0, 30.0, 40.0])
+    stacked = ptu.stack_clients(trees)
+    out = aggregate.aggregate(stacked, counts, weighted=False)
+    expected_b = np.mean([np.asarray(t["b"]) for t in trees], axis=0)
+    np.testing.assert_allclose(np.asarray(out["b"]), expected_b, rtol=1e-6)
+
+
+def test_mask_excludes_clients():
+    trees = _make_client_trees()
+    counts = jnp.asarray([10.0, 20.0, 30.0, 40.0])
+    mask = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    stacked = ptu.stack_clients(trees)
+    out = aggregate.aggregate(stacked, counts, mask=mask, weighted=True)
+    expected_w = (10 * np.asarray(trees[0]["w"]) + 30 * np.asarray(trees[2]["w"])) / 40
+    np.testing.assert_allclose(np.asarray(out["w"]), expected_w, rtol=1e-6)
+
+
+def test_empty_cohort_is_zero_not_nan():
+    trees = _make_client_trees()
+    counts = jnp.asarray([10.0, 20.0, 30.0, 40.0])
+    mask = jnp.zeros((4,))
+    stacked = ptu.stack_clients(trees)
+    out = aggregate.aggregate(stacked, counts, mask=mask)
+    assert np.all(np.isfinite(np.asarray(out["w"])))
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.0)
+
+
+def test_aggregate_losses():
+    losses = jnp.asarray([1.0, 2.0, 3.0])
+    counts = jnp.asarray([1.0, 1.0, 2.0])
+    out = aggregate.aggregate_losses(losses, counts, weighted=True)
+    np.testing.assert_allclose(float(out), (1 + 2 + 6) / 4, rtol=1e-6)
+    out_u = aggregate.aggregate_losses(losses, counts, weighted=False)
+    np.testing.assert_allclose(float(out_u), 2.0, rtol=1e-6)
+
+
+def test_determinism_under_jit():
+    trees = _make_client_trees(8, seed=3)
+    counts = jnp.arange(1.0, 9.0)
+    stacked = ptu.stack_clients(trees)
+    f = jax.jit(lambda s, c: aggregate.aggregate(s, c))
+    a = f(stacked, counts)
+    b = f(stacked, counts)
+    np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
+
+
+def test_masked_nan_client_cannot_poison_aggregate():
+    # A masked-out client slot holding NaN must not leak (0 * NaN == NaN trap).
+    trees = _make_client_trees(3)
+    trees[1] = jax.tree_util.tree_map(lambda x: x * jnp.nan, trees[1])
+    stacked = ptu.stack_clients(trees)
+    counts = jnp.asarray([1.0, 1.0, 1.0])
+    mask = jnp.asarray([1.0, 0.0, 1.0])
+    out = aggregate.aggregate(stacked, counts, mask=mask)
+    assert np.all(np.isfinite(np.asarray(out["w"])))
+
+
+def test_bf16_params_accumulate_in_f32():
+    trees = [{"w": jnp.full((4,), 1.0 + i * 1e-3, jnp.bfloat16)} for i in range(8)]
+    stacked = ptu.stack_clients(trees)
+    counts = jnp.asarray([999.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0])
+    out = aggregate.aggregate(stacked, counts)
+    assert out["w"].dtype == jnp.bfloat16
+    expected = sum(float(c) * (1.0 + i * 1e-3) for i, c in enumerate(counts)) / float(
+        jnp.sum(counts)
+    )
+    # f32 accumulation keeps error at bf16 rounding of the RESULT, not the sum
+    np.testing.assert_allclose(
+        float(out["w"][0].astype(jnp.float32)), expected, rtol=4e-3
+    )

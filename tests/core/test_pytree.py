@@ -1,0 +1,46 @@
+"""Pytree primitive tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from fl4health_tpu.core import pytree as ptu
+
+
+def test_leaf_paths_dict():
+    tree = {"a": {"b": jnp.zeros(2)}, "c": jnp.zeros(1)}
+    assert ptu.leaf_paths(tree) == ["a.b", "c"]
+
+
+def test_ravel_roundtrip():
+    tree = {"x": jnp.arange(3.0), "y": jnp.ones((2, 2))}
+    flat, unravel = ptu.ravel(tree)
+    assert flat.shape == (7,)
+    back = unravel(flat)
+    np.testing.assert_allclose(np.asarray(back["y"]), 1.0)
+
+
+def test_global_norm():
+    tree = {"x": jnp.asarray([3.0]), "y": jnp.asarray([4.0])}
+    np.testing.assert_allclose(float(ptu.global_norm(tree)), 5.0, rtol=1e-6)
+
+
+def test_stack_unstack_roundtrip():
+    trees = [{"w": jnp.full((2,), float(i))} for i in range(3)]
+    stacked = ptu.stack_clients(trees)
+    assert stacked["w"].shape == (3, 2)
+    back = ptu.unstack_clients(stacked, 3)
+    np.testing.assert_allclose(np.asarray(back[2]["w"]), 2.0)
+
+
+def test_broadcast_clients():
+    tree = {"w": jnp.ones((4,))}
+    out = ptu.broadcast_clients(tree, 5)
+    assert out["w"].shape == (5, 4)
+
+
+def test_tree_algebra():
+    a = {"w": jnp.ones((2,))}
+    b = {"w": jnp.full((2,), 3.0)}
+    np.testing.assert_allclose(np.asarray(ptu.tree_sub(b, a)["w"]), 2.0)
+    np.testing.assert_allclose(np.asarray(ptu.tree_axpy(2.0, a, b)["w"]), 5.0)
+    np.testing.assert_allclose(float(ptu.tree_dot(a, b)), 6.0)
