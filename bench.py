@@ -1,0 +1,131 @@
+"""Benchmark: FedAvg on a CIFAR-10-class CNN with 64 simulated clients.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Measures local-steps/sec/chip for the compiled SPMD round (all 64 clients'
+local training + aggregation inside jit). ``vs_baseline`` compares against a
+reference-style eager simulation measured on the SAME hardware: a Python loop
+over clients, each running eager (un-jitted) train steps with host round-trips
+per step and per-round parameter serialization — the dispatch pattern of the
+reference's Flower/PyTorch stack (see SURVEY.md §3.1-3.2). The north-star in
+BASELINE.json is a 10x wall-clock win over a single-A100 Flower sim; the
+eager-vs-compiled ratio on identical silicon is the closest locally measurable
+proxy.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import CifarNet
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+N_CLIENTS = 64
+BATCH = 32
+LOCAL_STEPS = 5
+TIMED_ROUNDS = 3
+
+
+def make_sim() -> FederatedSimulation:
+    datasets = []
+    for i in range(N_CLIENTS):
+        rng = jax.random.PRNGKey(i)
+        x, y = synthetic_classification(rng, BATCH * LOCAL_STEPS + 64, (32, 32, 3), 10)
+        datasets.append(
+            ClientDataset(
+                x_train=x[: BATCH * LOCAL_STEPS],
+                y_train=y[: BATCH * LOCAL_STEPS],
+                x_val=x[BATCH * LOCAL_STEPS :],
+                y_val=y[BATCH * LOCAL_STEPS :],
+            )
+        )
+    return FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(CifarNet()), engine.masked_cross_entropy
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=datasets,
+        batch_size=BATCH,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=LOCAL_STEPS,
+        seed=0,
+    )
+
+
+def timed_compiled_rounds(sim: FederatedSimulation) -> float:
+    """Wall time per round of the compiled fit path (excludes compile)."""
+    mask = sim.client_manager.sample_all()
+    batches = sim._round_batches(0)
+    r = jnp.asarray(1, jnp.int32)
+    # warmup/compile
+    out = sim._fit_round(sim.server_state, sim.client_states, batches, mask, r)
+    jax.block_until_ready(out[0])
+    t0 = time.perf_counter()
+    server_state, client_states = sim.server_state, sim.client_states
+    for i in range(TIMED_ROUNDS):
+        server_state, client_states, losses, metrics = sim._fit_round(
+            server_state, client_states, batches, mask, r + i
+        )
+    jax.block_until_ready(jax.tree_util.tree_leaves(server_state)[0])
+    return (time.perf_counter() - t0) / TIMED_ROUNDS
+
+
+def timed_eager_round(sim: FederatedSimulation) -> float:
+    """Reference-style dispatch: Python loop over clients, eager step calls,
+    per-round full-parameter host round-trip (numpy serialize/deserialize)."""
+    import numpy as np
+
+    logic, tx = sim.logic, sim.tx
+    step_fn = engine.make_train_step(logic, tx)  # NOT jitted: eager dispatch
+    batches = sim._round_batches(0)
+    t0 = time.perf_counter()
+    collected = []
+    for c in range(N_CLIENTS):
+        state = jax.tree_util.tree_map(lambda x: x[c], sim.client_states)
+        cb = jax.tree_util.tree_map(lambda x: x[c], batches)
+        for s in range(LOCAL_STEPS):
+            b = jax.tree_util.tree_map(lambda x: x[s], cb)
+            state, _ = step_fn(state, None, b)
+        # Flower-style wire: params -> host numpy list -> back
+        nds = [np.asarray(x) for x in jax.tree_util.tree_leaves(state.params)]
+        collected.append(nds)
+    # host-side aggregation over numpy lists (aggregate_utils.py style)
+    agg = [np.mean([c[i] for c in collected], axis=0) for i in range(len(collected[0]))]
+    _ = [jnp.asarray(a) for a in agg]
+    return time.perf_counter() - t0
+
+
+def main():
+    sim = make_sim()
+    per_round = timed_compiled_rounds(sim)
+    steps_per_round = N_CLIENTS * LOCAL_STEPS
+    compiled_sps = steps_per_round / per_round
+
+    eager_time = timed_eager_round(sim)
+    eager_sps = steps_per_round / eager_time
+
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_cifar_cnn_64clients_local_steps_per_sec_per_chip",
+                "value": round(compiled_sps, 2),
+                "unit": "local_steps/sec/chip",
+                "vs_baseline": round(compiled_sps / eager_sps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,422 @@
+"""Client training engine — the reference's BasicClient loop, TPU-native.
+
+Reference behavior (/root/reference/fl4health/clients/basic_client.py):
+``train_by_epochs``/``train_by_steps`` (:627,:699) iterate a DataLoader in
+eager PyTorch: train_step = zero_grad -> predict -> loss -> backward ->
+transform_gradients -> step (:578-605), with hook methods before/after
+steps/epochs (:1233-1302), loss meters + metric managers, and ``validate``
+(:867) running val + optional test loaders.
+
+TPU-native design: one local-training phase is ONE compiled program —
+``lax.scan`` over a statically-shaped stack of batches. Heterogeneous client
+data sizes are handled by padding to the cohort max with per-step and
+per-example masks (empty-batch semantics of basic_client.py:660-662 become
+mask arithmetic). Algorithm variants plug in as pure functions on a
+``ClientLogic`` object; persistent aux state (control variates, personal
+models) rides in ``TrainState.extra`` and is vmappable across the clients
+axis, so N simulated clients train as one SPMD program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from fl4health_tpu.core.types import Params, PRNGKey, PyTree
+from fl4health_tpu.losses.containers import LossMeter
+from fl4health_tpu.metrics.base import MetricManager
+
+
+# ---------------------------------------------------------------------------
+# Data containers
+# ---------------------------------------------------------------------------
+
+@struct.dataclass
+class Batch:
+    """One step's data. Leading [steps] axis when stacked for scan.
+
+    example_mask: [B] validity (ragged final batch -> zeros); step_mask: scalar
+    0/1 (padding steps beyond a client's true data length are full no-ops).
+    """
+
+    x: jax.Array
+    y: jax.Array
+    example_mask: jax.Array
+    step_mask: jax.Array
+
+
+@struct.dataclass
+class TrainState:
+    """Scan carry for local training."""
+
+    params: Params
+    opt_state: Any
+    model_state: Any  # mutable collections (batch_stats); empty dict if none
+    rng: PRNGKey
+    step: jax.Array
+    extra: Any = None  # algorithm-specific persistent state
+
+
+@struct.dataclass
+class StepOutput:
+    losses: Any  # dict of scalars (backward + additional)
+    preds: jax.Array
+    targets: jax.Array
+    example_mask: jax.Array
+    step_mask: jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Model definition — framework-agnostic adapter (flax, haiku, hand-rolled)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ModelDef:
+    """init(rng, sample_x) -> (params, model_state)
+    apply(params, model_state, x, train, rng) -> ((preds, features), model_state)
+
+    ``preds`` is a dict with at least key "prediction"; ``features`` is a dict
+    of intermediate activations (reference predict() contract,
+    basic_client.py:992).
+    """
+
+    init: Callable[[PRNGKey, jax.Array], tuple[Params, Any]]
+    apply: Callable[..., tuple[tuple[dict, dict], Any]]
+
+
+def from_flax(module, mutable: tuple[str, ...] = ("batch_stats",)) -> ModelDef:
+    """Wrap a flax.linen module whose __call__ returns either an array or a
+    (preds_dict, features_dict) pair."""
+
+    def init(rng, sample_x):
+        variables = module.init({"params": rng, "dropout": rng}, sample_x, train=False)
+        params = variables["params"]
+        model_state = {k: v for k, v in variables.items() if k != "params"}
+        return params, model_state
+
+    def apply(params, model_state, x, train=True, rng=None):
+        variables = {"params": params, **(model_state or {})}
+        rngs = {"dropout": rng} if rng is not None else {}
+        if train and model_state:
+            out, new_state = module.apply(
+                variables, x, train=True, rngs=rngs, mutable=list(model_state.keys())
+            )
+        else:
+            out = module.apply(variables, x, train=train, rngs=rngs)
+            new_state = model_state
+        if isinstance(out, tuple):
+            preds, features = out
+        else:
+            preds, features = {"prediction": out}, {}
+        return (preds, features), new_state
+
+    return ModelDef(init=init, apply=apply)
+
+
+# ---------------------------------------------------------------------------
+# Client logic — the algorithm plug-in surface
+# ---------------------------------------------------------------------------
+
+class ClientLogic:
+    """Pure-function hook surface mirroring BasicClient's override points.
+
+    Subclasses override any of these; all must stay jit-traceable. ``ctx`` is
+    the per-round context (e.g. snapshot of the received global params, the
+    drift penalty weight) built once per round by ``init_round_context``.
+    """
+
+    def __init__(self, model: ModelDef, criterion: Callable):
+        self.model = model
+        self.criterion = criterion  # (preds_array, targets, example_mask) -> scalar
+
+    # -- round lifecycle ----------------------------------------------------
+    def init_extra(self, params: Params) -> Any:
+        """Persistent algorithm state created at client setup (round 1)."""
+        return None
+
+    def init_round_context(self, state: TrainState, server_payload: Any) -> Any:
+        """Per-round constants (update_before_train, basic_client.py:1233)."""
+        return None
+
+    def finalize_round(self, state: TrainState, ctx: Any, local_steps: jax.Array) -> TrainState:
+        """update_after_train (basic_client.py:1248) — e.g. SCAFFOLD variates."""
+        return state
+
+    # -- step ---------------------------------------------------------------
+    def predict(self, params, model_state, batch: Batch, rng, train: bool):
+        return self.model.apply(params, model_state, batch.x, train=train, rng=rng)
+
+    def training_loss(
+        self, preds: dict, features: dict, batch: Batch, params: Params,
+        state: TrainState, ctx: Any,
+    ) -> tuple[jax.Array, dict]:
+        """-> (backward_loss, additional dict) (compute_training_loss :1054)."""
+        loss = self.criterion(preds["prediction"], batch.y, batch.example_mask)
+        return loss, {}
+
+    def eval_loss(
+        self, preds: dict, features: dict, batch: Batch, params: Params,
+        state: TrainState, ctx: Any,
+    ) -> tuple[jax.Array, dict]:
+        loss = self.criterion(preds["prediction"], batch.y, batch.example_mask)
+        return loss, {}
+
+    def transform_gradients(self, grads: Params, state: TrainState, ctx: Any) -> Params:
+        """(basic_client.py:1294) — e.g. SCAFFOLD variate correction."""
+        return grads
+
+    def update_after_step(self, state: TrainState, ctx: Any, batch: Batch) -> TrainState:
+        """(basic_client.py:1272) — e.g. APFL alpha update."""
+        return state
+
+
+# ---------------------------------------------------------------------------
+# Criteria
+# ---------------------------------------------------------------------------
+
+def masked_cross_entropy(logits: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
+    """Mean CE over valid examples; integer or one-hot targets."""
+    if targets.ndim == logits.ndim:
+        log_p = jax.nn.log_softmax(logits, axis=-1)
+        per = -jnp.sum(targets * log_p, axis=-1)
+    else:
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    m = mask.astype(jnp.float32)
+    return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def masked_mse(preds: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
+    per = jnp.mean(
+        jnp.square(preds - targets).reshape(preds.shape[0], -1), axis=-1
+    )
+    m = mask.astype(jnp.float32)
+    return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def masked_bce_with_logits(logits: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
+    logits = logits.reshape(logits.shape[0], -1)
+    targets = targets.reshape(targets.shape[0], -1).astype(jnp.float32)
+    per = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, targets), axis=-1)
+    m = mask.astype(jnp.float32)
+    return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Engine: compiled train / eval phases
+# ---------------------------------------------------------------------------
+
+def create_train_state(
+    logic: ClientLogic, tx: optax.GradientTransformation, rng: PRNGKey,
+    sample_x: jax.Array,
+) -> TrainState:
+    params, model_state = logic.model.init(rng, sample_x)
+    return TrainState(
+        params=params,
+        opt_state=tx.init(params),
+        model_state=model_state,
+        rng=rng,
+        step=jnp.zeros((), jnp.int32),
+        extra=logic.init_extra(params),
+    )
+
+
+def _mask_tree(new: PyTree, old: PyTree, keep_new: jax.Array) -> PyTree:
+    """Select new where keep_new==1 (real step) else old (padding no-op)."""
+    return jax.tree_util.tree_map(lambda n, o: jnp.where(keep_new > 0, n, o), new, old)
+
+
+def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
+    """Returns step(state, ctx, batch) -> (state, StepOutput) — jit/scan-safe."""
+
+    def step(state: TrainState, ctx: Any, batch: Batch):
+        rng, step_rng = jax.random.split(state.rng)
+
+        def loss_fn(params):
+            (preds, features), new_model_state = logic.predict(
+                params, state.model_state, batch, step_rng, train=True
+            )
+            backward, additional = logic.training_loss(
+                preds, features, batch, params, state, ctx
+            )
+            return backward, (preds, additional, new_model_state)
+
+        (backward, (preds, additional, new_model_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = logic.transform_gradients(grads, state, ctx)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        keep = batch.step_mask  # padding steps must not move anything
+        new_state = state.replace(
+            params=_mask_tree(new_params, state.params, keep),
+            opt_state=_mask_tree(new_opt_state, state.opt_state, keep),
+            model_state=_mask_tree(new_model_state, state.model_state, keep),
+            rng=rng,
+            step=state.step + keep.astype(jnp.int32),
+        )
+        new_state = logic.update_after_step(new_state, ctx, batch)
+        out = StepOutput(
+            losses={"backward": backward, **additional},
+            preds=preds["prediction"],
+            targets=batch.y,
+            example_mask=batch.example_mask * keep,
+            step_mask=keep,
+        )
+        return new_state, out
+
+    return step
+
+
+def make_local_train(
+    logic: ClientLogic,
+    tx: optax.GradientTransformation,
+    metric_manager: MetricManager,
+    loss_keys: tuple[str, ...] = ("backward",),
+):
+    """Compiled local-training phase: scan the train step over stacked batches.
+
+    Returns train(state, ctx, batches) -> (state, loss_dict, metric_dict,
+    n_steps). ``batches`` is a Batch pytree with a leading [steps] axis.
+    """
+    step_fn = make_train_step(logic, tx)
+    meter_proto = LossMeter.create(loss_keys)
+
+    def train(state: TrainState, ctx: Any, batches: Batch):
+        def body(carry, batch):
+            st, meter, mstate = carry
+            st, out = step_fn(st, ctx, batch)
+            meter = meter.update(out.losses, weight=out.step_mask)
+            mstate = metric_manager.update(
+                mstate, out.preds, out.targets, out.example_mask
+            )
+            return (st, meter, mstate), out.losses
+
+        (state, meter, mstate), _ = jax.lax.scan(
+            body, (state, meter_proto, metric_manager.init()), batches
+        )
+        n_steps = jnp.sum(batches.step_mask)
+        state = logic.finalize_round(state, ctx, n_steps)
+        return state, meter.compute(), metric_manager.compute(mstate), n_steps
+
+    return train
+
+
+def make_local_eval(
+    logic: ClientLogic,
+    metric_manager: MetricManager,
+    loss_keys: tuple[str, ...] = ("checkpoint",),
+):
+    """Compiled evaluation phase (validate, basic_client.py:867)."""
+    meter_proto = LossMeter.create(loss_keys)
+
+    def evaluate(state: TrainState, ctx: Any, batches: Batch):
+        def body(carry, batch):
+            meter, mstate, rng = carry
+            rng, step_rng = jax.random.split(rng)
+            (preds, features), _ = logic.predict(
+                state.params, state.model_state, batch, step_rng, train=False
+            )
+            loss, additional = logic.eval_loss(
+                preds, features, batch, state.params, state, ctx
+            )
+            meter = meter.update(
+                {"checkpoint": loss, **{k: additional[k] for k in meter.sums if k != "checkpoint"}},
+                weight=batch.step_mask,
+            )
+            mstate = metric_manager.update(
+                mstate, preds["prediction"], batch.y, batch.example_mask * batch.step_mask
+            )
+            return (meter, mstate, rng), loss
+
+        (meter, mstate, _), _ = jax.lax.scan(
+            body, (meter_proto, metric_manager.init(), state.rng), batches
+        )
+        return meter.compute(), metric_manager.compute(mstate)
+
+    return evaluate
+
+
+# ---------------------------------------------------------------------------
+# Host-side batching: DataLoader equivalent producing static-shaped stacks
+# ---------------------------------------------------------------------------
+
+def epoch_batches(
+    rng: PRNGKey,
+    x: jax.Array,
+    y: jax.Array,
+    batch_size: int,
+    n_steps: int | None = None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+) -> Batch:
+    """Build a [steps, B, ...] Batch stack for one epoch (or exactly n_steps).
+
+    If n_steps exceeds one epoch, batches wrap around (reference
+    train_by_steps cycles its loader); if it's shorter, the epoch is truncated.
+    Padding rows get example_mask 0; padding steps get step_mask 0.
+    """
+    n = x.shape[0]
+    order = jax.random.permutation(rng, n) if shuffle else jnp.arange(n)
+    steps_per_epoch = max(1, n // batch_size if drop_last else -(-n // batch_size))
+    total = n_steps if n_steps is not None else steps_per_epoch
+    idx = []
+    masks = []
+    smasks = []
+    for s in range(total):
+        if n_steps is not None and s >= steps_per_epoch and n_steps <= steps_per_epoch:
+            break
+        epoch_pos = s % steps_per_epoch
+        if n_steps is not None and s > 0 and epoch_pos == 0 and shuffle:
+            order = jax.random.permutation(jax.random.fold_in(rng, s), n)
+        start = epoch_pos * batch_size
+        take = min(batch_size, n - start)
+        if take <= 0:
+            idx.append(jnp.zeros((batch_size,), jnp.int32))
+            masks.append(jnp.zeros((batch_size,), jnp.float32))
+            smasks.append(jnp.zeros((), jnp.float32))
+            continue
+        row = jnp.concatenate(
+            [order[start : start + take], jnp.zeros((batch_size - take,), order.dtype)]
+        )
+        idx.append(row)
+        masks.append(
+            jnp.concatenate(
+                [jnp.ones((take,), jnp.float32), jnp.zeros((batch_size - take,), jnp.float32)]
+            )
+        )
+        smasks.append(jnp.ones((), jnp.float32))
+    idx_arr = jnp.stack(idx)
+    return Batch(
+        x=x[idx_arr],
+        y=y[idx_arr],
+        example_mask=jnp.stack(masks),
+        step_mask=jnp.stack(smasks),
+    )
+
+
+def pad_batch_stacks(stacks: list[Batch]) -> Batch:
+    """Pad per-client Batch stacks to a common [steps] length and stack along a
+    new leading clients axis -> [clients, steps, B, ...]."""
+    max_steps = max(b.step_mask.shape[0] for b in stacks)
+
+    def pad_one(b: Batch) -> Batch:
+        pad = max_steps - b.step_mask.shape[0]
+        if pad == 0:
+            return b
+        return Batch(
+            x=jnp.concatenate([b.x, jnp.zeros((pad, *b.x.shape[1:]), b.x.dtype)]),
+            y=jnp.concatenate([b.y, jnp.zeros((pad, *b.y.shape[1:]), b.y.dtype)]),
+            example_mask=jnp.concatenate(
+                [b.example_mask, jnp.zeros((pad, *b.example_mask.shape[1:]), jnp.float32)]
+            ),
+            step_mask=jnp.concatenate([b.step_mask, jnp.zeros((pad,), jnp.float32)]),
+        )
+
+    padded = [pad_one(b) for b in stacks]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *padded)

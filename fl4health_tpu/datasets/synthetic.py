@@ -1,0 +1,115 @@
+"""Deterministic synthetic datasets for tests, smoke runs and benchmarks.
+
+Role of /root/reference/fl4health/utils/dataset.py SyntheticDataset and
+utils/data_generation.py (FedProx synthetic generator). With zero data egress
+in this environment, the MNIST/CIFAR-shaped generators below also stand in for
+the real corpora in smoke tests; loaders in ``fl4health_tpu.datasets.vision``
+pick up real data from disk when present.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core.types import PRNGKey
+
+
+def synthetic_classification(
+    rng: PRNGKey,
+    n: int,
+    input_shape: tuple[int, ...],
+    n_classes: int,
+    class_sep: float = 2.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Gaussian class blobs flattened into ``input_shape`` images.
+
+    Learnable but not trivial; deterministic given rng.
+    """
+    k_mu, k_x, k_y = jax.random.split(rng, 3)
+    dim = 1
+    for s in input_shape:
+        dim *= s
+    mus = jax.random.normal(k_mu, (n_classes, dim)) * class_sep
+    y = jax.random.randint(k_y, (n,), 0, n_classes)
+    x = mus[y] + jax.random.normal(k_x, (n, dim))
+    # standardize: separability is unchanged, conditioning is image-like
+    x = (x - jnp.mean(x)) / jnp.maximum(jnp.std(x), 1e-6)
+    return x.reshape((n, *input_shape)).astype(jnp.float32), y.astype(jnp.int32)
+
+
+def fedprox_synthetic(
+    rng: PRNGKey,
+    n_clients: int,
+    samples_per_client: int,
+    alpha: float = 0.5,
+    beta: float = 0.5,
+    dim: int = 60,
+    n_classes: int = 10,
+) -> list[tuple[jax.Array, jax.Array]]:
+    """Heterogeneous synthetic generator of the FedProx paper
+    (utils/data_generation.py:12,147): per-client W_k ~ N(u_k, 1),
+    u_k ~ N(0, alpha); features x ~ N(v_k, Sigma), v_k ~ N(B_k, 1),
+    B_k ~ N(0, beta); labels = argmax(softmax(Wx + b)).
+    """
+    sigma = jnp.diag(jnp.arange(1, dim + 1, dtype=jnp.float32) ** -1.2)
+    out = []
+    for k in range(n_clients):
+        rk = jax.random.fold_in(rng, k)
+        k1, k2, k3, k4, k5 = jax.random.split(rk, 5)
+        u_k = jax.random.normal(k1, ()) * jnp.sqrt(alpha)
+        b_k = jax.random.normal(k2, ()) * jnp.sqrt(beta)
+        w = jax.random.normal(k3, (n_classes, dim)) + u_k
+        bias = jax.random.normal(k4, (n_classes,)) + u_k
+        v_k = jax.random.normal(k5, (dim,)) + b_k
+        x = v_k + jax.random.normal(
+            jax.random.fold_in(rk, 99), (samples_per_client, dim)
+        ) @ jnp.sqrt(sigma)
+        logits = x @ w.T + bias
+        y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out.append((x.astype(jnp.float32), y))
+    return out
+
+
+def dirichlet_partition(
+    rng: PRNGKey,
+    x: jax.Array,
+    y: jax.Array,
+    n_partitions: int,
+    beta: float,
+    n_classes: int | None = None,
+    min_examples: int = 1,
+    max_retries: int = 5,
+) -> list[tuple[jax.Array, jax.Array]]:
+    """Dirichlet label-skew partitioner
+    (utils/partitioners.py:16 DirichletLabelBasedAllocation): for each label,
+    draw p ~ Dir(beta * 1_N) and allocate that label's examples across the N
+    partitions by p; retry while any partition has < min_examples.
+    """
+    import numpy as np
+
+    n_classes = int(jnp.max(y)) + 1 if n_classes is None else n_classes
+    y_np = np.asarray(y)
+    seed = int(jax.random.randint(rng, (), 0, 2**31 - 1))
+    gen = np.random.default_rng(seed)
+    for attempt in range(max_retries):
+        parts: list[list[int]] = [[] for _ in range(n_partitions)]
+        for c in range(n_classes):
+            idx = np.flatnonzero(y_np == c)
+            gen.shuffle(idx)
+            p = gen.dirichlet(np.full((n_partitions,), beta))
+            splits = (np.cumsum(p) * len(idx)).astype(int)[:-1]
+            for part, chunk in zip(parts, np.split(idx, splits)):
+                part.extend(chunk.tolist())
+        if min(len(p) for p in parts) >= min_examples:
+            break
+    else:
+        raise ValueError(
+            f"Dirichlet partition failed to give every partition >= {min_examples} "
+            f"examples in {max_retries} tries (beta={beta})"
+        )
+    out = []
+    for part in parts:
+        sel = jnp.asarray(np.sort(np.asarray(part, dtype=np.int64)))
+        out.append((x[sel], y[sel]))
+    return out

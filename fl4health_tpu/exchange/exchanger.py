@@ -126,7 +126,14 @@ class DynamicLayerExchanger:
     exchange_fraction: float = 0.5
     normalized: bool = True
 
-    def push(self, params: Params, initial_params: Params) -> LayerMaskPacket:
+    def __post_init__(self):
+        if self.mode not in ("threshold", "topk"):
+            raise ValueError(f"mode must be 'threshold' or 'topk', got {self.mode!r}")
+
+    def push(self, params: Params, initial_params: Params | None = None) -> LayerMaskPacket:
+        if initial_params is None:
+            raise ValueError("DynamicLayerExchanger.push needs initial_params "
+                             "(drift is measured against the round's received params)")
         drift = ptu.tree_sub(params, initial_params)
         norms = jax.tree_util.tree_map(
             lambda d: jnp.linalg.norm(d.reshape(-1))
@@ -144,12 +151,14 @@ class DynamicLayerExchanger:
         leaf_mask = jax.tree_util.tree_unflatten(
             treedef, [sel[i] for i in range(len(flat_norms))]
         )
-        masked = jax.tree_util.tree_map(lambda m, p: m * p, leaf_mask, params)
+        masked = jax.tree_util.tree_map(
+            lambda m, p: (m * p).astype(p.dtype), leaf_mask, params
+        )
         return LayerMaskPacket(params=masked, leaf_mask=leaf_mask)
 
     def pull(self, payload: LayerMaskPacket, local: Params) -> Params:
         return jax.tree_util.tree_map(
-            lambda m, srv, loc: m * srv + (1.0 - m) * loc,
+            lambda m, srv, loc: (m * srv + (1.0 - m) * loc).astype(loc.dtype),
             payload.leaf_mask,
             payload.params,
             local,
@@ -174,7 +183,10 @@ class SparseExchanger:
         # Default: largest final magnitude (parameter_selection_criteria.py)
         return jax.tree_util.tree_map(jnp.abs, params)
 
-    def push(self, params: Params, initial_params: Params) -> SparseMaskPacket:
+    def push(self, params: Params, initial_params: Params | None = None) -> SparseMaskPacket:
+        if initial_params is None and self.score_fn is not None:
+            raise ValueError("SparseExchanger.push needs initial_params when a "
+                             "drift-based score_fn is set")
         scores = self._scores(params, initial_params)
         flat_scores, unravel = ptu.ravel(scores)
         n = flat_scores.shape[0]
@@ -184,12 +196,14 @@ class SparseExchanger:
         _, top_idx = jax.lax.top_k(flat_scores, k)
         mask_flat = jnp.zeros((n,), jnp.float32).at[top_idx].set(1.0)
         mask = unravel(mask_flat)
-        masked = jax.tree_util.tree_map(lambda m, p: m * p, mask, params)
+        masked = jax.tree_util.tree_map(
+            lambda m, p: (m * p).astype(p.dtype), mask, params
+        )
         return SparseMaskPacket(params=masked, element_mask=mask)
 
     def pull(self, payload: SparseMaskPacket, local: Params) -> Params:
         return jax.tree_util.tree_map(
-            lambda m, srv, loc: m * srv + (1.0 - m) * loc,
+            lambda m, srv, loc: (m * srv + (1.0 - m) * loc).astype(loc.dtype),
             payload.element_mask,
             payload.params,
             local,

@@ -1,0 +1,76 @@
+"""Loss containers and meters.
+
+Parity: /root/reference/fl4health/utils/losses.py:10-234 — TrainingLosses /
+EvaluationLosses (backward loss + named additional losses) and LossMeter with
+AVERAGE / ACCUMULATION modes.
+
+TPU shape: containers are struct dataclasses (scan-carry friendly); the meter
+is a running (sum, count) pytree updated inside jit.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainingLosses:
+    backward: jax.Array  # the loss that was differentiated
+    additional: Mapping[str, jax.Array] = struct.field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"backward": self.backward, **dict(self.additional)}
+
+
+@struct.dataclass
+class EvaluationLosses:
+    checkpoint: jax.Array  # the loss used for checkpoint selection
+    additional: Mapping[str, jax.Array] = struct.field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"checkpoint": self.checkpoint, **dict(self.additional)}
+
+
+class LossMeterType(enum.Enum):
+    AVERAGE = "AVERAGE"
+    ACCUMULATION = "ACCUMULATION"
+
+
+@struct.dataclass
+class LossMeter:
+    """Running reduction of loss dicts (utils/losses.py LossMeter).
+
+    State: {key: sum} + count; AVERAGE divides at compute, ACCUMULATION
+    doesn't. A ``weight`` lets callers mask padded steps.
+    """
+
+    sums: Mapping[str, jax.Array]
+    count: jax.Array
+    meter_type: str = struct.field(pytree_node=False, default="AVERAGE")
+
+    @classmethod
+    def create(cls, keys: tuple[str, ...], meter_type: str = "AVERAGE") -> "LossMeter":
+        return cls(
+            sums={k: jnp.zeros((), jnp.float32) for k in keys},
+            count=jnp.zeros((), jnp.float32),
+            meter_type=meter_type,
+        )
+
+    def update(self, losses: Mapping[str, jax.Array], weight=1.0) -> "LossMeter":
+        w = jnp.asarray(weight, jnp.float32)
+        new_sums = {
+            k: self.sums[k] + w * jnp.asarray(losses[k], jnp.float32)
+            for k in self.sums
+        }
+        return self.replace(sums=new_sums, count=self.count + w)
+
+    def compute(self) -> dict:
+        if self.meter_type == "ACCUMULATION":
+            return dict(self.sums)
+        c = jnp.maximum(self.count, 1.0)
+        return {k: v / c for k, v in self.sums.items()}

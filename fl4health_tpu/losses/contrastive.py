@@ -1,0 +1,117 @@
+"""Contrastive losses: MOON and NT-Xent.
+
+Parity: /root/reference/fl4health/losses/contrastive_loss.py:6 (MoonContrastiveLoss)
+and :95 (NtXentLoss), and cosine_similarity_loss.py:5.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _cos(a: jax.Array, b: jax.Array, axis=-1, eps=1e-8) -> jax.Array:
+    a_n = a / jnp.maximum(jnp.linalg.norm(a, axis=axis, keepdims=True), eps)
+    b_n = b / jnp.maximum(jnp.linalg.norm(b, axis=axis, keepdims=True), eps)
+    return jnp.sum(a_n * b_n, axis=axis)
+
+
+def moon_contrastive_loss(
+    features: jax.Array,
+    positive_pairs: jax.Array,
+    negative_pairs: jax.Array,
+    temperature: float = 0.5,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    """MOON model-contrastive loss (contrastive_loss.py:6).
+
+    features:       [B, D]   current local-model features z
+    positive_pairs: [P, B, D] features from the global model (usually P=1)
+    negative_pairs: [N, B, D] features from previous local models
+    loss = -log( sum_p exp(cos(z, z_p)/t) /
+                 (sum_p exp(cos(z,z_p)/t) + sum_n exp(cos(z,z_n)/t)) )
+    """
+    pos = _cos(features[None], positive_pairs) / temperature  # [P, B]
+    neg = _cos(features[None], negative_pairs) / temperature  # [N, B]
+    logits = jnp.concatenate([pos, neg], axis=0).T  # [B, P+N]
+    n_pos = positive_pairs.shape[0]
+    log_prob = jax.nn.log_softmax(logits, axis=-1)
+    per_example = -jax.scipy.special.logsumexp(
+        log_prob[:, :n_pos], axis=-1
+    ) if n_pos > 1 else -log_prob[:, 0]
+    if mask is not None:
+        m = mask.astype(jnp.float32)
+        return jnp.sum(per_example * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(per_example)
+
+
+def ntxent_loss(
+    features: jax.Array,
+    transformed_features: jax.Array,
+    temperature: float = 0.5,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    """NT-Xent (SimCLR) loss (contrastive_loss.py:95).
+
+    features / transformed_features: [B, D] paired views; for each anchor the
+    positive is its pair, negatives are all other samples in the 2B batch.
+    """
+    b = features.shape[0]
+    z = jnp.concatenate([features, transformed_features], axis=0)  # [2B, D]
+    sim = _cos(z[:, None, :], z[None, :, :]) / temperature  # [2B, 2B]
+    valid = jnp.ones((b,), jnp.float32) if mask is None else mask.astype(jnp.float32)
+    valid2 = jnp.concatenate([valid, valid])
+    # exclude self-similarity and padded columns
+    neg_inf = jnp.finfo(sim.dtype).min
+    diag = jnp.eye(2 * b, dtype=bool)
+    sim = jnp.where(diag | (valid2[None, :] < 0.5), neg_inf, sim)
+    pos_idx = jnp.concatenate([jnp.arange(b) + b, jnp.arange(b)])
+    log_prob = jax.nn.log_softmax(sim, axis=-1)
+    per_anchor = -log_prob[jnp.arange(2 * b), pos_idx]
+    return jnp.sum(per_anchor * valid2) / jnp.maximum(jnp.sum(valid2), 1.0)
+
+
+def cosine_similarity_loss(
+    features: jax.Array, reference_features: jax.Array, mask: jax.Array | None = None
+) -> jax.Array:
+    """Mean |cos| similarity to a reference feature bank
+    (cosine_similarity_loss.py:5) — minimized to push features apart."""
+    per = jnp.abs(_cos(features, reference_features))
+    if mask is not None:
+        m = mask.astype(jnp.float32)
+        return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(per)
+
+
+def perfcl_loss(
+    local_features: jax.Array,
+    old_local_features: jax.Array,
+    global_features: jax.Array,
+    old_global_features: jax.Array,
+    initial_global_features: jax.Array,
+    temperature: float = 0.5,
+    mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """PerFCL dual contrastive losses (perfcl_loss.py:7).
+
+    Returns (global_contrastive, local_contrastive):
+    - global: pull current global-extractor features toward the frozen initial
+      (aggregated) global features, away from previous-round global features.
+    - local: pull current local features toward previous local features, away
+      from current global features.
+    """
+    g = moon_contrastive_loss(
+        global_features,
+        initial_global_features[None],
+        old_global_features[None],
+        temperature,
+        mask,
+    )
+    l = moon_contrastive_loss(
+        local_features,
+        old_local_features[None],
+        global_features[None],
+        temperature,
+        mask,
+    )
+    return g, l

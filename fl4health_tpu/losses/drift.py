@@ -1,0 +1,30 @@
+"""Weight-drift (proximal) losses.
+
+Parity: /root/reference/fl4health/losses/weight_drift_loss.py:5 — l2 distance
+between current model params and a reference snapshot, scaled by a penalty
+weight. Used by FedProx, Ditto, MR-MTL (clients/adaptive_drift_constraint_client.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.core.types import Params
+
+
+def weight_drift_loss(
+    params: Params, reference_params: Params, weight: jax.Array | float = 1.0
+) -> jax.Array:
+    """weight * ||params - ref||^2 summed over all leaves.
+
+    The reference computes sum of squared per-tensor l2 norms — identical to
+    the global squared norm used here.
+    """
+    drift = ptu.tree_sub(params, reference_params)
+    sq = sum(
+        jnp.sum(jnp.square(x.astype(jnp.float32)))
+        for x in jax.tree_util.tree_leaves(drift)
+    )
+    return jnp.asarray(weight, jnp.float32) * sq

@@ -1,0 +1,59 @@
+"""Server-side metric aggregation over client results.
+
+Parity: /root/reference/fl4health/metrics/metric_aggregation.py:6-155 —
+sample-weighted or uniform averaging of per-client metric dicts, for both fit
+and evaluate phases, with normalization.
+
+TPU shape: metric values arrive client-stacked ([clients] per key); weighting
+reuses the same effective-weights kernel as parameter aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core.aggregate import effective_weights
+
+
+def aggregate_metrics(
+    client_metrics: Mapping[str, jax.Array],
+    sample_counts: jax.Array,
+    mask: jax.Array | None = None,
+    weighted: bool = True,
+) -> dict[str, jax.Array]:
+    """Aggregate stacked metric values [clients] -> scalar per key."""
+    w = effective_weights(sample_counts, mask, weighted)
+    return {
+        k: jnp.sum(jnp.asarray(v, jnp.float32) * w) for k, v in client_metrics.items()
+    }
+
+
+def aggregate_metrics_list(
+    per_client: Sequence[Mapping[str, jax.Array]],
+    sample_counts: Sequence[float],
+    weighted: bool = True,
+) -> dict[str, float]:
+    """Host-list convenience: list of per-client dicts -> aggregated floats.
+
+    Mirrors metric_aggregation.metric_aggregation + normalize_metrics.
+    """
+    if not per_client:
+        return {}
+    keys = per_client[0].keys()
+    stacked = {
+        k: jnp.asarray([float(m[k]) for m in per_client], jnp.float32) for k in keys
+    }
+    counts = jnp.asarray(list(sample_counts), jnp.float32)
+    out = aggregate_metrics(stacked, counts, weighted=weighted)
+    return {k: float(v) for k, v in out.items()}
+
+
+def prefix_test_metrics(metrics: Mapping[str, float]) -> tuple[dict, dict]:
+    """Split a metrics dict into (val, test) by the reference's 'test -' prefix
+    convention (servers/base_server.py:545 _unpack_metrics)."""
+    val = {k: v for k, v in metrics.items() if not k.startswith("test -")}
+    test = {k: v for k, v in metrics.items() if k.startswith("test -")}
+    return val, test

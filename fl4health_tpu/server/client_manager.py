@@ -1,0 +1,90 @@
+"""Client sampling managers — participation masks from PRNG keys.
+
+Parity: /root/reference/fl4health/client_managers/ —
+BaseFractionSamplingManager (base_sampling_manager.py:8),
+PoissonSamplingClientManager (poisson_sampling_manager.py:11, per-client
+Bernoulli, may return empty), FixedSamplingByFractionClientManager
+(fixed_without_replacement_manager.py:11), FixedSamplingClientManager
+(fixed_sampling_client_manager.py:6, caches its sample for FedDG-GA).
+
+TPU-native design: a manager maps (rng, round) -> [n_clients] 0/1 mask; shapes
+stay static so sampling composes with jit. "Empty cohort allowed" is a flag,
+not an exception path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core.types import PRNGKey
+
+
+class ClientManager:
+    def __init__(self, n_clients: int):
+        self.n_clients = n_clients
+
+    def sample(self, rng: PRNGKey, round_idx: int) -> jax.Array:
+        raise NotImplementedError
+
+    def sample_all(self) -> jax.Array:
+        return jnp.ones((self.n_clients,), jnp.float32)
+
+
+class FullParticipationManager(ClientManager):
+    """sample_all semantics — every client every round."""
+
+    def sample(self, rng, round_idx):
+        return self.sample_all()
+
+
+class FixedFractionManager(ClientManager):
+    """Sample floor(fraction * n) clients uniformly without replacement,
+    re-drawn each round (FixedSamplingByFractionClientManager)."""
+
+    def __init__(self, n_clients: int, fraction: float, min_clients: int = 1):
+        super().__init__(n_clients)
+        self.k = max(min_clients, int(fraction * n_clients))
+
+    def sample(self, rng, round_idx):
+        rng = jax.random.fold_in(rng, round_idx)
+        perm = jax.random.permutation(rng, self.n_clients)
+        mask = jnp.zeros((self.n_clients,), jnp.float32)
+        return mask.at[perm[: self.k]].set(1.0)
+
+
+class PoissonSamplingManager(ClientManager):
+    """Independent Bernoulli(fraction) per client — matches the DP accounting
+    assumptions; cohort can legitimately be empty."""
+
+    def __init__(self, n_clients: int, fraction: float):
+        super().__init__(n_clients)
+        self.fraction = fraction
+
+    def sample(self, rng, round_idx):
+        rng = jax.random.fold_in(rng, round_idx)
+        return (
+            jax.random.uniform(rng, (self.n_clients,)) < self.fraction
+        ).astype(jnp.float32)
+
+
+class FixedSamplingManager(ClientManager):
+    """Draw once, reuse every round (FedDG-GA's reproducibility requirement,
+    fixed_sampling_client_manager.py:6)."""
+
+    def __init__(self, n_clients: int, fraction: float = 1.0):
+        super().__init__(n_clients)
+        self.k = max(1, int(fraction * n_clients))
+        self._cached: jax.Array | None = None
+
+    def sample(self, rng, round_idx):
+        if self._cached is None:
+            perm = jax.random.permutation(rng, self.n_clients)
+            mask = jnp.zeros((self.n_clients,), jnp.float32)
+            self._cached = mask.at[perm[: self.k]].set(1.0)
+        return self._cached
+
+    def reset_sample(self):
+        self._cached = None

@@ -1,0 +1,282 @@
+"""FederatedSimulation — the round loop (FlServer.fit equivalent), SPMD-style.
+
+Reference control flow (/root/reference/fl4health/servers/base_server.py:232
+FlServer.fit -> fit_round :278 -> strategy.configure_fit -> gRPC fan-out ->
+strategy.aggregate_fit -> evaluate_round :357): one server process and N
+client processes exchanging serialized NumPy arrays.
+
+TPU-native re-design: the N simulated clients are one client-stacked
+``TrainState`` (leading [clients] axis on every leaf, shardable over a
+``clients`` mesh axis). One round compiles to two programs:
+
+    fit_round  = pull(payload) -> vmap(local_train scan) -> push -> aggregate
+    eval_round = pull(global)  -> vmap(local_eval scan)  -> metric aggregation
+
+The Python loop over rounds only moves host-side concerns: batch construction,
+sampling, reporting, checkpointing — matching the reference's split of
+responsibilities without any per-round serialize/deserialize.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.exchange.exchanger import FullExchanger
+from fl4health_tpu.metrics.aggregation import aggregate_metrics
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.server.client_manager import ClientManager, FullParticipationManager
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@dataclasses.dataclass
+class ClientDataset:
+    """Host-side per-client data (the DataLoader boundary)."""
+
+    x_train: jax.Array
+    y_train: jax.Array
+    x_val: jax.Array
+    y_val: jax.Array
+    x_test: jax.Array | None = None
+    y_test: jax.Array | None = None
+
+    @property
+    def n_train(self) -> int:
+        return int(self.x_train.shape[0])
+
+
+@dataclasses.dataclass
+class RoundRecord:
+    round: int
+    fit_losses: dict
+    fit_metrics: dict
+    eval_losses: dict
+    eval_metrics: dict
+    fit_elapsed_s: float
+    eval_elapsed_s: float
+
+
+class FederatedSimulation:
+    """Couples logic + optimizer + strategy + data into a runnable FL job."""
+
+    def __init__(
+        self,
+        logic: ClientLogic,
+        tx: optax.GradientTransformation,
+        strategy: Strategy,
+        datasets: Sequence[ClientDataset],
+        batch_size: int,
+        metrics: MetricManager,
+        local_epochs: int | None = None,
+        local_steps: int | None = None,
+        exchanger=None,
+        client_manager: ClientManager | None = None,
+        seed: int = 42,
+        extra_loss_keys: tuple[str, ...] = (),
+        eval_loss_keys: tuple[str, ...] = (),
+        reporters: Sequence[Any] = (),
+    ):
+        if (local_epochs is None) == (local_steps is None):
+            raise ValueError("specify exactly one of local_epochs / local_steps "
+                             "(reference: utils/config.py epochs-xor-steps check)")
+        self.logic = logic
+        self.tx = tx
+        self.strategy = strategy
+        self.datasets = list(datasets)
+        self.n_clients = len(self.datasets)
+        self.batch_size = batch_size
+        self.metrics = metrics
+        self.local_epochs = local_epochs
+        self.local_steps = local_steps
+        self.exchanger = exchanger or FullExchanger()
+        self.client_manager = client_manager or FullParticipationManager(self.n_clients)
+        self.reporters = list(reporters)
+        self.rng = jax.random.PRNGKey(seed)
+        self.sample_counts = jnp.asarray(
+            [d.n_train for d in self.datasets], jnp.float32
+        )
+        self.history: list[RoundRecord] = []
+
+        # --- init client + server state -----------------------------------
+        init_rng = jax.random.fold_in(self.rng, 0)
+        sample_x = self.datasets[0].x_train[:1]
+        proto = engine.create_train_state(logic, tx, init_rng, sample_x)
+        per_client = []
+        for i in range(self.n_clients):
+            st = engine.create_train_state(
+                logic, tx, jax.random.fold_in(init_rng, i + 1), sample_x
+            )
+            per_client.append(st)
+        self.client_states: TrainState = ptu.stack_clients(per_client)
+        self.server_state = strategy.init(proto.params)
+
+        self._build_compiled()
+
+    # ------------------------------------------------------------------
+    def _build_compiled(self):
+        logic, tx, strategy, exchanger = self.logic, self.tx, self.strategy, self.exchanger
+        train = engine.make_local_train(
+            logic, tx, self.metrics, ("backward", *self._extra_keys())
+        )
+        evaluate = engine.make_local_eval(logic, self.metrics, ("checkpoint", *self._eval_keys()))
+
+        def client_fit(state: TrainState, payload, batches: Batch, participate):
+            orig = state
+            pulled = exchanger.pull(payload, state.params)
+            state = state.replace(params=pulled)
+            ctx = logic.init_round_context(state, payload)
+            new_state, losses, metrics, n_steps = train(state, ctx, batches)
+            # non-participants neither pull nor train (their packet row is
+            # garbage but aggregation hard-zeroes masked rows)
+            new_state = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(participate > 0, n, o), new_state, orig
+            )
+            packet = exchanger.push(new_state.params, pulled)
+            return new_state, packet, losses, metrics
+
+        def fit_round(server_state, client_states, batches, mask, round_idx):
+            payload = strategy.client_payload(server_state, round_idx)
+            new_states, packets, losses, metrics = jax.vmap(
+                client_fit, in_axes=(0, None, 0, 0)
+            )(client_states, payload, batches, mask)
+            results = FitResults(
+                packets=packets,
+                sample_counts=self.sample_counts,
+                train_losses=losses,
+                train_metrics=metrics,
+                mask=mask,
+            )
+            new_server_state = strategy.aggregate(server_state, results, round_idx)
+            agg_losses = {
+                k: jnp.sum(v * results.mask * self.sample_counts)
+                / jnp.maximum(jnp.sum(results.mask * self.sample_counts), 1.0)
+                for k, v in losses.items()
+            }
+            agg_metrics = aggregate_metrics(metrics, self.sample_counts, mask)
+            return new_server_state, new_states, agg_losses, agg_metrics
+
+        def client_eval(state: TrainState, global_params, batches: Batch):
+            pulled = exchanger.pull(global_params, state.params)
+            st = state.replace(params=pulled)
+            ctx = logic.init_round_context(st, global_params)
+            losses, metrics = evaluate(st, ctx, batches)
+            return st, losses, metrics
+
+        def eval_round(server_state, client_states, batches, eval_counts):
+            gp = strategy.client_payload(server_state, jnp.zeros((), jnp.int32))
+            new_states, losses, metrics = jax.vmap(client_eval, in_axes=(0, None, 0))(
+                client_states, gp, batches
+            )
+            agg_losses = {
+                k: jnp.sum(v * eval_counts) / jnp.maximum(jnp.sum(eval_counts), 1.0)
+                for k, v in losses.items()
+            }
+            agg_metrics = aggregate_metrics(metrics, eval_counts)
+            return new_states, agg_losses, agg_metrics
+
+        self._fit_round = jax.jit(fit_round)
+        self._eval_round = jax.jit(eval_round)
+
+    def _extra_keys(self):
+        return getattr(self.logic, "extra_loss_keys", ())
+
+    def _eval_keys(self):
+        return getattr(self.logic, "eval_loss_keys", ())
+
+    # ------------------------------------------------------------------
+    def _round_batches(self, round_idx: int) -> Batch:
+        stacks = []
+        for i, d in enumerate(self.datasets):
+            rng = jax.random.fold_in(jax.random.fold_in(self.rng, 1000 + round_idx), i)
+            if self.local_steps is not None:
+                b = engine.epoch_batches(
+                    rng, d.x_train, d.y_train, self.batch_size, n_steps=self.local_steps
+                )
+            else:
+                per_epoch = [
+                    engine.epoch_batches(
+                        jax.random.fold_in(rng, e), d.x_train, d.y_train, self.batch_size
+                    )
+                    for e in range(self.local_epochs)
+                ]
+                b = jax.tree_util.tree_map(
+                    lambda *xs: jnp.concatenate(xs, axis=0), *per_epoch
+                )
+            stacks.append(b)
+        return engine.pad_batch_stacks(stacks)
+
+    def _val_batches(self) -> tuple[Batch, jax.Array]:
+        stacks = [
+            engine.epoch_batches(
+                jax.random.PRNGKey(0), d.x_val, d.y_val, self.batch_size, shuffle=False
+            )
+            for d in self.datasets
+        ]
+        counts = jnp.asarray([d.x_val.shape[0] for d in self.datasets], jnp.float32)
+        return engine.pad_batch_stacks(stacks), counts
+
+    # ------------------------------------------------------------------
+    def fit(self, n_rounds: int) -> list[RoundRecord]:
+        for r in self.reporters:
+            r.report({"host_type": "server", "fit_start": time.time(),
+                      "num_rounds": n_rounds})
+        val_batches, val_counts = self._val_batches()
+        for rnd in range(1, n_rounds + 1):
+            t0 = time.time()
+            mask = self.client_manager.sample(
+                jax.random.fold_in(self.rng, 2000 + rnd), rnd
+            )
+            batches = self._round_batches(rnd)
+            self.server_state, self.client_states, fit_losses, fit_metrics = (
+                self._fit_round(
+                    self.server_state, self.client_states, batches, mask,
+                    jnp.asarray(rnd, jnp.int32),
+                )
+            )
+            fit_losses = jax.device_get(fit_losses)
+            fit_metrics = jax.device_get(fit_metrics)
+            t1 = time.time()
+            self.client_states, eval_losses, eval_metrics = self._eval_round(
+                self.server_state, self.client_states, val_batches, val_counts
+            )
+            eval_losses = jax.device_get(eval_losses)
+            eval_metrics = jax.device_get(eval_metrics)
+            t2 = time.time()
+            rec = RoundRecord(
+                round=rnd,
+                fit_losses={k: float(v) for k, v in fit_losses.items()},
+                fit_metrics={k: float(v) for k, v in fit_metrics.items()},
+                eval_losses={k: float(v) for k, v in eval_losses.items()},
+                eval_metrics={k: float(v) for k, v in eval_metrics.items()},
+                fit_elapsed_s=t1 - t0,
+                eval_elapsed_s=t2 - t1,
+            )
+            self.history.append(rec)
+            for rep in self.reporters:
+                rep.report(
+                    {
+                        "fit_losses": rec.fit_losses,
+                        "fit_metrics": rec.fit_metrics,
+                        "eval_losses": rec.eval_losses,
+                        "eval_metrics": rec.eval_metrics,
+                        "fit_elapsed_s": rec.fit_elapsed_s,
+                        "eval_elapsed_s": rec.eval_elapsed_s,
+                    },
+                    round=rnd,
+                )
+        for rep in self.reporters:
+            rep.report({"fit_end": time.time()})
+            rep.shutdown()
+        return self.history
+
+    @property
+    def global_params(self):
+        return self.strategy.global_params(self.server_state)

@@ -1,0 +1,67 @@
+"""Strategy abstraction — server-side aggregation as pure functions.
+
+Reference surface: flwr Strategy subclasses in /root/reference/fl4health/strategies/
+own configure_fit/aggregate_fit/aggregate_evaluate plus wire pack/unpack.
+
+TPU-native design: a Strategy owns a ``ServerState`` pytree and two pure
+functions — ``client_payload`` (what every client receives this round;
+broadcast is free under SPMD) and ``aggregate`` (stacked client packets ->
+new server state), both jit-compiled into the round program. Client sampling
+lives in ``fl4health_tpu.server.client_manager`` and produces a mask, so a
+partially-sampled cohort never changes program shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Generic, TypeVar
+
+import jax
+from flax import struct
+
+from fl4health_tpu.core.types import Params
+
+S = TypeVar("S")
+
+
+@struct.dataclass
+class FitResults:
+    """Stacked results of one fit round — what aggregate() consumes.
+
+    packets:       client-stacked payload pytree (params or richer packet)
+    sample_counts: [clients] train-set sizes
+    train_losses:  dict of [clients] scalars from local training meters
+    train_metrics: dict of [clients] metric values
+    mask:          [clients] 1.0 = participated this round
+    """
+
+    packets: Any
+    sample_counts: jax.Array
+    train_losses: Any
+    train_metrics: Any
+    mask: jax.Array
+
+
+class Strategy:
+    """Base protocol. Subclasses override any of the four methods.
+
+    All methods must be jit-traceable (no data-dependent Python control flow).
+    """
+
+    weighted_aggregation: bool = True
+    weighted_eval_aggregation: bool = True
+
+    def init(self, params: Params) -> Any:
+        """Build initial server state from initial model params."""
+        raise NotImplementedError
+
+    def global_params(self, server_state: Any) -> Params:
+        """The current global model params (for checkpointing/eval)."""
+        return server_state.params
+
+    def client_payload(self, server_state: Any, round_idx: jax.Array) -> Any:
+        """What is broadcast to clients this round (configure_fit's parameters)."""
+        return server_state.params
+
+    def aggregate(self, server_state: Any, results: FitResults, round_idx: jax.Array) -> Any:
+        """aggregate_fit: consume stacked packets, produce new server state."""
+        raise NotImplementedError

@@ -1,0 +1,45 @@
+"""FedAvg — weighted/unweighted parameter averaging.
+
+Parity: /root/reference/fl4health/strategies/basic_fedavg.py:29 (BasicFedAvg,
+aggregate_fit :232, aggregate_evaluate :280) over aggregate_utils.py:8,35.
+Deterministic summation order comes for free from the stacked reduction
+(replacing decode_and_pseudo_sort_results, utils/functions.py:84).
+"""
+
+from __future__ import annotations
+
+import jax
+from flax import struct
+
+from fl4health_tpu.core import aggregate as agg
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class FedAvgState:
+    params: Params
+
+
+class FedAvg(Strategy):
+    def __init__(self, weighted_aggregation: bool = True):
+        self.weighted_aggregation = weighted_aggregation
+
+    def init(self, params: Params) -> FedAvgState:
+        return FedAvgState(params=params)
+
+    def aggregate(self, server_state: FedAvgState, results: FitResults, round_idx) -> FedAvgState:
+        new_params = agg.aggregate(
+            results.packets,
+            results.sample_counts,
+            mask=results.mask,
+            weighted=self.weighted_aggregation,
+        )
+        # An empty cohort (all-zero mask) keeps the previous params.
+        import jax.numpy as jnp
+
+        any_client = jnp.sum(results.mask) > 0
+        new_params = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(any_client, n, o), new_params, server_state.params
+        )
+        return server_state.replace(params=new_params)

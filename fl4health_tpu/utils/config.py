@@ -1,0 +1,58 @@
+"""YAML config loading + validation.
+
+Parity: /root/reference/fl4health/utils/config.py:19-98 — load_config /
+check_config (requires n_server_rounds, positive-int checks), narrow_dict_type
+runtime narrowing, epochs-xor-steps helper.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, TypeVar
+
+T = TypeVar("T")
+
+
+class InvalidConfigError(ValueError):
+    pass
+
+
+def load_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    check_config(cfg)
+    return cfg
+
+
+def check_config(config: Mapping[str, Any]) -> None:
+    """Required keys + type/positivity checks (utils/config.py:29)."""
+    if "n_server_rounds" not in config:
+        raise InvalidConfigError("config missing required key n_server_rounds")
+    if not isinstance(config["n_server_rounds"], int) or config["n_server_rounds"] <= 0:
+        raise InvalidConfigError("n_server_rounds must be a positive integer")
+    for key in ("local_epochs", "local_steps", "batch_size"):
+        if key in config and config[key] is not None:
+            if not isinstance(config[key], int) or config[key] <= 0:
+                raise InvalidConfigError(f"{key} must be a positive integer")
+
+
+def narrow_dict_type(config: Mapping[str, Any], key: str, ty: type[T]) -> T:
+    """Typed access with a clear error (utils/config.py:47)."""
+    if key not in config:
+        raise InvalidConfigError(f"config missing key {key}")
+    val = config[key]
+    if not isinstance(val, ty):
+        raise InvalidConfigError(
+            f"config[{key!r}] should be {ty.__name__}, got {type(val).__name__}"
+        )
+    return val
+
+
+def epochs_steps_from_config(config: Mapping[str, Any]) -> tuple[int | None, int | None]:
+    """Exactly one of local_epochs / local_steps (utils/config.py:98)."""
+    epochs = config.get("local_epochs")
+    steps = config.get("local_steps")
+    if (epochs is None) == (steps is None):
+        raise InvalidConfigError("specify exactly one of local_epochs / local_steps")
+    return epochs, steps

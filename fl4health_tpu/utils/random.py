@@ -1,0 +1,31 @@
+"""Reproducibility helpers.
+
+Parity: /root/reference/fl4health/utils/random.py:11-86 —
+set_all_random_seeds (torch/np/random + deterministic flags) and RNG
+state save/restore. JAX is functional so "seeding" is key construction, but
+host-side NumPy/python RNGs (partitioners, batch order) still need seeding.
+"""
+
+from __future__ import annotations
+
+import random as _random
+
+import jax
+import numpy as np
+
+
+def set_all_random_seeds(seed: int = 42) -> jax.Array:
+    """Seed python + NumPy global RNGs and return the root JAX key."""
+    _random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def save_random_state() -> tuple:
+    return (_random.getstate(), np.random.get_state())
+
+
+def restore_random_state(state: tuple) -> None:
+    py_state, np_state = state
+    _random.setstate(py_state)
+    np.random.set_state(np_state)

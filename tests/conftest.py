@@ -7,12 +7,16 @@ clients share one process and are sharded over 8 virtual CPU devices instead.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon sitecustomize imports jax at interpreter boot and forces
+# jax_platforms="axon,cpu" (see /root/.axon_site/axon/register/pjrt.py:112), so
+# env vars alone don't stick — override via jax.config before backend init.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

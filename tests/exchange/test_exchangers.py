@@ -103,3 +103,30 @@ def test_norm_exclusion_segment_matching():
     # neither 'subnet' nor 'normal_dense' is a norm layer — both must exchange
     np.testing.assert_allclose(np.asarray(merged["subnet.kernel"]), 1.0)
     np.testing.assert_allclose(np.asarray(merged["normal_dense.kernel"]), 1.0)
+
+
+def test_bf16_dtype_preserved_through_dynamic_and_sparse():
+    p16 = {"w": jnp.ones((4,), jnp.bfloat16), "v": jnp.full((4,), 2.0, jnp.bfloat16)}
+    init = {k: jnp.zeros_like(v) for k, v in p16.items()}
+    d = ex.DynamicLayerExchanger(mode="threshold", threshold=0.5)
+    pkt = d.push(p16, init)
+    assert pkt.params["w"].dtype == jnp.bfloat16
+    assert d.pull(pkt, init)["w"].dtype == jnp.bfloat16
+    s = ex.SparseExchanger(sparsity_level=0.5)
+    spkt = s.push(p16, init)
+    assert spkt.params["w"].dtype == jnp.bfloat16
+    assert s.pull(spkt, init)["w"].dtype == jnp.bfloat16
+
+
+def test_dynamic_mode_validated():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ex.DynamicLayerExchanger(mode="Threshold")
+
+
+def test_dynamic_push_requires_initial():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ex.DynamicLayerExchanger().push({"w": jnp.ones(2)})
