@@ -67,15 +67,16 @@ def timed_compiled_rounds(sim: FederatedSimulation) -> float:
     """Wall time per round of the compiled fit path (excludes compile)."""
     mask = sim.client_manager.sample_all()
     batches = sim._round_batches(0)
+    val_batches, _ = sim._val_batches()
     r = jnp.asarray(1, jnp.int32)
     # warmup/compile
-    out = sim._fit_round(sim.server_state, sim.client_states, batches, mask, r)
+    out = sim._fit_round(sim.server_state, sim.client_states, batches, mask, r, val_batches)
     jax.block_until_ready(out[0])
     t0 = time.perf_counter()
     server_state, client_states = sim.server_state, sim.client_states
     for i in range(TIMED_ROUNDS):
         server_state, client_states, losses, metrics = sim._fit_round(
-            server_state, client_states, batches, mask, r + i
+            server_state, client_states, batches, mask, r + i, val_batches
         )
     jax.block_until_ready(jax.tree_util.tree_leaves(server_state)[0])
     return (time.perf_counter() - t0) / TIMED_ROUNDS

@@ -1,0 +1,61 @@
+"""Client-level DP clipping client — clipped weight-update deltas.
+
+Parity: /root/reference/fl4health/clients/clipping_client.py:22
+(clip_parameters :86, compute_weight_update_and_clip :113): after local
+training compute delta = w_local - w_received, flat-clip it to the bound C
+received from the server (factor = min(1, C / ||delta||_2)), and send
+(clipped delta, clipping bit). Reference convention (clip_parameters :86):
+bit = 1.0 when the norm is BELOW the bound (the server's adaptive-bound
+update estimates P(||delta|| < C) ~ quantile, Andrew et al. 1905.03871), and
+is forced to 0.0 when adaptive clipping is off to avoid leaking norms.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.engine import ClientLogic, TrainState
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.exchange.packer import ClippingBitPacket
+
+
+@struct.dataclass
+class ClippingContext:
+    initial_params: Params
+    clipping_bound: jnp.ndarray
+
+
+class ClippingClientLogic(ClientLogic):
+    def __init__(self, model, criterion, adaptive_clipping: bool = False):
+        super().__init__(model, criterion)
+        self.adaptive_clipping = adaptive_clipping
+
+    def init_round_context(self, state: TrainState, payload) -> ClippingContext:
+        return ClippingContext(
+            initial_params=state.params,
+            clipping_bound=payload.clipping_bound,
+        )
+
+    def init_extra(self, params: Params):
+        return {"delta": ptu.tree_zeros_like(params),
+                "clipping_bit": jnp.zeros((), jnp.float32)}
+
+    def finalize_round(self, state: TrainState, ctx: ClippingContext, local_steps):
+        delta = ptu.tree_sub(state.params, ctx.initial_params)
+        norm = ptu.global_norm(delta)
+        bound = jnp.asarray(ctx.clipping_bound, jnp.float32)
+        factor = jnp.minimum(1.0, bound / jnp.maximum(norm, 1e-12))
+        clipped = ptu.tree_scale(delta, factor)
+        bit = (norm <= bound).astype(jnp.float32)
+        if not self.adaptive_clipping:
+            bit = jnp.zeros((), jnp.float32)  # don't leak norms when unused
+        return state.replace(extra={"delta": clipped, "clipping_bit": bit})
+
+    def pack(self, state: TrainState, pushed_params, train_losses) -> ClippingBitPacket:
+        # delta + bit were stashed by finalize_round (which runs inside the
+        # compiled round right after the last local step)
+        return ClippingBitPacket(
+            params=state.extra["delta"], clipping_bit=state.extra["clipping_bit"]
+        )

@@ -174,6 +174,12 @@ class ClientLogic:
         """(basic_client.py:1272) — e.g. APFL alpha update."""
         return state
 
+    # -- wire ---------------------------------------------------------------
+    def pack(self, state: TrainState, pushed_params: Params, train_losses: dict) -> Any:
+        """Build the packet sent to the server (get_parameters + packer,
+        basic_client.py:153). Default: just the exchanged params."""
+        return pushed_params
+
 
 # ---------------------------------------------------------------------------
 # Criteria

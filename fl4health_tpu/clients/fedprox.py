@@ -1,0 +1,47 @@
+"""Adaptive drift-constraint client logic (FedProx / MR-MTL base behavior).
+
+Parity: /root/reference/fl4health/clients/adaptive_drift_constraint_client.py:21
+(+ FedProxClient, fed_prox_client.py:4): training loss = criterion +
+drift_penalty_weight/2 * ||w - w_received||^2; the received penalty weight
+arrives in the payload; the vanilla (un-penalized) train loss is packed for
+server-side mu adaptation (:82-106).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.exchange.packer import AdaptiveConstraintPacket
+from fl4health_tpu.losses.drift import weight_drift_loss
+
+
+@struct.dataclass
+class ProxContext:
+    initial_params: Params
+    drift_penalty_weight: Any
+
+
+class FedProxClientLogic(ClientLogic):
+    extra_loss_keys = ("vanilla", "penalty")
+
+    def init_round_context(self, state: TrainState, payload) -> ProxContext:
+        mu = getattr(payload, "drift_penalty_weight", jnp.asarray(0.1, jnp.float32))
+        return ProxContext(initial_params=state.params, drift_penalty_weight=mu)
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx: ProxContext):
+        vanilla = self.criterion(preds["prediction"], batch.y, batch.example_mask)
+        penalty = 0.5 * weight_drift_loss(
+            params, ctx.initial_params, ctx.drift_penalty_weight
+        )
+        return vanilla + penalty, {"vanilla": vanilla, "penalty": penalty}
+
+    def pack(self, state: TrainState, pushed_params, train_losses) -> AdaptiveConstraintPacket:
+        return AdaptiveConstraintPacket(
+            params=pushed_params,
+            loss_for_adaptation=train_losses["vanilla"],
+        )

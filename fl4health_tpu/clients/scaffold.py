@@ -1,0 +1,81 @@
+"""SCAFFOLD client logic — control-variate-corrected local SGD.
+
+Parity: /root/reference/fl4health/clients/scaffold_client.py:23.
+- Requires vanilla SGD with a known learning rate (asserted there).
+- Per step the gradient is corrected: g <- g - c_i + c
+  (modify_grad, scaffold_client.py).
+- After local training, option-II variate update (update_control_variates
+  :137):  c_i+ = c_i - c + (x - y_i) / (K * lr);  delta_c_i = c_i+ - c_i.
+- Packs (weights, delta_c_i) (get_parameters :79-100).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.exchange.packer import ControlVariatesPacket
+
+
+@struct.dataclass
+class ScaffoldExtra:
+    client_variates: Params  # c_i
+    delta: Params  # delta_c_i from the last finished round
+
+
+@struct.dataclass
+class ScaffoldContext:
+    initial_params: Params  # x (received global)
+    server_variates: Params  # c
+
+
+class ScaffoldClientLogic(ClientLogic):
+    """Must be paired with optax.sgd(learning_rate) — plain SGD, no momentum
+    (reference asserts this, scaffold_client.py)."""
+
+    def __init__(self, model, criterion, learning_rate: float):
+        super().__init__(model, criterion)
+        self.learning_rate = learning_rate
+
+    def init_extra(self, params: Params) -> ScaffoldExtra:
+        zeros = ptu.tree_zeros_like(params)
+        return ScaffoldExtra(client_variates=zeros, delta=zeros)
+
+    def init_round_context(self, state: TrainState, payload) -> ScaffoldContext:
+        return ScaffoldContext(
+            initial_params=payload.params,
+            server_variates=payload.control_variates,
+        )
+
+    def transform_gradients(self, grads, state: TrainState, ctx: ScaffoldContext):
+        # g - c_i + c
+        return jax.tree_util.tree_map(
+            lambda g, ci, c: g - ci + c,
+            grads, state.extra.client_variates, ctx.server_variates,
+        )
+
+    def finalize_round(self, state: TrainState, ctx: ScaffoldContext, local_steps):
+        k_lr = jnp.maximum(local_steps.astype(jnp.float32), 1.0) * self.learning_rate
+        # c_i+ = c_i - c + (x - y_i) / (K * lr)
+        new_ci = jax.tree_util.tree_map(
+            lambda ci, c, x, y: ci - c + (x - y) / k_lr,
+            state.extra.client_variates,
+            ctx.server_variates,
+            ctx.initial_params,
+            state.params,
+        )
+        delta = ptu.tree_sub(new_ci, state.extra.client_variates)
+        return state.replace(
+            extra=ScaffoldExtra(client_variates=new_ci, delta=delta)
+        )
+
+    def pack(self, state: TrainState, pushed_params, train_losses) -> ControlVariatesPacket:
+        return ControlVariatesPacket(
+            params=pushed_params, control_variates=state.extra.delta
+        )

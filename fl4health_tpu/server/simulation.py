@@ -128,25 +128,36 @@ class FederatedSimulation:
         )
         evaluate = engine.make_local_eval(logic, self.metrics, ("checkpoint", *self._eval_keys()))
 
-        def client_fit(state: TrainState, payload, batches: Batch, participate):
+        evaluate_after_fit = getattr(strategy, "evaluate_after_fit", False)
+
+        def client_fit(state: TrainState, payload, batches: Batch, participate,
+                       val_batches: Batch):
             orig = state
-            pulled = exchanger.pull(payload, state.params)
+            payload_params = payload.params if hasattr(payload, "params") else payload
+            pulled = exchanger.pull(payload_params, state.params)
             state = state.replace(params=pulled)
             ctx = logic.init_round_context(state, payload)
             new_state, losses, metrics, n_steps = train(state, ctx, batches)
+            if evaluate_after_fit:
+                # pre-aggregation local validation (FedDG-GA's
+                # evaluate_after_fit=True requirement, feddg_ga.py:205-210)
+                post_fit_losses, _ = evaluate(new_state, ctx, val_batches)
+                losses = {**losses, "val_checkpoint_post_fit": post_fit_losses["checkpoint"]}
             # non-participants neither pull nor train (their packet row is
             # garbage but aggregation hard-zeroes masked rows)
             new_state = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(participate > 0, n, o), new_state, orig
             )
-            packet = exchanger.push(new_state.params, pulled)
+            pushed = exchanger.push(new_state.params, pulled)
+            packet = logic.pack(new_state, pushed, losses)
             return new_state, packet, losses, metrics
 
-        def fit_round(server_state, client_states, batches, mask, round_idx):
+        def fit_round(server_state, client_states, batches, mask, round_idx,
+                      val_batches):
             payload = strategy.client_payload(server_state, round_idx)
             new_states, packets, losses, metrics = jax.vmap(
-                client_fit, in_axes=(0, None, 0, 0)
-            )(client_states, payload, batches, mask)
+                client_fit, in_axes=(0, None, 0, 0, 0)
+            )(client_states, payload, batches, mask, val_batches)
             results = FitResults(
                 packets=packets,
                 sample_counts=self.sample_counts,
@@ -163,10 +174,11 @@ class FederatedSimulation:
             agg_metrics = aggregate_metrics(metrics, self.sample_counts, mask)
             return new_server_state, new_states, agg_losses, agg_metrics
 
-        def client_eval(state: TrainState, global_params, batches: Batch):
-            pulled = exchanger.pull(global_params, state.params)
+        def client_eval(state: TrainState, payload, batches: Batch):
+            payload_params = payload.params if hasattr(payload, "params") else payload
+            pulled = exchanger.pull(payload_params, state.params)
             st = state.replace(params=pulled)
-            ctx = logic.init_round_context(st, global_params)
+            ctx = logic.init_round_context(st, payload)
             losses, metrics = evaluate(st, ctx, batches)
             return st, losses, metrics
 
@@ -180,7 +192,7 @@ class FederatedSimulation:
                 for k, v in losses.items()
             }
             agg_metrics = aggregate_metrics(metrics, eval_counts)
-            return new_states, agg_losses, agg_metrics
+            return new_states, agg_losses, agg_metrics, losses, metrics
 
         self._fit_round = jax.jit(fit_round)
         self._eval_round = jax.jit(eval_round)
@@ -238,14 +250,23 @@ class FederatedSimulation:
             self.server_state, self.client_states, fit_losses, fit_metrics = (
                 self._fit_round(
                     self.server_state, self.client_states, batches, mask,
-                    jnp.asarray(rnd, jnp.int32),
+                    jnp.asarray(rnd, jnp.int32), val_batches,
                 )
             )
             fit_losses = jax.device_get(fit_losses)
             fit_metrics = jax.device_get(fit_metrics)
             t1 = time.time()
-            self.client_states, eval_losses, eval_metrics = self._eval_round(
+            (
+                self.client_states,
+                eval_losses,
+                eval_metrics,
+                per_client_eval_losses,
+                per_client_eval_metrics,
+            ) = self._eval_round(
                 self.server_state, self.client_states, val_batches, val_counts
+            )
+            self.server_state = self.strategy.update_after_eval(
+                self.server_state, per_client_eval_losses, per_client_eval_metrics, mask
             )
             eval_losses = jax.device_get(eval_losses)
             eval_metrics = jax.device_get(eval_metrics)

@@ -65,3 +65,18 @@ class Strategy:
     def aggregate(self, server_state: Any, results: FitResults, round_idx: jax.Array) -> Any:
         """aggregate_fit: consume stacked packets, produce new server state."""
         raise NotImplementedError
+
+    def update_after_eval(
+        self,
+        server_state: Any,
+        eval_losses: Any,
+        eval_metrics: Any,
+        mask: jax.Array,
+    ) -> Any:
+        """Consume per-client post-aggregation eval results ([clients] arrays).
+
+        Needed by strategies whose next-round weights depend on evaluation of
+        the aggregated model (FedDG-GA's generalization gaps,
+        strategies/feddg_ga.py:382 update_weights_by_ga). Default: no-op.
+        """
+        return server_state

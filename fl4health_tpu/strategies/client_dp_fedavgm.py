@@ -1,0 +1,120 @@
+"""Client-level DP-FedAvg with server momentum and adaptive clipping.
+
+Parity: /root/reference/fl4health/strategies/client_dp_fedavgm.py:33 (+
+noisy_aggregate.py:47,70; adaptive clipping per arXiv 1905.03871). Clients
+send their CLIPPED weight-update delta plus a clipping-indicator bit
+(ParameterPackerWithClippingBit; client half in
+fl4health_tpu.clients.clipping). Server:
+
+    delta_bar = (sum_i delta_i) / |S| + N(0, (z * C / |S|)^2)     [unweighted]
+    v         = beta * v + delta_bar                               [momentum]
+    x        += v
+    b_bar     = (sum_i b_i + N(0, z_b^2)) / |S|                    [noised]
+    C        *= exp(-lr_C * (b_bar - target_quantile))             [geometric]
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.exchange.packer import ClippingBitPacket
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class ClientDpFedAvgMState:
+    params: Params
+    momentum: Params
+    clipping_bound: jax.Array
+    rng: jax.Array
+
+
+@struct.dataclass
+class ClippingPayload:
+    params: Params
+    clipping_bound: jax.Array
+
+
+class ClientLevelDPFedAvgM(Strategy):
+    def __init__(
+        self,
+        noise_multiplier: float = 1.0,
+        server_momentum: float = 0.9,
+        initial_clipping_bound: float = 0.1,
+        adaptive_clipping: bool = False,
+        bit_noise_multiplier: float = 1.0,
+        clipping_learning_rate: float = 0.2,
+        clipping_quantile: float = 0.5,
+        weighted_aggregation: bool = False,
+        seed: int = 0,
+    ):
+        self.z = noise_multiplier
+        self.beta = server_momentum
+        self.c0 = initial_clipping_bound
+        self.adaptive = adaptive_clipping
+        self.z_bit = bit_noise_multiplier
+        self.lr_c = clipping_learning_rate
+        self.quantile = clipping_quantile
+        self.weighted_aggregation = weighted_aggregation
+        self.seed = seed
+
+    def init(self, params: Params) -> ClientDpFedAvgMState:
+        return ClientDpFedAvgMState(
+            params=params,
+            momentum=ptu.tree_zeros_like(params),
+            clipping_bound=jnp.asarray(self.c0, jnp.float32),
+            rng=jax.random.PRNGKey(self.seed),
+        )
+
+    def client_payload(self, server_state, round_idx):
+        return ClippingPayload(
+            params=server_state.params,
+            clipping_bound=server_state.clipping_bound,
+        )
+
+    def aggregate(self, server_state, results: FitResults, round_idx):
+        packets: ClippingBitPacket = results.packets
+        n_sampled = jnp.maximum(jnp.sum(results.mask), 1.0)
+        rng, k_delta, k_bit = jax.random.split(server_state.rng, 3)
+
+        # unweighted masked mean of clipped deltas
+        def mean_delta(stacked):
+            mm = results.mask.reshape((-1,) + (1,) * (stacked.ndim - 1))
+            return jnp.sum(stacked * mm, axis=0) / n_sampled
+
+        delta_bar = jax.tree_util.tree_map(mean_delta, packets.params)
+        # Gaussian mechanism: sensitivity C/|S| per coordinate-vector
+        sigma = self.z * server_state.clipping_bound / n_sampled
+        leaves, treedef = jax.tree_util.tree_flatten(delta_bar)
+        keys = jax.random.split(k_delta, len(leaves))
+        noised = [
+            l + sigma * jax.random.normal(k, l.shape, jnp.float32).astype(l.dtype)
+            for l, k in zip(leaves, keys)
+        ]
+        delta_bar = jax.tree_util.tree_unflatten(treedef, noised)
+
+        new_momentum = ptu.tree_axpy(self.beta, server_state.momentum, delta_bar)
+        new_params = ptu.tree_add(server_state.params, new_momentum)
+
+        bound = server_state.clipping_bound
+        if self.adaptive:
+            bit_sum = jnp.sum(packets.clipping_bit * results.mask)
+            b_bar = (bit_sum + self.z_bit * jax.random.normal(k_bit, ())) / n_sampled
+            bound = bound * jnp.exp(-self.lr_c * (b_bar - self.quantile))
+
+        any_client = jnp.sum(results.mask) > 0
+        new_params, new_momentum = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(any_client, n, o),
+            (new_params, new_momentum),
+            (server_state.params, server_state.momentum),
+        )
+        return ClientDpFedAvgMState(
+            params=new_params,
+            momentum=new_momentum,
+            clipping_bound=bound,
+            rng=rng,
+        )

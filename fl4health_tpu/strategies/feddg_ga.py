@@ -1,0 +1,85 @@
+"""FedDG-GA — generalization-adjustment aggregation weights.
+
+Parity: /root/reference/fl4health/strategies/feddg_ga.py:98 (+ the adaptive-
+constraint combination, feddg_ga_with_adaptive_constraint.py:15).
+
+Semantics (verified against weight_and_aggregate_results :333 and
+update_weights_by_ga :382-451):
+- aggregation: params = sum_i w_i * params_i with per-client adjustment
+  weights w_i (initialized 1/N, kept normalized to sum 1);
+- after the post-aggregation evaluation round, per-client generalization gap
+  g_i = eval_metric(global model on client i) - fit_metric(local model on
+  client i, post local fit). With the LOSS fairness metric the "fit" value is
+  the client's val loss evaluated right after local training
+  (evaluate_after_fit=True);
+- centered gaps d_i = g_i - mean(g); if max|d| == 0 weights are unchanged;
+  else w_i += signal * step_size(round) * d_i / max|d|, clipped to [0, 1] and
+  renormalized to sum 1;
+- step_size(round) decays linearly: s - (round-1) * s / num_rounds (:453-477);
+- requires full participation + fixed sampling (:205-210).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.core.aggregate import weighted_mean
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class FedDgGaState:
+    params: Params
+    adjustment_weights: jax.Array  # [n_clients], sums to 1
+    local_val_losses: jax.Array  # [n_clients] post-fit pre-agg val losses
+    round_idx: jax.Array
+
+
+class FedDgGa(Strategy):
+    evaluate_after_fit = True
+
+    def __init__(
+        self,
+        n_clients: int,
+        num_rounds: int,
+        adjustment_weight_step_size: float = 0.2,
+        signal: float = 1.0,  # +1 for loss metrics, -1 for accuracy-like
+    ):
+        self.n_clients = n_clients
+        self.num_rounds = num_rounds
+        self.step_size = adjustment_weight_step_size
+        self.signal = signal
+
+    def init(self, params: Params) -> FedDgGaState:
+        return FedDgGaState(
+            params=params,
+            adjustment_weights=jnp.full((self.n_clients,), 1.0 / self.n_clients),
+            local_val_losses=jnp.zeros((self.n_clients,)),
+            round_idx=jnp.zeros((), jnp.int32),
+        )
+
+    def aggregate(self, server_state: FedDgGaState, results: FitResults, round_idx):
+        new_params = weighted_mean(results.packets, server_state.adjustment_weights)
+        return server_state.replace(
+            params=new_params,
+            local_val_losses=results.train_losses["val_checkpoint_post_fit"],
+            round_idx=round_idx,
+        )
+
+    def update_after_eval(self, server_state: FedDgGaState, eval_losses, eval_metrics, mask):
+        gaps = eval_losses["checkpoint"] - server_state.local_val_losses
+        centered = gaps - jnp.mean(gaps)
+        max_dev = jnp.max(jnp.abs(centered))
+        step = self.step_size - (
+            (server_state.round_idx.astype(jnp.float32) - 1.0)
+            * self.step_size / self.num_rounds
+        )
+        delta = jnp.where(
+            max_dev > 0, self.signal * step * centered / jnp.maximum(max_dev, 1e-12), 0.0
+        )
+        w = jnp.clip(server_state.adjustment_weights + delta, 0.0, 1.0)
+        w = w / jnp.maximum(jnp.sum(w), 1e-12)
+        return server_state.replace(adjustment_weights=w)

@@ -1,0 +1,62 @@
+"""FedPCA — federated principal-component merging.
+
+Parity: /root/reference/fl4health/strategies/fedpca.py:18 (merging client
+subspaces by SVD of stacked, singular-value-scaled principal components) and
+clients/fed_pca_client.py:18 (local SVD). Model side: fl4health_tpu.models.pca.
+
+One-shot protocol (no training rounds): each client sends its top-k principal
+axes U_i [D, k] and singular values S_i [k]; the server stacks S_i-scaled
+axes row-wise and re-runs SVD to get the merged subspace.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class PcaPacket:
+    components: jax.Array  # [D, k] column principal axes (U)
+    singular_values: jax.Array  # [k]
+
+
+@struct.dataclass
+class FedPcaState:
+    components: jax.Array
+    singular_values: jax.Array
+
+
+class FedPCA(Strategy):
+    def __init__(self, n_components: int):
+        self.n_components = n_components
+
+    def init(self, params) -> FedPcaState:
+        # params is a dummy shape carrier: {"components": [D,k], "singular_values": [k]}
+        return FedPcaState(
+            components=params["components"],
+            singular_values=params["singular_values"],
+        )
+
+    def global_params(self, server_state: FedPcaState):
+        return {
+            "components": server_state.components,
+            "singular_values": server_state.singular_values,
+        }
+
+    def aggregate(self, server_state: FedPcaState, results: FitResults, round_idx):
+        pk: PcaPacket = results.packets
+        # [clients, D, k] * [clients, 1, k] -> stack scaled axes as rows
+        scaled = pk.components * pk.singular_values[:, None, :]
+        mask = results.mask.reshape((-1, 1, 1))
+        scaled = scaled * mask
+        n, d, k = scaled.shape
+        stacked = jnp.transpose(scaled, (0, 2, 1)).reshape((n * k, d))  # rows = axes
+        # SVD of the stacked subspace matrix; right-singular vectors span the merge
+        _, s, vt = jnp.linalg.svd(stacked, full_matrices=False)
+        comp = vt[: self.n_components].T  # [D, k]
+        sv = s[: self.n_components]
+        return FedPcaState(components=comp, singular_values=sv)

@@ -1,0 +1,79 @@
+"""FedPM — Bayesian aggregation of binary parameter masks.
+
+Parity: /root/reference/fl4health/strategies/fedpm.py:12 (aggregate_bayesian)
++ FedPmServer's periodic Beta-posterior reset (servers/fedpm_server.py:14).
+
+Clients train Bernoulli probability scores over frozen weights and sample
+binary masks for exchange (clients/fedpm_client.py:18; model side in
+fl4health_tpu.models.masked). The server keeps Beta(alpha, beta) posteriors
+per parameter:
+    alpha += sum_i m_i ;  beta += sum_i (1 - m_i)
+    theta  = (alpha - 1) / (alpha + beta - 2)
+and broadcasts theta as the new global probability scores.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class FedPmState:
+    params: Params  # probability scores (theta) pytree
+    alpha: Params
+    beta: Params
+    rounds_since_reset: jax.Array
+
+
+class FedPm(Strategy):
+    def __init__(self, reset_frequency: int | None = None):
+        """reset_frequency: reset Beta posteriors to uniform every k rounds
+        (FedPmServer reset logic); None = never."""
+        self.reset_frequency = reset_frequency
+
+    def init(self, params: Params) -> FedPmState:
+        ones = jax.tree_util.tree_map(lambda x: jnp.ones_like(x, jnp.float32), params)
+        return FedPmState(
+            params=params,
+            alpha=ones,
+            beta=ones,
+            rounds_since_reset=jnp.zeros((), jnp.int32),
+        )
+
+    def aggregate(self, server_state: FedPmState, results: FitResults, round_idx):
+        masks = results.packets  # stacked binary masks, same tree as params
+        m = results.mask
+
+        def acc(a, stacked):
+            mm = m.reshape((-1,) + (1,) * (stacked.ndim - 1))
+            return a + jnp.sum(stacked.astype(jnp.float32) * mm, axis=0)
+
+        def acc_inv(b, stacked):
+            mm = m.reshape((-1,) + (1,) * (stacked.ndim - 1))
+            return b + jnp.sum((1.0 - stacked.astype(jnp.float32)) * mm, axis=0)
+
+        alpha = jax.tree_util.tree_map(acc, server_state.alpha, masks)
+        beta = jax.tree_util.tree_map(acc_inv, server_state.beta, masks)
+        theta = jax.tree_util.tree_map(
+            lambda a, b: jnp.clip((a - 1.0) / jnp.maximum(a + b - 2.0, 1e-12), 0.0, 1.0),
+            alpha, beta,
+        )
+        rounds = server_state.rounds_since_reset + 1
+        if self.reset_frequency is not None:
+            do_reset = rounds >= self.reset_frequency
+            alpha = jax.tree_util.tree_map(
+                lambda a: jnp.where(do_reset, jnp.ones_like(a), a), alpha
+            )
+            beta = jax.tree_util.tree_map(
+                lambda b: jnp.where(do_reset, jnp.ones_like(b), b), beta
+            )
+            rounds = jnp.where(do_reset, 0, rounds)
+        return FedPmState(
+            params=theta, alpha=alpha, beta=beta, rounds_since_reset=rounds
+        )

@@ -1,0 +1,96 @@
+"""FedAvg with adaptive proximal constraint (FedProx-style mu adaptation).
+
+Parity: /root/reference/fl4health/strategies/fedavg_with_adaptive_constraint.py:16.
+Clients pack their train loss next to the weights
+(ParameterPackerAdaptiveConstraint); the server tracks the aggregated train
+loss trajectory: if it falls ``loss_weight_patience`` rounds in a row,
+mu -= loss_weight_delta (floored at 0); on any increase, mu += delta and the
+counter resets (:216-231). The adapted mu is broadcast back.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.core import aggregate as agg
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.exchange.packer import AdaptiveConstraintPacket
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class AdaptiveConstraintState:
+    params: Params
+    drift_penalty_weight: jax.Array  # mu
+    previous_loss: jax.Array
+    loss_drop_streak: jax.Array  # consecutive improvements
+
+
+@struct.dataclass
+class AdaptiveConstraintPayload:
+    params: Params
+    drift_penalty_weight: jax.Array
+
+
+class FedAvgWithAdaptiveConstraint(Strategy):
+    def __init__(
+        self,
+        initial_drift_penalty_weight: float = 0.1,
+        adapt_loss_weight: bool = True,
+        loss_weight_delta: float = 0.1,
+        loss_weight_patience: int = 5,
+        weighted_aggregation: bool = True,
+        weighted_train_losses: bool = True,
+    ):
+        self.mu0 = initial_drift_penalty_weight
+        self.adapt = adapt_loss_weight
+        self.delta = loss_weight_delta
+        self.patience = loss_weight_patience
+        self.weighted_aggregation = weighted_aggregation
+        self.weighted_train_losses = weighted_train_losses
+
+    def init(self, params: Params) -> AdaptiveConstraintState:
+        return AdaptiveConstraintState(
+            params=params,
+            drift_penalty_weight=jnp.asarray(self.mu0, jnp.float32),
+            previous_loss=jnp.asarray(jnp.inf, jnp.float32),
+            loss_drop_streak=jnp.zeros((), jnp.int32),
+        )
+
+    def client_payload(self, server_state, round_idx):
+        return AdaptiveConstraintPayload(
+            params=server_state.params,
+            drift_penalty_weight=server_state.drift_penalty_weight,
+        )
+
+    def aggregate(self, server_state, results: FitResults, round_idx):
+        packets: AdaptiveConstraintPacket = results.packets
+        new_params = agg.aggregate(
+            packets.params, results.sample_counts, results.mask,
+            self.weighted_aggregation,
+        )
+        train_loss = agg.aggregate_losses(
+            packets.loss_for_adaptation, results.sample_counts, results.mask,
+            self.weighted_train_losses,
+        )
+        improved = train_loss <= server_state.previous_loss
+        streak = jnp.where(improved, server_state.loss_drop_streak + 1, 0)
+        mu = server_state.drift_penalty_weight
+        if self.adapt:
+            # patience hit -> decrease mu, reset streak; any increase -> raise mu
+            hit = streak >= self.patience
+            mu = jnp.where(hit, jnp.maximum(mu - self.delta, 0.0), mu)
+            mu = jnp.where(~improved, mu + self.delta, mu)
+            streak = jnp.where(hit, 0, streak)
+        any_client = jnp.sum(results.mask) > 0
+        new_params = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(any_client, n, o), new_params, server_state.params
+        )
+        return AdaptiveConstraintState(
+            params=new_params,
+            drift_penalty_weight=mu,
+            previous_loss=jnp.where(any_client, train_loss, server_state.previous_loss),
+            loss_drop_streak=streak,
+        )

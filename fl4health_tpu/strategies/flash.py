@@ -1,0 +1,82 @@
+"""FLASH — server-side adaptive optimization with drift-aware third moment.
+
+Parity: /root/reference/fl4health/strategies/flash.py:21
+(_update_parameters :125-142, aggregate_fit :143-171):
+    Delta_t = x_bar - x
+    m_t = b1*m + (1-b1)*Delta
+    v_t = b2*v + (1-b2)*Delta^2
+    b3  = |v_{t-1}| / (|Delta^2 - v_t| + |v_{t-1}|)        (elementwise)
+    d_t = b3*d_{t-1} + (1-b3)*(Delta^2 - v_t)
+    x  += eta * m_t / (sqrt(v_t) - d_t + tau)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.core import aggregate as agg, pytree as ptu
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class FlashState:
+    params: Params
+    m: Params
+    v: Params
+    d: Params
+
+
+class Flash(Strategy):
+    def __init__(
+        self,
+        eta: float = 0.1,
+        beta_1: float = 0.9,
+        beta_2: float = 0.99,
+        tau: float = 1e-3,
+        weighted_aggregation: bool = True,
+    ):
+        self.eta = eta
+        self.b1 = beta_1
+        self.b2 = beta_2
+        self.tau = tau
+        self.weighted_aggregation = weighted_aggregation
+
+    def init(self, params: Params) -> FlashState:
+        z = ptu.tree_zeros_like(params)
+        return FlashState(params=params, m=z, v=z, d=z)
+
+    def aggregate(self, server_state: FlashState, results: FitResults, round_idx):
+        x_bar = agg.aggregate(
+            results.packets, results.sample_counts, results.mask,
+            self.weighted_aggregation,
+        )
+
+        def upd(x, xb, m, v, d):
+            delta = xb - x
+            m_t = self.b1 * m + (1 - self.b1) * delta
+            v_t = self.b2 * v + (1 - self.b2) * jnp.square(delta)
+            gap = jnp.square(delta) - v_t
+            b3 = jnp.abs(v) / (jnp.abs(gap) + jnp.abs(v) + 1e-12)
+            d_t = b3 * d + (1 - b3) * gap
+            x_t = x + self.eta * m_t / (jnp.sqrt(v_t) - d_t + self.tau)
+            return x_t, m_t, v_t, d_t
+
+        out = jax.tree_util.tree_map(
+            upd, server_state.params, x_bar, server_state.m, server_state.v,
+            server_state.d,
+        )
+        # out leaves are 4-tuples; transpose to four trees
+        treedef = jax.tree_util.tree_structure(server_state.params)
+        flat = jax.tree_util.tree_leaves(out, is_leaf=lambda t: isinstance(t, tuple))
+        x_t = jax.tree_util.tree_unflatten(treedef, [t[0] for t in flat])
+        m_t = jax.tree_util.tree_unflatten(treedef, [t[1] for t in flat])
+        v_t = jax.tree_util.tree_unflatten(treedef, [t[2] for t in flat])
+        d_t = jax.tree_util.tree_unflatten(treedef, [t[3] for t in flat])
+        any_client = jnp.sum(results.mask) > 0
+        x_t = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(any_client, n, o), x_t, server_state.params
+        )
+        return FlashState(params=x_t, m=m_t, v=v_t, d=d_t)

@@ -1,0 +1,36 @@
+"""Model merging — one-shot parameter averaging with evaluation.
+
+Parity: /root/reference/fl4health/strategies/model_merge_strategy.py:26 +
+servers/model_merge_server.py:23 + clients/model_merge_client.py:23: clients
+send locally-trained weights once; the server merges (uniform or weighted) and
+runs a federated evaluation. No training rounds.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core import aggregate as agg
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.strategies.base import FitResults, Strategy
+from fl4health_tpu.strategies.fedavg import FedAvgState
+
+
+class ModelMergeStrategy(Strategy):
+    def __init__(self, weighted: bool = False):
+        self.weighted_aggregation = weighted
+
+    def init(self, params: Params) -> FedAvgState:
+        return FedAvgState(params=params)
+
+    def aggregate(self, server_state: FedAvgState, results: FitResults, round_idx):
+        merged = agg.aggregate(
+            results.packets, results.sample_counts, results.mask,
+            self.weighted_aggregation,
+        )
+        any_client = jnp.sum(results.mask) > 0
+        merged = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(any_client, n, o), merged, server_state.params
+        )
+        return FedAvgState(params=merged)

@@ -1,0 +1,75 @@
+"""SCAFFOLD — stochastic controlled averaging.
+
+Parity: /root/reference/fl4health/strategies/scaffold.py:28 (server side;
+client in fl4health_tpu.clients.scaffold). Packed payload = weights plus
+control variates (ParameterPackerWithControlVariates). Server updates
+(scaffold.py:303,325):
+    x  <- x + server_lr * (mean_i(y_i) - x)          [unweighted]
+    c  <- c + (|S| / N) * mean_i(delta_c_i)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.core import aggregate as agg, pytree as ptu
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.exchange.packer import ControlVariatesPacket
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class ScaffoldState:
+    params: Params
+    control_variates: Params
+
+
+class Scaffold(Strategy):
+    """Server half of SCAFFOLD. Aggregation is UNWEIGHTED by algorithm design
+    (strategies/scaffold.py docstring + aggregate :245)."""
+
+    weighted_aggregation = False
+
+    def __init__(self, learning_rate: float = 1.0):
+        self.server_lr = learning_rate
+
+    def init(self, params: Params) -> ScaffoldState:
+        return ScaffoldState(
+            params=params, control_variates=ptu.tree_zeros_like(params)
+        )
+
+    def client_payload(self, server_state: ScaffoldState, round_idx):
+        return ControlVariatesPacket(
+            params=server_state.params,
+            control_variates=server_state.control_variates,
+        )
+
+    def aggregate(self, server_state: ScaffoldState, results: FitResults, round_idx):
+        packets: ControlVariatesPacket = results.packets
+        y_bar = agg.aggregate(
+            packets.params, results.sample_counts, results.mask, weighted=False
+        )
+        delta_c_bar = agg.aggregate(
+            packets.control_variates, results.sample_counts, results.mask,
+            weighted=False,
+        )
+        n_sampled = jnp.sum(results.mask)
+        n_total = jnp.asarray(results.mask.shape[0], jnp.float32)
+        any_client = n_sampled > 0
+        # x += lr * (y_bar - x)
+        new_params = ptu.tree_axpy(
+            self.server_lr, ptu.tree_sub(y_bar, server_state.params),
+            server_state.params,
+        )
+        # c += (|S|/N) * delta_c_bar
+        new_c = ptu.tree_axpy(
+            n_sampled / n_total, delta_c_bar, server_state.control_variates
+        )
+        new_params, new_c = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(any_client, n, o),
+            (new_params, new_c),
+            (server_state.params, server_state.control_variates),
+        )
+        return ScaffoldState(params=new_params, control_variates=new_c)

@@ -1,0 +1,231 @@
+"""Strategy unit tests (reference: tests/strategies/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.exchange.packer import (
+    AdaptiveConstraintPacket,
+    ClippingBitPacket,
+    ControlVariatesPacket,
+    LayerMaskPacket,
+    SparseMaskPacket,
+)
+from fl4health_tpu.strategies.base import FitResults
+from fl4health_tpu.strategies.client_dp_fedavgm import ClientLevelDPFedAvgM
+from fl4health_tpu.strategies.dynamic_layer import FedAvgDynamicLayer, FedAvgSparse
+from fl4health_tpu.strategies.feddg_ga import FedDgGa
+from fl4health_tpu.strategies.fedopt import fed_adam, fed_avg_m
+from fl4health_tpu.strategies.fedpm import FedPm
+from fl4health_tpu.strategies.fedprox import FedAvgWithAdaptiveConstraint
+from fl4health_tpu.strategies.flash import Flash
+from fl4health_tpu.strategies.model_merge import ModelMergeStrategy
+from fl4health_tpu.strategies.scaffold import Scaffold
+
+
+def _results(packets, counts=None, mask=None, losses=None, metrics=None, n=None):
+    n = n or jax.tree_util.tree_leaves(packets)[0].shape[0]
+    return FitResults(
+        packets=packets,
+        sample_counts=jnp.ones((n,)) if counts is None else counts,
+        train_losses=losses or {},
+        train_metrics=metrics or {},
+        mask=jnp.ones((n,)) if mask is None else mask,
+    )
+
+
+def _stacked(vals):
+    return {"w": jnp.asarray(vals, jnp.float32)}
+
+
+def test_fedopt_adam_moves_toward_avg():
+    strat = fed_adam(lr=0.1)
+    state = strat.init({"w": jnp.zeros((2,))})
+    packets = {"w": jnp.asarray([[1.0, 1.0], [3.0, 3.0]])}
+    new = strat.aggregate(state, _results(packets), 1)
+    # pseudo-grad = 0 - 2 = -2; adam step ~ +lr * sign
+    assert float(new.params["w"][0]) > 0
+
+
+def test_fedavgm_momentum_accumulates():
+    strat = fed_avg_m(lr=1.0, momentum=0.5)
+    state = strat.init({"w": jnp.zeros((1,))})
+    packets = {"w": jnp.asarray([[1.0]])}
+    s1 = strat.aggregate(state, _results(packets), 1)
+    first = float(s1.params["w"][0])
+    s2 = strat.aggregate(s1, _results({"w": jnp.asarray([[s1.params["w"][0] + 1.0]])}), 2)
+    second = float(s2.params["w"][0]) - first
+    assert second > 1.0  # momentum carries previous direction
+
+
+def test_fedprox_mu_adaptation():
+    strat = FedAvgWithAdaptiveConstraint(
+        initial_drift_penalty_weight=0.5, loss_weight_delta=0.1, loss_weight_patience=2
+    )
+    state = strat.init({"w": jnp.zeros((1,))})
+
+    def roundres(loss):
+        return _results(
+            AdaptiveConstraintPacket(
+                params={"w": jnp.asarray([[0.0]])},
+                loss_for_adaptation=jnp.asarray([loss]),
+            )
+        )
+
+    # two consecutive drops -> mu decreases by delta
+    s = strat.aggregate(state, roundres(1.0), 1)
+    s = strat.aggregate(s, roundres(0.9), 2)
+    np.testing.assert_allclose(float(s.drift_penalty_weight), 0.4, atol=1e-6)
+    # an increase -> mu increases
+    s = strat.aggregate(s, roundres(1.5), 3)
+    np.testing.assert_allclose(float(s.drift_penalty_weight), 0.5, atol=1e-6)
+
+
+def test_scaffold_server_update():
+    strat = Scaffold(learning_rate=0.5)
+    state = strat.init({"w": jnp.zeros((1,))})
+    packets = ControlVariatesPacket(
+        params={"w": jnp.asarray([[2.0], [4.0]])},  # y_bar = 3
+        control_variates={"w": jnp.asarray([[0.2], [0.4]])},  # delta_bar = 0.3
+    )
+    new = strat.aggregate(state, _results(packets), 1)
+    # x += 0.5 * (3 - 0) = 1.5 ; c += (2/2)*0.3 = 0.3
+    np.testing.assert_allclose(float(new.params["w"][0]), 1.5, rtol=1e-6)
+    np.testing.assert_allclose(float(new.control_variates["w"][0]), 0.3, rtol=1e-6)
+
+
+def test_scaffold_partial_cohort_scales_variate_update():
+    strat = Scaffold(learning_rate=1.0)
+    state = strat.init({"w": jnp.zeros((1,))})
+    packets = ControlVariatesPacket(
+        params={"w": jnp.asarray([[2.0], [99.0]])},
+        control_variates={"w": jnp.asarray([[0.4], [99.0]])},
+    )
+    mask = jnp.asarray([1.0, 0.0])
+    new = strat.aggregate(state, _results(packets, mask=mask), 1)
+    # only client 0: y_bar=2, delta_bar=0.4, |S|/N = 1/2
+    np.testing.assert_allclose(float(new.params["w"][0]), 2.0, rtol=1e-6)
+    np.testing.assert_allclose(float(new.control_variates["w"][0]), 0.2, rtol=1e-6)
+
+
+def test_flash_matches_reference_round1_math():
+    # Reference semantics (flash.py:125-142): round 1 with zero moments gives
+    # m=0.1*d, v=0.01*d^2, b3=0, d_t=d^2-v, x += eta*m/(sqrt(v)-d_t+tau) —
+    # note the denominator CAN be negative round 1 (no epsilon in reference).
+    strat = Flash(eta=0.1, beta_1=0.9, beta_2=0.99, tau=1e-3)
+    state = strat.init({"w": jnp.zeros((1,))})
+    packets = {"w": jnp.asarray([[1.0], [1.0]])}
+    s = strat.aggregate(state, _results(packets), 1)
+    m, v = 0.1, 0.01
+    d_t = 1.0 - v
+    expected = 0.1 * m / (np.sqrt(v) - d_t + 1e-3)
+    np.testing.assert_allclose(float(s.params["w"][0]), expected, rtol=1e-4)
+    # subsequent rounds stay finite
+    s2 = strat.aggregate(s, _results({"w": jnp.asarray([[1.0], [1.0]])}), 2)
+    assert np.all(np.isfinite(np.asarray(s2.params["w"])))
+
+
+def test_dynamic_layer_sender_average():
+    strat = FedAvgDynamicLayer(weighted_aggregation=False)
+    state = strat.init({"a": jnp.zeros((1,)), "b": jnp.full((1,), 7.0)})
+    packets = LayerMaskPacket(
+        params={"a": jnp.asarray([[2.0], [4.0]]), "b": jnp.asarray([[1.0], [9.0]])},
+        leaf_mask={
+            "a": jnp.asarray([1.0, 1.0]),  # both sent a
+            "b": jnp.asarray([0.0, 0.0]),  # nobody sent b
+        },
+    )
+    new = strat.aggregate(state, _results(packets), 1)
+    np.testing.assert_allclose(float(new.params["a"][0]), 3.0, rtol=1e-6)
+    np.testing.assert_allclose(float(new.params["b"][0]), 7.0, rtol=1e-6)  # kept
+
+
+def test_sparse_elementwise_average():
+    strat = FedAvgSparse(weighted_aggregation=False)
+    state = strat.init({"w": jnp.asarray([10.0, 20.0])})
+    packets = SparseMaskPacket(
+        params={"w": jnp.asarray([[2.0, 0.0], [4.0, 6.0]])},
+        element_mask={"w": jnp.asarray([[1.0, 0.0], [1.0, 1.0]])},
+    )
+    new = strat.aggregate(state, _results(packets), 1)
+    np.testing.assert_allclose(np.asarray(new.params["w"]), [3.0, 6.0], rtol=1e-6)
+
+
+def test_fedpm_beta_posterior():
+    strat = FedPm()
+    state = strat.init({"w": jnp.full((2,), 0.5)})
+    masks = {"w": jnp.asarray([[1.0, 0.0], [1.0, 0.0], [1.0, 1.0]])}
+    new = strat.aggregate(state, _results(masks), 1)
+    # w[0]: alpha=1+3=4, beta=1+0=1 -> theta=(4-1)/(4+1-2)=1.0
+    # w[1]: alpha=1+1=2, beta=1+2=3 -> theta=(2-1)/(2+3-2)=1/3
+    np.testing.assert_allclose(np.asarray(new.params["w"]), [1.0, 1 / 3], rtol=1e-5)
+
+
+def test_fedpm_reset():
+    strat = FedPm(reset_frequency=1)
+    state = strat.init({"w": jnp.full((1,), 0.5)})
+    masks = {"w": jnp.asarray([[1.0]])}
+    new = strat.aggregate(state, _results(masks), 1)
+    np.testing.assert_allclose(float(new.alpha["w"][0]), 1.0)  # reset to prior
+
+
+def test_feddg_ga_weights_shift_toward_large_gap():
+    strat = FedDgGa(n_clients=2, num_rounds=3, adjustment_weight_step_size=0.2)
+    state = strat.init({"w": jnp.zeros((1,))})
+    res = _results(
+        {"w": jnp.asarray([[2.0], [4.0]])},
+        losses={"val_checkpoint_post_fit": jnp.asarray([1.0, 1.0])},
+    )
+    state = strat.aggregate(state, res, jnp.asarray(1))
+    # client 1 generalizes worse (higher post-agg loss) -> gets more weight
+    state = strat.update_after_eval(
+        state, {"checkpoint": jnp.asarray([1.0, 2.0])}, {}, jnp.ones((2,))
+    )
+    w = np.asarray(state.adjustment_weights)
+    np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-6)
+    assert w[1] > w[0]
+
+
+def test_client_dp_fedavgm_zero_noise_is_mean_delta():
+    strat = ClientLevelDPFedAvgM(noise_multiplier=0.0, server_momentum=0.0)
+    state = strat.init({"w": jnp.zeros((1,))})
+    packets = ClippingBitPacket(
+        params={"w": jnp.asarray([[0.2], [0.4]])},
+        clipping_bit=jnp.asarray([0.0, 1.0]),
+    )
+    new = strat.aggregate(state, _results(packets), 1)
+    np.testing.assert_allclose(float(new.params["w"][0]), 0.3, atol=1e-6)
+
+
+def test_client_dp_adaptive_bound_shrinks_when_all_below():
+    # bit convention (clipping_client.py:86): 1.0 = norm BELOW bound. All
+    # below -> b_bar=1 > quantile -> bound shrinks toward the quantile.
+    strat = ClientLevelDPFedAvgM(
+        noise_multiplier=0.0, adaptive_clipping=True, bit_noise_multiplier=0.0,
+        clipping_quantile=0.5, initial_clipping_bound=1.0,
+    )
+    state = strat.init({"w": jnp.zeros((1,))})
+    packets = ClippingBitPacket(
+        params={"w": jnp.asarray([[0.0], [0.0]])},
+        clipping_bit=jnp.asarray([1.0, 1.0]),
+    )
+    new = strat.aggregate(state, _results(packets), 1)
+    assert float(new.clipping_bound) < 1.0
+    # and grows when every update hit the bound
+    packets2 = ClippingBitPacket(
+        params={"w": jnp.asarray([[0.0], [0.0]])},
+        clipping_bit=jnp.asarray([0.0, 0.0]),
+    )
+    new2 = strat.aggregate(state, _results(packets2), 1)
+    assert float(new2.clipping_bound) > 1.0
+
+
+def test_model_merge_uniform():
+    strat = ModelMergeStrategy(weighted=False)
+    state = strat.init({"w": jnp.zeros((1,))})
+    new = strat.aggregate(
+        state, _results({"w": jnp.asarray([[1.0], [3.0]])},
+                        counts=jnp.asarray([10.0, 1.0])), 1
+    )
+    np.testing.assert_allclose(float(new.params["w"][0]), 2.0, rtol=1e-6)
