@@ -1,0 +1,99 @@
+"""APFL client logic — adaptive personalized federated learning.
+
+Parity: /root/reference/fl4health/clients/apfl_client.py:18 +
+model_bases/apfl_base.py:9. Twin local/global models; the personal
+prediction is the alpha-mixture of their logits. Each train step updates the
+global model with the global loss and the local model with the personal
+(mixed) loss; when ``adaptive_alpha`` is on, alpha takes its own gradient
+step after each batch (``ApflModule.update_alpha``, apfl_base.py:86) and is
+clipped to [0, 1].
+
+TPU-native design: alpha lives in the persistent ``extra`` state (it never
+crosses the wire); its gradient is taken by autodiff through the mixing —
+the exact quantity the reference computes manually:
+d(personal_loss)/d(alpha) = <dL/d(mix), local_logits - global_logits>.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+
+
+@struct.dataclass
+class ApflExtra:
+    alpha: jax.Array  # scalar in [0, 1]
+
+
+class ApflClientLogic(ClientLogic):
+    """Pair with ``models.bases.ApflModule`` and a FixedLayerExchanger on
+    ``ApflModule.exchange_global_model``."""
+
+    extra_loss_keys = ("global_ce", "personal_ce")
+
+    def __init__(self, model, criterion, alpha: float = 0.5,
+                 alpha_lr: float = 0.01, adaptive_alpha: bool = True):
+        super().__init__(model, criterion)
+        self.alpha0 = alpha
+        self.alpha_lr = alpha_lr
+        self.adaptive_alpha = adaptive_alpha
+
+    def init_extra(self, params) -> ApflExtra:
+        return ApflExtra(alpha=jnp.asarray(self.alpha0, jnp.float32))
+
+    def predict(self, params, model_state, batch: Batch, rng, train: bool,
+                extra=None, ctx=None):
+        alpha = extra.alpha if extra is not None else jnp.asarray(self.alpha0)
+        return self.model.apply(
+            params, model_state, batch.x, train=train, rng=rng, alpha=alpha
+        )
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx):
+        # Global model learns from its own logits; the local model learns from
+        # the mixture with the global branch frozen (the reference steps the
+        # local optimizer on the personal loss only, apfl_client.py train_step).
+        global_ce = self.criterion(preds["global"], batch.y, batch.example_mask)
+        alpha = state.extra.alpha
+        mixed = alpha * preds["local"] + (1.0 - alpha) * jax.lax.stop_gradient(
+            preds["global"]
+        )
+        personal_ce = self.criterion(mixed, batch.y, batch.example_mask)
+        return global_ce + personal_ce, {
+            "global_ce": global_ce,
+            "personal_ce": personal_ce,
+        }
+
+    def update_after_step(self, state: TrainState, ctx, batch: Batch,
+                          preds=None) -> TrainState:
+        if not self.adaptive_alpha:
+            return state
+        # alpha <- clip(alpha - lr * dL_personal/dalpha) (apfl_base.py:86).
+        # The step's logits are reused, so the gradient only flows through the
+        # mixing — d(personal)/d(alpha) = <dL/d(mix), local - global>, the
+        # reference's analytic formula, at no extra model cost.
+        local = jax.lax.stop_gradient(preds["local"])
+        glob = jax.lax.stop_gradient(preds["global"])
+
+        def personal_loss(alpha):
+            mixed = alpha * local + (1.0 - alpha) * glob
+            return self.criterion(mixed, batch.y, batch.example_mask)
+
+        g = jax.grad(personal_loss)(state.extra.alpha)
+        new_alpha = jnp.clip(state.extra.alpha - self.alpha_lr * g, 0.0, 1.0)
+        # Padding steps must not move alpha.
+        new_alpha = jnp.where(batch.step_mask > 0, new_alpha, state.extra.alpha)
+        return state.replace(extra=ApflExtra(alpha=new_alpha))
+
+    def eval_loss(self, preds, features, batch: Batch, params, state, ctx):
+        return self.criterion(preds["personal"], batch.y, batch.example_mask), {}
+
+
+def apfl_model_def(module):
+    """ModelDef adapter for ApflModule — ``engine.from_flax`` forwards the
+    alpha kwarg (and handles mutable collections) already."""
+    from fl4health_tpu.clients.engine import from_flax
+
+    return from_flax(module)

@@ -1,0 +1,153 @@
+"""Ditto and MR-MTL client logics — drift-constrained personal models.
+
+Parity targets:
+- Ditto (/root/reference/fl4health/clients/ditto_client.py:20): trains a
+  GLOBAL model (exchanged, vanilla loss) and a PERSONAL model (private) with
+  an l2 drift constraint pulling the personal weights toward the weights
+  received from the server this round; two optimizers. Validation/metrics run
+  on the personal model. The adaptive variant packs the global-model vanilla
+  train loss so the server can adapt lambda
+  (adaptive_drift_constraint_client.py:82-106).
+- MR-MTL (/root/reference/fl4health/clients/mr_mtl_client.py:18): a single
+  personal model that is NEVER overwritten by the server; the received
+  aggregate is only the drift target. The personal weights are still sent up
+  for averaging.
+
+TPU-native design: Ditto's twin models are one param tree with
+``global_model`` / ``personal_model`` subtrees (models.bases.TwinModel);
+one grad pass over the combined loss yields exactly the two reference
+backward passes because the two loss terms touch disjoint subtrees.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.exchange.packer import AdaptiveConstraintPacket
+from fl4health_tpu.losses.drift import weight_drift_loss
+
+
+@struct.dataclass
+class DittoContext:
+    initial_global_params: Params  # received global-model weights (drift target)
+    drift_penalty_weight: Any  # lambda
+
+
+class DittoClientLogic(ClientLogic):
+    """Pair with ``models.bases.TwinModel`` (params have ``global_model`` /
+    ``personal_model`` subtrees) and a FixedLayerExchanger on
+    ``TwinModel.exchange_global_model``.
+
+    Reference: clients/ditto_client.py:20 (loss composition at
+    compute_training_loss — global vanilla CE + personal CE +
+    lam/2 * ||personal - received||^2).
+    """
+
+    extra_loss_keys = ("global_ce", "personal_ce", "penalty")
+
+    def __init__(self, model, criterion, lam: float = 1.0, adaptive: bool = False):
+        super().__init__(model, criterion)
+        self.lam = lam
+        self.adaptive = adaptive
+
+    def init_round_context(self, state: TrainState, payload) -> DittoContext:
+        lam = getattr(payload, "drift_penalty_weight", None)
+        if lam is None:
+            lam = jnp.asarray(self.lam, jnp.float32)
+        payload_params = payload.params if hasattr(payload, "params") else payload
+        return DittoContext(
+            initial_global_params=payload_params["global_model"],
+            drift_penalty_weight=lam,
+        )
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx: DittoContext):
+        global_ce = self.criterion(preds["global"], batch.y, batch.example_mask)
+        personal_ce = self.criterion(preds["personal"], batch.y, batch.example_mask)
+        penalty = 0.5 * weight_drift_loss(
+            params["personal_model"], ctx.initial_global_params,
+            ctx.drift_penalty_weight,
+        )
+        total = global_ce + personal_ce + penalty
+        return total, {
+            "global_ce": global_ce,
+            "personal_ce": personal_ce,
+            "penalty": penalty,
+        }
+
+    def eval_loss(self, preds, features, batch: Batch, params, state, ctx):
+        # Validation is on the personal model (ditto_client.py validate path).
+        loss = self.criterion(preds["personal"], batch.y, batch.example_mask)
+        return loss, {}
+
+    def pack(self, state: TrainState, pushed_params, train_losses):
+        if not self.adaptive:
+            return pushed_params
+        return AdaptiveConstraintPacket(
+            params=pushed_params,
+            loss_for_adaptation=train_losses["global_ce"],
+        )
+
+
+@struct.dataclass
+class MrMtlContext:
+    initial_params: Params  # received aggregate (drift target only)
+    drift_penalty_weight: Any
+
+
+class KeepLocalExchanger:
+    """MR-MTL wire behavior: push the personal weights for aggregation, but
+    NEVER overwrite them on pull — the aggregate is consumed as a drift
+    target inside the loss (mr_mtl_client.py:18 setup: model weights are not
+    set from the server after round 1)."""
+
+    def push(self, params: Params, initial_params: Params | None = None) -> Params:
+        del initial_params
+        return params
+
+    def pull(self, payload: Params, local: Params) -> Params:
+        del payload
+        return local
+
+
+class MrMtlClientLogic(ClientLogic):
+    """Mean-regularized multi-task learning. Pair with KeepLocalExchanger.
+
+    Reference: clients/mr_mtl_client.py:18 — loss = vanilla +
+    lam/2 * ||w - w_aggregate||^2, with the adaptive variant packing the
+    vanilla loss.
+    """
+
+    extra_loss_keys = ("vanilla", "penalty")
+
+    def __init__(self, model, criterion, lam: float = 1.0, adaptive: bool = False):
+        super().__init__(model, criterion)
+        self.lam = lam
+        self.adaptive = adaptive
+
+    def init_round_context(self, state: TrainState, payload) -> MrMtlContext:
+        lam = getattr(payload, "drift_penalty_weight", None)
+        if lam is None:
+            lam = jnp.asarray(self.lam, jnp.float32)
+        payload_params = payload.params if hasattr(payload, "params") else payload
+        return MrMtlContext(initial_params=payload_params, drift_penalty_weight=lam)
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx: MrMtlContext):
+        vanilla = self.criterion(preds["prediction"], batch.y, batch.example_mask)
+        penalty = 0.5 * weight_drift_loss(
+            params, ctx.initial_params, ctx.drift_penalty_weight
+        )
+        return vanilla + penalty, {"vanilla": vanilla, "penalty": penalty}
+
+    def pack(self, state: TrainState, pushed_params, train_losses):
+        if not self.adaptive:
+            return pushed_params
+        return AdaptiveConstraintPacket(
+            params=pushed_params,
+            loss_for_adaptation=train_losses["vanilla"],
+        )

@@ -99,15 +99,19 @@ def from_flax(module, mutable: tuple[str, ...] = ("batch_stats",)) -> ModelDef:
         model_state = {k: v for k, v in variables.items() if k != "params"}
         return params, model_state
 
-    def apply(params, model_state, x, train=True, rng=None):
+    def apply(params, model_state, x, train=True, rng=None, **kwargs):
+        # Extra kwargs (e.g. APFL's alpha, GPFL's conditional inputs) are
+        # forwarded to the module so algorithm-specific forwards don't need
+        # their own adapter.
         variables = {"params": params, **(model_state or {})}
         rngs = {"dropout": rng} if rng is not None else {}
         if train and model_state:
             out, new_state = module.apply(
-                variables, x, train=True, rngs=rngs, mutable=list(model_state.keys())
+                variables, x, train=True, rngs=rngs,
+                mutable=list(model_state.keys()), **kwargs
             )
         else:
-            out = module.apply(variables, x, train=train, rngs=rngs)
+            out = module.apply(variables, x, train=train, rngs=rngs, **kwargs)
             new_state = model_state
         if isinstance(out, tuple):
             preds, features = out
@@ -148,7 +152,12 @@ class ClientLogic:
         return state
 
     # -- step ---------------------------------------------------------------
-    def predict(self, params, model_state, batch: Batch, rng, train: bool):
+    def predict(self, params, model_state, batch: Batch, rng, train: bool,
+                extra=None, ctx=None):
+        """(basic_client.py:992). ``extra`` is the persistent algorithm state
+        (e.g. APFL's alpha); ``ctx`` the per-round context (e.g. GPFL's frozen
+        conditional inputs) for logics whose forward depends on them."""
+        del extra, ctx
         return self.model.apply(params, model_state, batch.x, train=train, rng=rng)
 
     def training_loss(
@@ -170,8 +179,11 @@ class ClientLogic:
         """(basic_client.py:1294) — e.g. SCAFFOLD variate correction."""
         return grads
 
-    def update_after_step(self, state: TrainState, ctx: Any, batch: Batch) -> TrainState:
-        """(basic_client.py:1272) — e.g. APFL alpha update."""
+    def update_after_step(self, state: TrainState, ctx: Any, batch: Batch,
+                          preds: dict | None = None) -> TrainState:
+        """(basic_client.py:1272) — e.g. APFL alpha update. ``preds`` is the
+        step's prediction dict so hooks can reuse it without re-running the
+        model."""
         return state
 
     # -- wire ---------------------------------------------------------------
@@ -244,7 +256,8 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
 
         def loss_fn(params):
             (preds, features), new_model_state = logic.predict(
-                params, state.model_state, batch, step_rng, train=True
+                params, state.model_state, batch, step_rng, train=True,
+                extra=state.extra, ctx=ctx,
             )
             backward, additional = logic.training_loss(
                 preds, features, batch, params, state, ctx
@@ -266,7 +279,7 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
             rng=rng,
             step=state.step + keep.astype(jnp.int32),
         )
-        new_state = logic.update_after_step(new_state, ctx, batch)
+        new_state = logic.update_after_step(new_state, ctx, batch, preds=preds)
         out = StepOutput(
             losses={"backward": backward, **additional},
             preds=preds["prediction"],
@@ -326,7 +339,8 @@ def make_local_eval(
             meter, mstate, rng = carry
             rng, step_rng = jax.random.split(rng)
             (preds, features), _ = logic.predict(
-                state.params, state.model_state, batch, step_rng, train=False
+                state.params, state.model_state, batch, step_rng, train=False,
+                extra=state.extra, ctx=ctx,
             )
             loss, additional = logic.eval_loss(
                 preds, features, batch, state.params, state, ctx

@@ -1,0 +1,35 @@
+"""Ensemble client logic.
+
+Parity: /root/reference/fl4health/clients/ensemble_client.py:17 +
+model_bases/ensemble_base.py:15 — all ensemble members train simultaneously
+on each batch (one optimizer each in the reference; a single combined
+gradient pass here touches the same disjoint subtrees), and metrics are
+computed on both the per-member and uniformly-averaged predictions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic
+
+
+class EnsembleClientLogic(ClientLogic):
+    """Pair with ``models.bases.EnsembleModel`` and a FullExchanger."""
+
+    def __init__(self, model, criterion, n_members: int):
+        super().__init__(model, criterion)
+        self.n_members = n_members
+        self.extra_loss_keys = tuple(
+            f"member_{i}" for i in range(n_members)
+        )
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx):
+        member_losses = {
+            f"member_{i}": self.criterion(
+                preds[f"ensemble-pred-{i}"], batch.y, batch.example_mask
+            )
+            for i in range(self.n_members)
+        }
+        total = sum(member_losses.values())
+        return total, member_losses

@@ -1,0 +1,68 @@
+"""FedPer / FedRep / FedBN client logics — exchange-boundary personalization.
+
+Parity targets:
+- FedPer (/root/reference/fl4health/clients/fedper_client.py:9): shared
+  feature extractor + private head — pure exchanger configuration
+  (SequentiallySplitExchangeBaseModel.exchange_features_only).
+- FedBN (fedbn_client.py:7): exchange everything except normalization layers
+  — ``exchange.norm_exclusion_exchanger()``.
+- FedRep (fedrep_client.py:33): the same split as FedPer, but each round
+  first trains the HEAD with the representation frozen for ``head_steps``
+  local steps, then trains the REPRESENTATION with the head frozen
+  (FedRepTrainMode, fedrep_client.py:28). Freezing is realized as gradient
+  masks keyed on the step-within-round — one compiled program, no
+  re-jitting per phase.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.core.types import Params
+
+# FedPer and FedBN need no logic subclass — only an exchanger:
+#   FedPer: FixedLayerExchanger(SequentiallySplitModel.exchange_features_only)
+#   FedBN:  exchange.norm_exclusion_exchanger()
+FedPerClientLogic = ClientLogic
+FedBnClientLogic = ClientLogic
+
+
+@struct.dataclass
+class FedRepContext:
+    round_start_step: jax.Array  # state.step when the round began
+
+
+class FedRepClientLogic(ClientLogic):
+    """Pair with ``models.bases.FedRepModel`` (= SequentiallySplitModel) and
+    FixedLayerExchanger(SequentiallySplitModel.exchange_features_only).
+
+    ``head_steps``: local steps of head-only training at the start of every
+    round; all remaining steps train the representation only
+    (fedrep_client.py:33 alternation).
+    """
+
+    def __init__(self, model, criterion, head_steps: int,
+                 head_predicate=None):
+        super().__init__(model, criterion)
+        self.head_steps = head_steps
+        self.head_predicate = head_predicate or (
+            lambda path: path.startswith("head_module")
+        )
+
+    def init_round_context(self, state: TrainState, payload) -> FedRepContext:
+        return FedRepContext(round_start_step=state.step)
+
+    def transform_gradients(self, grads: Params, state: TrainState,
+                            ctx: FedRepContext) -> Params:
+        step_in_round = state.step - ctx.round_start_step
+        head_phase = (step_in_round < self.head_steps).astype(jnp.float32)
+        is_head = ptu.select_by_path(grads, self.head_predicate)
+        return jax.tree_util.tree_map(
+            lambda g, h: g * (head_phase if h else (1.0 - head_phase)),
+            grads,
+            is_head,
+        )

@@ -1,0 +1,239 @@
+"""FENDA, Constrained FENDA, FENDA+Ditto, and PerFCL client logics.
+
+Parity targets:
+- FENDA (/root/reference/fl4health/clients/fenda_client.py:17): a
+  ParallelSplitModel whose ``second_feature_extractor`` is exchanged; no
+  extra loss terms — vanilla FENDA is BasicClient + the FENDA exchanger.
+- Constrained FENDA (constrained_fenda_client.py:22): optional auxiliary
+  losses from fenda_loss_config.py — cosine-similarity between current local
+  and global features, a MOON-style contrastive on local features, and/or
+  the PerFCL pair.
+- PerFCL (perfcl_client.py:20, losses/perfcl_loss.py:7): two MOON-style
+  contrastive losses —
+  global term: anchor = current global features z_s, positive = features of
+  the AGGREGATED (received) global extractor z_g, negative = features of the
+  previous round's FINAL global extractor;
+  local term: anchor = current local features z_p, positive = previous
+  round's final local features, negative = z_g.
+- FENDA+Ditto (fenda_ditto_client.py:21): a FENDA personal model whose
+  global extractor is drift-constrained toward a received global FENDA model.
+
+All of these persist previous-round extractor params in ``extra`` and the
+received params in the round context — pure pytree state under vmap, no
+model cloning.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.losses.contrastive import (
+    cosine_similarity,
+    moon_contrastive_loss,
+)
+from fl4health_tpu.losses.drift import weight_drift_loss
+
+
+# FENDA needs no logic subclass: use ClientLogic with
+# FixedLayerExchanger(ParallelSplitModel.exchange_global_extractor).
+FendaClientLogic = ClientLogic
+
+
+@struct.dataclass
+class PerFclExtra:
+    old_params: Params  # final params from the previous round
+    have_old: jax.Array  # 0/1 — previous round exists
+
+
+@struct.dataclass
+class PerFclContext:
+    # Snapshot of the post-pull params — the runnable AGGREGATED model
+    # (init_round_context runs after exchanger.pull, so state.params is the
+    # merged model at round start; perfcl_client.py update_before_train).
+    initial_params: Params
+
+
+class PerFclClientLogic(ClientLogic):
+    """Pair with ``models.bases.PerFclModel`` (= ParallelSplitModel exposing
+    ``local_features`` / ``global_features``) and the FENDA exchanger."""
+
+    extra_loss_keys = ("vanilla", "global_contrastive", "local_contrastive")
+
+    def __init__(self, model, criterion,
+                 global_feature_loss_weight: float = 1.0,
+                 local_feature_loss_weight: float = 1.0,
+                 global_feature_loss_temperature: float = 0.5,
+                 local_feature_loss_temperature: float = 0.5):
+        super().__init__(model, criterion)
+        self.mu = global_feature_loss_weight
+        self.gamma = local_feature_loss_weight
+        self.t_global = global_feature_loss_temperature
+        self.t_local = local_feature_loss_temperature
+
+    def init_extra(self, params: Params) -> PerFclExtra:
+        return PerFclExtra(old_params=params, have_old=jnp.zeros((), jnp.float32))
+
+    def init_round_context(self, state: TrainState, payload) -> PerFclContext:
+        del payload
+        return PerFclContext(
+            initial_params=jax.lax.stop_gradient(state.params)
+        )
+
+    def _features(self, params, model_state, x, rng):
+        (_, features), _ = self.model.apply(params, model_state, x, train=False, rng=rng)
+        return features
+
+    def training_loss(self, preds, features, batch: Batch, params, state,
+                      ctx: PerFclContext):
+        vanilla = self.criterion(preds["prediction"], batch.y, batch.example_mask)
+        rng = jax.random.fold_in(state.rng, 17)
+        # Frozen feature passes (perfcl_client.py predict gathers these).
+        old_f = jax.lax.stop_gradient(
+            self._features(state.extra.old_params, state.model_state, batch.x, rng)
+        )
+        init_f = jax.lax.stop_gradient(
+            self._features(ctx.initial_params, state.model_state, batch.x, rng)
+        )
+        z_p = features["local_features"]
+        z_s = features["global_features"]
+        # Temperatures may differ per term, so call perfcl_loss's two halves
+        # explicitly (losses/contrastive.py:perfcl_loss).
+        g_term = moon_contrastive_loss(
+            z_s, init_f["global_features"][None], old_f["global_features"][None],
+            self.t_global, batch.example_mask,
+        )
+        l_term = moon_contrastive_loss(
+            z_p, old_f["local_features"][None], init_f["global_features"][None],
+            self.t_local, batch.example_mask,
+        )
+        have_old = state.extra.have_old
+        g_term = g_term * have_old
+        l_term = l_term * have_old
+        total = vanilla + self.mu * g_term + self.gamma * l_term
+        return total, {
+            "vanilla": vanilla,
+            "global_contrastive": g_term,
+            "local_contrastive": l_term,
+        }
+
+    def finalize_round(self, state: TrainState, ctx, local_steps) -> TrainState:
+        return state.replace(
+            extra=PerFclExtra(old_params=state.params,
+                              have_old=jnp.ones((), jnp.float32))
+        )
+
+
+@struct.dataclass
+class ConstrainedFendaExtra:
+    old_local_params: Params
+    have_old: jax.Array
+
+
+class ConstrainedFendaClientLogic(ClientLogic):
+    """Constrained FENDA (constrained_fenda_client.py:22): vanilla FENDA plus
+    any of — cosine-similarity loss between local and global features
+    (minimizing |cos|, cosine_similarity_loss.py:5), a MOON contrastive on
+    local features vs the previous round's local extractor, and the PerFCL
+    pair (delegated to PerFclClientLogic when wanted alone)."""
+
+    extra_loss_keys = ("vanilla", "cos_sim", "contrastive")
+
+    def __init__(self, model, criterion,
+                 cos_sim_loss_weight: float = 0.0,
+                 contrastive_loss_weight: float = 0.0,
+                 temperature: float = 0.5):
+        super().__init__(model, criterion)
+        self.cos_w = cos_sim_loss_weight
+        self.con_w = contrastive_loss_weight
+        self.temperature = temperature
+
+    def init_extra(self, params: Params) -> ConstrainedFendaExtra:
+        return ConstrainedFendaExtra(
+            old_local_params=params, have_old=jnp.zeros((), jnp.float32)
+        )
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx):
+        vanilla = self.criterion(preds["prediction"], batch.y, batch.example_mask)
+        m = batch.example_mask.astype(jnp.float32)
+        z_p, z_s = features["local_features"], features["global_features"]
+        # Squared cosine similarity pushes the two streams orthogonal
+        # (cosine_similarity_loss.py:5).
+        cos_sim = jnp.sum(jnp.square(cosine_similarity(z_p, z_s)) * m) / jnp.maximum(
+            jnp.sum(m), 1.0
+        )
+        contrastive = jnp.zeros(())
+        if self.con_w > 0.0:
+            rng = jax.random.fold_in(state.rng, 19)
+            (_, old_feats), _ = self.model.apply(
+                state.extra.old_local_params, state.model_state, batch.x,
+                train=False, rng=rng,
+            )
+            old_local = jax.lax.stop_gradient(old_feats["local_features"])
+            # Positive = current global stream, negative = old local stream
+            # (fenda_loss_config.py MoonContrastiveLossContainer usage).
+            contrastive = moon_contrastive_loss(
+                z_p, jax.lax.stop_gradient(z_s)[None], old_local[None],
+                self.temperature, batch.example_mask,
+            ) * state.extra.have_old
+        total = vanilla + self.cos_w * cos_sim + self.con_w * contrastive
+        return total, {"vanilla": vanilla, "cos_sim": cos_sim,
+                       "contrastive": contrastive}
+
+    def finalize_round(self, state: TrainState, ctx, local_steps) -> TrainState:
+        return state.replace(
+            extra=ConstrainedFendaExtra(
+                old_local_params=state.params, have_old=jnp.ones((), jnp.float32)
+            )
+        )
+
+
+@struct.dataclass
+class FendaDittoContext:
+    initial_global_params: Params  # received FENDA model (drift target for the
+    # personal model's global extractor)
+    drift_penalty_weight: jax.Array
+
+
+class FendaDittoClientLogic(ClientLogic):
+    """FENDA + Ditto (fenda_ditto_client.py:21): the personal FENDA model's
+    GLOBAL extractor is drift-constrained toward the received global weights;
+    the global model subtree is exchanged. Pair with models.bases.TwinModel
+    wrapping two FENDA models, exchanging ``global_model.second_feature_extractor``."""
+
+    extra_loss_keys = ("global_ce", "personal_ce", "penalty")
+
+    def __init__(self, model, criterion, lam: float = 1.0):
+        super().__init__(model, criterion)
+        self.lam = lam
+
+    def init_round_context(self, state: TrainState, payload) -> FendaDittoContext:
+        lam = getattr(payload, "drift_penalty_weight", None)
+        if lam is None:
+            lam = jnp.asarray(self.lam, jnp.float32)
+        payload_params = payload.params if hasattr(payload, "params") else payload
+        return FendaDittoContext(
+            initial_global_params=payload_params["global_model"][
+                "second_feature_extractor"
+            ],
+            drift_penalty_weight=lam,
+        )
+
+    def training_loss(self, preds, features, batch: Batch, params, state,
+                      ctx: FendaDittoContext):
+        global_ce = self.criterion(preds["global"], batch.y, batch.example_mask)
+        personal_ce = self.criterion(preds["personal"], batch.y, batch.example_mask)
+        penalty = 0.5 * weight_drift_loss(
+            params["personal_model"]["second_feature_extractor"],
+            ctx.initial_global_params,
+            ctx.drift_penalty_weight,
+        )
+        total = global_ce + personal_ce + penalty
+        return total, {"global_ce": global_ce, "personal_ce": personal_ce,
+                       "penalty": penalty}
+
+    def eval_loss(self, preds, features, batch: Batch, params, state, ctx):
+        return self.criterion(preds["personal"], batch.y, batch.example_mask), {}

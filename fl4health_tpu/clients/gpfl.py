@@ -1,0 +1,116 @@
+"""GPFL client logic.
+
+Parity: /root/reference/fl4health/clients/gpfl_client.py:23. Per round the
+client freezes the received GCE embedding table and computes two conditional
+inputs from it and the client's class-sample proportions
+(``compute_conditional_inputs`` :213-233):
+    g = E_frozen^T @ uniform / C        (global conditional)
+    p = E_frozen^T @ class_props / C    (personalized conditional)
+The combined training loss (:334+) is
+    CE(head(personal_features), y)
+  + GCE softmax loss (CE over cosine logits of the general features)
+  + lam * magnitude-level loss ||general_features - E_frozen[y]||_2
+with mu realized as L2 weight decay on the GCE and CoV parameters (the
+reference sets optimizer weight_decay=mu for those groups :144-152; here it
+is an explicit loss term over the same subtrees — identical gradients).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.core.types import Params
+
+
+@struct.dataclass
+class GpflContext:
+    frozen_embeddings: jax.Array  # [C, D] received GCE table
+    p_cond: jax.Array  # [D]
+    g_cond: jax.Array  # [D]
+
+
+class GpflClientLogic(ClientLogic):
+    """Pair with ``models.bases.GpflModel`` via ``gpfl_model_def`` and
+    FixedLayerExchanger(GpflModel.exchange_shared)."""
+
+    extra_loss_keys = ("prediction_ce", "gce_softmax", "magnitude")
+
+    def __init__(self, model, criterion, n_classes: int,
+                 class_proportions: jnp.ndarray | None = None,
+                 lam: float = 0.01, mu: float = 0.01):
+        super().__init__(model, criterion)
+        self.n_classes = n_classes
+        # Per-client label marginal (calculate_class_sample_proportions,
+        # gpfl_client.py:169). Uniform if unknown.
+        self.class_proportions = (
+            jnp.asarray(class_proportions, jnp.float32)
+            if class_proportions is not None
+            else jnp.full((n_classes,), 1.0 / n_classes)
+        )
+        self.lam = lam
+        self.mu = mu
+
+    def init_round_context(self, state: TrainState, payload) -> GpflContext:
+        payload_params = payload.params if hasattr(payload, "params") else payload
+        # After pull, state.params holds the merged model; the frozen table is
+        # the received one — identical to state at round start.
+        emb = state.params["gce"]["embedding"]
+        del payload_params
+        # g = sum_c E_c / C ; p = E^T @ class_props / C
+        # (gpfl_client.py:213-233 compute_conditional_inputs).
+        g_cond = jnp.sum(emb, axis=0) / self.n_classes
+        p_cond = emb.T @ self.class_proportions / self.n_classes
+        return GpflContext(
+            frozen_embeddings=jax.lax.stop_gradient(emb),
+            p_cond=jax.lax.stop_gradient(p_cond),
+            g_cond=jax.lax.stop_gradient(g_cond),
+        )
+
+    def predict(self, params, model_state, batch: Batch, rng, train: bool,
+                extra=None, ctx=None):
+        p_cond = ctx.p_cond if ctx is not None else None
+        g_cond = ctx.g_cond if ctx is not None else None
+        return self.model.apply(
+            params, model_state, batch.x, train=train, rng=rng,
+            p_cond=p_cond, g_cond=g_cond,
+        )
+
+    def training_loss(self, preds, features, batch: Batch, params, state,
+                      ctx: GpflContext):
+        m = batch.example_mask.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(m), 1.0)
+        ce = self.criterion(preds["prediction"], batch.y, batch.example_mask)
+        # GCE softmax loss over the cosine logits (gpfl_base.py:29-58).
+        per = optax.softmax_cross_entropy_with_integer_labels(
+            preds["gce_logits"], batch.y
+        )
+        gce_loss = jnp.sum(per * m) / denom
+        # Magnitude-level loss vs frozen embedding lookup (gpfl_client.py:311).
+        target_emb = ctx.frozen_embeddings[batch.y]  # [B, D]
+        diff = (features["general_features"] - target_emb) * m[:, None]
+        magnitude = jnp.linalg.norm(diff)
+        # mu-weight-decay on GCE + CoV subtrees (gpfl_client.py:144-152).
+        l2 = 0.0
+        if self.mu > 0.0:
+            gce_cov = [params["gce"], params["cov"]]
+            l2 = 0.5 * sum(
+                jnp.sum(jnp.square(leaf))
+                for t in gce_cov
+                for leaf in jax.tree_util.tree_leaves(t)
+            )
+        total = ce + gce_loss + self.lam * magnitude + self.mu * l2
+        return total, {"prediction_ce": ce, "gce_softmax": gce_loss,
+                       "magnitude": magnitude}
+
+
+def gpfl_model_def(module):
+    """ModelDef adapter for GpflModel — ``engine.from_flax`` forwards the
+    conditional-input kwargs (and handles mutable collections) already."""
+    from fl4health_tpu.clients.engine import from_flax
+
+    return from_flax(module)

@@ -1,0 +1,106 @@
+"""MOON client logic — model-contrastive federated learning.
+
+Parity: /root/reference/fl4health/clients/moon_client.py:19. The client keeps
+a buffer of up to ``len_old_models_buffer`` FROZEN previous local models plus
+the frozen received global model; ``predict`` (:85-119) runs the input
+through all of them to collect ``old_features`` / ``global_features`` and the
+training loss adds ``contrastive_weight`` (mu) times the MOON contrastive
+term (positive pair = global features, negatives = old local features).
+
+TPU-native design: the buffer is a params pytree with a leading [buffer]
+axis in ``extra`` (static length — scan/vmap friendly); a fill counter masks
+not-yet-populated slots out of the contrastive logits, reproducing the
+reference's "no contrastive loss until an old model exists" behavior without
+dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.losses.contrastive import moon_contrastive_loss
+
+
+@struct.dataclass
+class MoonExtra:
+    old_params: Params  # [buffer, ...] stacked previous local params
+    n_valid: jax.Array  # scalar int — filled slots
+
+
+@struct.dataclass
+class MoonContext:
+    global_params: Params  # frozen received global model
+
+
+class MoonClientLogic(ClientLogic):
+    """Pair with ``models.bases.MoonModel`` (features exposed under
+    ``features``) and a FullExchanger."""
+
+    extra_loss_keys = ("vanilla", "contrastive")
+
+    def __init__(self, model, criterion, contrastive_weight: float = 1.0,
+                 temperature: float = 0.5, buffer_len: int = 1):
+        super().__init__(model, criterion)
+        self.mu = contrastive_weight
+        self.temperature = temperature
+        self.buffer_len = buffer_len
+
+    def init_extra(self, params: Params) -> MoonExtra:
+        stacked = jax.tree_util.tree_map(
+            lambda p: jnp.stack([p] * self.buffer_len), params
+        )
+        return MoonExtra(old_params=stacked, n_valid=jnp.zeros((), jnp.int32))
+
+    def init_round_context(self, state: TrainState, payload) -> MoonContext:
+        payload_params = payload.params if hasattr(payload, "params") else payload
+        return MoonContext(global_params=payload_params)
+
+    def _features_of(self, params, model_state, x, rng):
+        (_, features), _ = self.model.apply(
+            params, model_state, x, train=False, rng=rng
+        )
+        return features["features"]
+
+    def training_loss(self, preds, features, batch: Batch, params, state,
+                      ctx: MoonContext):
+        vanilla = self.criterion(preds["prediction"], batch.y, batch.example_mask)
+        rng = jax.random.fold_in(state.rng, 13)
+        z = features["features"]  # current local features [B, D]
+        z_glob = jax.lax.stop_gradient(
+            self._features_of(ctx.global_params, state.model_state, batch.x, rng)
+        )
+        # Old-model features: vmap over the buffer axis -> [L, B, D].
+        z_old = jax.lax.stop_gradient(
+            jax.vmap(
+                lambda p: self._features_of(p, state.model_state, batch.x, rng)
+            )(state.extra.old_params)
+        )
+        # Mask invalid buffer slots out of the softmax (reference skips the
+        # contrastive term entirely while the buffer is empty,
+        # moon_client.py:85-119). finalize_round appends newest at the END, so
+        # the last n_valid slots hold real previous models.
+        slot_idx = jnp.arange(self.buffer_len)
+        valid = (slot_idx >= self.buffer_len - state.extra.n_valid).astype(
+            jnp.float32
+        )  # [L]
+        contrastive = moon_contrastive_loss(
+            z, z_glob[None], z_old, self.temperature, batch.example_mask,
+            negative_mask=valid,
+        )
+        contrastive = contrastive * (state.extra.n_valid > 0).astype(jnp.float32)
+        total = vanilla + self.mu * contrastive
+        return total, {"vanilla": vanilla, "contrastive": contrastive}
+
+    def finalize_round(self, state: TrainState, ctx, local_steps) -> TrainState:
+        # Shift the frozen-model buffer and append this round's final local
+        # params (update_after_train in the reference).
+        def shift(buf, p):
+            return jnp.concatenate([buf[1:], p[None]], axis=0)
+
+        new_buf = jax.tree_util.tree_map(shift, state.extra.old_params, state.params)
+        n_valid = jnp.minimum(state.extra.n_valid + 1, self.buffer_len)
+        return state.replace(extra=MoonExtra(old_params=new_buf, n_valid=n_valid))

@@ -10,10 +10,14 @@ import jax
 import jax.numpy as jnp
 
 
-def _cos(a: jax.Array, b: jax.Array, axis=-1, eps=1e-8) -> jax.Array:
+def cosine_similarity(a: jax.Array, b: jax.Array, axis=-1, eps=1e-8) -> jax.Array:
+    """Cosine similarity along ``axis`` (shared by every contrastive loss)."""
     a_n = a / jnp.maximum(jnp.linalg.norm(a, axis=axis, keepdims=True), eps)
     b_n = b / jnp.maximum(jnp.linalg.norm(b, axis=axis, keepdims=True), eps)
     return jnp.sum(a_n * b_n, axis=axis)
+
+
+_cos = cosine_similarity
 
 
 def moon_contrastive_loss(
@@ -22,17 +26,22 @@ def moon_contrastive_loss(
     negative_pairs: jax.Array,
     temperature: float = 0.5,
     mask: jax.Array | None = None,
+    negative_mask: jax.Array | None = None,
 ) -> jax.Array:
     """MOON model-contrastive loss (contrastive_loss.py:6).
 
     features:       [B, D]   current local-model features z
     positive_pairs: [P, B, D] features from the global model (usually P=1)
     negative_pairs: [N, B, D] features from previous local models
+    negative_mask:  [N] optional 0/1 validity per negative row (e.g. MOON's
+                    not-yet-populated old-model buffer slots)
     loss = -log( sum_p exp(cos(z, z_p)/t) /
                  (sum_p exp(cos(z,z_p)/t) + sum_n exp(cos(z,z_n)/t)) )
     """
     pos = _cos(features[None], positive_pairs) / temperature  # [P, B]
     neg = _cos(features[None], negative_pairs) / temperature  # [N, B]
+    if negative_mask is not None:
+        neg = jnp.where(negative_mask[:, None] > 0, neg, -1e9)
     logits = jnp.concatenate([pos, neg], axis=0).T  # [B, P+N]
     n_pos = positive_pairs.shape[0]
     log_prob = jax.nn.log_softmax(logits, axis=-1)
@@ -110,7 +119,9 @@ def perfcl_loss(
     l = moon_contrastive_loss(
         local_features,
         old_local_features[None],
-        global_features[None],
+        # Negative pair is the frozen AGGREGATED global features z_g, not the
+        # live ones (perfcl_loss.py:85-89).
+        initial_global_features[None],
         temperature,
         mask,
     )

@@ -1,0 +1,390 @@
+"""Split-architecture model bases, flax-native.
+
+Parity targets (/root/reference/fl4health/model_bases/):
+- ``SequentiallySplitModel`` / ``SequentiallySplitExchangeBaseModel``
+  (sequential_split_models.py:7,92) — features -> head, with the feature
+  extractor as the exchange base.
+- ``ParallelSplitModel`` + ``ParallelSplitHeadModule`` join modes CONCAT/SUM
+  (parallel_split_models.py:13,83).
+- ``FendaModel`` (fenda_base.py:8) — local ‖ global extractors, only the
+  global ("second") extractor crosses the wire.
+- ``ApflModule`` (apfl_base.py:9) — twin local/global models with adaptive
+  alpha-mixed logits.
+- ``MoonModel`` (moon_base.py:7) — sequential split + optional projection
+  head, exposing contrastive features.
+- ``FedRepModel`` (fedrep_base.py:4) — sequential split with head/rep
+  training phases (freezing realized as gradient masks in the client logic).
+- ``PerFclModel`` (perfcl_base.py:8) — parallel split exposing both feature
+  streams for the dual contrastive losses.
+- ``GpflModel`` + ``Gce``/``CoV`` (gpfl_base.py:12,90,171).
+- ``EnsembleModel`` (ensemble_base.py:15).
+- ``FedSimClrModel`` (fedsimclr_base.py:12).
+
+TPU-native stance: "which subtree crosses the wire" is not a model-base
+concern here — it is a path predicate handed to a
+``fl4health_tpu.exchange.FixedLayerExchanger``. Each base documents its
+exchange predicate as a staticmethod so client code stays declarative.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class JoinMode(enum.Enum):
+    """ParallelSplitHeadModule join modes (parallel_split_models.py:13)."""
+
+    CONCATENATE = "concatenate"
+    SUM = "sum"
+
+
+# ---------------------------------------------------------------------------
+# Sequential split
+# ---------------------------------------------------------------------------
+
+class SequentiallySplitModel(nn.Module):
+    """features -> head; returns prediction plus the feature stream
+    (sequential_split_models.py:7 ``sequential_forward``)."""
+
+    features_module: nn.Module
+    head_module: nn.Module
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        features = self.features_module(x, train=train)
+        preds = self.head_module(features, train=train)
+        return {"prediction": preds}, {"features": features}
+
+    @staticmethod
+    def exchange_features_only(path: str) -> bool:
+        """Exchange predicate for SequentiallySplitExchangeBaseModel
+        (sequential_split_models.py:92): share the feature extractor, keep
+        the head private (FedPer/FedRep semantics)."""
+        return path.startswith("features_module")
+
+
+class HeadModule(nn.Module):
+    """Parallel-split head joining two feature streams
+    (parallel_split_models.py:13)."""
+
+    head: nn.Module
+    join_mode: JoinMode = JoinMode.CONCATENATE
+
+    @nn.compact
+    def __call__(self, local_features, global_features, train: bool = True):
+        if self.join_mode is JoinMode.CONCATENATE:
+            joined = jnp.concatenate([local_features, global_features], axis=-1)
+        else:
+            joined = local_features + global_features
+        return self.head(joined, train=train)
+
+
+class ParallelSplitModel(nn.Module):
+    """Two parallel feature extractors joined by a head
+    (parallel_split_models.py:83). Naming convention fixes the exchange
+    boundary: ``second_feature_extractor`` is the globally-shared one
+    (fenda_base.py:8 exchanges only ``second_feature_extractor.*``)."""
+
+    first_feature_extractor: nn.Module  # local / personal
+    second_feature_extractor: nn.Module  # global / aggregated
+    head_module: HeadModule
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        local_f = self.first_feature_extractor(x, train=train)
+        global_f = self.second_feature_extractor(x, train=train)
+        preds = self.head_module(local_f, global_f, train=train)
+        return (
+            {"prediction": preds},
+            {"local_features": local_f, "global_features": global_f},
+        )
+
+    @staticmethod
+    def exchange_global_extractor(path: str) -> bool:
+        """FENDA exchange predicate (fenda_base.py:20 layers_to_exchange)."""
+        return path.startswith("second_feature_extractor")
+
+
+# FENDA is exactly a ParallelSplitModel with the global-extractor exchange
+# predicate; PerFCL additionally consumes both feature streams in its loss.
+FendaModel = ParallelSplitModel
+PerFclModel = ParallelSplitModel
+
+
+# ---------------------------------------------------------------------------
+# APFL
+# ---------------------------------------------------------------------------
+
+class ApflModule(nn.Module):
+    """APFL twin models with alpha-mixed personal logits (apfl_base.py:9).
+
+    ``alpha`` lives in ``extra`` state on the client (it must never cross the
+    wire and is updated with its own learning rate, apfl_base.py:86
+    ``update_alpha``); the forward takes it as an argument so the mixing is
+    differentiable and the client logic can take d(personal_loss)/d(alpha)
+    directly — the exact gradient the reference's manual formula computes.
+    """
+
+    local_model: nn.Module
+    global_model: nn.Module
+
+    @nn.compact
+    def __call__(self, x, alpha=None, train: bool = True):
+        if alpha is None:
+            alpha = 0.5
+        local_out = self.local_model(x, train=train)
+        global_out = self.global_model(x, train=train)
+        local_logits = _prediction_of(local_out)
+        global_logits = _prediction_of(global_out)
+        personal = alpha * local_logits + (1.0 - alpha) * global_logits
+        return (
+            {
+                "personal": personal,
+                "global": global_logits,
+                "local": local_logits,
+                "prediction": personal,
+            },
+            {},
+        )
+
+    @staticmethod
+    def exchange_global_model(path: str) -> bool:
+        return path.startswith("global_model")
+
+
+def _prediction_of(out):
+    if isinstance(out, tuple):
+        out = out[0]
+    if isinstance(out, dict):
+        return out["prediction"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MOON
+# ---------------------------------------------------------------------------
+
+class MoonModel(nn.Module):
+    """Sequential split exposing (optionally projected) contrastive features
+    (moon_base.py:7)."""
+
+    base_module: nn.Module
+    head_module: nn.Module
+    projection_module: nn.Module | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        features = self.base_module(x, train=train)
+        if self.projection_module is not None:
+            features = self.projection_module(features, train=train)
+        preds = self.head_module(features, train=train)
+        return {"prediction": preds}, {"features": features}
+
+
+# FedRep shares MOON's topology; phase freezing is a gradient mask in
+# FedRepClientLogic (fedrep_base.py:4 freeze/unfreeze become masks).
+FedRepModel = SequentiallySplitModel
+
+
+# ---------------------------------------------------------------------------
+# GPFL
+# ---------------------------------------------------------------------------
+
+class Gce(nn.Module):
+    """Global Conditional Embedding table (gpfl_base.py:12): a learnable
+    class-embedding matrix. ``__call__`` returns cosine-similarity logits of
+    features against the (L2-normalized) class embeddings — the GCE softmax
+    loss is cross-entropy over these logits (gpfl_base.py:29-58) — plus the
+    raw embedding table for conditional-input computation and the
+    magnitude-level loss (frozen lookup, gpfl_client.py:311-330)."""
+
+    n_classes: int
+    feature_dim: int
+
+    @nn.compact
+    def __call__(self, features):
+        embeddings = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=1.0),
+            (self.n_classes, self.feature_dim),
+        )
+        f = features / jnp.maximum(
+            jnp.linalg.norm(features, axis=-1, keepdims=True), 1e-8
+        )
+        e = embeddings / jnp.maximum(
+            jnp.linalg.norm(embeddings, axis=-1, keepdims=True), 1e-8
+        )
+        return f @ e.T, embeddings  # [B, C] cosine logits, raw table
+
+
+class CoV(nn.Module):
+    """Conditional-Value mapping (gpfl_base.py:90): computes gamma/beta from
+    the conditional input and modulates the base features with a residual
+    affine transform."""
+
+    feature_dim: int
+
+    @nn.compact
+    def __call__(self, features, conditional):
+        h = nn.relu(nn.Dense(self.feature_dim)(conditional))
+        gamma = nn.Dense(self.feature_dim)(h)
+        beta = nn.Dense(self.feature_dim)(h)
+        return nn.relu(features * (1.0 + gamma) + beta)
+
+
+class GpflModel(nn.Module):
+    """GPFL (gpfl_base.py:12): base extractor -> CoV-modulated personalized
+    feature (classified by the head) and generalized feature (aligned to the
+    GCE class embeddings). The conditional inputs are NOT learned here — the
+    client computes them each round from the frozen received GCE embeddings
+    and the client's class-sample proportions
+    (gpfl_client.py:213-233 ``compute_conditional_inputs``) and passes them in.
+    """
+
+    base_module: nn.Module
+    n_classes: int
+    feature_dim: int
+
+    @nn.compact
+    def __call__(self, x, p_cond=None, g_cond=None, train: bool = True):
+        base = self.base_module(x, train=train)
+        base = nn.Dense(self.feature_dim, name="feature_mapper")(base)
+        if p_cond is None:
+            p_cond = jnp.zeros((self.feature_dim,), base.dtype)
+        if g_cond is None:
+            g_cond = jnp.zeros((self.feature_dim,), base.dtype)
+        cov = CoV(self.feature_dim, name="cov")
+        b = base.shape[0]
+        personal_f = cov(base, jnp.tile(p_cond[None], (b, 1)))
+        general_f = cov(base, jnp.tile(g_cond[None], (b, 1)))
+        gce_logits, embeddings = Gce(self.n_classes, self.feature_dim, name="gce")(
+            general_f
+        )
+        preds = nn.Dense(self.n_classes, name="head")(personal_f)
+        return (
+            {"prediction": preds, "gce_logits": gce_logits},
+            {
+                "personal_features": personal_f,
+                "general_features": general_f,
+                "gce_embeddings": embeddings,
+            },
+        )
+
+    @staticmethod
+    def exchange_shared(path: str) -> bool:
+        """GPFL aggregates the base extractor, feature mapper, CoV, and GCE;
+        only the personalized head stays local (gpfl_client.py:155)."""
+        return not path.startswith("head")
+
+
+# ---------------------------------------------------------------------------
+# Twin models (Ditto and friends)
+# ---------------------------------------------------------------------------
+
+class TwinModel(nn.Module):
+    """Two full copies of an architecture: an exchanged ``global_model`` and a
+    private ``personal_model`` (Ditto's twin-model layout, clients/
+    ditto_client.py:20 keeps ``self.global_model`` + ``self.model``)."""
+
+    global_model: nn.Module
+    personal_model: nn.Module
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        g = _prediction_of(self.global_model(x, train=train))
+        p = _prediction_of(self.personal_model(x, train=train))
+        return {"global": g, "personal": p, "prediction": p}, {}
+
+    @staticmethod
+    def exchange_global_model(path: str) -> bool:
+        return path.startswith("global_model")
+
+
+# ---------------------------------------------------------------------------
+# Ensemble
+# ---------------------------------------------------------------------------
+
+class EnsembleModel(nn.Module):
+    """Train an ensemble simultaneously (ensemble_base.py:15). Predictions are
+    keyed ``ensemble-pred-i`` plus the uniform-average ``prediction``."""
+
+    members: Sequence[nn.Module]
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        preds = {}
+        logits = []
+        for i, member in enumerate(self.members):
+            out = _prediction_of(member(x, train=train))
+            preds[f"ensemble-pred-{i}"] = out
+            logits.append(out)
+        preds["prediction"] = sum(logits) / float(len(logits))
+        return preds, {}
+
+
+# ---------------------------------------------------------------------------
+# FedSimCLR
+# ---------------------------------------------------------------------------
+
+class FedSimClrModel(nn.Module):
+    """SimCLR encoder + projection head, with an optional prediction head for
+    the fine-tuning stage (fedsimclr_base.py:12 ``pretrain`` flag)."""
+
+    encoder: nn.Module
+    projection_head: nn.Module
+    prediction_head: nn.Module | None = None
+    pretrain: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        features = self.encoder(x, train=train)
+        if self.pretrain:
+            proj = self.projection_head(features, train=train)
+            return {"prediction": proj}, {"features": features}
+        assert self.prediction_head is not None
+        preds = self.prediction_head(features, train=train)
+        return {"prediction": preds}, {"features": features}
+
+
+# ---------------------------------------------------------------------------
+# Simple building-block extractors/heads for tests and examples
+# ---------------------------------------------------------------------------
+
+class DenseFeatures(nn.Module):
+    """Small MLP feature extractor block."""
+
+    features: Sequence[int] = (64,)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = nn.relu(nn.Dense(f)(x))
+        return x
+
+
+class DenseHead(nn.Module):
+    n_outputs: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        return nn.Dense(self.n_outputs)(x)
+
+
+class ConvFeatures(nn.Module):
+    """Conv feature extractor block (NHWC)."""
+
+    channels: Sequence[int] = (16, 32)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for c in self.channels:
+            x = nn.Conv(c, (3, 3))(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x.reshape((x.shape[0], -1))

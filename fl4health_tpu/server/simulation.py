@@ -111,9 +111,11 @@ class FederatedSimulation:
         proto = engine.create_train_state(logic, tx, init_rng, sample_x)
         per_client = []
         for i in range(self.n_clients):
-            st = engine.create_train_state(
-                logic, tx, jax.random.fold_in(init_rng, i + 1), sample_x
-            )
+            # All clients share the server's initial params (the reference's
+            # round-1 initialize_all_model_weights broadcast covers the FULL
+            # model, basic_client.py:205 — including personal subtrees that
+            # never cross the wire afterwards); only the PRNG stream differs.
+            st = proto.replace(rng=jax.random.fold_in(init_rng, i + 1))
             per_client.append(st)
         self.client_states: TrainState = ptu.stack_clients(per_client)
         self.server_state = strategy.init(proto.params)

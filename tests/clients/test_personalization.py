@@ -1,0 +1,279 @@
+"""End-to-end tests for the personalization client wave (reference:
+tests/clients/test_{ditto,apfl,moon,fenda,fedrep,...}* + smoke tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.apfl import ApflClientLogic, apfl_model_def
+from fl4health_tpu.clients.ditto import (
+    DittoClientLogic,
+    KeepLocalExchanger,
+    MrMtlClientLogic,
+)
+from fl4health_tpu.clients.ensemble import EnsembleClientLogic
+from fl4health_tpu.clients.fenda import (
+    ConstrainedFendaClientLogic,
+    PerFclClientLogic,
+)
+from fl4health_tpu.clients.fedrep import FedRepClientLogic
+from fl4health_tpu.clients.gpfl import GpflClientLogic, gpfl_model_def
+from fl4health_tpu.clients.moon import MoonClientLogic
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.exchange.exchanger import (
+    FixedLayerExchanger,
+    norm_exclusion_exchanger,
+)
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models import bases
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.fedprox import FedAvgWithAdaptiveConstraint
+
+N_CLASSES = 3
+DIM = 8
+
+
+def _datasets(n_clients=3, n=48, seed=0):
+    out = []
+    for i in range(n_clients):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(seed + i), n, (DIM,), N_CLASSES
+        )
+        out.append(ClientDataset(x[: n - 16], y[: n - 16], x[n - 16:], y[n - 16:]))
+    return out
+
+
+def _metrics():
+    return MetricManager((efficient.accuracy(),))
+
+
+def _sim(logic, exchanger=None, strategy=None, rounds=3, tx=None, **kwargs):
+    sim = FederatedSimulation(
+        logic=logic,
+        tx=tx or optax.sgd(0.05),
+        strategy=strategy or FedAvg(),
+        datasets=_datasets(),
+        batch_size=8,
+        metrics=_metrics(),
+        local_epochs=1,
+        exchanger=exchanger,
+        seed=3,
+        **kwargs,
+    )
+    return sim, sim.fit(rounds)
+
+
+def _small_mlp():
+    return Mlp(features=(16,), n_outputs=N_CLASSES)
+
+
+def test_ditto_end_to_end():
+    model = bases.TwinModel(global_model=_small_mlp(), personal_model=_small_mlp())
+    logic = DittoClientLogic(engine.from_flax(model), engine.masked_cross_entropy,
+                             lam=0.5)
+    sim, hist = _sim(
+        logic, FixedLayerExchanger(bases.TwinModel.exchange_global_model)
+    )
+    assert np.isfinite(hist[-1].fit_losses["penalty"])
+    assert hist[-1].eval_losses["checkpoint"] < hist[0].eval_losses["checkpoint"]
+    # Personal models diverge across clients (they never cross the wire)...
+    personal = sim.client_states.params["personal_model"]
+    flat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(personal)
+    assert float(jnp.max(jnp.abs(flat[0] - flat[1]))) > 1e-6
+    # ...while the pulled global models match across clients post-eval.
+    glob = sim.client_states.params["global_model"]
+    gflat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(glob)
+    np.testing.assert_allclose(np.asarray(gflat[0]), np.asarray(gflat[1]), atol=1e-6)
+
+
+def test_ditto_adaptive_packs_loss():
+    model = bases.TwinModel(global_model=_small_mlp(), personal_model=_small_mlp())
+    logic = DittoClientLogic(engine.from_flax(model), engine.masked_cross_entropy,
+                             adaptive=True)
+    strat = FedAvgWithAdaptiveConstraint(initial_drift_penalty_weight=0.3)
+    sim, hist = _sim(
+        logic, FixedLayerExchanger(bases.TwinModel.exchange_global_model), strat
+    )
+    assert np.isfinite(float(sim.server_state.drift_penalty_weight))
+
+
+def test_mr_mtl_end_to_end():
+    logic = MrMtlClientLogic(engine.from_flax(_small_mlp()),
+                             engine.masked_cross_entropy, lam=0.5)
+    sim, hist = _sim(logic, KeepLocalExchanger())
+    assert np.isfinite(hist[-1].fit_losses["penalty"])
+    # Local models stay personal — different from the aggregate.
+    flat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(
+        sim.client_states.params
+    )
+    agg = jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    assert float(jnp.max(jnp.abs(flat[0] - agg))) > 1e-6
+
+
+def test_apfl_end_to_end():
+    module = bases.ApflModule(local_model=_small_mlp(), global_model=_small_mlp())
+    logic = ApflClientLogic(apfl_model_def(module), engine.masked_cross_entropy,
+                            alpha=0.5, alpha_lr=0.1)
+    sim, hist = _sim(
+        logic, FixedLayerExchanger(bases.ApflModule.exchange_global_model)
+    )
+    assert hist[-1].eval_losses["checkpoint"] < hist[0].eval_losses["checkpoint"]
+    alphas = np.asarray(sim.client_states.extra.alpha)
+    assert np.all((alphas >= 0.0) & (alphas <= 1.0))
+    # adaptive alpha moved off its initialization for at least one client
+    assert np.max(np.abs(alphas - 0.5)) > 1e-5
+
+
+def test_moon_end_to_end():
+    model = bases.MoonModel(
+        base_module=bases.DenseFeatures((16,)),
+        head_module=bases.DenseHead(N_CLASSES),
+    )
+    logic = MoonClientLogic(engine.from_flax(model), engine.masked_cross_entropy,
+                            contrastive_weight=1.0, buffer_len=1)
+    sim, hist = _sim(logic)
+    # Round 1: empty buffer -> no contrastive term (moon_client.py behavior).
+    assert hist[0].fit_losses["contrastive"] == 0.0
+    assert hist[1].fit_losses["contrastive"] > 0.0
+    assert hist[-1].eval_losses["checkpoint"] < hist[0].eval_losses["checkpoint"]
+
+
+def test_fenda_end_to_end():
+    model = bases.FendaModel(
+        first_feature_extractor=bases.DenseFeatures((12,)),
+        second_feature_extractor=bases.DenseFeatures((12,)),
+        head_module=bases.HeadModule(head=bases.DenseHead(N_CLASSES)),
+    )
+    logic = engine.ClientLogic(engine.from_flax(model), engine.masked_cross_entropy)
+    sim, hist = _sim(
+        logic, FixedLayerExchanger(bases.ParallelSplitModel.exchange_global_extractor)
+    )
+    assert hist[-1].eval_losses["checkpoint"] < hist[0].eval_losses["checkpoint"]
+    # local extractors diverge across clients; they are never aggregated
+    local = sim.client_states.params["first_feature_extractor"]
+    flat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(local)
+    assert float(jnp.max(jnp.abs(flat[0] - flat[1]))) > 1e-6
+
+
+def test_perfcl_end_to_end():
+    model = bases.PerFclModel(
+        first_feature_extractor=bases.DenseFeatures((12,)),
+        second_feature_extractor=bases.DenseFeatures((12,)),
+        head_module=bases.HeadModule(head=bases.DenseHead(N_CLASSES)),
+    )
+    logic = PerFclClientLogic(
+        engine.from_flax(model), engine.masked_cross_entropy,
+        global_feature_loss_weight=0.5, local_feature_loss_weight=0.5,
+    )
+    sim, hist = _sim(
+        logic, FixedLayerExchanger(bases.ParallelSplitModel.exchange_global_extractor)
+    )
+    # contrastive terms inactive in round 1 (no previous round), active after
+    assert hist[0].fit_losses["global_contrastive"] == 0.0
+    assert hist[1].fit_losses["global_contrastive"] != 0.0
+    assert np.isfinite(hist[-1].eval_losses["checkpoint"])
+
+
+def test_constrained_fenda_cos_sim():
+    model = bases.FendaModel(
+        first_feature_extractor=bases.DenseFeatures((12,)),
+        second_feature_extractor=bases.DenseFeatures((12,)),
+        head_module=bases.HeadModule(head=bases.DenseHead(N_CLASSES)),
+    )
+    logic = ConstrainedFendaClientLogic(
+        engine.from_flax(model), engine.masked_cross_entropy,
+        cos_sim_loss_weight=0.5, contrastive_loss_weight=0.5,
+    )
+    sim, hist = _sim(
+        logic, FixedLayerExchanger(bases.ParallelSplitModel.exchange_global_extractor)
+    )
+    assert np.isfinite(hist[-1].fit_losses["cos_sim"])
+    assert hist[0].fit_losses["contrastive"] == 0.0
+
+
+def test_fedrep_phase_masking():
+    model = bases.FedRepModel(
+        features_module=bases.DenseFeatures((16,)),
+        head_module=bases.DenseHead(N_CLASSES),
+    )
+    # All local steps are head-phase: the representation must not move from
+    # the pulled (server) weights.
+    logic = FedRepClientLogic(
+        engine.from_flax(model), engine.masked_cross_entropy, head_steps=10_000
+    )
+    sim = FederatedSimulation(
+        logic=logic, tx=optax.sgd(0.05), strategy=FedAvg(),
+        datasets=_datasets(), batch_size=8, metrics=_metrics(), local_steps=3,
+        exchanger=FixedLayerExchanger(
+            bases.SequentiallySplitModel.exchange_features_only
+        ),
+        seed=3,
+    )
+    before = jax.flatten_util.ravel_pytree(
+        sim.global_params["features_module"]
+    )[0]
+    sim.fit(1)
+    feats = sim.client_states.params["features_module"]
+    flat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(feats)
+    for i in range(flat.shape[0]):
+        np.testing.assert_allclose(np.asarray(flat[i]), np.asarray(before),
+                                   atol=1e-6)
+    # while the heads did move
+    heads = sim.client_states.params["head_module"]
+    hflat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(heads)
+    assert float(jnp.max(jnp.abs(hflat[0] - hflat[1]))) > 1e-7
+
+
+def test_fedbn_norm_layers_stay_local():
+    class BnMlp(bases.nn.Module):
+        @bases.nn.compact
+        def __call__(self, x, train: bool = True):
+            x = bases.nn.Dense(16)(x)
+            x = bases.nn.BatchNorm(use_running_average=not train)(x)
+            x = bases.nn.relu(x)
+            return {"prediction": bases.nn.Dense(N_CLASSES)(x)}, {}
+
+    logic = engine.ClientLogic(engine.from_flax(BnMlp()),
+                               engine.masked_cross_entropy)
+    sim, hist = _sim(logic, norm_exclusion_exchanger())
+    # BatchNorm scale/bias diverge across clients (not exchanged)
+    bn = sim.client_states.params["BatchNorm_0"]
+    flat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(bn)
+    assert float(jnp.max(jnp.abs(flat[0] - flat[1]))) > 1e-7
+    # Dense layers were exchanged: equal across clients after final pull
+    dense = sim.client_states.params["Dense_0"]
+    dflat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(dense)
+    np.testing.assert_allclose(np.asarray(dflat[0]), np.asarray(dflat[1]),
+                               atol=1e-6)
+
+
+def test_gpfl_end_to_end():
+    module = bases.GpflModel(
+        base_module=bases.DenseFeatures((16,)), n_classes=N_CLASSES,
+        feature_dim=12,
+    )
+    logic = GpflClientLogic(
+        gpfl_model_def(module), engine.masked_cross_entropy,
+        n_classes=N_CLASSES, lam=0.01, mu=0.01,
+    )
+    sim, hist = _sim(logic, FixedLayerExchanger(bases.GpflModel.exchange_shared))
+    for key in ("prediction_ce", "gce_softmax", "magnitude"):
+        assert np.isfinite(hist[-1].fit_losses[key])
+    # personalized heads diverge
+    heads = sim.client_states.params["head"]
+    flat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(heads)
+    assert float(jnp.max(jnp.abs(flat[0] - flat[1]))) > 1e-7
+
+
+def test_ensemble_end_to_end():
+    model = bases.EnsembleModel(members=(_small_mlp(), _small_mlp()))
+    logic = EnsembleClientLogic(engine.from_flax(model),
+                                engine.masked_cross_entropy, n_members=2)
+    sim, hist = _sim(logic)
+    assert "member_0" in hist[-1].fit_losses and "member_1" in hist[-1].fit_losses
+    assert hist[-1].eval_losses["checkpoint"] < hist[0].eval_losses["checkpoint"]

@@ -1,0 +1,125 @@
+"""Unit tests for split-architecture model bases (reference:
+tests/model_bases/)."""
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.models import bases
+
+
+def _init_apply(module, x, **kwargs):
+    variables = module.init(jax.random.PRNGKey(0), x, **kwargs)
+    out = module.apply(variables, x, **kwargs)
+    return variables, out
+
+
+def test_sequentially_split_model_shapes_and_predicate():
+    m = bases.SequentiallySplitModel(
+        features_module=bases.DenseFeatures((16,)),
+        head_module=bases.DenseHead(4),
+    )
+    x = jnp.ones((2, 8))
+    variables, (preds, feats) = _init_apply(m, x)
+    assert preds["prediction"].shape == (2, 4)
+    assert feats["features"].shape == (2, 16)
+    paths = ptu.leaf_paths(variables["params"])
+    shared = [p for p in paths if bases.SequentiallySplitModel.exchange_features_only(p)]
+    assert shared and all(p.startswith("features_module") for p in shared)
+    private = [p for p in paths if not bases.SequentiallySplitModel.exchange_features_only(p)]
+    assert private and all(p.startswith("head_module") for p in private)
+
+
+def test_parallel_split_join_modes():
+    for mode, dim in [(bases.JoinMode.CONCATENATE, 32), (bases.JoinMode.SUM, 16)]:
+        m = bases.ParallelSplitModel(
+            first_feature_extractor=bases.DenseFeatures((16,)),
+            second_feature_extractor=bases.DenseFeatures((16,)),
+            head_module=bases.HeadModule(head=bases.DenseHead(3), join_mode=mode),
+        )
+        x = jnp.ones((2, 8))
+        variables, (preds, feats) = _init_apply(m, x)
+        assert preds["prediction"].shape == (2, 3)
+        assert feats["local_features"].shape == (2, 16)
+        assert feats["global_features"].shape == (2, 16)
+    # FENDA predicate exchanges exactly the second extractor
+    paths = ptu.leaf_paths(variables["params"])
+    ex = [p for p in paths if bases.ParallelSplitModel.exchange_global_extractor(p)]
+    assert ex and all(p.startswith("second_feature_extractor") for p in ex)
+
+
+def test_apfl_module_alpha_mixing():
+    m = bases.ApflModule(
+        local_model=bases.DenseHead(3), global_model=bases.DenseHead(3)
+    )
+    x = jnp.ones((2, 8))
+    variables = m.init(jax.random.PRNGKey(0), x, alpha=jnp.asarray(0.5))
+    for alpha in (0.0, 1.0):
+        preds, _ = m.apply(variables, x, alpha=jnp.asarray(alpha))
+        ref = preds["global"] if alpha == 0.0 else preds["local"]
+        assert jnp.allclose(preds["personal"], ref)
+
+
+def test_twin_model_structure():
+    m = bases.TwinModel(
+        global_model=bases.DenseHead(3), personal_model=bases.DenseHead(3)
+    )
+    x = jnp.ones((2, 8))
+    variables, (preds, _) = _init_apply(m, x)
+    assert set(variables["params"].keys()) == {"global_model", "personal_model"}
+    assert preds["prediction"].shape == (2, 3)
+    assert jnp.allclose(preds["prediction"], preds["personal"])
+
+
+def test_moon_model_projection():
+    m = bases.MoonModel(
+        base_module=bases.DenseFeatures((16,)),
+        head_module=bases.DenseHead(3),
+        projection_module=bases.DenseFeatures((8,)),
+    )
+    x = jnp.ones((2, 10))
+    _, (preds, feats) = _init_apply(m, x)
+    assert feats["features"].shape == (2, 8)  # projected
+    assert preds["prediction"].shape == (2, 3)
+
+
+def test_gpfl_model_outputs():
+    m = bases.GpflModel(
+        base_module=bases.DenseFeatures((16,)), n_classes=5, feature_dim=12
+    )
+    x = jnp.ones((3, 8))
+    variables = m.init(jax.random.PRNGKey(0), x)
+    preds, feats = m.apply(
+        variables, x, p_cond=jnp.ones((12,)), g_cond=jnp.zeros((12,))
+    )
+    assert preds["prediction"].shape == (3, 5)
+    assert preds["gce_logits"].shape == (3, 5)
+    assert feats["gce_embeddings"].shape == (5, 12)
+    # cosine logits bounded
+    assert float(jnp.max(jnp.abs(preds["gce_logits"]))) <= 1.0 + 1e-5
+    paths = ptu.leaf_paths(variables["params"])
+    private = [p for p in paths if not bases.GpflModel.exchange_shared(p)]
+    assert private and all(p.startswith("head") for p in private)
+
+
+def test_ensemble_model_average():
+    m = bases.EnsembleModel(members=(bases.DenseHead(3), bases.DenseHead(3)))
+    x = jnp.ones((2, 8))
+    _, (preds, _) = _init_apply(m, x)
+    avg = (preds["ensemble-pred-0"] + preds["ensemble-pred-1"]) / 2.0
+    assert jnp.allclose(preds["prediction"], avg)
+
+
+def test_fedsimclr_modes():
+    enc = bases.DenseFeatures((16,))
+    proj = bases.DenseFeatures((8,))
+    head = bases.DenseHead(3)
+    pre = bases.FedSimClrModel(encoder=enc, projection_head=proj,
+                               prediction_head=head, pretrain=True)
+    x = jnp.ones((2, 10))
+    _, (preds, _) = _init_apply(pre, x)
+    assert preds["prediction"].shape == (2, 8)
+    ft = bases.FedSimClrModel(encoder=enc, projection_head=proj,
+                              prediction_head=head, pretrain=False)
+    _, (preds, _) = _init_apply(ft, x)
+    assert preds["prediction"].shape == (2, 3)
