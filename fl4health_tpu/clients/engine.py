@@ -179,6 +179,25 @@ class ClientLogic:
         """(basic_client.py:1294) — e.g. SCAFFOLD variate correction."""
         return grads
 
+    def value_and_grads(self, state: TrainState, ctx: Any, batch: Batch, step_rng: PRNGKey):
+        """Compute ((backward, (preds, additional, new_model_state)), grads).
+
+        Default: whole-batch ``value_and_grad``. DP logics override this with
+        vmapped per-example gradients + clip + noise (the Opacus hook point,
+        instance_level_dp_client.py:85-114 in the reference)."""
+
+        def loss_fn(params):
+            (preds, features), new_model_state = self.predict(
+                params, state.model_state, batch, step_rng, train=True,
+                extra=state.extra, ctx=ctx,
+            )
+            backward, additional = self.training_loss(
+                preds, features, batch, params, state, ctx
+            )
+            return backward, (preds, additional, new_model_state)
+
+        return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+
     def update_after_step(self, state: TrainState, ctx: Any, batch: Batch,
                           preds: dict | None = None) -> TrainState:
         """(basic_client.py:1272) — e.g. APFL alpha update. ``preds`` is the
@@ -253,20 +272,9 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
 
     def step(state: TrainState, ctx: Any, batch: Batch):
         rng, step_rng = jax.random.split(state.rng)
-
-        def loss_fn(params):
-            (preds, features), new_model_state = logic.predict(
-                params, state.model_state, batch, step_rng, train=True,
-                extra=state.extra, ctx=ctx,
-            )
-            backward, additional = logic.training_loss(
-                preds, features, batch, params, state, ctx
-            )
-            return backward, (preds, additional, new_model_state)
-
-        (backward, (preds, additional, new_model_state)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+        (backward, (preds, additional, new_model_state)), grads = logic.value_and_grads(
+            state, ctx, batch, step_rng
+        )
         grads = logic.transform_gradients(grads, state, ctx)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

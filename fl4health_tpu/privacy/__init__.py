@@ -1,0 +1,34 @@
+"""Differential-privacy subsystem: native RDP accounting + DP-SGD primitives.
+
+Replaces the reference's dp-accounting/Opacus dependencies (SURVEY.md §2.8)
+with pure-math RDP accounting (privacy.rdp, privacy.accountants) and
+vmap-based per-example gradient clipping/noising (privacy.dpsgd).
+"""
+
+from fl4health_tpu.privacy.accountants import (
+    FixedSamplingWithoutReplacement,
+    FlClientLevelAccountantFixedSamplingNoReplacement,
+    FlClientLevelAccountantPoissonSampling,
+    FlInstanceLevelAccountant,
+    MomentsAccountant,
+    PoissonSampling,
+)
+from fl4health_tpu.privacy.dpsgd import (
+    clip_per_example,
+    gaussian_noise_like,
+    noisy_clipped_mean_grads,
+    validate_dp_safe_model_state,
+)
+
+__all__ = [
+    "FixedSamplingWithoutReplacement",
+    "FlClientLevelAccountantFixedSamplingNoReplacement",
+    "FlClientLevelAccountantPoissonSampling",
+    "FlInstanceLevelAccountant",
+    "MomentsAccountant",
+    "PoissonSampling",
+    "clip_per_example",
+    "gaussian_noise_like",
+    "noisy_clipped_mean_grads",
+    "validate_dp_safe_model_state",
+]

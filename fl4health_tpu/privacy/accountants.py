@@ -1,0 +1,211 @@
+"""FL privacy accountants — parity with the reference's accountant stack.
+
+Reference: /root/reference/fl4health/privacy/moments_accountant.py:64
+(`MomentsAccountant` wrapping dp-accounting's RDP accountant, with
+`PoissonSampling` :30 / `FixedSamplingWithoutReplacement` :46 sampling
+strategies) and /root/reference/fl4health/privacy/fl_accountants.py:12
+(`FlInstanceLevelAccountant`, `FlClientLevelAccountantPoissonSampling` :127,
+`FlClientLevelAccountantFixedSamplingNoReplacement` :184).
+
+Here the RDP math is native (fl4health_tpu.privacy.rdp); the accountant layer
+keeps the reference's API shapes: sampling-strategy objects, single-event or
+trajectory composition, get_epsilon / get_delta.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from math import ceil
+from typing import Sequence
+
+import numpy as np
+
+from fl4health_tpu.privacy import rdp as rdp_math
+
+
+class SamplingStrategy(ABC):
+    """How examples/clients enter a batch/round; selects the RDP formula."""
+
+    @abstractmethod
+    def step_rdp(self, noise_multiplier: float, orders: Sequence[float]) -> np.ndarray:
+        ...
+
+
+class PoissonSampling(SamplingStrategy):
+    def __init__(self, sampling_ratio: float):
+        if not 0.0 <= sampling_ratio <= 1.0:
+            raise ValueError("sampling_ratio must be in [0, 1]")
+        self.sampling_ratio = sampling_ratio
+
+    def step_rdp(self, noise_multiplier, orders):
+        return rdp_math.rdp_poisson_subsampled_gaussian(
+            self.sampling_ratio, noise_multiplier, orders
+        )
+
+
+class FixedSamplingWithoutReplacement(SamplingStrategy):
+    def __init__(self, population_size: int, sample_size: int):
+        self.population_size = population_size
+        self.sample_size = sample_size
+
+    def step_rdp(self, noise_multiplier, orders):
+        return rdp_math.rdp_sampled_without_replacement_gaussian(
+            self.population_size, self.sample_size, noise_multiplier, orders
+        )
+
+
+class MomentsAccountant:
+    """Compose (sampling, sigma, steps) events; answer epsilon/delta queries.
+
+    Mirrors the reference MomentsAccountant (moments_accountant.py:64-200):
+    scalar args = one self-composed event; list args = a training trajectory
+    composed in sequence.
+    """
+
+    def __init__(self, moment_orders: Sequence[float] | None = None):
+        self.moment_orders = (
+            list(moment_orders) if moment_orders is not None
+            else rdp_math.default_orders()
+        )
+
+    def _total_rdp(
+        self,
+        sampling: SamplingStrategy | Sequence[SamplingStrategy],
+        noise_multiplier: float | Sequence[float],
+        updates: int | Sequence[int],
+    ) -> np.ndarray:
+        if isinstance(sampling, SamplingStrategy):
+            sampling = [sampling]
+        if isinstance(noise_multiplier, (int, float)):
+            noise_multiplier = [float(noise_multiplier)]
+        if isinstance(updates, int):
+            updates = [updates]
+        if not (len(sampling) == len(noise_multiplier) == len(updates)):
+            raise ValueError("trajectory lists must have equal length")
+        total = np.zeros(len(self.moment_orders), dtype=np.float64)
+        for strat, sigma, n in zip(sampling, noise_multiplier, updates):
+            total = total + n * strat.step_rdp(sigma, self.moment_orders)
+        return total
+
+    def get_epsilon(self, sampling, noise_multiplier, updates, delta: float) -> float:
+        rdp = self._total_rdp(sampling, noise_multiplier, updates)
+        return rdp_math.epsilon_from_rdp(self.moment_orders, rdp, delta)
+
+    def get_delta(self, sampling, noise_multiplier, updates, epsilon: float) -> float:
+        rdp = self._total_rdp(sampling, noise_multiplier, updates)
+        return rdp_math.delta_from_rdp(self.moment_orders, rdp, epsilon)
+
+
+class FlInstanceLevelAccountant:
+    """Instance-level DP across FL rounds (fl_accountants.py:12): Poisson
+    sampling at BOTH levels — effective per-step inclusion probability for a
+    data point on client c is client_sampling_rate * (batch_c / dataset_c);
+    total steps = rounds * epochs_per_round * batches_per_epoch_c; epsilon is
+    the max over clients."""
+
+    def __init__(
+        self,
+        client_sampling_rate: float,
+        noise_multiplier: float,
+        epochs_per_round: int,
+        client_batch_sizes: Sequence[int],
+        client_dataset_sizes: Sequence[int],
+        moment_orders: Sequence[float] | None = None,
+    ):
+        if len(client_batch_sizes) != len(client_dataset_sizes):
+            raise ValueError("batch/dataset size lists must align")
+        self.noise_multiplier = noise_multiplier
+        self.epochs_per_round = epochs_per_round
+        self.num_batches_per_client = [
+            ceil(d / b) for b, d in zip(client_batch_sizes, client_dataset_sizes)
+        ]
+        self.sampling_per_client = [
+            PoissonSampling(client_sampling_rate * b / d)
+            for b, d in zip(client_batch_sizes, client_dataset_sizes)
+        ]
+        self.accountant = MomentsAccountant(moment_orders)
+
+    def _per_client(self, fn, server_updates: int, value: float) -> float:
+        results = []
+        for n_batches, sampling in zip(
+            self.num_batches_per_client, self.sampling_per_client
+        ):
+            total = ceil(server_updates * self.epochs_per_round * n_batches)
+            results.append(fn(sampling, self.noise_multiplier, total, value))
+        return max(results)
+
+    def get_epsilon(self, server_updates: int, delta: float) -> float:
+        return self._per_client(self.accountant.get_epsilon, server_updates, delta)
+
+    def get_delta(self, server_updates: int, epsilon: float) -> float:
+        return self._per_client(self.accountant.get_delta, server_updates, epsilon)
+
+
+class ClientLevelAccountant(ABC):
+    """Client-level DP: each round is one subsampled-Gaussian query over the
+    client population (fl_accountants.py:98)."""
+
+    def __init__(
+        self,
+        noise_multiplier: float | Sequence[float],
+        moment_orders: Sequence[float] | None = None,
+    ):
+        self.noise_multiplier = noise_multiplier
+        self.accountant = MomentsAccountant(moment_orders)
+
+    @abstractmethod
+    def _sampling(self) -> SamplingStrategy | Sequence[SamplingStrategy]:
+        ...
+
+    def get_epsilon(self, server_updates: int | Sequence[int], delta: float) -> float:
+        return self.accountant.get_epsilon(
+            self._sampling(), self.noise_multiplier, server_updates, delta
+        )
+
+    def get_delta(self, server_updates: int | Sequence[int], epsilon: float) -> float:
+        return self.accountant.get_delta(
+            self._sampling(), self.noise_multiplier, server_updates, epsilon
+        )
+
+
+class FlClientLevelAccountantPoissonSampling(ClientLevelAccountant):
+    """fl_accountants.py:127 — clients join each round i.i.d. Bernoulli(q)."""
+
+    def __init__(
+        self,
+        client_sampling_rate: float | Sequence[float],
+        noise_multiplier: float | Sequence[float],
+        moment_orders: Sequence[float] | None = None,
+    ):
+        super().__init__(noise_multiplier, moment_orders)
+        self.client_sampling_rate = client_sampling_rate
+
+    def _sampling(self):
+        if isinstance(self.client_sampling_rate, (int, float)):
+            return PoissonSampling(float(self.client_sampling_rate))
+        return [PoissonSampling(float(q)) for q in self.client_sampling_rate]
+
+
+class FlClientLevelAccountantFixedSamplingNoReplacement(ClientLevelAccountant):
+    """fl_accountants.py:184 — exactly n of N clients sampled per round."""
+
+    def __init__(
+        self,
+        n_total_clients: int,
+        n_clients_sampled: int | Sequence[int],
+        noise_multiplier: float | Sequence[float],
+        moment_orders: Sequence[float] | None = None,
+    ):
+        super().__init__(noise_multiplier, moment_orders)
+        self.n_total_clients = n_total_clients
+        self.n_clients_sampled = n_clients_sampled
+
+    def _sampling(self):
+        if isinstance(self.n_clients_sampled, int):
+            return FixedSamplingWithoutReplacement(
+                self.n_total_clients, self.n_clients_sampled
+            )
+        return [
+            FixedSamplingWithoutReplacement(self.n_total_clients, n)
+            for n in self.n_clients_sampled
+        ]

@@ -1,0 +1,96 @@
+"""Per-example DP-SGD primitives — the Opacus replacement, TPU-native.
+
+Reference path: Opacus ``PrivacyEngine.make_private`` installs per-sample
+gradient hooks + flat clipping + Gaussian noise inside the optimizer step
+(/root/reference/fl4health/clients/instance_level_dp_client.py:85-114). On TPU
+the same computation is ``vmap(grad)`` over the batch, a per-example global-norm
+clip, a masked sum, and one Gaussian draw per parameter leaf — all fused by XLA
+into the training step (no hooks, no eager per-tensor work).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core.types import Params, PRNGKey
+
+
+def clip_per_example(per_example_grads: Params, bound: float) -> tuple[Params, jax.Array]:
+    """Flat-clip each example's gradient pytree to l2 norm <= bound.
+
+    ``per_example_grads`` has a leading [B] axis on every leaf. Returns the
+    clipped tree and the pre-clip per-example norms [B].
+    """
+    sq = sum(
+        jnp.sum(jnp.square(g).reshape(g.shape[0], -1), axis=-1)
+        for g in jax.tree_util.tree_leaves(per_example_grads)
+    )
+    norms = jnp.sqrt(jnp.maximum(sq, 0.0))
+    factor = jnp.minimum(1.0, bound / jnp.maximum(norms, 1e-12))
+
+    def scale(g):
+        return g * factor.reshape((-1,) + (1,) * (g.ndim - 1))
+
+    return jax.tree_util.tree_map(scale, per_example_grads), norms
+
+
+def gaussian_noise_like(rng: PRNGKey, tree: Params, stddev) -> Params:
+    """One independent Gaussian draw per leaf, std ``stddev``."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(rng, len(leaves))
+    noised = [
+        jax.random.normal(k, l.shape, jnp.float32).astype(l.dtype) * stddev
+        for k, l in zip(keys, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, noised)
+
+
+def noisy_clipped_mean_grads(
+    per_example_grads: Params,
+    example_mask: jax.Array,
+    rng: PRNGKey,
+    clipping_bound: float,
+    noise_multiplier: float,
+) -> Params:
+    """DP-SGD gradient: clip each example to C, masked-sum, add N(0, (sigma C)^2)
+    per coordinate, divide by the number of real examples (Opacus' mean-loss
+    semantics with the actual batch size)."""
+    clipped, _ = clip_per_example(per_example_grads, clipping_bound)
+    m = example_mask.astype(jnp.float32)
+
+    def masked_sum(g):
+        return jnp.sum(g * m.reshape((-1,) + (1,) * (g.ndim - 1)), axis=0)
+
+    summed = jax.tree_util.tree_map(masked_sum, clipped)
+    noise = gaussian_noise_like(rng, summed, noise_multiplier * clipping_bound)
+    denom = jnp.maximum(jnp.sum(m), 1.0)
+    return jax.tree_util.tree_map(lambda s, n: (s + n) / denom, summed, noise)
+
+
+def make_per_example_grads(
+    single_example_loss: Callable[[Params, Any], jax.Array],
+):
+    """vmap(grad) over a batch: single_example_loss(params, example) -> scalar."""
+    g = jax.grad(single_example_loss)
+    return jax.vmap(g, in_axes=(None, 0))
+
+
+def validate_dp_safe_model_state(model_state: Any) -> None:
+    """Per-example gradients require per-example independence: mutable batch
+    statistics (BatchNorm) mix examples and are rejected, mirroring the
+    reference's privacy_validate_and_fix_modules
+    (/root/reference/fl4health/utils/privacy_utilities.py:11) which swaps
+    BatchNorm for GroupNorm. In flax, build DP models with GroupNorm/LayerNorm.
+    """
+    if model_state:
+        bad = [k for k in model_state.keys() if k == "batch_stats"]
+        if bad:
+            raise ValueError(
+                "DP-SGD with per-example gradients is incompatible with "
+                "BatchNorm (mutable 'batch_stats' collection present). Use "
+                "GroupNorm/LayerNorm in DP models, as the reference's Opacus "
+                "module validator enforces."
+            )

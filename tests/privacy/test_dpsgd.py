@@ -1,0 +1,153 @@
+"""DP-SGD primitive + instance-level DP client tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.engine import Batch, ClientLogic
+from fl4health_tpu.clients.instance_level_dp import (
+    DpScaffoldClientLogic,
+    InstanceLevelDpClientLogic,
+)
+from fl4health_tpu.privacy import dpsgd
+from fl4health_tpu.models.cnn import MnistNet
+
+
+def _tree(batch=4):
+    return {
+        "w": jnp.arange(batch * 3, dtype=jnp.float32).reshape(batch, 3),
+        "b": jnp.ones((batch, 2), jnp.float32) * 10.0,
+    }
+
+
+def test_clip_per_example_norms_bounded():
+    grads = _tree()
+    clipped, norms = dpsgd.clip_per_example(grads, 1.0)
+    sq = sum(
+        jnp.sum(jnp.square(g).reshape(4, -1), axis=-1)
+        for g in jax.tree_util.tree_leaves(clipped)
+    )
+    assert np.all(np.sqrt(np.asarray(sq)) <= 1.0 + 1e-5)
+    # small gradients are untouched
+    tiny = jax.tree_util.tree_map(lambda g: g * 1e-6, grads)
+    same, _ = dpsgd.clip_per_example(tiny, 1.0)
+    np.testing.assert_allclose(
+        np.asarray(same["w"]), np.asarray(tiny["w"]), rtol=1e-6
+    )
+
+
+def test_noisy_clipped_mean_zero_noise_is_clipped_mean():
+    grads = _tree()
+    mask = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    out = dpsgd.noisy_clipped_mean_grads(
+        grads, mask, jax.random.PRNGKey(0), clipping_bound=1.0, noise_multiplier=0.0
+    )
+    clipped, _ = dpsgd.clip_per_example(grads, 1.0)
+    want = jax.tree_util.tree_map(
+        lambda g: jnp.sum(g[:3], axis=0) / 3.0, clipped
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_noise_scale_matches_sigma_c():
+    zeros = {"w": jnp.zeros((8, 1000), jnp.float32)}
+    mask = jnp.ones((8,))
+    sigma, c = 2.0, 3.0
+    out = dpsgd.noisy_clipped_mean_grads(
+        zeros, mask, jax.random.PRNGKey(1), clipping_bound=c, noise_multiplier=sigma
+    )
+    # std of leaf should be sigma*C/B
+    std = float(jnp.std(out["w"]))
+    assert std == pytest.approx(sigma * c / 8.0, rel=0.1)
+
+
+def _dp_logic(**kw):
+    return InstanceLevelDpClientLogic(
+        engine.from_flax(MnistNet(hidden=16)),
+        engine.masked_cross_entropy,
+        **kw,
+    )
+
+
+def _batch(rng, b=8):
+    x = jax.random.normal(rng, (b, 28, 28, 1))
+    y = jnp.arange(b) % 10
+    return Batch(
+        x=x, y=y, example_mask=jnp.ones((b,)), step_mask=jnp.ones(())
+    )
+
+
+def test_instance_level_dp_step_runs_and_updates():
+    logic = _dp_logic(clipping_bound=1.0, noise_multiplier=0.5)
+    tx = optax.sgd(0.1)
+    state = engine.create_train_state(logic, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    step = jax.jit(engine.make_train_step(logic, tx))
+    new_state, out = step(state, None, _batch(jax.random.PRNGKey(1)))
+    assert np.isfinite(float(out.losses["backward"]))
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), state.params, new_state.params
+    )
+    assert max(jax.tree_util.tree_leaves(moved)) > 0
+
+
+def test_dp_zero_noise_matches_clipped_nondp_direction():
+    """With sigma=0 and a huge bound, DP grads equal the batch-mean gradient."""
+    logic = _dp_logic(clipping_bound=1e9, noise_multiplier=0.0)
+    plain = ClientLogic(engine.from_flax(MnistNet(hidden=16)), engine.masked_cross_entropy)
+    tx = optax.sgd(0.1)
+    state = engine.create_train_state(logic, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    batch = _batch(jax.random.PRNGKey(1))
+    (_, _), dp_grads = logic.value_and_grads(state, None, batch, jax.random.PRNGKey(2))
+    (_, _), ref_grads = plain.value_and_grads(state, None, batch, jax.random.PRNGKey(2))
+    for a, b in zip(jax.tree_util.tree_leaves(dp_grads), jax.tree_util.tree_leaves(ref_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_dp_scaffold_combo_trains():
+    logic = DpScaffoldClientLogic(
+        engine.from_flax(MnistNet(hidden=16)),
+        engine.masked_cross_entropy,
+        learning_rate=0.05,
+        clipping_bound=1.0,
+        noise_multiplier=0.1,
+    )
+    tx = optax.sgd(0.05)
+    state = engine.create_train_state(logic, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    from fl4health_tpu.clients.scaffold import ScaffoldContext
+    ctx = ScaffoldContext(
+        initial_params=state.params,
+        server_variates=jax.tree_util.tree_map(jnp.zeros_like, state.params),
+    )
+    step = jax.jit(engine.make_train_step(logic, tx))
+    st, out = step(state, ctx, _batch(jax.random.PRNGKey(3)))
+    st = logic.finalize_round(st, ctx, jnp.asarray(1.0))
+    # variates updated away from zero
+    delta_norm = sum(
+        float(jnp.sum(jnp.abs(x))) for x in jax.tree_util.tree_leaves(st.extra.delta)
+    )
+    assert delta_norm > 0
+
+
+def test_batch_stats_rejected():
+    import flax.linen as nn
+
+    class BnNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(8)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(10)(x)
+
+    logic = InstanceLevelDpClientLogic(
+        engine.from_flax(BnNet()), engine.masked_cross_entropy,
+        clipping_bound=1.0, noise_multiplier=0.5,
+    )
+    tx = optax.sgd(0.1)
+    state = engine.create_train_state(logic, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    with pytest.raises(ValueError, match="BatchNorm"):
+        logic.value_and_grads(state, None, _batch(jax.random.PRNGKey(1)), jax.random.PRNGKey(2))
