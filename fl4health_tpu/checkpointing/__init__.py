@@ -1,0 +1,37 @@
+"""Checkpointing subsystem — model artifacts + resumable run state.
+
+Two concerns, as in the reference (SURVEY.md §2.9): (1) model-artifact
+checkpointing with latest/best policies and pre/post-aggregation modes
+(checkpointing.checkpointer); (2) preemption-resilient state checkpointing
+with typed snapshotters and per-round resume (checkpointing.state).
+"""
+
+from fl4health_tpu.checkpointing.checkpointer import (
+    BestLossCheckpointer,
+    BestMetricCheckpointer,
+    CheckpointMode,
+    FunctionCheckpointer,
+    LatestCheckpointer,
+    ParamsCheckpointer,
+    load_params,
+    save_params,
+)
+from fl4health_tpu.checkpointing.state import (
+    SimulationStateCheckpointer,
+    Snapshotter,
+    StateCheckpointer,
+)
+
+__all__ = [
+    "BestLossCheckpointer",
+    "BestMetricCheckpointer",
+    "CheckpointMode",
+    "FunctionCheckpointer",
+    "LatestCheckpointer",
+    "ParamsCheckpointer",
+    "SimulationStateCheckpointer",
+    "Snapshotter",
+    "StateCheckpointer",
+    "load_params",
+    "save_params",
+]

@@ -1,0 +1,119 @@
+"""Model-artifact checkpointing — latest/best-loss/best-metric policies.
+
+Parity: /root/reference/fl4health/checkpointing/checkpointer.py
+(`TorchModuleCheckpointer` :15, `FunctionTorchModuleCheckpointer` :62,
+`LatestTorchModuleCheckpointer` :162, `BestLossTorchModuleCheckpointer` :204,
+`BestMetricTorchModuleCheckpointer` :267) and the PRE/POST-aggregation modes
+of /root/reference/fl4health/checkpointing/client_module.py:23-28.
+
+TPU-native: a "model" is a params pytree; artifacts are flax msgpack bytes
+(`flax.serialization.to_bytes`). Loading requires a template pytree of the
+same structure — the natural JAX idiom (orbax does the same via restore args).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Mapping
+
+import numpy as np
+from flax import serialization
+
+from fl4health_tpu.core.types import Params
+
+
+class CheckpointMode(enum.Enum):
+    """When a client-side checkpointer fires (client_module.py:23-28)."""
+
+    PRE_AGGREGATION = "pre_aggregation"
+    POST_AGGREGATION = "post_aggregation"
+
+
+def save_params(path: str, params: Params) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(params))
+
+
+def load_params(path: str, template: Params) -> Params:
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())
+
+
+class ParamsCheckpointer(ABC):
+    """Decides per call whether the given params are worth persisting."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @abstractmethod
+    def maybe_checkpoint(
+        self, params: Params, loss: float | None, metrics: Mapping[str, Any]
+    ) -> bool:
+        ...
+
+    def load(self, template: Params) -> Params:
+        return load_params(self.path, template)
+
+
+class FunctionCheckpointer(ParamsCheckpointer):
+    """Score-function policy (FunctionTorchModuleCheckpointer :62): keep the
+    checkpoint whenever score improves (maximize=True: larger is better)."""
+
+    def __init__(
+        self,
+        path: str,
+        score_fn: Callable[[float | None, Mapping[str, Any]], float],
+        maximize: bool = False,
+        name: str | None = None,
+    ):
+        super().__init__(path)
+        self.score_fn = score_fn
+        self.maximize = maximize
+        self.name = name or score_fn.__name__
+        self.best_score: float | None = None
+
+    def maybe_checkpoint(self, params, loss, metrics) -> bool:
+        score = float(self.score_fn(loss, metrics))
+        if np.isnan(score):
+            return False
+        improved = (
+            self.best_score is None
+            or (score > self.best_score if self.maximize else score < self.best_score)
+        )
+        if improved:
+            self.best_score = score
+            save_params(self.path, params)
+        return improved
+
+
+class LatestCheckpointer(ParamsCheckpointer):
+    """Unconditional overwrite (LatestTorchModuleCheckpointer :162)."""
+
+    def maybe_checkpoint(self, params, loss, metrics) -> bool:
+        save_params(self.path, params)
+        return True
+
+
+class BestLossCheckpointer(FunctionCheckpointer):
+    """Keep the lowest loss seen (BestLossTorchModuleCheckpointer :204)."""
+
+    def __init__(self, path: str):
+        super().__init__(path, lambda loss, _m: float("inf") if loss is None else loss,
+                         maximize=False, name="loss")
+
+
+class BestMetricCheckpointer(FunctionCheckpointer):
+    """Track one metric key (BestMetricTorchModuleCheckpointer :267)."""
+
+    def __init__(self, path: str, metric_key: str, maximize: bool = True):
+        def score(_loss, metrics):
+            if metric_key not in metrics:
+                raise KeyError(
+                    f"metric '{metric_key}' not present in {sorted(metrics)}"
+                )
+            return float(metrics[metric_key])
+
+        super().__init__(path, score, maximize=maximize, name=metric_key)

@@ -370,6 +370,108 @@ def make_local_eval(
     return evaluate
 
 
+@dataclasses.dataclass(frozen=True)
+class EarlyStoppingConfig:
+    """Reference EarlyStopper (utils/early_stopper.py:14): snapshot the best
+    state every ``interval_steps`` local steps; stop when validation hasn't
+    improved for ``patience`` consecutive checks; restore the best snapshot."""
+
+    interval_steps: int
+    patience: int
+
+
+def make_local_train_with_early_stopping(
+    logic: ClientLogic,
+    tx: optax.GradientTransformation,
+    metric_manager: MetricManager,
+    config: EarlyStoppingConfig,
+    loss_keys: tuple[str, ...] = ("backward",),
+):
+    """Early-stopped local training as ONE compiled program.
+
+    The step stream is chunked into [n_chunks, interval_steps]; after each
+    chunk the client validates, tracks the best params snapshot in the scan
+    carry, and raises a ``stopped`` flag once patience runs out — subsequent
+    chunks have their step_mask zeroed, making them no-ops (the TPU-native
+    replacement for breaking out of the reference's Python batch loop,
+    basic_client.py:676,755).
+
+    Returns train(state, ctx, batches, val_batches) with the same outputs as
+    ``make_local_train``.
+    """
+    step_fn = make_train_step(logic, tx)
+    evaluate = make_local_eval(logic, metric_manager)
+    meter_proto = LossMeter.create(loss_keys)
+    interval = config.interval_steps
+
+    def train(state: TrainState, ctx: Any, batches: Batch, val_batches: Batch):
+        total = batches.step_mask.shape[0]
+        n_chunks = -(-total // interval)
+        pad = n_chunks * interval - total
+        if pad:
+            batches = jax.tree_util.tree_map(
+                lambda x: jnp.concatenate(
+                    [x, jnp.zeros((pad, *x.shape[1:]), x.dtype)]
+                ),
+                batches,
+            )
+        chunked = jax.tree_util.tree_map(
+            lambda x: x.reshape((n_chunks, interval) + x.shape[1:]), batches
+        )
+
+        def chunk_body(carry, chunk: Batch):
+            st, meter, mstate, best_state, best_score, bad, stopped, executed = carry
+            chunk = chunk.replace(step_mask=chunk.step_mask * (1.0 - stopped))
+
+            def body(c, b):
+                st2, meter2, ms2 = c
+                st2, out = step_fn(st2, ctx, b)
+                meter2 = meter2.update(out.losses, weight=out.step_mask)
+                ms2 = metric_manager.update(
+                    ms2, out.preds, out.targets, out.example_mask
+                )
+                return (st2, meter2, ms2), None
+
+            (st, meter, mstate), _ = jax.lax.scan(body, (st, meter, mstate), chunk)
+            executed = executed + jnp.sum(chunk.step_mask)
+
+            val_losses, _ = evaluate(st, ctx, val_batches)
+            score = val_losses["checkpoint"]
+            live = stopped < 0.5
+            improved = (score < best_score) & live
+            best_state = _mask_tree(st, best_state, improved)
+            best_score = jnp.where(improved, score, best_score)
+            bad = jnp.where(live, jnp.where(improved, 0, bad + 1), bad)
+            stopped = jnp.maximum(
+                stopped, (bad >= config.patience).astype(jnp.float32)
+            )
+            return (st, meter, mstate, best_state, best_score, bad, stopped, executed), score
+
+        init = (
+            state,
+            meter_proto,
+            metric_manager.init(),
+            state,
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+        )
+        (final, meter, mstate, best_state, _, _, _, executed), _ = jax.lax.scan(
+            chunk_body, init, chunked
+        )
+        # restore the FULL best snapshot — params, optimizer, model_state and
+        # algorithm extra move together (the reference snapshots model AND
+        # optimizer state, early_stopper.py:46,90); keep the advanced RNG so
+        # randomness is never replayed. finalize_round then runs on the
+        # restored state, matching update_after_train-after-restore ordering.
+        state = best_state.replace(rng=final.rng)
+        state = logic.finalize_round(state, ctx, executed)
+        return state, meter.compute(), metric_manager.compute(mstate), executed
+
+    return train
+
+
 # ---------------------------------------------------------------------------
 # Host-side batching: DataLoader equivalent producing static-shaped stacks
 # ---------------------------------------------------------------------------

@@ -56,13 +56,17 @@ class InstanceLevelDpMixin:
                 params, state.model_state, b1, grad_rng, train=True,
                 extra=state.extra, ctx=ctx,
             )
-            loss, _ = self.training_loss(preds, features, b1, params, state, ctx)
-            return loss, preds
+            loss, additional = self.training_loss(
+                preds, features, b1, params, state, ctx
+            )
+            return loss, (preds, additional)
 
         grad_fn = jax.vmap(
             jax.value_and_grad(single_loss, has_aux=True), in_axes=(None, 0, 0)
         )
-        (per_losses, per_preds), per_grads = grad_fn(state.params, batch.x, batch.y)
+        (per_losses, (per_preds, per_additional)), per_grads = grad_fn(
+            state.params, batch.x, batch.y
+        )
 
         grads = dpsgd.noisy_clipped_mean_grads(
             per_grads, batch.example_mask, noise_rng,
@@ -70,10 +74,16 @@ class InstanceLevelDpMixin:
         )
 
         m = batch.example_mask.astype(jnp.float32)
-        backward = jnp.sum(per_losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+        denom = jnp.maximum(jnp.sum(m), 1.0)
+        backward = jnp.sum(per_losses * m) / denom
+        # composed logics' auxiliary losses (extra_loss_keys) are per-example
+        # scalars after vmap: masked-average them back to batch scalars
+        additional = jax.tree_util.tree_map(
+            lambda v: jnp.sum(v * m) / denom, per_additional
+        )
         # per-example predict ran on singleton batches: squeeze back to [B,...]
         preds = jax.tree_util.tree_map(lambda p: p[:, 0], per_preds)
-        return (backward, (preds, {}, state.model_state)), grads
+        return (backward, (preds, additional, state.model_state)), grads
 
 
 class InstanceLevelDpClientLogic(InstanceLevelDpMixin, ClientLogic):

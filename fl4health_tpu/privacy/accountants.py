@@ -76,10 +76,18 @@ class MomentsAccountant:
     ) -> np.ndarray:
         if isinstance(sampling, SamplingStrategy):
             sampling = [sampling]
+        n = max(
+            len(sampling),
+            len(noise_multiplier) if not isinstance(noise_multiplier, (int, float)) else 1,
+            len(updates) if not isinstance(updates, int) else 1,
+        )
+        # scalars broadcast to the trajectory length
         if isinstance(noise_multiplier, (int, float)):
-            noise_multiplier = [float(noise_multiplier)]
+            noise_multiplier = [float(noise_multiplier)] * n
         if isinstance(updates, int):
-            updates = [updates]
+            updates = [updates] * n
+        if len(sampling) == 1 and n > 1:
+            sampling = list(sampling) * n
         if not (len(sampling) == len(noise_multiplier) == len(updates)):
             raise ValueError("trajectory lists must have equal length")
         total = np.zeros(len(self.moment_orders), dtype=np.float64)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from fl4health_tpu.checkpointing.checkpointer import CheckpointMode
 from fl4health_tpu.clients import engine
 from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
 from fl4health_tpu.core import pytree as ptu
@@ -83,6 +84,9 @@ class FederatedSimulation:
         extra_loss_keys: tuple[str, ...] = (),
         eval_loss_keys: tuple[str, ...] = (),
         reporters: Sequence[Any] = (),
+        model_checkpointers: Sequence[tuple[Any, Any]] = (),
+        state_checkpointer: Any = None,
+        early_stopping: engine.EarlyStoppingConfig | None = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -99,6 +103,12 @@ class FederatedSimulation:
         self.exchanger = exchanger or FullExchanger()
         self.client_manager = client_manager or FullParticipationManager(self.n_clients)
         self.reporters = list(reporters)
+        # (CheckpointMode, ParamsCheckpointer) pairs — PRE_AGGREGATION fires on
+        # the client-stacked post-fit params, POST_AGGREGATION on the
+        # aggregated global model (client_module.py:23-28 semantics).
+        self.model_checkpointers = list(model_checkpointers)
+        self.state_checkpointer = state_checkpointer
+        self.early_stopping = early_stopping
         self.rng = jax.random.PRNGKey(seed)
         self.sample_counts = jnp.asarray(
             [d.n_train for d in self.datasets], jnp.float32
@@ -125,9 +135,15 @@ class FederatedSimulation:
     # ------------------------------------------------------------------
     def _build_compiled(self):
         logic, tx, strategy, exchanger = self.logic, self.tx, self.strategy, self.exchanger
-        train = engine.make_local_train(
-            logic, tx, self.metrics, ("backward", *self._extra_keys())
-        )
+        loss_keys = ("backward", *self._extra_keys())
+        if self.early_stopping is not None:
+            es_train = engine.make_local_train_with_early_stopping(
+                logic, tx, self.metrics, self.early_stopping, loss_keys
+            )
+            train = None
+        else:
+            es_train = None
+            train = engine.make_local_train(logic, tx, self.metrics, loss_keys)
         evaluate = engine.make_local_eval(logic, self.metrics, ("checkpoint", *self._eval_keys()))
 
         evaluate_after_fit = getattr(strategy, "evaluate_after_fit", False)
@@ -139,7 +155,12 @@ class FederatedSimulation:
             pulled = exchanger.pull(payload_params, state.params)
             state = state.replace(params=pulled)
             ctx = logic.init_round_context(state, payload)
-            new_state, losses, metrics, n_steps = train(state, ctx, batches)
+            if es_train is not None:
+                new_state, losses, metrics, n_steps = es_train(
+                    state, ctx, batches, val_batches
+                )
+            else:
+                new_state, losses, metrics, n_steps = train(state, ctx, batches)
             if evaluate_after_fit:
                 # pre-aggregation local validation (FedDG-GA's
                 # evaluate_after_fit=True requirement, feddg_ga.py:205-210)
@@ -243,7 +264,11 @@ class FederatedSimulation:
             r.report({"host_type": "server", "fit_start": time.time(),
                       "num_rounds": n_rounds})
         val_batches, val_counts = self._val_batches()
-        for rnd in range(1, n_rounds + 1):
+        start_round = 1
+        if self.state_checkpointer is not None and self.state_checkpointer.exists():
+            # fit_with_per_round_checkpointing resume (base_server.py:143-229)
+            start_round = self.state_checkpointer.load_simulation(self)
+        for rnd in range(start_round, n_rounds + 1):
             t0 = time.time()
             mask = self.client_manager.sample(
                 jax.random.fold_in(self.rng, 2000 + rnd), rnd
@@ -255,8 +280,15 @@ class FederatedSimulation:
                     jnp.asarray(rnd, jnp.int32), val_batches,
                 )
             )
-            fit_losses = jax.device_get(fit_losses)
-            fit_metrics = jax.device_get(fit_metrics)
+            fit_losses = {k: float(v) for k, v in jax.device_get(fit_losses).items()}
+            fit_metrics = {k: float(v) for k, v in jax.device_get(fit_metrics).items()}
+            for mode, ckpt in self.model_checkpointers:
+                if mode == CheckpointMode.PRE_AGGREGATION:
+                    ckpt.maybe_checkpoint(
+                        self.client_states.params,
+                        fit_losses.get("backward", float("nan")),
+                        fit_metrics,
+                    )
             t1 = time.time()
             (
                 self.client_states,
@@ -270,8 +302,15 @@ class FederatedSimulation:
             self.server_state = self.strategy.update_after_eval(
                 self.server_state, per_client_eval_losses, per_client_eval_metrics, mask
             )
-            eval_losses = jax.device_get(eval_losses)
-            eval_metrics = jax.device_get(eval_metrics)
+            eval_losses = {k: float(v) for k, v in jax.device_get(eval_losses).items()}
+            eval_metrics = {k: float(v) for k, v in jax.device_get(eval_metrics).items()}
+            for mode, ckpt in self.model_checkpointers:
+                if mode == CheckpointMode.POST_AGGREGATION:
+                    ckpt.maybe_checkpoint(
+                        self.global_params,
+                        eval_losses.get("checkpoint", float("nan")),
+                        eval_metrics,
+                    )
             t2 = time.time()
             rec = RoundRecord(
                 round=rnd,
@@ -283,6 +322,9 @@ class FederatedSimulation:
                 eval_elapsed_s=t2 - t1,
             )
             self.history.append(rec)
+            if self.state_checkpointer is not None:
+                # per-round durable state (_save_server_state, base_server.py:420)
+                self.state_checkpointer.save_simulation(self, rnd)
             for rep in self.reporters:
                 rep.report(
                     {

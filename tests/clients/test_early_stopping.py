@@ -1,0 +1,117 @@
+"""Compiled early-stopper tests (reference utils/early_stopper.py:14)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.engine import Batch, ClientLogic, EarlyStoppingConfig
+from fl4health_tpu.metrics.base import MetricManager
+
+
+class _LinearModel:
+    """y = w*x with scalar w; lets us force train/val objectives to conflict."""
+
+    def init(self, rng, sample_x):
+        return {"w": jnp.zeros(())}, {}
+
+    def apply(self, params, model_state, x, train=True, rng=None):
+        return ({"prediction": params["w"] * x}, {}), model_state
+
+
+def _mse(preds, targets, mask):
+    per = jnp.square(preds - targets)
+    m = mask.astype(jnp.float32)
+    return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def _stack(x, y, steps):
+    b = x.shape[0] // steps
+    return Batch(
+        x=x.reshape(steps, b),
+        y=y.reshape(steps, b),
+        example_mask=jnp.ones((steps, b)),
+        step_mask=jnp.ones((steps,)),
+    )
+
+
+def _setup():
+    model = engine.ModelDef(init=_LinearModel().init, apply=_LinearModel().apply)
+    logic = ClientLogic(model, _mse)
+    tx = optax.sgd(0.1)
+    state = engine.create_train_state(logic, tx, jax.random.PRNGKey(0), jnp.ones((1,)))
+    return logic, tx, state
+
+
+def test_early_stop_restores_best_and_halts():
+    # Train targets push w -> +1; val targets want w = 0. Chunk 1 always
+    # "improves" (best_score starts at inf); every later chunk worsens val, so
+    # with patience=2 training halts after 3 chunks and w reverts to the
+    # chunk-1 snapshot.
+    logic, tx, state = _setup()
+    train_batches = _stack(jnp.ones((40,)), jnp.ones((40,)), steps=10)
+    val_batches = _stack(jnp.ones((8,)), jnp.zeros((8,)), steps=2)
+
+    cfg = EarlyStoppingConfig(interval_steps=2, patience=2)
+    train = engine.make_local_train_with_early_stopping(
+        logic, tx, MetricManager(()), cfg
+    )
+    new_state, losses, _, executed = jax.jit(train)(
+        state, None, train_batches, val_batches
+    )
+    # halted after (1 + patience) * interval steps, not all 10
+    assert float(executed) == cfg.interval_steps * 3
+    # restored snapshot is the w after chunk 1, not the final (larger) w
+    assert 0.0 < float(new_state.params["w"]) < 0.9
+
+
+def test_no_stop_when_patience_large_matches_plain_train():
+    logic, tx, state = _setup()
+    x = jnp.linspace(-1, 1, 40)
+    y = 0.5 * x
+    train_batches = _stack(x, y, steps=10)
+    val_batches = _stack(x[:8], y[:8], steps=2)
+
+    plain = engine.make_local_train(logic, tx, MetricManager(()))
+    s_plain, _, _, n_plain = jax.jit(plain)(state, None, train_batches)
+
+    cfg = EarlyStoppingConfig(interval_steps=2, patience=100)
+    es = engine.make_local_train_with_early_stopping(logic, tx, MetricManager(()), cfg)
+    s_es, _, _, n_es = jax.jit(es)(state, None, train_batches, val_batches)
+
+    assert float(n_plain) == float(n_es) == 10
+    # val improves monotonically toward w=0.5, so the best snapshot IS the
+    # final state and both paths agree
+    np.testing.assert_allclose(
+        float(s_es.params["w"]), float(s_plain.params["w"]), atol=1e-6
+    )
+
+
+def test_simulation_accepts_early_stopping():
+    from fl4health_tpu.datasets.synthetic import synthetic_classification
+    from fl4health_tpu.metrics import efficient
+    from fl4health_tpu.models.cnn import MnistNet
+    from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+    from fl4health_tpu.strategies.fedavg import FedAvg
+
+    datasets = []
+    for i in range(2):
+        x, y = synthetic_classification(jax.random.PRNGKey(i), 24, (28, 28, 1), 10)
+        datasets.append(ClientDataset(x[:16], y[:16], x[16:], y[16:]))
+    sim = FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(MnistNet(hidden=16)), engine.masked_cross_entropy
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=datasets,
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=4,
+        seed=0,
+        early_stopping=EarlyStoppingConfig(interval_steps=2, patience=5),
+    )
+    recs = sim.fit(2)
+    assert len(recs) == 2
+    assert np.isfinite(recs[-1].eval_losses["checkpoint"])

@@ -101,5 +101,17 @@ def test_client_level_accountants_run():
     ep = poisson.get_epsilon(20, 1e-5)
     es = swor.get_epsilon(20, 1e-5)
     assert ep > 0 and es > 0
-    # SWOR bound is conservative (halved sigma) => at least the Poisson value
+    # SWOR bound is conservative (no amplification) => at least the Poisson value
     assert es >= ep * 0.9
+
+
+def test_scalar_noise_broadcasts_over_trajectory():
+    acc = FlClientLevelAccountantPoissonSampling([0.1, 0.2], 1.5)
+    eps = acc.get_epsilon([100, 200], 1e-5)
+    assert eps > 0
+
+
+def test_swor_bound_is_amplification_free_gaussian():
+    # sound bound: RDP = 2*alpha/sigma^2, independent of n/N
+    got = rdp_math.rdp_sampled_without_replacement_gaussian(100, 5, 2.0, [8.0])
+    assert got[0] == pytest.approx(2 * 8.0 / 4.0)

@@ -151,3 +151,32 @@ def test_batch_stats_rejected():
     state = engine.create_train_state(logic, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
     with pytest.raises(ValueError, match="BatchNorm"):
         logic.value_and_grads(state, None, _batch(jax.random.PRNGKey(1)), jax.random.PRNGKey(2))
+
+
+def test_dp_mixin_composes_with_extra_loss_logic():
+    """DP + FedProx: the mixin must surface the composed logic's additional
+    losses (extra_loss_keys) as masked means, not drop them."""
+    from fl4health_tpu.clients.fedprox import FedProxClientLogic, ProxContext
+    from fl4health_tpu.clients.instance_level_dp import InstanceLevelDpMixin
+
+    class DpFedProx(InstanceLevelDpMixin, FedProxClientLogic):
+        pass
+
+    logic = DpFedProx(
+        engine.from_flax(MnistNet(hidden=16)), engine.masked_cross_entropy,
+        clipping_bound=1.0, noise_multiplier=0.0,
+    )
+    tx = optax.sgd(0.05)
+    state = engine.create_train_state(
+        logic, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+    )
+    ctx = logic.init_round_context(
+        state, type("P", (), {"params": state.params,
+                              "drift_penalty_weight": jnp.asarray(0.1)})()
+    )
+    batch = _batch(jax.random.PRNGKey(1))
+    (backward, (preds, additional, _)), grads = logic.value_and_grads(
+        state, ctx, batch, jax.random.PRNGKey(2)
+    )
+    assert set(additional.keys()) >= {"vanilla", "penalty"}
+    assert np.isfinite(float(additional["vanilla"]))

@@ -1,0 +1,41 @@
+"""Reporter tests (reference tests/reporting/)."""
+
+import json
+
+from fl4health_tpu.reporting.base import JsonReporter, ReportsManager
+
+
+def test_json_reporter_nested_rounds(tmp_path):
+    rep = JsonReporter(output_folder=str(tmp_path), run_id="run1")
+    rep.report({"host_type": "server"})
+    rep.report({"fit_losses": {"backward": 1.5}}, round=1)
+    rep.report({"step_loss": 0.25}, round=1, step=3)
+    rep.report({"fit_losses": {"backward": 1.2}}, round=2)
+    path = rep.dump()
+    with open(path) as f:
+        data = json.load(f)
+    assert data["host_type"] == "server"
+    assert data["rounds"]["1"]["fit_losses"]["backward"] == 1.5
+    assert data["rounds"]["1"]["steps"]["3"]["step_loss"] == 0.25
+    assert data["rounds"]["2"]["fit_losses"]["backward"] == 1.2
+
+
+def test_reports_manager_fans_out(tmp_path):
+    reps = [
+        JsonReporter(output_folder=str(tmp_path), run_id="a"),
+        JsonReporter(output_folder=str(tmp_path), run_id="b"),
+    ]
+    mgr = ReportsManager(reps)
+    mgr.report({"x": 1}, round=1)
+    mgr.shutdown()
+    for rid in ("a", "b"):
+        with open(tmp_path / f"{rid}.json") as f:
+            assert json.load(f)["rounds"]["1"]["x"] == 1
+
+
+def test_jsonify_coerces_arrays(tmp_path):
+    import jax.numpy as jnp
+
+    rep = JsonReporter(output_folder=str(tmp_path), run_id="c")
+    rep.report({"loss": jnp.asarray(2.5)}, round=1)
+    assert rep.data["rounds"]["1"]["loss"] == 2.5
