@@ -179,6 +179,14 @@ class ClientLogic:
         """(basic_client.py:1294) — e.g. SCAFFOLD variate correction."""
         return grads
 
+    def update_before_step(self, state: TrainState, ctx: Any, batch: Batch) -> TrainState:
+        """(basic_client.py:1260 update_before_step) — runs before the
+        gradient step; e.g. DeepMMD kernel training on the incoming batch.
+        The engine masks this hook's state changes on padding steps
+        (``batch.step_mask == 0``), but implementations should still gate
+        expensive work on the mask to avoid wasted compute."""
+        return state
+
     def value_and_grads(self, state: TrainState, ctx: Any, batch: Batch, step_rng: PRNGKey):
         """Compute ((backward, (preds, additional, new_model_state)), grads).
 
@@ -271,6 +279,9 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
     """Returns step(state, ctx, batch) -> (state, StepOutput) — jit/scan-safe."""
 
     def step(state: TrainState, ctx: Any, batch: Batch):
+        state = _mask_tree(
+            logic.update_before_step(state, ctx, batch), state, batch.step_mask
+        )
         rng, step_rng = jax.random.split(state.rng)
         (backward, (preds, additional, new_model_state)), grads = logic.value_and_grads(
             state, ctx, batch, step_rng

@@ -1,0 +1,491 @@
+"""MMD-regularized personalization clients: Ditto/MR-MTL + MK-MMD or DeepMMD.
+
+Parity targets:
+- DittoMkMmdClient (/root/reference/fl4health/clients/mkmmd_clients/
+  ditto_mkmmd_client.py:22): Ditto, plus an MK-MMD penalty pulling the
+  personal model's intermediate features toward the features the *initial*
+  (received, frozen) global model produces on the same batch. Kernel weights
+  (betas) re-optimized every ``beta_global_update_interval`` steps; -1 means
+  per-batch re-optimization inside the loss, 0 means never
+  (ditto_mkmmd_client.py:94-101,340-344). Optional feature-l2-norm penalty
+  (ditto_mkmmd_client.py:354-357).
+- MrMtlMkMmdClient (mkmmd_clients/mr_mtl_mkmmd_client.py): same penalty
+  between the personal model and the frozen round aggregate.
+- DittoDeepMmdClient / MrMtlDeepMmdClient (deep_mmd_clients/*.py): the
+  penalty is a learned deep-kernel MMD; ``mmd_kernel_train_interval``
+  controls kernel training (-1 per batch before the loss, 0 never, N every
+  N steps — ditto_deep_mmd_client.py:135-159).
+
+TPU-native design:
+- The reference extracts features with forward hooks into host-side buffers
+  (model_bases/feature_extractor_buffer.py) and re-runs train batches to
+  refresh them before each beta optimization. Here features are the model's
+  returned feature dict (already part of the predict contract), the
+  frozen-model features come from one extra compiled forward with the frozen
+  params, and beta/kernel refreshes use the current step's batch inside
+  ``lax.cond`` — streaming estimates instead of full-dataset host buffers, so
+  the whole round stays one XLA program.
+- The beta QP is solved on device (losses/mmd.py optimize_betas).
+- All MMD statistics respect ``batch.example_mask`` so zero-padded rows of
+  ragged batches never contribute (the torch reference always sees
+  true-sized batches).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.ditto import DittoClientLogic, DittoContext, MrMtlClientLogic, MrMtlContext
+from fl4health_tpu.clients.engine import Batch, ModelDef, TrainState
+from fl4health_tpu.losses.mmd import DeepMmd, default_gammas, mkmmd, optimize_betas, uniform_betas
+
+
+def _flat(x: jax.Array) -> jax.Array:
+    return x.reshape(x.shape[0], -1)
+
+
+def _branch_state(model_state: Any, branch: str) -> Any:
+    """Slice a TwinModel's mutable collections down to one branch so the
+    single-branch feature model can consume them (e.g. batch_stats)."""
+    if not model_state:
+        return {}
+    return {coll: tree[branch] for coll, tree in model_state.items() if branch in tree}
+
+
+@struct.dataclass
+class DittoMmdContext(DittoContext):
+    round_start_step: Any = 0
+    # Round-start snapshot of mutable collections (batch_stats) so the frozen
+    # target model is TRULY frozen (reference clone_and_freeze_model freezes
+    # params AND buffers, ditto_mkmmd_client.py update_before_train).
+    initial_model_state: Any = None
+
+
+@struct.dataclass
+class MrMtlMmdContext(MrMtlContext):
+    round_start_step: Any = 0
+    initial_model_state: Any = None
+
+
+class _MkMmdMixin:
+    """Shared MK-MMD machinery: betas in persistent extra state, interval
+    refresh, per-layer penalty sum, optional feature-l2 penalty."""
+
+    def _init_mkmmd(self, feature_keys: Sequence[str], mkmmd_weight: float,
+                    beta_interval: int, gammas, normalize_features: bool,
+                    feature_l2_norm_weight: float):
+        self.feature_keys = tuple(feature_keys)
+        self.mkmmd_weight = mkmmd_weight
+        self.beta_interval = beta_interval
+        self.gammas = default_gammas() if gammas is None else gammas
+        self.normalize_features = normalize_features
+        self.feature_l2_norm_weight = feature_l2_norm_weight
+        if beta_interval < -1:
+            raise ValueError("beta_global_update_interval must be -1, 0 or positive")
+
+    def _init_betas(self) -> dict:
+        k = self.gammas.shape[0]
+        return {key: uniform_betas(k) for key in self.feature_keys}
+
+    def _mkmmd_penalty(self, local_feats: Mapping[str, jax.Array],
+                       target_feats: Mapping[str, jax.Array],
+                       betas: Mapping[str, jax.Array], mask: jax.Array):
+        total = jnp.asarray(0.0, jnp.float32)
+        for key in self.feature_keys:
+            total = total + mkmmd(
+                _flat(local_feats[key]),
+                jax.lax.stop_gradient(_flat(target_feats[key])),
+                betas[key],
+                self.gammas,
+                normalize_features=self.normalize_features,
+                mask=mask,
+            )
+        return total
+
+    def _feature_l2_penalty(self, local_feats: Mapping[str, jax.Array],
+                            mask: jax.Array) -> jax.Array:
+        """Average feature l2 norm (ditto_mkmmd_client.py:354-357)."""
+        f = _flat(local_feats[self.feature_keys[0]]) * mask[:, None]
+        n_valid = jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.linalg.norm(f) / n_valid
+
+    def _optimized_betas(self, state: TrainState, ctx, batch: Batch) -> dict:
+        local_f, target_f = self._mmd_features(state, ctx, batch)
+        return {
+            key: optimize_betas(
+                _flat(local_f[key]),
+                _flat(target_f[key]),
+                self.gammas,
+                normalize_features=self.normalize_features,
+                mask=batch.example_mask,
+            )
+            for key in self.feature_keys
+        }
+
+    def update_before_step(self, state: TrainState, ctx, batch: Batch) -> TrainState:
+        """interval == -1: re-optimize betas on every batch before the loss
+        consumes them (ditto_mkmmd_client.py:340-344)."""
+        if self.mkmmd_weight == 0 or self.beta_interval != -1:
+            return state
+
+        def recompute(extra):
+            return {**extra, "mkmmd_betas": self._optimized_betas(state, ctx, batch)}
+
+        new_extra = jax.lax.cond(batch.step_mask > 0, recompute, lambda e: e, state.extra)
+        return state.replace(extra=new_extra)
+
+    def update_after_step(self, state: TrainState, ctx, batch: Batch,
+                          preds=None) -> TrainState:
+        """interval > 0: refresh betas at the step interval
+        (ditto_mkmmd_client.py:140-159)."""
+        if self.mkmmd_weight == 0 or self.beta_interval <= 0:
+            return state
+        # state.step is already incremented when this hook runs; the reference
+        # counter is passed pre-increment, so its (step-1) % I == 0 first fires
+        # after the SECOND gradient step (basic_client.py:669,748-749).
+        step_in_round = state.step - ctx.round_start_step  # 1-based at hook time
+        do = (step_in_round - 2) % self.beta_interval == 0
+        do = jnp.logical_and(do, batch.step_mask > 0)
+
+        def recompute(extra):
+            return {**extra, "mkmmd_betas": self._optimized_betas(state, ctx, batch)}
+
+        new_extra = jax.lax.cond(do, recompute, lambda e: e, state.extra)
+        return state.replace(extra=new_extra)
+
+
+class DittoMkMmdClientLogic(_MkMmdMixin, DittoClientLogic):
+    """Ditto + MK-MMD feature alignment (ditto_mkmmd_client.py:22).
+
+    ``model`` is the TwinModel ModelDef (submodules must return a feature
+    dict); ``feature_model`` is the single-branch architecture used to run the
+    frozen initial-global params for target features.
+    """
+
+    extra_loss_keys = ("global_ce", "personal_ce", "penalty", "mkmmd")
+
+    def __init__(self, model: ModelDef, criterion, feature_model: ModelDef,
+                 lam: float = 1.0, mkmmd_loss_weight: float = 10.0,
+                 feature_keys: Sequence[str] = ("features",),
+                 beta_global_update_interval: int = 20,
+                 gammas=None, normalize_features: bool = True,
+                 feature_l2_norm_weight: float = 0.0,
+                 adaptive: bool = False):
+        DittoClientLogic.__init__(self, model, criterion, lam=lam, adaptive=adaptive)
+        self.feature_model = feature_model
+        self._init_mkmmd(feature_keys, mkmmd_loss_weight, beta_global_update_interval,
+                         gammas, normalize_features, feature_l2_norm_weight)
+
+    def init_extra(self, params):
+        return {"mkmmd_betas": self._init_betas()}
+
+    def init_round_context(self, state: TrainState, payload) -> DittoMmdContext:
+        base = DittoClientLogic.init_round_context(self, state, payload)
+        return DittoMmdContext(
+            initial_global_params=base.initial_global_params,
+            drift_penalty_weight=base.drift_penalty_weight,
+            round_start_step=state.step,
+            initial_model_state=state.model_state,
+        )
+
+    def _frozen_global_features(self, ctx, batch: Batch) -> dict:
+        (_, feats), _ = self.feature_model.apply(
+            ctx.initial_global_params,
+            _branch_state(ctx.initial_model_state, "global_model"),
+            batch.x, train=False,
+        )
+        return feats
+
+    def _mmd_features(self, state: TrainState, ctx, batch: Batch):
+        (_, pfeats), _ = self.feature_model.apply(
+            state.params["personal_model"],
+            _branch_state(state.model_state, "personal_model"),
+            batch.x, train=False,
+        )
+        return pfeats, self._frozen_global_features(ctx, batch)
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx):
+        total, parts = DittoClientLogic.training_loss(
+            self, preds, features, batch, params, state, ctx
+        )
+        local_feats = {k: features[f"personal_{k}"] for k in self.feature_keys}
+        target_feats = self._frozen_global_features(ctx, batch)
+        mmd = self._mkmmd_penalty(local_feats, target_feats,
+                                  state.extra["mkmmd_betas"], batch.example_mask)
+        parts["mkmmd"] = mmd
+        total = total + self.mkmmd_weight * mmd
+        if self.feature_l2_norm_weight != 0:
+            l2 = self._feature_l2_penalty(local_feats, batch.example_mask)
+            parts["feature_l2_norm"] = l2
+            total = total + self.feature_l2_norm_weight * l2
+        return total, parts
+
+
+class MrMtlMkMmdClientLogic(_MkMmdMixin, MrMtlClientLogic):
+    """MR-MTL + MK-MMD alignment to the frozen aggregate
+    (mkmmd_clients/mr_mtl_mkmmd_client.py)."""
+
+    extra_loss_keys = ("vanilla", "penalty", "mkmmd")
+
+    def __init__(self, model: ModelDef, criterion, lam: float = 1.0,
+                 mkmmd_loss_weight: float = 10.0,
+                 feature_keys: Sequence[str] = ("features",),
+                 beta_global_update_interval: int = 20,
+                 gammas=None, normalize_features: bool = True,
+                 feature_l2_norm_weight: float = 0.0,
+                 adaptive: bool = False):
+        MrMtlClientLogic.__init__(self, model, criterion, lam=lam, adaptive=adaptive)
+        self._init_mkmmd(feature_keys, mkmmd_loss_weight, beta_global_update_interval,
+                         gammas, normalize_features, feature_l2_norm_weight)
+
+    def init_extra(self, params):
+        return {"mkmmd_betas": self._init_betas()}
+
+    def init_round_context(self, state: TrainState, payload) -> MrMtlMmdContext:
+        base = MrMtlClientLogic.init_round_context(self, state, payload)
+        return MrMtlMmdContext(
+            initial_params=base.initial_params,
+            drift_penalty_weight=base.drift_penalty_weight,
+            round_start_step=state.step,
+            initial_model_state=state.model_state,
+        )
+
+    def _frozen_features(self, ctx, batch: Batch) -> dict:
+        (_, feats), _ = self.model.apply(ctx.initial_params,
+                                         ctx.initial_model_state,
+                                         batch.x, train=False)
+        return feats
+
+    def _mmd_features(self, state: TrainState, ctx, batch: Batch):
+        (_, feats), _ = self.model.apply(state.params, state.model_state,
+                                         batch.x, train=False)
+        return feats, self._frozen_features(ctx, batch)
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx):
+        total, parts = MrMtlClientLogic.training_loss(
+            self, preds, features, batch, params, state, ctx
+        )
+        local_feats = {k: features[k] for k in self.feature_keys}
+        target_feats = self._frozen_features(ctx, batch)
+        mmd = self._mkmmd_penalty(local_feats, target_feats,
+                                  state.extra["mkmmd_betas"], batch.example_mask)
+        parts["mkmmd"] = mmd
+        total = total + self.mkmmd_weight * mmd
+        if self.feature_l2_norm_weight != 0:
+            l2 = self._feature_l2_penalty(local_feats, batch.example_mask)
+            parts["feature_l2_norm"] = l2
+            total = total + self.feature_l2_norm_weight * l2
+        return total, parts
+
+
+# ---------------------------------------------------------------------------
+# Deep-kernel MMD variants
+# ---------------------------------------------------------------------------
+
+class _DeepMmdMixin:
+    """Shared DeepMMD machinery: per-layer learned kernels in extra state.
+
+    ``mmd_kernel_train_interval`` mirrors the reference knob
+    (ditto_deep_mmd_client.py:135-159): -1 trains the kernel on every batch
+    BEFORE the loss consumes it (deep_mmd_loss.py:304-311 forward protocol),
+    0 never trains, and a positive interval trains every N steps — the
+    reference trains on accumulated feature buffers there; this build uses
+    the interval step's batch as a streaming estimate.
+    """
+
+    def _init_deep_mmd(self, feature_sizes: Mapping[str, int], weight: float,
+                       lr: float, hidden_size: int, output_size: int,
+                       optimization_steps: int, train_interval: int):
+        self.deep_mmd_weight = weight
+        self.kernel_train_interval = train_interval
+        if train_interval < -1:
+            raise ValueError("mmd_kernel_train_interval must be -1, 0 or positive")
+        self.feature_keys = tuple(feature_sizes.keys())
+        self.kernels = {
+            key: DeepMmd(size, hidden_size=hidden_size, output_size=output_size,
+                         lr=lr, optimization_steps=optimization_steps)
+            for key, size in feature_sizes.items()
+        }
+
+    def _init_kernel_states(self, rng: jax.Array) -> dict:
+        keys = jax.random.split(rng, max(len(self.feature_keys), 1))
+        return {
+            key: self.kernels[key].init(keys[i])
+            for i, key in enumerate(self.feature_keys)
+        }
+
+    def _deep_mmd_penalty(self, local_feats, target_feats, kernel_states,
+                          mask: jax.Array):
+        total = jnp.asarray(0.0, jnp.float32)
+        for key in self.feature_keys:
+            total = total + self.kernels[key].value(
+                kernel_states[key],
+                _flat(local_feats[key]),
+                jax.lax.stop_gradient(_flat(target_feats[key])),
+                mask=mask,
+            )
+        return total
+
+    def _trained_kernels(self, state: TrainState, ctx, batch: Batch, extra) -> dict:
+        local_f, target_f = self._mmd_features(state, ctx, batch)
+        rng = jax.random.fold_in(state.rng, state.step)
+        new_states = {}
+        for i, key in enumerate(self.feature_keys):
+            new_states[key] = self.kernels[key].train(
+                extra["deep_mmd"][key],
+                _flat(local_f[key]),
+                _flat(target_f[key]),
+                jax.random.fold_in(rng, i),
+                mask=batch.example_mask,
+            )
+        return {**extra, "deep_mmd": new_states}
+
+    def update_before_step(self, state: TrainState, ctx, batch: Batch) -> TrainState:
+        """interval == -1: train the kernels on this batch before the loss
+        step (the reference trains inside forward, before the value)."""
+        if self.deep_mmd_weight == 0 or self.kernel_train_interval != -1:
+            return state
+        new_extra = jax.lax.cond(
+            batch.step_mask > 0,
+            lambda e: self._trained_kernels(state, ctx, batch, e),
+            lambda e: e,
+            state.extra,
+        )
+        return state.replace(extra=new_extra)
+
+    def update_after_step(self, state: TrainState, ctx, batch: Batch,
+                          preds=None) -> TrainState:
+        """interval > 0: train the kernels every N steps
+        (ditto_deep_mmd_client.py:146-159)."""
+        if self.deep_mmd_weight == 0 or self.kernel_train_interval <= 0:
+            return state
+        step_in_round = state.step - ctx.round_start_step  # 1-based at hook time
+        do = (step_in_round - 2) % self.kernel_train_interval == 0
+        do = jnp.logical_and(do, batch.step_mask > 0)
+        new_extra = jax.lax.cond(
+            do,
+            lambda e: self._trained_kernels(state, ctx, batch, e),
+            lambda e: e,
+            state.extra,
+        )
+        return state.replace(extra=new_extra)
+
+
+class DittoDeepMmdClientLogic(_DeepMmdMixin, DittoClientLogic):
+    """Ditto + deep-kernel MMD (deep_mmd_clients/ditto_deep_mmd_client.py:23).
+
+    ``feature_sizes`` maps feature keys to their flattened dimension (the
+    reference's feature_extraction_layers_with_size).
+    """
+
+    extra_loss_keys = ("global_ce", "personal_ce", "penalty", "deep_mmd")
+
+    def __init__(self, model: ModelDef, criterion, feature_model: ModelDef,
+                 feature_sizes: Mapping[str, int], lam: float = 1.0,
+                 deep_mmd_loss_weight: float = 10.0, lr: float = 0.001,
+                 hidden_size: int = 10, output_size: int = 50,
+                 optimization_steps: int = 5,
+                 mmd_kernel_train_interval: int = 20,
+                 adaptive: bool = False, seed: int = 0):
+        DittoClientLogic.__init__(self, model, criterion, lam=lam, adaptive=adaptive)
+        self.feature_model = feature_model
+        self._seed = seed
+        self._init_deep_mmd(feature_sizes, deep_mmd_loss_weight, lr,
+                            hidden_size, output_size, optimization_steps,
+                            mmd_kernel_train_interval)
+
+    def init_extra(self, params):
+        return {"deep_mmd": self._init_kernel_states(jax.random.PRNGKey(self._seed))}
+
+    def init_round_context(self, state: TrainState, payload) -> DittoMmdContext:
+        base = DittoClientLogic.init_round_context(self, state, payload)
+        return DittoMmdContext(
+            initial_global_params=base.initial_global_params,
+            drift_penalty_weight=base.drift_penalty_weight,
+            round_start_step=state.step,
+            initial_model_state=state.model_state,
+        )
+
+    def _frozen_global_features(self, ctx, batch: Batch) -> dict:
+        (_, feats), _ = self.feature_model.apply(
+            ctx.initial_global_params,
+            _branch_state(ctx.initial_model_state, "global_model"),
+            batch.x, train=False,
+        )
+        return feats
+
+    def _mmd_features(self, state: TrainState, ctx, batch: Batch):
+        (_, pfeats), _ = self.feature_model.apply(
+            state.params["personal_model"],
+            _branch_state(state.model_state, "personal_model"),
+            batch.x, train=False,
+        )
+        return pfeats, self._frozen_global_features(ctx, batch)
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx):
+        total, parts = DittoClientLogic.training_loss(
+            self, preds, features, batch, params, state, ctx
+        )
+        local_feats = {k: features[f"personal_{k}"] for k in self.feature_keys}
+        target_feats = self._frozen_global_features(ctx, batch)
+        mmd = self._deep_mmd_penalty(local_feats, target_feats,
+                                     state.extra["deep_mmd"], batch.example_mask)
+        parts["deep_mmd"] = mmd
+        return total + self.deep_mmd_weight * mmd, parts
+
+
+class MrMtlDeepMmdClientLogic(_DeepMmdMixin, MrMtlClientLogic):
+    """MR-MTL + deep-kernel MMD (deep_mmd_clients/mr_mtl_deep_mmd_client.py)."""
+
+    extra_loss_keys = ("vanilla", "penalty", "deep_mmd")
+
+    def __init__(self, model: ModelDef, criterion,
+                 feature_sizes: Mapping[str, int], lam: float = 1.0,
+                 deep_mmd_loss_weight: float = 10.0, lr: float = 0.001,
+                 hidden_size: int = 10, output_size: int = 50,
+                 optimization_steps: int = 5,
+                 mmd_kernel_train_interval: int = 20,
+                 adaptive: bool = False, seed: int = 0):
+        MrMtlClientLogic.__init__(self, model, criterion, lam=lam, adaptive=adaptive)
+        self._seed = seed
+        self._init_deep_mmd(feature_sizes, deep_mmd_loss_weight, lr,
+                            hidden_size, output_size, optimization_steps,
+                            mmd_kernel_train_interval)
+
+    def init_extra(self, params):
+        return {"deep_mmd": self._init_kernel_states(jax.random.PRNGKey(self._seed))}
+
+    def init_round_context(self, state: TrainState, payload) -> MrMtlMmdContext:
+        base = MrMtlClientLogic.init_round_context(self, state, payload)
+        return MrMtlMmdContext(
+            initial_params=base.initial_params,
+            drift_penalty_weight=base.drift_penalty_weight,
+            round_start_step=state.step,
+            initial_model_state=state.model_state,
+        )
+
+    def _frozen_features(self, ctx, batch: Batch) -> dict:
+        (_, feats), _ = self.model.apply(ctx.initial_params,
+                                         ctx.initial_model_state,
+                                         batch.x, train=False)
+        return feats
+
+    def _mmd_features(self, state: TrainState, ctx, batch: Batch):
+        (_, feats), _ = self.model.apply(state.params, state.model_state,
+                                         batch.x, train=False)
+        return feats, self._frozen_features(ctx, batch)
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx):
+        total, parts = MrMtlClientLogic.training_loss(
+            self, preds, features, batch, params, state, ctx
+        )
+        local_feats = {k: features[k] for k in self.feature_keys}
+        target_feats = self._frozen_features(ctx, batch)
+        mmd = self._deep_mmd_penalty(local_feats, target_feats,
+                                     state.extra["deep_mmd"], batch.example_mask)
+        parts["deep_mmd"] = mmd
+        return total + self.deep_mmd_weight * mmd, parts

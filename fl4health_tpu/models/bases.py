@@ -296,9 +296,15 @@ class TwinModel(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        g = _prediction_of(self.global_model(x, train=train))
-        p = _prediction_of(self.personal_model(x, train=train))
-        return {"global": g, "personal": p, "prediction": p}, {}
+        g_out = self.global_model(x, train=train)
+        p_out = self.personal_model(x, train=train)
+        features = {}
+        for prefix, out in (("global", g_out), ("personal", p_out)):
+            if isinstance(out, tuple) and len(out) == 2 and isinstance(out[1], dict):
+                for k, v in out[1].items():
+                    features[f"{prefix}_{k}"] = v
+        g, p = _prediction_of(g_out), _prediction_of(p_out)
+        return {"global": g, "personal": p, "prediction": p}, features
 
     @staticmethod
     def exchange_global_model(path: str) -> bool:

@@ -1,0 +1,141 @@
+"""End-to-end tests for the MMD personalization clients (reference:
+tests/clients/test_mkmmd* + deep-mmd client tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.ditto import KeepLocalExchanger
+from fl4health_tpu.clients.mmd import (
+    DittoDeepMmdClientLogic,
+    DittoMkMmdClientLogic,
+    MrMtlDeepMmdClientLogic,
+    MrMtlMkMmdClientLogic,
+)
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.exchange.exchanger import FixedLayerExchanger
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models import bases
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+N_CLASSES = 3
+DIM = 8
+HIDDEN = 12
+
+
+def _datasets(n_clients=2, n=40, seed=0):
+    out = []
+    for i in range(n_clients):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(seed + i), n, (DIM,), N_CLASSES
+        )
+        out.append(ClientDataset(x[: n - 16], y[: n - 16], x[n - 16:], y[n - 16:]))
+    return out
+
+
+def _sim(logic, exchanger=None, rounds=2):
+    sim = FederatedSimulation(
+        logic=logic,
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=_datasets(),
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1,
+        exchanger=exchanger,
+        seed=3,
+    )
+    return sim, sim.fit(rounds)
+
+
+def _mlp():
+    return Mlp(features=(HIDDEN,), n_outputs=N_CLASSES)
+
+
+def test_ditto_mkmmd_end_to_end():
+    model = bases.TwinModel(global_model=_mlp(), personal_model=_mlp())
+    logic = DittoMkMmdClientLogic(
+        engine.from_flax(model),
+        engine.masked_cross_entropy,
+        feature_model=engine.from_flax(_mlp()),
+        lam=0.5,
+        mkmmd_loss_weight=1.0,
+        beta_global_update_interval=2,
+    )
+    sim, hist = _sim(logic, FixedLayerExchanger(bases.TwinModel.exchange_global_model))
+    assert np.isfinite(hist[-1].fit_losses["mkmmd"])
+    # Betas were re-optimized away from the uniform init and stay on the simplex.
+    betas = sim.client_states.extra["mkmmd_betas"]["features"]
+    assert betas.shape[-1] == 19
+    sums = jnp.sum(betas, axis=-1)
+    assert np.allclose(np.asarray(sums), 1.0, atol=1e-3)
+    assert float(jnp.max(jnp.abs(betas - 1.0 / 19))) > 1e-4
+
+
+def test_mr_mtl_mkmmd_end_to_end():
+    logic = MrMtlMkMmdClientLogic(
+        engine.from_flax(_mlp()),
+        engine.masked_cross_entropy,
+        lam=0.5,
+        mkmmd_loss_weight=1.0,
+        beta_global_update_interval=-1,  # re-optimize on every batch
+    )
+    sim, hist = _sim(logic, KeepLocalExchanger())
+    assert np.isfinite(hist[-1].fit_losses["mkmmd"])
+    assert hist[-1].eval_losses["checkpoint"] < hist[0].eval_losses["checkpoint"] + 1.0
+
+
+def test_ditto_deep_mmd_end_to_end():
+    model = bases.TwinModel(global_model=_mlp(), personal_model=_mlp())
+    logic = DittoDeepMmdClientLogic(
+        engine.from_flax(model),
+        engine.masked_cross_entropy,
+        feature_model=engine.from_flax(_mlp()),
+        feature_sizes={"features": HIDDEN},
+        lam=0.5,
+        deep_mmd_loss_weight=1.0,
+        optimization_steps=1,
+        mmd_kernel_train_interval=-1,  # train on every batch
+    )
+    sim, hist = _sim(logic, FixedLayerExchanger(bases.TwinModel.exchange_global_model))
+    assert np.isfinite(hist[-1].fit_losses["deep_mmd"])
+    # The learned kernel actually trained away from its shared seed init.
+    kstate = sim.client_states.extra["deep_mmd"]["features"]
+    flat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(kstate.params)
+    assert flat.shape[0] == 2  # stacked over clients
+    init_flat = jax.flatten_util.ravel_pytree(
+        logic.kernels["features"].init(jax.random.PRNGKey(0)).params
+    )[0]
+    assert float(jnp.max(jnp.abs(flat[0] - init_flat))) > 1e-8
+
+
+def test_mr_mtl_deep_mmd_end_to_end():
+    logic = MrMtlDeepMmdClientLogic(
+        engine.from_flax(_mlp()),
+        engine.masked_cross_entropy,
+        feature_sizes={"features": HIDDEN},
+        lam=0.5,
+        deep_mmd_loss_weight=1.0,
+        optimization_steps=1,
+        mmd_kernel_train_interval=2,  # interval-based kernel training
+    )
+    _, hist = _sim(logic, KeepLocalExchanger())
+    assert np.isfinite(hist[-1].fit_losses["deep_mmd"])
+
+
+def test_mkmmd_weight_zero_disables_penalty():
+    model = bases.TwinModel(global_model=_mlp(), personal_model=_mlp())
+    logic = DittoMkMmdClientLogic(
+        engine.from_flax(model),
+        engine.masked_cross_entropy,
+        feature_model=engine.from_flax(_mlp()),
+        mkmmd_loss_weight=0.0,
+        beta_global_update_interval=0,
+    )
+    _, hist = _sim(logic, FixedLayerExchanger(bases.TwinModel.exchange_global_model))
+    assert np.isclose(float(hist[-1].fit_losses["mkmmd"] * 0.0), 0.0)
