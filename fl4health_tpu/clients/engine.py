@@ -94,7 +94,10 @@ def from_flax(module, mutable: tuple[str, ...] = ("batch_stats",)) -> ModelDef:
     (preds_dict, features_dict) pair."""
 
     def init(rng, sample_x):
-        variables = module.init({"params": rng, "dropout": rng}, sample_x, train=False)
+        variables = module.init(
+            {"params": rng, "dropout": rng, "mask": rng, "sampling": rng},
+            sample_x, train=False,
+        )
         params = variables["params"]
         model_state = {k: v for k, v in variables.items() if k != "params"}
         return params, model_state
@@ -102,9 +105,19 @@ def from_flax(module, mutable: tuple[str, ...] = ("batch_stats",)) -> ModelDef:
     def apply(params, model_state, x, train=True, rng=None, **kwargs):
         # Extra kwargs (e.g. APFL's alpha, GPFL's conditional inputs) are
         # forwarded to the module so algorithm-specific forwards don't need
-        # their own adapter.
+        # their own adapter. The extra rng streams serve masked layers
+        # ("mask", models/masked.py) and VAE reparameterization ("sampling").
         variables = {"params": params, **(model_state or {})}
-        rngs = {"dropout": rng} if rng is not None else {}
+        # Stochastic streams only while training: eval uses the masked
+        # layers' deterministic expectation and the VAEs' fixed noise so
+        # repeated validation of identical params agrees (checkpoint/early-
+        # stop selection must not ride sampling noise).
+        rngs = {}
+        if rng is not None:
+            rngs["dropout"] = rng
+            if train:
+                rngs["mask"] = jax.random.fold_in(rng, 1)
+                rngs["sampling"] = jax.random.fold_in(rng, 2)
         if train and model_state:
             out, new_state = module.apply(
                 variables, x, train=True, rngs=rngs,

@@ -1,0 +1,47 @@
+"""FedPM client — trains Bernoulli scores over frozen weights, ships masks.
+
+Parity: /root/reference/fl4health/clients/fedpm_client.py:18 + the
+FedPmExchanger (parameter_exchange/fedpm_exchanger.py:10, sampling in
+parameter_selection_criteria.py:202): training is the BasicClient loop over
+a masked model; ``get_parameters`` samples binary masks from
+sigmoid(scores); the server's Beta-posterior aggregate theta is loaded
+DIRECTLY into the score tensors on pull (the reference deliberately allows
+this score/probability aliasing — parameter_selection_criteria.py:230-234).
+
+TPU-native design: the model is built from models.masked layers (scores are
+ordinary flax params; frozen weights live in the ``frozen`` collection of
+model_state), so the whole BasicClient machinery applies unchanged. Mask
+sampling happens in ``pack`` with the client's traced PRNG (the reference's
+exchanger-side scipy sampling would freeze into a jit constant here), and
+the plain FullExchanger handles the theta pull.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.clients.engine import ClientLogic, TrainState
+from fl4health_tpu.core.types import Params
+
+
+def sample_masks(scores: Params, rng: jax.Array) -> Params:
+    """Binary masks ~ Bernoulli(sigmoid(scores)) leaf-wise
+    (parameter_selection_criteria.py:202-205)."""
+    leaves, treedef = jax.tree_util.tree_flatten(scores)
+    keys = jax.random.split(rng, len(leaves))
+    sampled = [
+        jax.random.bernoulli(k, jax.nn.sigmoid(leaf)).astype(jnp.float32)
+        for k, leaf in zip(keys, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, sampled)
+
+
+class FedPmClientLogic(ClientLogic):
+    """BasicClient training over a masked model (fedpm_client.py:18). The
+    trainable params ARE the scores; per-forward mask sampling happens inside
+    the masked layers (models/masked.py) via the ``mask`` rng stream; the
+    wire packet is one sampled binary mask per score tensor."""
+
+    def pack(self, state: TrainState, pushed_params: Params, train_losses: dict):
+        return sample_masks(pushed_params, jax.random.fold_in(state.rng, state.step))

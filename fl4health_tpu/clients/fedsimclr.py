@@ -1,0 +1,52 @@
+"""FedSimCLR client — federated self-supervised contrastive pretraining.
+
+Parity: /root/reference/examples/fedsimclr_example/
+fedsimclr_pretraining_example/client.py + model_bases/fedsimclr_base.py:12
+and losses/contrastive_loss.py:95 (NtXentLoss). Batches carry
+(input_view, transformed_view) as (x, y) — the reference's SslTensorDataset
+yields exactly that pairing, and its ``transform_target`` runs the model on
+the target view (client.py:84-85). The fine-tuning stage
+(pretrain=False + prediction head) is plain BasicClient classification.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.losses.contrastive import ntxent_loss
+
+
+class FedSimClrClientLogic(ClientLogic):
+    """Pretraining logic: NT-Xent between the projections of the two views.
+    Pair with models.bases.FedSimClrModel(pretrain=True)."""
+
+    def __init__(self, model, temperature: float = 0.5):
+        super().__init__(model, criterion=None)
+        self.temperature = temperature
+
+    def predict(self, params, model_state, batch: Batch, rng, train: bool,
+                extra=None, ctx=None):
+        (preds, features), new_state = self.model.apply(
+            params, model_state, batch.x, train=train, rng=rng
+        )
+        # transform_target equivalent: the second view through the same model.
+        (t_preds, _), new_state = self.model.apply(
+            params, new_state, batch.y, train=train, rng=rng
+        )
+        preds = {**preds, "transformed": t_preds["prediction"]}
+        return (preds, features), new_state
+
+    def _ntxent(self, preds, batch: Batch):
+        return ntxent_loss(
+            preds["prediction"], preds["transformed"],
+            temperature=self.temperature, mask=batch.example_mask,
+        )
+
+    def training_loss(self, preds, features, batch: Batch, params,
+                      state: TrainState, ctx):
+        return self._ntxent(preds, batch), {}
+
+    def eval_loss(self, preds, features, batch: Batch, params,
+                  state: TrainState, ctx):
+        return self._ntxent(preds, batch), {}

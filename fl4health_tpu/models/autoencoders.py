@@ -1,0 +1,227 @@
+"""Autoencoder model bases + VAE loss + PCA module.
+
+Parity targets:
+- BasicAe / VariationalAe / ConditionalVae
+  (/root/reference/fl4health/model_bases/autoencoders_base.py:45,99,185):
+  encoder/decoder composition; the VAE forward returns
+  ``concat([logvar, mu, flattened_reconstruction])`` so the packed output can
+  ride the standard prediction pipe and be unpacked by the loss
+  (autoencoders_base.py:165-183).
+- VaeLoss (/root/reference/fl4health/preprocessing/autoencoders/loss.py:8):
+  base reconstruction loss + analytic KL to the standard normal.
+- PcaModule (/root/reference/fl4health/model_bases/pca.py:12): SVD of
+  (centered) data, projection/reconstruction, explained-variance APIs.
+
+TPU-native design: reparameterization noise comes from the ``sampling`` PRNG
+stream (deterministic under jit given the stream key); PCA is a pure
+function returning an immutable ``PcaState`` instead of registered buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax import struct
+
+
+class BasicAe(nn.Module):
+    """Standard autoencoder (autoencoders_base.py:45)."""
+
+    encoder: nn.Module
+    decoder: nn.Module
+
+    def encode(self, x: jax.Array, train: bool = True) -> jax.Array:
+        return self.encoder(x, train=train)
+
+    def decode(self, z: jax.Array, train: bool = True) -> jax.Array:
+        return self.decoder(z, train=train)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        z = self.encode(x, train=train)
+        recon = self.decode(z, train=train)
+        return {"prediction": recon}, {"latent": z}
+
+
+class VariationalAe(nn.Module):
+    """VAE (autoencoders_base.py:99). The encoder must return (mu, logvar);
+    the forward packs ``[logvar | mu | flat reconstruction]`` along the last
+    axis exactly as the reference does (autoencoders_base.py:165-183) so
+    ``vae_loss`` can unpack it."""
+
+    encoder: nn.Module
+    decoder: nn.Module
+
+    def sampling(self, mu: jax.Array, logvar: jax.Array, rng: jax.Array) -> jax.Array:
+        """Reparameterization trick (autoencoders_base.py:148-163)."""
+        std = jnp.exp(0.5 * logvar)
+        eps = jax.random.normal(rng, std.shape, std.dtype)
+        return mu + eps * std
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        mu, logvar = self.encoder(x, train=train)
+        rng = (
+            self.make_rng("sampling")
+            if self.has_rng("sampling")
+            else jax.random.PRNGKey(0)
+        )
+        z = self.sampling(mu, logvar, rng)
+        recon = self.decoder(z, train=train)
+        flat = recon.reshape(recon.shape[0], -1)
+        packed = jnp.concatenate([logvar, mu, flat], axis=1)
+        return {"prediction": packed}, {"latent": z, "mu": mu, "logvar": logvar}
+
+
+class ConditionalVae(nn.Module):
+    """CVAE (autoencoders_base.py:185). ``unpack_input_condition`` splits the
+    packed model input into (input, condition); encoder/decoder receive the
+    condition as their second argument."""
+
+    encoder: nn.Module
+    decoder: nn.Module
+    unpack_input_condition: Callable[[jax.Array], tuple[jax.Array, jax.Array]] | None = None
+
+    def sampling(self, mu: jax.Array, logvar: jax.Array, rng: jax.Array) -> jax.Array:
+        std = jnp.exp(0.5 * logvar)
+        eps = jax.random.normal(rng, std.shape, std.dtype)
+        return mu + eps * std
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.unpack_input_condition is not None:
+            inputs, condition = self.unpack_input_condition(x)
+        else:
+            inputs, condition = x, None
+        mu, logvar = self.encoder(inputs, condition, train=train)
+        rng = (
+            self.make_rng("sampling")
+            if self.has_rng("sampling")
+            else jax.random.PRNGKey(0)
+        )
+        z = self.sampling(mu, logvar, rng)
+        recon = self.decoder(z, condition, train=train)
+        flat = recon.reshape(recon.shape[0], -1)
+        packed = jnp.concatenate([logvar, mu, flat], axis=1)
+        return {"prediction": packed}, {"latent": z, "mu": mu, "logvar": logvar}
+
+
+def unpack_vae_output(packed: jax.Array, latent_dim: int):
+    """[logvar | mu | flat recon] -> (recon, mu, logvar) (loss.py:44-65)."""
+    logvar = packed[:, :latent_dim]
+    mu = packed[:, latent_dim : 2 * latent_dim]
+    recon = packed[:, 2 * latent_dim :]
+    return recon, mu, logvar
+
+
+def kl_to_standard_normal(mu: jax.Array, logvar: jax.Array,
+                          mask: jax.Array | None = None) -> jax.Array:
+    """-0.5 * sum(1 + logvar - mu^2 - e^logvar) (loss.py:31-42)."""
+    per_example = -0.5 * jnp.sum(1 + logvar - mu**2 - jnp.exp(logvar), axis=-1)
+    if mask is not None:
+        per_example = per_example * mask
+    return jnp.sum(per_example)
+
+
+def make_vae_loss(latent_dim: int, base_loss: Callable) -> Callable:
+    """VaeLoss equivalent (loss.py:8): criterion(packed_preds, targets, mask)
+    = base_loss(recon, target, mask) + KL. ``base_loss`` follows the engine's
+    (preds, targets, mask) criterion contract."""
+
+    def criterion(packed: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
+        recon, mu, logvar = unpack_vae_output(packed, latent_dim)
+        recon = recon.reshape(targets.shape)
+        return base_loss(recon, targets, mask) + kl_to_standard_normal(mu, logvar, mask)
+
+    return criterion
+
+
+# ---------------------------------------------------------------------------
+# PCA
+# ---------------------------------------------------------------------------
+
+@struct.dataclass
+class PcaState:
+    """Principal components as an immutable pytree (pca.py:12 holds these as
+    module buffers)."""
+
+    components: jax.Array  # [d, k] columns = principal directions
+    singular_values: jax.Array  # [k]
+    data_mean: jax.Array  # [d]
+
+
+class PcaModule:
+    """SVD-based PCA (pca.py:12). ``low_rank`` truncates to
+    ``rank_estimation`` components after the (full) SVD — jnp has no partial
+    SVD, and these matrices are off the hot path."""
+
+    def __init__(self, low_rank: bool = False, full_svd: bool = False,
+                 rank_estimation: int = 6):
+        self.low_rank = low_rank
+        self.full_svd = full_svd
+        self.rank_estimation = rank_estimation
+
+    @staticmethod
+    def maybe_reshape(x: jax.Array) -> jax.Array:
+        """Flatten trailing dims to 2-D [N, d] (pca.py:96)."""
+        return x.reshape(x.shape[0], -1)
+
+    def fit(self, x: jax.Array, center_data: bool = True) -> PcaState:
+        """SVD of the (optionally centered) data matrix (pca.py:61-94)."""
+        x = self.maybe_reshape(x)
+        mean = jnp.mean(x, axis=0)
+        if center_data:
+            x = x - mean
+        _, s, vt = jnp.linalg.svd(x, full_matrices=self.full_svd)
+        components = vt.T
+        if self.low_rank:
+            k = min(self.rank_estimation, components.shape[1])
+            components = components[:, :k]
+            s = s[:k]
+        return PcaState(components=components, singular_values=s, data_mean=mean)
+
+    def project_lower_dim(self, state: PcaState, x: jax.Array,
+                          k: int | None = None, center_data: bool = False) -> jax.Array:
+        """x @ U_k (pca.py:149)."""
+        x = self.maybe_reshape(x)
+        if center_data:
+            x = x - state.data_mean
+        u = state.components if k is None else state.components[:, :k]
+        return x @ u
+
+    def project_back(self, state: PcaState, x_low: jax.Array,
+                     add_mean: bool = False) -> jax.Array:
+        """x_low @ U_k^T (+ mean) (pca.py:174)."""
+        u = state.components[:, : x_low.shape[1]]
+        out = x_low @ u.T
+        if add_mean:
+            out = out + state.data_mean
+        return out
+
+    def reconstruction_error(self, state: PcaState, x: jax.Array,
+                             k: int | None = None, center_data: bool = False) -> jax.Array:
+        """Mean squared reconstruction error (pca.py:195)."""
+        x2d = self.maybe_reshape(x)
+        low = self.project_lower_dim(state, x, k, center_data)
+        back = self.project_back(state, low, add_mean=center_data)
+        return jnp.sum((x2d - back) ** 2) / x2d.shape[0]
+
+    def projection_variance(self, state: PcaState, x: jax.Array,
+                            k: int | None = None, center_data: bool = False) -> jax.Array:
+        """||X U_k||_F^2 / N (pca.py:220)."""
+        low = self.project_lower_dim(state, x, k, center_data)
+        return jnp.sum(low**2) / low.shape[0]
+
+    @staticmethod
+    def explained_variance_ratios(state: PcaState) -> jax.Array:
+        """(pca.py:240)"""
+        s2 = state.singular_values**2
+        return s2 / jnp.sum(s2)
+
+    @staticmethod
+    def cumulative_explained_variance(state: PcaState) -> jax.Array:
+        """(pca.py:237)"""
+        return jnp.sum(state.singular_values**2)

@@ -1,0 +1,251 @@
+"""Masked layers for FedPM — Bernoulli-score parameter masking.
+
+Parity targets (/root/reference/fl4health/model_bases/masked_layers/):
+- masked_linear.py:11 MaskedLinear, masked_conv.py:15-720 MaskedConv1d/2d/3d +
+  transposed variants, masked_normalization_layers.py:19-313 MaskedLayerNorm /
+  MaskedBatchNorm*: the underlying weight/bias are FROZEN; learnable "score"
+  tensors are passed through a sigmoid to Bernoulli probabilities, a binary
+  mask is sampled each forward, and ``mask * weight`` is applied. Gradients
+  reach the scores through the straight-through estimator
+  (utils/functions.py:10 BernoulliSample: backward = probs * grad).
+- masked_layers_utils.py:23 convert_to_masked_model (module swap in place).
+
+TPU-native design: frozen weights live in a ``frozen`` variable collection
+(part of the engine's model_state, never touched by the optimizer); scores
+are ordinary flax ``params`` so every optimizer/exchanger works unchanged.
+Mask sampling uses the ``mask`` PRNG stream when provided; without it (e.g.
+deterministic evaluation) the expected mask ``probs`` is used instead of a
+sample — torch's global-RNG sampling during eval has no jit-safe equivalent,
+and the expectation is the variance-free estimator of the same forward.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@jax.custom_vjp
+def bernoulli_ste(probs: jax.Array, rng: jax.Array) -> jax.Array:
+    """Bernoulli sample with the straight-through 'gradient' = probs * g
+    (utils/functions.py:10, per Bengio et al. 1308.3432 §4)."""
+    return jax.random.bernoulli(rng, probs).astype(probs.dtype)
+
+
+def _bernoulli_fwd(probs, rng):
+    return bernoulli_ste(probs, rng), probs
+
+
+def _bernoulli_bwd(probs, g):
+    return probs * g, None
+
+
+bernoulli_ste.defvjp(_bernoulli_fwd, _bernoulli_bwd)
+
+
+class _MaskedMixin:
+    """Shared score-init + mask-sampling for all masked layers."""
+
+    def _masked(self, name: str, value: jax.Array) -> jax.Array:
+        """Sample (or take the expectation of) the binary mask for a frozen
+        tensor and apply it."""
+        scores = self.param(f"{name}_scores", nn.initializers.normal(1.0), value.shape)
+        probs = jax.nn.sigmoid(scores)
+        if self.has_rng("mask"):
+            mask = bernoulli_ste(probs, self.make_rng("mask"))
+        else:
+            mask = probs  # deterministic expectation (eval without an rng)
+        return mask * value
+
+    def _frozen(self, name: str, init, shape) -> jax.Array:
+        var = self.variable("frozen", name, init, shape)
+        return var.value
+
+
+def _dim_numbers(n_spatial: int):
+    """Channel-last conv dimension numbers for 1/2/3 spatial dims."""
+    spatial = ("W", "HW", "DHW")[n_spatial - 1]
+    return (f"N{spatial}C", f"{spatial}IO", f"N{spatial}C")
+
+
+class MaskedDense(_MaskedMixin, nn.Module):
+    """Masked linear layer (masked_linear.py:11)."""
+
+    features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self._frozen(
+            "kernel",
+            lambda shape: nn.initializers.lecun_normal()(self.make_rng("params"), shape),
+            (x.shape[-1], self.features),
+        )
+        y = x @ self._masked("kernel", kernel)
+        if self.use_bias:
+            bias = self._frozen("bias", lambda s: jnp.zeros(s), (self.features,))
+            y = y + self._masked("bias", bias)
+        return y
+
+
+class MaskedConv(_MaskedMixin, nn.Module):
+    """Masked N-D convolution (masked_conv.py:15,144,270 for 1d/2d/3d —
+    dimensionality follows len(kernel_size))."""
+
+    features: int
+    kernel_size: Sequence[int]
+    strides: Sequence[int] | None = None
+    padding: str = "SAME"
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        ksize = tuple(self.kernel_size)
+        in_features = x.shape[-1]
+        kernel = self._frozen(
+            "kernel",
+            lambda shape: nn.initializers.lecun_normal()(self.make_rng("params"), shape),
+            (*ksize, in_features, self.features),
+        )
+        masked_kernel = self._masked("kernel", kernel)
+        y = jax.lax.conv_general_dilated(
+            x, masked_kernel,
+            window_strides=tuple(self.strides) if self.strides else (1,) * len(ksize),
+            padding=self.padding, dimension_numbers=_dim_numbers(len(ksize)),
+        )
+        if self.use_bias:
+            bias = self._frozen("bias", lambda s: jnp.zeros(s), (self.features,))
+            y = y + self._masked("bias", bias)
+        return y
+
+
+class MaskedConvTranspose(_MaskedMixin, nn.Module):
+    """Masked N-D transposed convolution (masked_conv.py:396-720)."""
+
+    features: int
+    kernel_size: Sequence[int]
+    strides: Sequence[int] | None = None
+    padding: str = "SAME"
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        ksize = tuple(self.kernel_size)
+        in_features = x.shape[-1]
+        kernel = self._frozen(
+            "kernel",
+            lambda shape: nn.initializers.lecun_normal()(self.make_rng("params"), shape),
+            (*ksize, in_features, self.features),
+        )
+        masked_kernel = self._masked("kernel", kernel)
+        y = jax.lax.conv_transpose(
+            x, masked_kernel,
+            strides=tuple(self.strides) if self.strides else (1,) * len(ksize),
+            padding=self.padding,
+        )
+        if self.use_bias:
+            bias = self._frozen("bias", lambda s: jnp.zeros(s), (self.features,))
+            y = y + self._masked("bias", bias)
+        return y
+
+
+class MaskedLayerNorm(_MaskedMixin, nn.Module):
+    """Masked LayerNorm (masked_normalization_layers.py:19): normalization is
+    standard; the frozen affine scale/bias are masked."""
+
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + self.epsilon)
+        scale = self._frozen("scale", lambda s: jnp.ones(s), (x.shape[-1],))
+        bias = self._frozen("bias", lambda s: jnp.zeros(s), (x.shape[-1],))
+        return y * self._masked("scale", scale) + self._masked("bias", bias)
+
+
+class MaskedBatchNorm(_MaskedMixin, nn.Module):
+    """Masked BatchNorm (masked_normalization_layers.py:147): running stats
+    behave as in nn.BatchNorm (batch_stats collection); the frozen affine
+    parameters are masked."""
+
+    momentum: float = 0.99
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, use_running_average: bool = False) -> jax.Array:
+        features = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean", lambda s: jnp.zeros(s), (features,))
+        ra_var = self.variable("batch_stats", "var", lambda s: jnp.ones(s), (features,))
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            if not self.is_initializing():
+                ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+        y = (x - mean) / jnp.sqrt(var + self.epsilon)
+        scale = self._frozen("scale", lambda s: jnp.ones(s), (features,))
+        bias = self._frozen("bias", lambda s: jnp.zeros(s), (features,))
+        return y * self._masked("scale", scale) + self._masked("bias", bias)
+
+
+# ---------------------------------------------------------------------------
+# Ready-made masked architectures + dense-weight transplant
+# ---------------------------------------------------------------------------
+
+class MaskedMlp(nn.Module):
+    """Masked counterpart of models.cnn.Mlp — the convert_to_masked_model
+    analog for the standard test/bench MLP (flax module trees are static, so
+    conversion is 'build the masked twin + transplant weights' rather than an
+    in-place module swap)."""
+
+    features: Sequence[int] = (64, 32)
+    n_outputs: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = nn.relu(MaskedDense(f)(x))
+        logits = MaskedDense(self.n_outputs)(x)
+        return {"prediction": logits}, {"features": x}
+
+
+class MaskedCnn(nn.Module):
+    """Masked counterpart of a small conv net (masked_conv.py parity)."""
+
+    channels: Sequence[int] = (8, 16)
+    n_outputs: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for c in self.channels:
+            x = nn.relu(MaskedConv(c, (3, 3))(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        logits = MaskedDense(self.n_outputs)(x)
+        return {"prediction": logits}, {"features": x}
+
+
+def transplant_dense_weights(dense_params, frozen: dict) -> dict:
+    """Copy a trained dense model's parameters into a masked model's frozen
+    collection (MaskedLinear.from_pretrained parity, masked_linear.py:83).
+
+    Matches leaves by path: a dense layer's {kernel, bias} land in the masked
+    twin's frozen {kernel, bias} wherever the tree paths coincide.
+    """
+    flat_dense = dict(
+        jax.tree_util.tree_flatten_with_path(dense_params)[0]
+    )
+
+    def replace(path, leaf):
+        return flat_dense.get(path, leaf)
+
+    return jax.tree_util.tree_map_with_path(replace, frozen)

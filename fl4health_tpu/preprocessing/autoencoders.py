@@ -1,0 +1,182 @@
+"""Autoencoder data plumbing: dataset converter + dim-reduction processors.
+
+Parity targets:
+- AutoEncoderDatasetConverter (/root/reference/fl4health/utils/
+  dataset_converter.py:68): rewires a supervised (x, y) dataset for
+  self-supervised AE training — target becomes the input, and an optional
+  condition (fixed vector or per-sample label, optionally one-hot) is packed
+  into the input tensor; provides the matching unpacking function the CVAE
+  consumes (``unpack_input_condition``, :204).
+- AeProcessor / VaeProcessor / CvaeFixedConditionProcessor /
+  CvaeVariableConditionProcessor (/root/reference/fl4health/preprocessing/
+  autoencoders/dim_reduction.py:42-144): map samples into the latent space of
+  a trained encoder.
+- PcaPreprocessor (/root/reference/fl4health/preprocessing/
+  pca_preprocessor.py:10): dimensionality reduction through saved principal
+  components.
+
+TPU-native design: converters are array->array transforms applied to whole
+stacked datasets (one vectorized op instead of per-item __getitem__ hooks);
+processors close over (apply_fn, params) pairs instead of loading torch
+checkpoints.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.models.autoencoders import PcaModule, PcaState
+
+
+class AutoEncoderDatasetConverter:
+    """Pack (x, y) into AE-training form (dataset_converter.py:68).
+
+    condition: None (plain AE/VAE), "label" (per-sample label condition,
+    optionally one-hot), or a fixed 1-D array shared by all samples.
+    """
+
+    def __init__(self, condition: str | jax.Array | None = None,
+                 do_one_hot_encoding: bool = False,
+                 custom_converter: Callable | None = None,
+                 condition_vector_size: int | None = None):
+        self.condition = condition
+        self.do_one_hot_encoding = do_one_hot_encoding
+        self.custom_converter = custom_converter
+        self._condition_vector_size = condition_vector_size
+        self.data_shape: tuple[int, ...] | None = None
+        self._n_classes: int | None = None
+        if custom_converter is not None and condition_vector_size is None:
+            raise ValueError("condition_vector_size is required with a custom converter")
+
+    def get_condition_vector_size(self) -> int:
+        """(dataset_converter.py:124)"""
+        if self._condition_vector_size is not None:
+            return self._condition_vector_size
+        if self.condition is None:
+            return 0
+        if isinstance(self.condition, str) and self.condition == "label":
+            if self._n_classes is None:
+                raise RuntimeError("convert_dataset must run before the size is known")
+            return self._n_classes
+        return int(jnp.asarray(self.condition).shape[0])
+
+    def convert_dataset(self, x: jax.Array, y: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """Vectorized equivalent of the reference's per-item converter
+        functions (:162-193): returns (packed_inputs, targets=original x)."""
+        self.data_shape = tuple(x.shape[1:])
+        if self.custom_converter is not None:
+            return self.custom_converter(x, y)
+        flat = x.reshape(x.shape[0], -1)
+        if self.condition is None:
+            return x, x  # self-supervised: target is the data (:162-168)
+        if isinstance(self.condition, str) and self.condition == "label":
+            if self.do_one_hot_encoding:
+                self._n_classes = int(jnp.max(y)) + 1
+                cond = jax.nn.one_hot(y, self._n_classes)
+            else:
+                cond = y.reshape(y.shape[0], -1)
+                self._n_classes = cond.shape[1]
+            return jnp.concatenate([flat, cond], axis=1), x  # (:182-193)
+        cond = jnp.broadcast_to(
+            jnp.asarray(self.condition)[None, :], (x.shape[0], len(self.condition))
+        )
+        return jnp.concatenate([flat, cond], axis=1), x  # (:169-180)
+
+    def get_unpacking_function(self) -> Callable[[jax.Array], tuple[jax.Array, jax.Array]]:
+        """For ConditionalVae.unpack_input_condition (:195-215)."""
+        cond_size = self.get_condition_vector_size()
+        data_shape = self.data_shape
+
+        def unpack(packed: jax.Array) -> tuple[jax.Array, jax.Array]:
+            if cond_size == 0:
+                return packed, jnp.zeros((packed.shape[0], 0), packed.dtype)
+            data = packed[:, :-cond_size].reshape(packed.shape[0], *data_shape)
+            cond = packed[:, -cond_size:]
+            return data, cond
+
+        return unpack
+
+
+class AeProcessor:
+    """Encode samples into the latent space (dim_reduction.py:42): sample ->
+    encoder(sample)."""
+
+    def __init__(self, encode_fn: Callable[[jax.Array], jax.Array]):
+        self.encode_fn = encode_fn
+
+    def __call__(self, sample: jax.Array) -> jax.Array:
+        return self.encode_fn(sample)
+
+
+class VaeProcessor:
+    """VAE latent processor (dim_reduction.py:51): returns mu, or mu + eps*std
+    when return_mu_only=False."""
+
+    def __init__(self, encode_fn: Callable[[jax.Array], tuple[jax.Array, jax.Array]],
+                 return_mu_only: bool = False, seed: int = 0):
+        self.encode_fn = encode_fn
+        self.return_mu_only = return_mu_only
+        self._rng = jax.random.PRNGKey(seed)
+
+    def __call__(self, sample: jax.Array) -> jax.Array:
+        mu, logvar = self.encode_fn(sample)
+        if self.return_mu_only:
+            return mu
+        self._rng, sub = jax.random.split(self._rng)
+        return mu + jax.random.normal(sub, mu.shape, mu.dtype) * jnp.exp(0.5 * logvar)
+
+
+class CvaeFixedConditionProcessor:
+    """CVAE latent processor with one condition for every sample
+    (dim_reduction.py:81)."""
+
+    def __init__(self, encode_fn: Callable[[jax.Array, jax.Array], tuple[jax.Array, jax.Array]],
+                 condition: jax.Array, return_mu_only: bool = False, seed: int = 0):
+        self.encode_fn = encode_fn
+        self.condition = condition
+        self.return_mu_only = return_mu_only
+        self._rng = jax.random.PRNGKey(seed)
+
+    def __call__(self, sample: jax.Array) -> jax.Array:
+        cond = jnp.broadcast_to(
+            self.condition[None, :], (sample.shape[0], self.condition.shape[0])
+        )
+        mu, logvar = self.encode_fn(sample, cond)
+        if self.return_mu_only:
+            return mu
+        self._rng, sub = jax.random.split(self._rng)
+        return mu + jax.random.normal(sub, mu.shape, mu.dtype) * jnp.exp(0.5 * logvar)
+
+
+class CvaeVariableConditionProcessor:
+    """CVAE latent processor with per-sample conditions (dim_reduction.py:124)."""
+
+    def __init__(self, encode_fn: Callable[[jax.Array, jax.Array], tuple[jax.Array, jax.Array]],
+                 return_mu_only: bool = False, seed: int = 0):
+        self.encode_fn = encode_fn
+        self.return_mu_only = return_mu_only
+        self._rng = jax.random.PRNGKey(seed)
+
+    def __call__(self, sample: jax.Array, condition: jax.Array) -> jax.Array:
+        mu, logvar = self.encode_fn(sample, condition)
+        if self.return_mu_only:
+            return mu
+        self._rng, sub = jax.random.split(self._rng)
+        return mu + jax.random.normal(sub, mu.shape, mu.dtype) * jnp.exp(0.5 * logvar)
+
+
+class PcaPreprocessor:
+    """Dimensionality reduction through saved principal components
+    (pca_preprocessor.py:10)."""
+
+    def __init__(self, pca_state: PcaState, pca_module: PcaModule | None = None):
+        self.state = pca_state
+        self.module = pca_module or PcaModule()
+
+    def reduce_dimension(self, x: jax.Array, new_dimension: int,
+                         center_data: bool = False) -> jax.Array:
+        """(pca_preprocessor.py:26)"""
+        return self.module.project_lower_dim(self.state, x, new_dimension, center_data)

@@ -1,0 +1,82 @@
+"""Warm-start weight injection with name-mapping surgery.
+
+Parity: WarmedUpModule (/root/reference/fl4health/preprocessing/
+warmed_up_module.py:10): copy a pretrained model's states into a target
+model wherever keys (after optional prefix remapping) and shapes match;
+non-matching leaves keep their fresh initialization.
+
+TPU-native design: operates on params pytrees; keys are '.'-joined tree
+paths (flax param naming). The mapping may contain PARTIAL prefixes — the
+longest-prefix match rewrites the head of the path, exactly like the
+reference's get_matching_component (:57-84).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+from fl4health_tpu.core.types import Params
+
+logger = logging.getLogger(__name__)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+class WarmedUpModule:
+    """Pretrained-weight injection (warmed_up_module.py:10)."""
+
+    def __init__(self, pretrained_params: Params,
+                 weights_mapping: dict[str, str] | None = None):
+        flat = jax.tree_util.tree_flatten_with_path(pretrained_params)[0]
+        self.pretrained = {_path_str(path): leaf for path, leaf in flat}
+        self.weights_mapping = weights_mapping
+
+    def get_matching_component(self, key: str) -> str | None:
+        """Prefix-rewrite a target key into the pretrained namespace
+        (warmed_up_module.py:57-84)."""
+        if self.weights_mapping is None:
+            return key
+        components = key.split(".")
+        prefix = ""
+        for i, component in enumerate(components):
+            prefix = component if i == 0 else f"{prefix}.{component}"
+            if prefix in self.weights_mapping:
+                return self.weights_mapping[prefix] + key[len(prefix):]
+        return None
+
+    def load_from_pretrained(self, params: Params) -> Params:
+        """Return ``params`` with every matchable leaf replaced by its
+        pretrained counterpart (warmed_up_module.py:85-120)."""
+        matched = [0]
+
+        def inject(path, leaf):
+            key = _path_str(path)
+            pretrained_key = self.get_matching_component(key)
+            if pretrained_key is None or pretrained_key not in self.pretrained:
+                return leaf
+            candidate = self.pretrained[pretrained_key]
+            if candidate.shape != leaf.shape:
+                logger.warning(
+                    "state not loaded, mismatched shapes %s -> %s for %s",
+                    leaf.shape, candidate.shape, key,
+                )
+                return leaf
+            matched[0] += 1
+            return candidate
+
+        out = jax.tree_util.tree_map_with_path(inject, params)
+        total = len(jax.tree_util.tree_leaves(params))
+        logger.info("%d/%d states were matched.", matched[0], total)
+        return out
