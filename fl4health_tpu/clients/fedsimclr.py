@@ -30,9 +30,11 @@ class FedSimClrClientLogic(ClientLogic):
         (preds, features), new_state = self.model.apply(
             params, model_state, batch.x, train=train, rng=rng
         )
-        # transform_target equivalent: the second view through the same model.
+        # transform_target equivalent: the second view through the same model,
+        # with decorrelated stochasticity (fresh dropout/mask noise per view).
+        view_rng = None if rng is None else jax.random.fold_in(rng, 1)
         (t_preds, _), new_state = self.model.apply(
-            params, new_state, batch.y, train=train, rng=rng
+            params, new_state, batch.y, train=train, rng=view_rng
         )
         preds = {**preds, "transformed": t_preds["prediction"]}
         return (preds, features), new_state

@@ -27,6 +27,25 @@ from flax import linen as nn
 from flax import struct
 
 
+def reparameterize(mu: jax.Array, logvar: jax.Array, rng: jax.Array) -> jax.Array:
+    """Reparameterization trick: mu + eps * exp(0.5*logvar), eps ~ N(0, I)
+    (autoencoders_base.py:148-163). Shared by the VAEs and the latent-space
+    processors (preprocessing/autoencoders.py)."""
+    std = jnp.exp(0.5 * logvar)
+    eps = jax.random.normal(rng, std.shape, std.dtype)
+    return mu + eps * std
+
+
+def _sampling_rng(module: nn.Module) -> jax.Array:
+    """The 'sampling' stream when provided; a fixed key otherwise so
+    evaluation without an rng stays deterministic."""
+    return (
+        module.make_rng("sampling")
+        if module.has_rng("sampling")
+        else jax.random.PRNGKey(0)
+    )
+
+
 class BasicAe(nn.Module):
     """Standard autoencoder (autoencoders_base.py:45)."""
 
@@ -56,20 +75,12 @@ class VariationalAe(nn.Module):
     decoder: nn.Module
 
     def sampling(self, mu: jax.Array, logvar: jax.Array, rng: jax.Array) -> jax.Array:
-        """Reparameterization trick (autoencoders_base.py:148-163)."""
-        std = jnp.exp(0.5 * logvar)
-        eps = jax.random.normal(rng, std.shape, std.dtype)
-        return mu + eps * std
+        return reparameterize(mu, logvar, rng)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mu, logvar = self.encoder(x, train=train)
-        rng = (
-            self.make_rng("sampling")
-            if self.has_rng("sampling")
-            else jax.random.PRNGKey(0)
-        )
-        z = self.sampling(mu, logvar, rng)
+        z = reparameterize(mu, logvar, _sampling_rng(self))
         recon = self.decoder(z, train=train)
         flat = recon.reshape(recon.shape[0], -1)
         packed = jnp.concatenate([logvar, mu, flat], axis=1)
@@ -86,9 +97,7 @@ class ConditionalVae(nn.Module):
     unpack_input_condition: Callable[[jax.Array], tuple[jax.Array, jax.Array]] | None = None
 
     def sampling(self, mu: jax.Array, logvar: jax.Array, rng: jax.Array) -> jax.Array:
-        std = jnp.exp(0.5 * logvar)
-        eps = jax.random.normal(rng, std.shape, std.dtype)
-        return mu + eps * std
+        return reparameterize(mu, logvar, rng)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -97,12 +106,7 @@ class ConditionalVae(nn.Module):
         else:
             inputs, condition = x, None
         mu, logvar = self.encoder(inputs, condition, train=train)
-        rng = (
-            self.make_rng("sampling")
-            if self.has_rng("sampling")
-            else jax.random.PRNGKey(0)
-        )
-        z = self.sampling(mu, logvar, rng)
+        z = reparameterize(mu, logvar, _sampling_rng(self))
         recon = self.decoder(z, condition, train=train)
         flat = recon.reshape(recon.shape[0], -1)
         packed = jnp.concatenate([logvar, mu, flat], axis=1)

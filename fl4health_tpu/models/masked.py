@@ -234,18 +234,35 @@ class MaskedCnn(nn.Module):
         return {"prediction": logits}, {"features": x}
 
 
+def _normalized_path(path) -> tuple:
+    """Strip flax module-class prefixes so Dense_0.kernel and
+    MaskedDense_0.kernel coincide: 'Name_3' segments normalize to '3'."""
+    out = []
+    for p in path:
+        seg = str(getattr(p, "key", getattr(p, "idx", p)))
+        head, _, tail = seg.rpartition("_")
+        out.append(tail if head and tail.isdigit() else seg)
+    return tuple(out)
+
+
 def transplant_dense_weights(dense_params, frozen: dict) -> dict:
     """Copy a trained dense model's parameters into a masked model's frozen
     collection (MaskedLinear.from_pretrained parity, masked_linear.py:83).
 
-    Matches leaves by path: a dense layer's {kernel, bias} land in the masked
-    twin's frozen {kernel, bias} wherever the tree paths coincide.
+    Matching is by module-index + parameter name with the flax class-name
+    prefix stripped (Dense_0.kernel -> MaskedDense_0.kernel), since the
+    masked twin's auto-generated module names differ from the dense ones.
+    Shapes must agree for a leaf to be copied.
     """
-    flat_dense = dict(
-        jax.tree_util.tree_flatten_with_path(dense_params)[0]
-    )
+    flat_dense = {
+        _normalized_path(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(dense_params)[0]
+    }
 
     def replace(path, leaf):
-        return flat_dense.get(path, leaf)
+        candidate = flat_dense.get(_normalized_path(path))
+        if candidate is not None and candidate.shape == leaf.shape:
+            return candidate
+        return leaf
 
     return jax.tree_util.tree_map_with_path(replace, frozen)

@@ -28,7 +28,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from fl4health_tpu.models.autoencoders import PcaModule, PcaState
+from fl4health_tpu.models.autoencoders import PcaModule, PcaState, reparameterize
 
 
 class AutoEncoderDatasetConverter:
@@ -126,7 +126,7 @@ class VaeProcessor:
         if self.return_mu_only:
             return mu
         self._rng, sub = jax.random.split(self._rng)
-        return mu + jax.random.normal(sub, mu.shape, mu.dtype) * jnp.exp(0.5 * logvar)
+        return reparameterize(mu, logvar, sub)
 
 
 class CvaeFixedConditionProcessor:
@@ -148,7 +148,7 @@ class CvaeFixedConditionProcessor:
         if self.return_mu_only:
             return mu
         self._rng, sub = jax.random.split(self._rng)
-        return mu + jax.random.normal(sub, mu.shape, mu.dtype) * jnp.exp(0.5 * logvar)
+        return reparameterize(mu, logvar, sub)
 
 
 class CvaeVariableConditionProcessor:
@@ -165,7 +165,7 @@ class CvaeVariableConditionProcessor:
         if self.return_mu_only:
             return mu
         self._rng, sub = jax.random.split(self._rng)
-        return mu + jax.random.normal(sub, mu.shape, mu.dtype) * jnp.exp(0.5 * logvar)
+        return reparameterize(mu, logvar, sub)
 
 
 class PcaPreprocessor:

@@ -53,7 +53,9 @@ class WarmedUpModule:
         for i, component in enumerate(components):
             prefix = component if i == 0 else f"{prefix}.{component}"
             if prefix in self.weights_mapping:
-                return self.weights_mapping[prefix] + key[len(prefix):]
+                # lstrip handles empty-string replacements ({"global_model":
+                # ""} -> "Dense_0.kernel", not ".Dense_0.kernel").
+                return (self.weights_mapping[prefix] + key[len(prefix):]).lstrip(".")
         return None
 
     def load_from_pretrained(self, params: Params) -> Params:

@@ -115,15 +115,22 @@ class FlInstanceLevelAccountant:
         self,
         client_sampling_rate: float,
         noise_multiplier: float,
-        epochs_per_round: int,
+        epochs_per_round: int | None,
         client_batch_sizes: Sequence[int],
         client_dataset_sizes: Sequence[int],
         moment_orders: Sequence[float] | None = None,
+        steps_per_round: int | None = None,
     ):
+        """steps_per_round: alternative to epochs_per_round for step-driven
+        local training (the reference's epochs-xor-steps config shape) —
+        total compositions become rounds * steps_per_round per client."""
         if len(client_batch_sizes) != len(client_dataset_sizes):
             raise ValueError("batch/dataset size lists must align")
+        if (epochs_per_round is None) == (steps_per_round is None):
+            raise ValueError("specify exactly one of epochs_per_round / steps_per_round")
         self.noise_multiplier = noise_multiplier
         self.epochs_per_round = epochs_per_round
+        self.steps_per_round = steps_per_round
         self.num_batches_per_client = [
             ceil(d / b) for b, d in zip(client_batch_sizes, client_dataset_sizes)
         ]
@@ -138,7 +145,10 @@ class FlInstanceLevelAccountant:
         for n_batches, sampling in zip(
             self.num_batches_per_client, self.sampling_per_client
         ):
-            total = ceil(server_updates * self.epochs_per_round * n_batches)
+            if self.steps_per_round is not None:
+                total = ceil(server_updates * self.steps_per_round)
+            else:
+                total = ceil(server_updates * self.epochs_per_round * n_batches)
             results.append(fn(sampling, self.noise_multiplier, total, value))
         return max(results)
 

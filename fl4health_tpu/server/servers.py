@@ -1,0 +1,313 @@
+"""Specialized servers: polling, failure policy, warm starts, DP wiring,
+evaluate-only and model-merge orchestration.
+
+Parity targets (/root/reference/fl4health/servers/):
+- polling.py:47,63 ``poll_clients`` — get_properties fan-out.
+- base_server.py:104,316-318,443-472 — accept_failures policy and
+  ``_terminate_after_unacceptable_failures``.
+- scaffold_server.py:21,89-163 — SCAFFOLD warm start: every client runs one
+  training pass whose weights are DISCARDED; control variates are
+  initialized from the average local gradients.
+- instance_level_dp_server.py:19 / client_level_dp_fed_avg_server.py:23 —
+  sample-count polling + accountant construction + epsilon logging.
+- evaluate_server.py:20 — single federated evaluation round from a
+  checkpoint, no training.
+- model_merge_server.py:23 — one-shot parameter merge + evaluation.
+- fedpm_server.py:14 — periodic Beta-posterior reset (the reset itself is
+  compiled into strategies.fedpm.FedPm; the server class here is the
+  orchestration-level wrapper).
+- adaptive_constraint_servers/*.py:12 — thin wrappers asserting the
+  strategy/logic pairing for packed adaptive-constraint algorithms.
+
+TPU-native design: clients are in-process mesh shards, so "polling" is a
+host-level property lookup (no RPC, no thread pool) and "client failure"
+surfaces as non-finite per-client losses in the stacked result (a crashed
+gRPC peer has no SPMD equivalent; a NaN-poisoned shard is the analogous
+failure mode and is what the policy screens).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.privacy.accountants import (
+    FlClientLevelAccountantFixedSamplingNoReplacement,
+    FlClientLevelAccountantPoissonSampling,
+    FlInstanceLevelAccountant,
+)
+from fl4health_tpu.server.simulation import (
+    ClientFailuresError,
+    FailurePolicy,
+    FederatedSimulation,
+)
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Polling protocol
+# ---------------------------------------------------------------------------
+
+def poll_clients(
+    providers: Sequence[Callable[[Mapping[str, Any]], Mapping[str, Any]]],
+    request: Mapping[str, Any],
+) -> list[dict[str, Any]]:
+    """get_properties fan-out (polling.py:63-98). Providers are per-client
+    callables (in-process stand-ins for the gRPC ``get_properties`` handler);
+    the reference's thread pool is unnecessary without network latency."""
+    return [dict(provider(request)) for provider in providers]
+
+
+def poll_sample_counts(sim: FederatedSimulation) -> list[int]:
+    """poll_clients_for_sample_counts (base_server.py:327-356): ask every
+    client for its training-set size."""
+    providers = [
+        (lambda req, d=d: {"num_train_samples": int(d.n_train)})
+        for d in sim.datasets
+    ]
+    return [p["num_train_samples"] for p in poll_clients(providers, {})]
+
+
+# ---------------------------------------------------------------------------
+# Failure policy
+# ---------------------------------------------------------------------------
+
+# Failure policy lives in simulation.py (wired into the round loop there);
+# re-exported here because the reference groups it with the server layer.
+# ---------------------------------------------------------------------------
+# SCAFFOLD warm start
+# ---------------------------------------------------------------------------
+
+def scaffold_warm_start(sim: FederatedSimulation) -> None:
+    """ScaffoldServer warm start (scaffold_server.py:89-163): run one local
+    training pass per client, DISCARD the trained weights/optimizer state,
+    and keep the resulting control variates (average local gradients:
+    c_i = (x - y_i) / (K * lr), which is exactly the round-0 variate update
+    with c = 0). The server's variates are warm-started from the aggregated
+    deltas while its weights x remain the initial ones."""
+    pre_client_states = sim.client_states
+    pre_params = sim.global_params
+    mask = jnp.ones((sim.n_clients,), jnp.float32)
+    batches = sim._round_batches(0)
+    val_batches, _ = sim._val_batches()
+    server_state, client_states, _, _, _ = sim._fit_round(
+        sim.server_state, sim.client_states, batches, mask,
+        jnp.asarray(0, jnp.int32), val_batches,
+    )
+    # Keep only the warmed variates: client weights/opt/rng/step roll back.
+    sim.client_states = pre_client_states.replace(extra=client_states.extra)
+    # Server keeps warmed c, original x (scaffold_server.py:139-158 discards
+    # the aggregated weights from the warm-up round).
+    sim.server_state = server_state.replace(params=pre_params)
+    logger.info("SCAFFOLD warm start complete: control variates initialized "
+                "from average local gradients; model weights unchanged.")
+
+
+class ScaffoldServer:
+    """Server wrapper running SCAFFOLD with optional warm start
+    (scaffold_server.py:21)."""
+
+    def __init__(self, sim: FederatedSimulation, warm_start: bool = False):
+        from fl4health_tpu.strategies.scaffold import Scaffold
+
+        assert isinstance(sim.strategy, Scaffold), "ScaffoldServer requires the Scaffold strategy"
+        self.sim = sim
+        self.warm_start = warm_start
+
+    def fit(self, n_rounds: int):
+        if self.warm_start:
+            scaffold_warm_start(self.sim)
+        return self.sim.fit(n_rounds)
+
+
+# ---------------------------------------------------------------------------
+# DP servers
+# ---------------------------------------------------------------------------
+
+class InstanceLevelDpServer:
+    """Instance-level DP orchestration (instance_level_dp_server.py:19):
+    polls per-client sample counts, configures the FL instance-level
+    accountant, and logs/returns epsilon for the run."""
+
+    def __init__(self, sim: FederatedSimulation, noise_multiplier: float,
+                 batch_size: int, local_epochs: int | None = None,
+                 local_steps: int | None = None, delta: float | None = None):
+        self.sim = sim
+        self.noise_multiplier = noise_multiplier
+        self.batch_size = batch_size
+        self.local_epochs = local_epochs if local_epochs is not None else sim.local_epochs
+        self.local_steps = local_steps if local_steps is not None else sim.local_steps
+        self.delta = delta
+        self.accountant: FlInstanceLevelAccountant | None = None
+
+    def setup_accountant(self, n_rounds: int) -> FlInstanceLevelAccountant:
+        counts = poll_sample_counts(self.sim)
+        # Client sampling ratio: expected fraction of clients per round.
+        q_client = getattr(self.sim.client_manager, "fraction", 1.0)
+        self.accountant = FlInstanceLevelAccountant(
+            client_sampling_rate=q_client,
+            noise_multiplier=self.noise_multiplier,
+            epochs_per_round=self.local_epochs,
+            client_batch_sizes=[self.batch_size] * len(counts),
+            client_dataset_sizes=counts,
+            steps_per_round=self.local_steps,
+        )
+        return self.accountant
+
+    def fit(self, n_rounds: int):
+        self.setup_accountant(n_rounds)
+        assert self.accountant is not None
+        delta = self.delta if self.delta is not None else min(
+            1.0 / c for c in poll_sample_counts(self.sim)
+        )
+        epsilon = self.accountant.get_epsilon(n_rounds, delta)
+        logger.info("Instance-level DP run: epsilon=%.4f at delta=%.2e over %d rounds",
+                    epsilon, delta, n_rounds)
+        history = self.sim.fit(n_rounds)
+        return history, epsilon
+
+
+class ClientLevelDpFedAvgServer:
+    """Client-level DP orchestration (client_level_dp_fed_avg_server.py:23):
+    counts clients, builds the client-level accountant matching the sampling
+    scheme, logs epsilon."""
+
+    def __init__(self, sim: FederatedSimulation, noise_multiplier: float,
+                 delta: float | None = None):
+        self.sim = sim
+        self.noise_multiplier = noise_multiplier
+        self.delta = delta
+
+    def _accountant(self):
+        from fl4health_tpu.server.client_manager import PoissonSamplingManager
+
+        manager = self.sim.client_manager
+        n = self.sim.n_clients
+        fraction = getattr(manager, "fraction", 1.0)
+        if isinstance(manager, PoissonSamplingManager):
+            return FlClientLevelAccountantPoissonSampling(
+                client_sampling_rate=fraction, noise_multiplier=self.noise_multiplier
+            )
+        return FlClientLevelAccountantFixedSamplingNoReplacement(
+            n_total_clients=n,
+            n_clients_sampled=max(int(round(fraction * n)), 1),
+            noise_multiplier=self.noise_multiplier,
+        )
+
+    def fit(self, n_rounds: int):
+        accountant = self._accountant()
+        delta = self.delta if self.delta is not None else 1.0 / self.sim.n_clients
+        epsilon = accountant.get_epsilon(n_rounds, delta)
+        logger.info("Client-level DP run: epsilon=%.4f at delta=%.2e over %d rounds",
+                    epsilon, delta, n_rounds)
+        history = self.sim.fit(n_rounds)
+        return history, epsilon
+
+
+# ---------------------------------------------------------------------------
+# Evaluate-only server
+# ---------------------------------------------------------------------------
+
+class EvaluateServer:
+    """Single federated evaluation round (evaluate_server.py:20): load model
+    weights (e.g. from a checkpointer), broadcast, evaluate on every client,
+    aggregate. No training rounds."""
+
+    def __init__(self, sim: FederatedSimulation, params=None):
+        self.sim = sim
+        self.params = params
+
+    def fit(self):
+        sim = self.sim
+        if self.params is not None:
+            # Hydrate the server model from the provided checkpoint params
+            # (evaluate_server.py loads from model checkpoint path).
+            sim.server_state = sim.server_state.replace(params=self.params)
+        val_batches, val_counts = sim._val_batches()
+        _, losses, metrics, per_losses, per_metrics = sim._eval_round(
+            sim.server_state, sim.client_states, val_batches, val_counts
+        )
+        out_losses = {k: float(v) for k, v in jax.device_get(losses).items()}
+        out_metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        return out_losses, out_metrics
+
+
+# ---------------------------------------------------------------------------
+# Model-merge server
+# ---------------------------------------------------------------------------
+
+class ModelMergeServer:
+    """One-shot parameter merge + federated evaluation
+    (model_merge_server.py:23): clients send their locally-trained weights
+    once; the merge strategy averages them; the merged model is evaluated on
+    all clients."""
+
+    def __init__(self, sim: FederatedSimulation):
+        self.sim = sim
+
+    def fit(self):
+        sim = self.sim
+        # One "round" with zero local steps is not meaningful here; instead
+        # merge the clients' CURRENT parameters directly (the reference's
+        # clients train locally before connecting).
+        from fl4health_tpu.core import aggregate as agg
+
+        stacked = sim.client_states.params
+        weights = jnp.ones((sim.n_clients,), jnp.float32)
+        merged = jax.tree_util.tree_map(
+            lambda s: jnp.sum(
+                s * weights.reshape((-1,) + (1,) * (s.ndim - 1)), axis=0
+            ) / jnp.sum(weights),
+            stacked,
+        )
+        evaluator = EvaluateServer(sim, params=merged)
+        losses, metrics = evaluator.fit()
+        return merged, losses, metrics
+
+
+# ---------------------------------------------------------------------------
+# Thin parity wrappers
+# ---------------------------------------------------------------------------
+
+class FedPmServer:
+    """FedPM orchestration (fedpm_server.py:14). The periodic Beta reset is
+    compiled into strategies.fedpm.FedPm(reset_frequency=...); this wrapper
+    asserts the pairing."""
+
+    def __init__(self, sim: FederatedSimulation):
+        from fl4health_tpu.strategies.fedpm import FedPm
+
+        assert isinstance(sim.strategy, FedPm), "FedPmServer requires the FedPm strategy"
+        self.sim = sim
+
+    def fit(self, n_rounds: int):
+        return self.sim.fit(n_rounds)
+
+
+class FedProxServer:
+    """adaptive_constraint_servers/fedprox_server.py:12 — asserts the
+    adaptive-constraint strategy pairing."""
+
+    def __init__(self, sim: FederatedSimulation):
+        from fl4health_tpu.strategies.fedprox import FedAvgWithAdaptiveConstraint
+
+        assert isinstance(sim.strategy, FedAvgWithAdaptiveConstraint), (
+            "FedProxServer requires FedAvgWithAdaptiveConstraint"
+        )
+        self.sim = sim
+
+    def fit(self, n_rounds: int):
+        return self.sim.fit(n_rounds)
+
+
+class DittoServer(FedProxServer):
+    """adaptive_constraint_servers/ditto_server.py — same packing contract."""
+
+
+class MrMtlServer(FedProxServer):
+    """adaptive_constraint_servers/mrmtl_server.py — same packing contract."""

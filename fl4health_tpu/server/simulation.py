@@ -20,6 +20,7 @@ responsibilities without any per-round serialize/deserialize.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Any, Callable, Sequence
 
@@ -52,6 +53,40 @@ class ClientDataset:
     @property
     def n_train(self) -> int:
         return int(self.x_train.shape[0])
+
+
+class ClientFailuresError(RuntimeError):
+    """Raised when accept_failures=False and client failures occur
+    (base_server.py:443-451)."""
+
+
+@dataclasses.dataclass
+class FailurePolicy:
+    """accept_failures semantics (base_server.py:104,316-318): with
+    ``accept_failures=False`` any failed client terminates the run. The SPMD
+    failure signal is a non-finite backward loss in a participating client's
+    row of the stacked results (a crashed gRPC peer has no in-process
+    equivalent; a NaN-poisoned shard is the analogous failure mode)."""
+
+    accept_failures: bool = True
+
+    def check(self, per_client_losses, mask) -> list[int]:
+        key = "backward" if "backward" in per_client_losses else None
+        if key is None:
+            return []
+        row = jnp.asarray(per_client_losses[key])
+        bad = jnp.logical_and(~jnp.isfinite(row), jnp.asarray(mask) > 0)
+        failed = [int(i) for i in jnp.nonzero(bad)[0]]
+        for cid in failed:
+            logging.getLogger(__name__).error(
+                "Client %d failed (non-finite training loss).", cid
+            )
+        if failed and not self.accept_failures:
+            raise ClientFailuresError(
+                f"The server encountered failures from clients {failed} and "
+                "accept_failures is set to False"
+            )
+        return failed
 
 
 @dataclasses.dataclass
@@ -87,6 +122,7 @@ class FederatedSimulation:
         model_checkpointers: Sequence[tuple[Any, Any]] = (),
         state_checkpointer: Any = None,
         early_stopping: engine.EarlyStoppingConfig | None = None,
+        failure_policy: FailurePolicy | None = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -109,6 +145,7 @@ class FederatedSimulation:
         self.model_checkpointers = list(model_checkpointers)
         self.state_checkpointer = state_checkpointer
         self.early_stopping = early_stopping
+        self.failure_policy = failure_policy or FailurePolicy()
         self.rng = jax.random.PRNGKey(seed)
         self.sample_counts = jnp.asarray(
             [d.n_train for d in self.datasets], jnp.float32
@@ -181,21 +218,30 @@ class FederatedSimulation:
             new_states, packets, losses, metrics = jax.vmap(
                 client_fit, in_axes=(0, None, 0, 0, 0)
             )(client_states, payload, batches, mask, val_batches)
+            # Failed clients (non-finite loss) are excluded from aggregation,
+            # matching the reference where failures never enter results
+            # (strategies/basic_fedavg.py:254-256 skips on failures; here the
+            # per-client row is masked out so the aggregate stays clean).
+            finite = jnp.isfinite(losses.get("backward", jnp.zeros_like(mask)))
+            agg_mask = mask * finite.astype(mask.dtype)
             results = FitResults(
                 packets=packets,
                 sample_counts=self.sample_counts,
                 train_losses=losses,
                 train_metrics=metrics,
-                mask=mask,
+                mask=agg_mask,
             )
             new_server_state = strategy.aggregate(server_state, results, round_idx)
+            w = results.mask * self.sample_counts
             agg_losses = {
-                k: jnp.sum(v * results.mask * self.sample_counts)
-                / jnp.maximum(jnp.sum(results.mask * self.sample_counts), 1.0)
+                # where() not multiply: an excluded client's NaN loss must not
+                # poison the weighted mean (NaN * 0 == NaN).
+                k: jnp.sum(jnp.where(results.mask > 0, v, 0.0) * w)
+                / jnp.maximum(jnp.sum(w), 1.0)
                 for k, v in losses.items()
             }
-            agg_metrics = aggregate_metrics(metrics, self.sample_counts, mask)
-            return new_server_state, new_states, agg_losses, agg_metrics
+            agg_metrics = aggregate_metrics(metrics, self.sample_counts, results.mask)
+            return new_server_state, new_states, agg_losses, agg_metrics, losses
 
         def client_eval(state: TrainState, payload, batches: Batch):
             payload_params = payload.params if hasattr(payload, "params") else payload
@@ -274,12 +320,19 @@ class FederatedSimulation:
                 jax.random.fold_in(self.rng, 2000 + rnd), rnd
             )
             batches = self._round_batches(rnd)
-            self.server_state, self.client_states, fit_losses, fit_metrics = (
-                self._fit_round(
-                    self.server_state, self.client_states, batches, mask,
-                    jnp.asarray(rnd, jnp.int32), val_batches,
-                )
+            (
+                self.server_state,
+                self.client_states,
+                fit_losses,
+                fit_metrics,
+                per_client_fit_losses,
+            ) = self._fit_round(
+                self.server_state, self.client_states, batches, mask,
+                jnp.asarray(rnd, jnp.int32), val_batches,
             )
+            # Failure policy screen (base_server.py:316-318): terminate before
+            # checkpointing a poisoned aggregate when accept_failures=False.
+            self.failure_policy.check(jax.device_get(per_client_fit_losses), mask)
             fit_losses = {k: float(v) for k, v in jax.device_get(fit_losses).items()}
             fit_metrics = {k: float(v) for k, v in jax.device_get(fit_metrics).items()}
             for mode, ckpt in self.model_checkpointers:

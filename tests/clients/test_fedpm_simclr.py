@@ -111,7 +111,10 @@ def test_warmed_up_module_mapping():
 
     # Prefix remapping: target under twin "global_model" pulls from the flat
     # pretrained tree (warmed_up_module.py:57-84 partial-prefix semantics).
-    target = {"global_model": fresh}
     warm2 = WarmedUpModule(pre, weights_mapping={"global_model": ""})
     mapped = warm2.get_matching_component("global_model.Dense_0.kernel")
-    assert mapped == ".Dense_0.kernel"
+    assert mapped == "Dense_0.kernel"
+    injected = warm2.load_from_pretrained({"global_model": fresh})
+    for a, b in zip(jax.tree_util.tree_leaves(injected["global_model"]),
+                    jax.tree_util.tree_leaves(pre)):
+        assert np.allclose(np.asarray(a), np.asarray(b))

@@ -88,10 +88,13 @@ def test_transplant_dense_weights():
     masked = MaskedMlp(features=(8,), n_outputs=3)
     mv = masked.init({"params": jax.random.PRNGKey(1), "mask": jax.random.PRNGKey(2)}, x)
     frozen = transplant_dense_weights(dense_params, mv["frozen"])
-    # Shapes align and at least the first layer kernel was actually copied.
-    chex_src = jax.tree_util.tree_leaves(dense_params)
-    chex_dst = jax.tree_util.tree_leaves(frozen)
-    assert sum(l.size for l in chex_src) == sum(l.size for l in chex_dst)
+    # Every dense layer's weights actually landed in the masked twin's frozen
+    # collection (Dense_i -> MaskedDense_i via class-prefix normalization).
+    src = sorted(np.asarray(l).sum() for l in jax.tree_util.tree_leaves(dense_params))
+    dst = sorted(np.asarray(l).sum() for l in jax.tree_util.tree_leaves(frozen))
+    assert np.allclose(src, dst)
+    before = sorted(np.asarray(l).sum() for l in jax.tree_util.tree_leaves(mv["frozen"]))
+    assert not np.allclose(src, before)  # init values were really replaced
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,226 @@
+"""Specialized-server tests (reference: tests/servers/*)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.instance_level_dp import InstanceLevelDpClientLogic
+from fl4health_tpu.clients.scaffold import ScaffoldClientLogic
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.servers import (
+    ClientLevelDpFedAvgServer,
+    EvaluateServer,
+    FedPmServer,
+    FedProxServer,
+    InstanceLevelDpServer,
+    ModelMergeServer,
+    ScaffoldServer,
+    poll_clients,
+    poll_sample_counts,
+)
+from fl4health_tpu.server.simulation import (
+    ClientDataset,
+    ClientFailuresError,
+    FailurePolicy,
+    FederatedSimulation,
+)
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.fedprox import FedAvgWithAdaptiveConstraint
+from fl4health_tpu.strategies.scaffold import Scaffold
+
+N_CLASSES = 3
+DIM = 8
+
+
+def _datasets(n_clients=3, n=40, seed=0):
+    out = []
+    for i in range(n_clients):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(seed + i), n, (DIM,), N_CLASSES
+        )
+        out.append(ClientDataset(x[: n - 16], y[: n - 16], x[n - 16:], y[n - 16:]))
+    return out
+
+
+def _mlp():
+    return Mlp(features=(16,), n_outputs=N_CLASSES)
+
+
+def _basic_sim(**kw):
+    logic = engine.ClientLogic(engine.from_flax(_mlp()), engine.masked_cross_entropy)
+    return FederatedSimulation(
+        logic=logic, tx=optax.sgd(0.05), strategy=FedAvg(), datasets=_datasets(),
+        batch_size=8, metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1, seed=1, **kw,
+    )
+
+
+def test_poll_clients_and_sample_counts():
+    providers = [lambda req: {"id": 0, "echo": req["q"]},
+                 lambda req: {"id": 1, "echo": req["q"]}]
+    props = poll_clients(providers, {"q": 7})
+    assert props == [{"id": 0, "echo": 7}, {"id": 1, "echo": 7}]
+    sim = _basic_sim()
+    assert poll_sample_counts(sim) == [24, 24, 24]
+
+
+def test_failure_policy_accepts_and_raises():
+    policy = FailurePolicy(accept_failures=True)
+    losses = {"backward": jnp.asarray([1.0, jnp.nan, 2.0])}
+    mask = jnp.asarray([1.0, 1.0, 1.0])
+    assert policy.check(losses, mask) == [1]
+    # Masked-out client's NaN is not a failure.
+    assert policy.check(losses, jnp.asarray([1.0, 0.0, 1.0])) == []
+    strict = FailurePolicy(accept_failures=False)
+    with pytest.raises(ClientFailuresError):
+        strict.check(losses, mask)
+
+
+def test_failed_client_excluded_from_aggregate():
+    # Client 1's data is NaN-poisoned -> its loss and update go non-finite;
+    # the compiled round must exclude it so the aggregate stays clean
+    # (reference: failures never enter aggregate_fit results).
+    ds = _datasets()
+    ds[1] = ClientDataset(
+        jnp.full_like(ds[1].x_train, jnp.nan), ds[1].y_train,
+        ds[1].x_val, ds[1].y_val,
+    )
+    logic = engine.ClientLogic(engine.from_flax(_mlp()), engine.masked_cross_entropy)
+    sim = FederatedSimulation(
+        logic=logic, tx=optax.sgd(0.05), strategy=FedAvg(), datasets=ds,
+        batch_size=8, metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1, seed=1,
+    )
+    hist = sim.fit(2)
+    flat = jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    assert bool(jnp.all(jnp.isfinite(flat)))
+    assert np.isfinite(hist[-1].fit_losses["backward"])
+    # Strict policy terminates instead.
+    sim2 = FederatedSimulation(
+        logic=logic, tx=optax.sgd(0.05), strategy=FedAvg(), datasets=ds,
+        batch_size=8, metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1, seed=1, failure_policy=FailurePolicy(accept_failures=False),
+    )
+    with pytest.raises(ClientFailuresError):
+        sim2.fit(1)
+
+
+def test_scaffold_warm_start_initializes_variates():
+    logic = ScaffoldClientLogic(engine.from_flax(_mlp()), engine.masked_cross_entropy,
+                                learning_rate=0.05)
+    sim = FederatedSimulation(
+        logic=logic, tx=optax.sgd(0.05), strategy=Scaffold(),
+        datasets=_datasets(), batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)), local_epochs=1, seed=2,
+    )
+    pre_params = jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    server = ScaffoldServer(sim, warm_start=True)
+    hist = server.fit(2)
+    assert len(hist) == 2
+    # Warm start must not have moved the initial global weights before round 1
+    # — but rounds have since updated them; instead verify variates exist and
+    # training progressed.
+    post_cv = jax.flatten_util.ravel_pytree(sim.server_state.control_variates)[0]
+    assert float(jnp.max(jnp.abs(post_cv))) > 0.0
+    assert np.isfinite(hist[-1].eval_losses["checkpoint"])
+
+
+def test_scaffold_warm_start_preserves_weights():
+    logic = ScaffoldClientLogic(engine.from_flax(_mlp()), engine.masked_cross_entropy,
+                                learning_rate=0.05)
+    sim = FederatedSimulation(
+        logic=logic, tx=optax.sgd(0.05), strategy=Scaffold(),
+        datasets=_datasets(), batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)), local_epochs=1, seed=2,
+    )
+    from fl4health_tpu.server.servers import scaffold_warm_start
+
+    pre = jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    pre_client = jax.flatten_util.ravel_pytree(sim.client_states.params)[0]
+    scaffold_warm_start(sim)
+    post = jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    post_client = jax.flatten_util.ravel_pytree(sim.client_states.params)[0]
+    # Weights discarded (scaffold_server.py:139-158)...
+    assert np.allclose(np.asarray(pre), np.asarray(post))
+    assert np.allclose(np.asarray(pre_client), np.asarray(post_client))
+    # ...variates warmed.
+    cv = jax.flatten_util.ravel_pytree(sim.client_states.extra.client_variates)[0]
+    assert float(jnp.max(jnp.abs(cv))) > 0.0
+
+
+def test_instance_level_dp_server_epsilon():
+    logic = InstanceLevelDpClientLogic(
+        engine.from_flax(_mlp()), engine.masked_cross_entropy,
+        clipping_bound=1.0, noise_multiplier=1.0,
+    )
+    sim = FederatedSimulation(
+        logic=logic, tx=optax.sgd(0.05), strategy=FedAvg(), datasets=_datasets(),
+        batch_size=8, metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1, seed=3,
+    )
+    server = InstanceLevelDpServer(sim, noise_multiplier=1.0, batch_size=8)
+    hist, epsilon = server.fit(2)
+    assert len(hist) == 2
+    assert 0.0 < epsilon < 100.0
+
+
+def test_client_level_dp_server_epsilon():
+    sim = _basic_sim()
+    server = ClientLevelDpFedAvgServer(sim, noise_multiplier=2.0)
+    hist, epsilon = server.fit(1)
+    assert len(hist) == 1
+    assert 0.0 < epsilon < 200.0
+
+
+def test_evaluate_server_no_training():
+    sim = _basic_sim()
+    pre = jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    losses, metrics = EvaluateServer(sim).fit()
+    post = jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    assert np.allclose(np.asarray(pre), np.asarray(post))  # nothing trained
+    assert np.isfinite(losses["checkpoint"])
+    assert "accuracy" in metrics
+
+
+def test_evaluate_server_from_checkpoint_params():
+    sim = _basic_sim()
+    zeroed = jax.tree_util.tree_map(jnp.zeros_like, sim.global_params)
+    losses_zero, _ = EvaluateServer(sim, params=zeroed).fit()
+    assert np.isfinite(losses_zero["checkpoint"])
+
+
+def test_model_merge_server():
+    sim = _basic_sim()
+    sim.fit(1)  # local training happened; clients differ from each other
+    merged, losses, metrics = ModelMergeServer(sim).fit()
+    m = jax.flatten_util.ravel_pytree(merged)[0]
+    stacked = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(
+        sim.client_states.params
+    )
+    assert np.allclose(np.asarray(m), np.asarray(jnp.mean(stacked, axis=0)), atol=1e-6)
+    assert np.isfinite(losses["checkpoint"])
+
+
+def test_wrapper_assertions():
+    sim = _basic_sim()
+    with pytest.raises(AssertionError):
+        FedPmServer(sim)
+    with pytest.raises(AssertionError):
+        ScaffoldServer(sim)
+    with pytest.raises(AssertionError):
+        FedProxServer(sim)
+    # Correct pairing constructs fine.
+    logic = engine.ClientLogic(engine.from_flax(_mlp()), engine.masked_cross_entropy)
+    sim2 = FederatedSimulation(
+        logic=logic, tx=optax.sgd(0.05),
+        strategy=FedAvgWithAdaptiveConstraint(initial_drift_penalty_weight=0.1),
+        datasets=_datasets(), batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)), local_epochs=1, seed=1,
+    )
+    FedProxServer(sim2)
