@@ -1,0 +1,151 @@
+"""Numpy column transforms driven by the negotiated schema.
+
+Parity: TabularFeaturesPreprocessor (/root/reference/fl4health/
+feature_alignment/tab_features_preprocessor.py:18) — one transform per
+column from its TabularType, features one-hot / targets ordinal, unknown
+categories handled, missing values imputed with the schema's fill value,
+string columns TF-IDF'd against the shared vocabulary
+(string_columns_transformer.py:9,50). Output column order is the sorted
+feature-name order the reference's ColumnTransformer uses (:147-166), so
+every client produces identically-shaped aligned arrays.
+
+Built on numpy instead of sklearn pipelines: the transforms are small,
+deterministic, and dependency-free; ``set_feature_pipeline`` keeps the
+reference's per-column customization hook (:168).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import numpy as np
+
+from fl4health_tpu.feature_alignment.schema import (
+    TabularFeature,
+    TabularFeaturesInfoEncoder,
+    TabularType,
+    tokenize,
+)
+
+
+def _impute(col: np.ndarray, fill_value) -> np.ndarray:
+    out = np.array(col, dtype=object)
+    missing = np.asarray([v is None or v != v for v in out])
+    out[missing] = fill_value
+    return out
+
+
+def _numeric_transform(feature: TabularFeature) -> Callable[[np.ndarray], np.ndarray]:
+    """Impute + min-max scale (tab_features_preprocessor.py:48-55). The
+    min/max are fit on the client's own column, as sklearn's pipeline does."""
+
+    def transform(col: np.ndarray) -> np.ndarray:
+        vals = _impute(col, feature.fill_value).astype(np.float64)
+        lo, hi = float(np.min(vals)), float(np.max(vals))
+        scale = (hi - lo) if hi > lo else 1.0
+        return ((vals - lo) / scale)[:, None]
+
+    return transform
+
+
+def _categorical_transform(feature: TabularFeature, one_hot: bool
+                           ) -> Callable[[np.ndarray], np.ndarray]:
+    """One-hot with ignored unknowns (features) or ordinal with a dedicated
+    unknown code (targets) (tab_features_preprocessor.py:66-101)."""
+    categories = [str(c) for c in feature.metadata]
+    index = {c: i for i, c in enumerate(categories)}
+
+    def transform(col: np.ndarray) -> np.ndarray:
+        vals = [str(v) for v in _impute(col, feature.fill_value)]
+        codes = np.asarray([index.get(v, -1) for v in vals])
+        if one_hot:
+            out = np.zeros((len(vals), len(categories)), np.float64)
+            known = codes >= 0
+            out[np.nonzero(known)[0], codes[known]] = 1.0  # unknown -> all-zero row
+            return out
+        unknown_code = len(categories) + 1  # (:78-90 OrdinalEncoder unknown_value)
+        return np.where(codes >= 0, codes, unknown_code).astype(np.float64)[:, None]
+
+    return transform
+
+
+def _tfidf_transform(feature: TabularFeature) -> Callable[[np.ndarray], np.ndarray]:
+    """TF-IDF against the shared vocabulary (string_columns_transformer.py:50
+    wraps TfidfVectorizer(vocabulary=...)): smooth idf, l2-normalized rows —
+    sklearn's defaults."""
+    vocab = {tok: i for i, tok in enumerate(feature.metadata)}
+    v = len(vocab)
+
+    def transform(col: np.ndarray) -> np.ndarray:
+        docs = [tokenize(x) for x in _impute(col, feature.fill_value)]
+        n = len(docs)
+        counts = np.zeros((n, v), np.float64)
+        for row, doc in enumerate(docs):
+            for tok in doc:
+                j = vocab.get(tok)
+                if j is not None:
+                    counts[row, j] += 1.0
+        df = np.count_nonzero(counts, axis=0)
+        idf = np.log((1.0 + n) / (1.0 + df)) + 1.0  # smooth_idf
+        tfidf = counts * idf[None, :]
+        norms = np.linalg.norm(tfidf, axis=1, keepdims=True)
+        return tfidf / np.maximum(norms, 1e-12)
+
+    return transform
+
+
+def _default_transform(feature: TabularFeature, one_hot: bool):
+    t = feature.feature_type
+    if t is TabularType.NUMERIC:
+        return _numeric_transform(feature)
+    if t in (TabularType.BINARY, TabularType.ORDINAL):
+        return _categorical_transform(feature, one_hot=one_hot)
+    return _tfidf_transform(feature)
+
+
+class TabularFeaturesPreprocessor:
+    """Schema-driven dataframe -> aligned arrays (tab_features_preprocessor.py:18)."""
+
+    def __init__(self, tab_feature_encoder: TabularFeaturesInfoEncoder):
+        self.encoder = tab_feature_encoder
+        self.features_to_pipelines: dict[str, Callable] = {
+            f.feature_name: _default_transform(f, one_hot=True)
+            for f in tab_feature_encoder.get_tabular_features()
+        }
+        self.targets_to_pipelines: dict[str, Callable] = {
+            t.feature_name: _default_transform(t, one_hot=False)
+            for t in tab_feature_encoder.get_tabular_targets()
+        }
+
+    def set_feature_pipeline(self, feature_name: str, transform: Callable) -> None:
+        """Per-column customization hook (tab_features_preprocessor.py:168)."""
+        if feature_name in self.features_to_pipelines:
+            self.features_to_pipelines[feature_name] = transform
+        if feature_name in self.targets_to_pipelines:
+            self.targets_to_pipelines[feature_name] = transform
+
+    def _get_column(self, df, name: str, fill_value, n_rows: int) -> np.ndarray:
+        # Columns missing entirely from a client's dataframe are synthesized
+        # from the fill value — the core of cross-client alignment.
+        if name in df.columns:
+            return np.asarray(df[name], dtype=object)
+        return np.full((n_rows,), fill_value, dtype=object)
+
+    def preprocess_features(self, df) -> tuple[np.ndarray, np.ndarray]:
+        """-> (aligned_features, aligned_targets) (tabular_data_client.py:113)."""
+        n = len(df)
+        blocks = []
+        for feature in self.encoder.get_tabular_features():  # sorted order
+            col = self._get_column(df, feature.feature_name, feature.fill_value, n)
+            blocks.append(self.features_to_pipelines[feature.feature_name](col))
+        x = np.concatenate(blocks, axis=1) if blocks else np.zeros((n, 0))
+
+        target_blocks = []
+        for target in self.encoder.get_tabular_targets():
+            col = self._get_column(df, target.feature_name, target.fill_value, n)
+            target_blocks.append(self.targets_to_pipelines[target.feature_name](col))
+        y = np.concatenate(target_blocks, axis=1) if target_blocks else np.zeros((n, 0))
+        if y.shape[1] == 1:
+            y = y[:, 0]
+        return x.astype(np.float32), y.astype(np.float32)
