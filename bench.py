@@ -89,8 +89,11 @@ def timed_compiled_rounds(sim) -> float:
     t0 = time.perf_counter()
     server_state, client_states = sim.server_state, sim.client_states
     for i in range(TIMED_ROUNDS):
+        # Honest full-round cost: per-round batch construction included
+        # (host index plan + one device gather), exactly as fit() pays it.
+        round_batches = sim._round_batches(i + 1)
         server_state, client_states, losses, metrics, _per_client = sim._fit_round(
-            server_state, client_states, batches, mask, r + i, val_batches
+            server_state, client_states, round_batches, mask, r + i, val_batches
         )
     jax.block_until_ready(jax.tree_util.tree_leaves(server_state)[0])
     return (time.perf_counter() - t0) / TIMED_ROUNDS

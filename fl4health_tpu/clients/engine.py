@@ -24,6 +24,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 
@@ -499,6 +500,72 @@ def make_local_train_with_early_stopping(
 # ---------------------------------------------------------------------------
 # Host-side batching: DataLoader equivalent producing static-shaped stacks
 # ---------------------------------------------------------------------------
+#
+# Index construction is pure numpy (zero device dispatches); the only device
+# work per round is ONE gather per array. At 64 clients the previous per-step
+# jnp indexing was thousands of tiny dispatches per round — the reference's
+# eager-DataLoader dispatch pattern this build exists to eliminate.
+
+
+def _entropy_from_key(rng: PRNGKey) -> list[int]:
+    """Stable integer entropy from a JAX PRNG key (legacy uint32 or typed)."""
+    try:
+        data = np.asarray(jax.random.key_data(rng))
+    except (TypeError, ValueError):
+        data = np.asarray(rng)
+    return [int(v) for v in data.ravel()]
+
+
+def epoch_index_plan(
+    entropy: list[int],
+    n: int,
+    batch_size: int,
+    n_steps: int | None = None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized batch-index plan: (idx [S,B] i32, example_mask [S,B] f32,
+    step_mask [S] f32), all numpy.
+
+    Semantics match the reference loader: one epoch (or exactly n_steps,
+    wrapping with a fresh shuffle at each epoch boundary — train_by_steps
+    cycles its loader, basic_client.py:699); ragged final batch rows get
+    example_mask 0.
+    """
+    steps_per_epoch = max(1, n // batch_size if drop_last else -(-n // batch_size))
+    total = n_steps if n_steps is not None else steps_per_epoch
+    n_epochs = -(-total // steps_per_epoch)
+
+    rng = np.random.default_rng(np.random.SeedSequence(entropy))
+    if shuffle:
+        orders = rng.permuted(
+            np.tile(np.arange(n, dtype=np.int32), (n_epochs, 1)), axis=1
+        )
+    else:
+        orders = np.tile(np.arange(n, dtype=np.int32), (n_epochs, 1))
+
+    padded_len = steps_per_epoch * batch_size
+    if padded_len <= n:
+        epoch_idx = orders[:, :padded_len]
+        epoch_mask = np.ones((padded_len,), np.float32)
+    else:
+        pad = padded_len - n
+        epoch_idx = np.concatenate(
+            [orders, np.zeros((n_epochs, pad), np.int32)], axis=1
+        )
+        epoch_mask = np.concatenate(
+            [np.ones((n,), np.float32), np.zeros((pad,), np.float32)]
+        )
+
+    idx = epoch_idx.reshape(n_epochs * steps_per_epoch, batch_size)[:total]
+    example_mask = np.tile(
+        epoch_mask.reshape(steps_per_epoch, batch_size), (n_epochs, 1)
+    )[:total]
+    # A step with zero valid examples (e.g. an empty client dataset) is a full
+    # no-op: the engine gates optimizer/meter updates on step_mask.
+    step_mask = (example_mask.sum(axis=1) > 0).astype(np.float32)
+    return idx, example_mask, step_mask
+
 
 def epoch_batches(
     rng: PRNGKey,
@@ -515,42 +582,89 @@ def epoch_batches(
     train_by_steps cycles its loader); if it's shorter, the epoch is truncated.
     Padding rows get example_mask 0; padding steps get step_mask 0.
     """
-    n = x.shape[0]
-    order = jax.random.permutation(rng, n) if shuffle else jnp.arange(n)
-    steps_per_epoch = max(1, n // batch_size if drop_last else -(-n // batch_size))
-    total = n_steps if n_steps is not None else steps_per_epoch
-    idx = []
-    masks = []
-    smasks = []
-    for s in range(total):
-        if n_steps is not None and s >= steps_per_epoch and n_steps <= steps_per_epoch:
-            break
-        epoch_pos = s % steps_per_epoch
-        if n_steps is not None and s > 0 and epoch_pos == 0 and shuffle:
-            order = jax.random.permutation(jax.random.fold_in(rng, s), n)
-        start = epoch_pos * batch_size
-        take = min(batch_size, n - start)
-        if take <= 0:
-            idx.append(jnp.zeros((batch_size,), jnp.int32))
-            masks.append(jnp.zeros((batch_size,), jnp.float32))
-            smasks.append(jnp.zeros((), jnp.float32))
-            continue
-        row = jnp.concatenate(
-            [order[start : start + take], jnp.zeros((batch_size - take,), order.dtype)]
-        )
-        idx.append(row)
-        masks.append(
-            jnp.concatenate(
-                [jnp.ones((take,), jnp.float32), jnp.zeros((batch_size - take,), jnp.float32)]
-            )
-        )
-        smasks.append(jnp.ones((), jnp.float32))
-    idx_arr = jnp.stack(idx)
+    idx, example_mask, step_mask = epoch_index_plan(
+        _entropy_from_key(rng), x.shape[0], batch_size, n_steps, shuffle, drop_last
+    )
+    idx_arr = jnp.asarray(idx)
     return Batch(
         x=x[idx_arr],
         y=y[idx_arr],
-        example_mask=jnp.stack(masks),
-        step_mask=jnp.stack(smasks),
+        example_mask=jnp.asarray(example_mask),
+        step_mask=jnp.asarray(step_mask),
+    )
+
+
+def multi_client_index_plans(
+    entropies: list[list[int]],
+    ns: list[int],
+    batch_size: int,
+    n_steps: int | None = None,
+    local_epochs: int | None = None,
+    shuffle: bool = True,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Cohort-wide batch plan: (idx [C,S,B], example_mask [C,S,B],
+    step_mask [C,S]) numpy arrays, padded to the cohort's max step count.
+
+    Pure host-side index math — the per-client DataLoader loop collapsed into
+    one plan that feeds a single device gather (``gather_batches``).
+    """
+    plans = []
+    for ent, n in zip(entropies, ns):
+        if local_epochs is not None:
+            parts = [
+                epoch_index_plan([*ent, e], n, batch_size, None, shuffle)
+                for e in range(local_epochs)
+            ]
+            idx = np.concatenate([p[0] for p in parts], axis=0)
+            em = np.concatenate([p[1] for p in parts], axis=0)
+            sm = np.concatenate([p[2] for p in parts], axis=0)
+        else:
+            idx, em, sm = epoch_index_plan(ent, n, batch_size, n_steps, shuffle)
+        plans.append((idx, em, sm))
+    n_clients = len(plans)
+    max_steps = max(p[0].shape[0] for p in plans)
+    idx_all = np.zeros((n_clients, max_steps, batch_size), np.int32)
+    em_all = np.zeros((n_clients, max_steps, batch_size), np.float32)
+    sm_all = np.zeros((n_clients, max_steps), np.float32)
+    for c, (idx, em, sm) in enumerate(plans):
+        s = idx.shape[0]
+        idx_all[c, :s] = idx
+        em_all[c, :s] = em
+        sm_all[c, :s] = sm
+    return idx_all, em_all, sm_all
+
+
+def pad_and_stack_data(arrays: list[jax.Array]) -> jax.Array:
+    """Zero-pad along axis 0 to the max length and stack -> [C, max_n, ...].
+
+    Setup-time only; padding rows are never selected by a valid index plan.
+    Assembly happens on HOST (numpy) with a single device transfer at the end,
+    so device memory holds only the stacked copy — not stack + originals.
+    Pass numpy arrays in ClientDataset to avoid any device round-trip.
+    """
+    host = [np.asarray(a) for a in arrays]
+    max_n = max(a.shape[0] for a in host)
+    stack = np.zeros((len(host), max_n, *host[0].shape[1:]), host[0].dtype)
+    for i, a in enumerate(host):
+        stack[i, : a.shape[0]] = a
+    return jnp.asarray(stack)
+
+
+def gather_batches(
+    x_stack: jax.Array,
+    y_stack: jax.Array,
+    idx: np.ndarray,
+    example_mask: np.ndarray,
+    step_mask: np.ndarray,
+) -> Batch:
+    """One device-side gather from pre-stacked data -> [C,S,B,...] Batch."""
+    idx_arr = jnp.asarray(idx)
+    c = jnp.arange(idx_arr.shape[0])[:, None, None]
+    return Batch(
+        x=x_stack[c, idx_arr],
+        y=y_stack[c, idx_arr],
+        example_mask=jnp.asarray(example_mask),
+        step_mask=jnp.asarray(step_mask),
     )
 
 

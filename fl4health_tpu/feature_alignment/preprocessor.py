@@ -36,17 +36,34 @@ def _impute(col: np.ndarray, fill_value) -> np.ndarray:
     return out
 
 
-def _numeric_transform(feature: TabularFeature) -> Callable[[np.ndarray], np.ndarray]:
-    """Impute + min-max scale (tab_features_preprocessor.py:48-55). The
-    min/max are fit on the client's own column, as sklearn's pipeline does."""
+class _NumericTransform:
+    """Impute + min-max scale (tab_features_preprocessor.py:48-55). The scaler
+    is fit explicitly via ``fit`` (TabularFeaturesPreprocessor.fit does this on
+    the training dataframe) — or lazily on the first column seen — and the
+    stored min/max are reused afterwards, matching sklearn's fit-then-transform
+    pipeline so train and validation/test scale consistently."""
 
-    def transform(col: np.ndarray) -> np.ndarray:
-        vals = _impute(col, feature.fill_value).astype(np.float64)
+    def __init__(self, feature: TabularFeature):
+        self.feature = feature
+        self.lo: float | None = None
+        self.scale: float = 1.0
+
+    def fit(self, col: np.ndarray) -> "_NumericTransform":
+        vals = _impute(col, self.feature.fill_value).astype(np.float64)
         lo, hi = float(np.min(vals)), float(np.max(vals))
-        scale = (hi - lo) if hi > lo else 1.0
-        return ((vals - lo) / scale)[:, None]
+        self.lo = lo
+        self.scale = (hi - lo) if hi > lo else 1.0
+        return self
 
-    return transform
+    def __call__(self, col: np.ndarray) -> np.ndarray:
+        if self.lo is None:
+            self.fit(col)
+        vals = _impute(col, self.feature.fill_value).astype(np.float64)
+        return ((vals - self.lo) / self.scale)[:, None]
+
+
+def _numeric_transform(feature: TabularFeature) -> Callable[[np.ndarray], np.ndarray]:
+    return _NumericTransform(feature)
 
 
 def _categorical_transform(feature: TabularFeature, one_hot: bool
@@ -70,29 +87,47 @@ def _categorical_transform(feature: TabularFeature, one_hot: bool
     return transform
 
 
-def _tfidf_transform(feature: TabularFeature) -> Callable[[np.ndarray], np.ndarray]:
+class _TfidfTransform:
     """TF-IDF against the shared vocabulary (string_columns_transformer.py:50
     wraps TfidfVectorizer(vocabulary=...)): smooth idf, l2-normalized rows —
-    sklearn's defaults."""
-    vocab = {tok: i for i, tok in enumerate(feature.metadata)}
-    v = len(vocab)
+    sklearn's defaults. idf is fit once (explicitly via ``fit`` or lazily on
+    the first corpus), like the reference's fitted TfidfVectorizer."""
 
-    def transform(col: np.ndarray) -> np.ndarray:
-        docs = [tokenize(x) for x in _impute(col, feature.fill_value)]
-        n = len(docs)
-        counts = np.zeros((n, v), np.float64)
+    def __init__(self, feature: TabularFeature):
+        self.feature = feature
+        self.vocab = {tok: i for i, tok in enumerate(feature.metadata)}
+        self.idf: np.ndarray | None = None
+
+    def _counts(self, col: np.ndarray) -> np.ndarray:
+        docs = [tokenize(x) for x in _impute(col, self.feature.fill_value)]
+        counts = np.zeros((len(docs), len(self.vocab)), np.float64)
         for row, doc in enumerate(docs):
             for tok in doc:
-                j = vocab.get(tok)
+                j = self.vocab.get(tok)
                 if j is not None:
                     counts[row, j] += 1.0
+        return counts
+
+    def fit(self, col: np.ndarray) -> "_TfidfTransform":
+        counts = self._counts(col)
+        n = counts.shape[0]
         df = np.count_nonzero(counts, axis=0)
-        idf = np.log((1.0 + n) / (1.0 + df)) + 1.0  # smooth_idf
-        tfidf = counts * idf[None, :]
+        self.idf = np.log((1.0 + n) / (1.0 + df)) + 1.0  # smooth_idf
+        return self
+
+    def __call__(self, col: np.ndarray) -> np.ndarray:
+        counts = self._counts(col)
+        if self.idf is None:
+            n = counts.shape[0]
+            df = np.count_nonzero(counts, axis=0)
+            self.idf = np.log((1.0 + n) / (1.0 + df)) + 1.0
+        tfidf = counts * self.idf[None, :]
         norms = np.linalg.norm(tfidf, axis=1, keepdims=True)
         return tfidf / np.maximum(norms, 1e-12)
 
-    return transform
+
+def _tfidf_transform(feature: TabularFeature) -> Callable[[np.ndarray], np.ndarray]:
+    return _TfidfTransform(feature)
 
 
 def _default_transform(feature: TabularFeature, one_hot: bool):
@@ -117,6 +152,24 @@ class TabularFeaturesPreprocessor:
             t.feature_name: _default_transform(t, one_hot=False)
             for t in tab_feature_encoder.get_tabular_targets()
         }
+
+    def fit(self, df) -> "TabularFeaturesPreprocessor":
+        """Explicitly fit all stateful column transforms (scalers, idf) on the
+        TRAINING dataframe. Callers that preprocess multiple splits should fit
+        here first; otherwise transforms lazily fit on the first column they
+        see, which makes call order significant."""
+        n = len(df)
+        for feature in self.encoder.get_tabular_features():
+            pipe = self.features_to_pipelines[feature.feature_name]
+            if hasattr(pipe, "fit"):
+                pipe.fit(self._get_column(df, feature.feature_name,
+                                          feature.fill_value, n))
+        for target in self.encoder.get_tabular_targets():
+            pipe = self.targets_to_pipelines[target.feature_name]
+            if hasattr(pipe, "fit"):
+                pipe.fit(self._get_column(df, target.feature_name,
+                                          target.fill_value, n))
+        return self
 
     def set_feature_pipeline(self, feature_name: str, transform: Callable) -> None:
         """Per-column customization hook (tab_features_preprocessor.py:168)."""

@@ -173,7 +173,9 @@ class MaskedBatchNorm(_MaskedMixin, nn.Module):
     behave as in nn.BatchNorm (batch_stats collection); the frozen affine
     parameters are masked."""
 
-    momentum: float = 0.99
+    # torch momentum=0.1 (reference masked batch norm default) == flax-style
+    # decay 0.9: running stats adapt at the reference's rate.
+    momentum: float = 0.9
     epsilon: float = 1e-5
 
     @nn.compact

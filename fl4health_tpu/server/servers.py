@@ -162,8 +162,11 @@ class InstanceLevelDpServer:
     def fit(self, n_rounds: int):
         self.setup_accountant(n_rounds)
         assert self.accountant is not None
-        delta = self.delta if self.delta is not None else min(
-            1.0 / c for c in poll_sample_counts(self.sim)
+        # Default delta = 1/total_samples across the federation
+        # (instance_level_dp_server.py:163) — NOT 1/max(client size), which
+        # would silently report a much weaker guarantee.
+        delta = self.delta if self.delta is not None else 1.0 / sum(
+            poll_sample_counts(self.sim)
         )
         epsilon = self.accountant.get_epsilon(n_rounds, delta)
         logger.info("Instance-level DP run: epsilon=%.4f at delta=%.2e over %d rounds",

@@ -152,6 +152,15 @@ class FederatedSimulation:
         )
         self.history: list[RoundRecord] = []
 
+        # Pre-stacked per-client data (one-time, device-resident) feeding the
+        # per-round single-gather batch construction (engine.gather_batches).
+        self._x_train_stack = engine.pad_and_stack_data([d.x_train for d in self.datasets])
+        self._y_train_stack = engine.pad_and_stack_data([d.y_train for d in self.datasets])
+        self._x_val_stack = engine.pad_and_stack_data([d.x_val for d in self.datasets])
+        self._y_val_stack = engine.pad_and_stack_data([d.y_val for d in self.datasets])
+        self._base_entropy = engine._entropy_from_key(self.rng)
+        self._val_cache: tuple[Batch, jax.Array] | None = None
+
         # --- init client + server state -----------------------------------
         init_rng = jax.random.fold_in(self.rng, 0)
         sample_x = self.datasets[0].x_train[:1]
@@ -274,35 +283,32 @@ class FederatedSimulation:
 
     # ------------------------------------------------------------------
     def _round_batches(self, round_idx: int) -> Batch:
-        stacks = []
-        for i, d in enumerate(self.datasets):
-            rng = jax.random.fold_in(jax.random.fold_in(self.rng, 1000 + round_idx), i)
-            if self.local_steps is not None:
-                b = engine.epoch_batches(
-                    rng, d.x_train, d.y_train, self.batch_size, n_steps=self.local_steps
-                )
-            else:
-                per_epoch = [
-                    engine.epoch_batches(
-                        jax.random.fold_in(rng, e), d.x_train, d.y_train, self.batch_size
-                    )
-                    for e in range(self.local_epochs)
-                ]
-                b = jax.tree_util.tree_map(
-                    lambda *xs: jnp.concatenate(xs, axis=0), *per_epoch
-                )
-            stacks.append(b)
-        return engine.pad_batch_stacks(stacks)
+        entropies = [
+            [*self._base_entropy, 1000 + round_idx, i] for i in range(self.n_clients)
+        ]
+        idx, em, sm = engine.multi_client_index_plans(
+            entropies,
+            [d.n_train for d in self.datasets],
+            self.batch_size,
+            n_steps=self.local_steps,
+            local_epochs=self.local_epochs,
+        )
+        return engine.gather_batches(
+            self._x_train_stack, self._y_train_stack, idx, em, sm
+        )
 
     def _val_batches(self) -> tuple[Batch, jax.Array]:
-        stacks = [
-            engine.epoch_batches(
-                jax.random.PRNGKey(0), d.x_val, d.y_val, self.batch_size, shuffle=False
+        if self._val_cache is None:
+            ns = [d.x_val.shape[0] for d in self.datasets]
+            idx, em, sm = engine.multi_client_index_plans(
+                [[0]] * self.n_clients, ns, self.batch_size, shuffle=False
             )
-            for d in self.datasets
-        ]
-        counts = jnp.asarray([d.x_val.shape[0] for d in self.datasets], jnp.float32)
-        return engine.pad_batch_stacks(stacks), counts
+            batches = engine.gather_batches(
+                self._x_val_stack, self._y_val_stack, idx, em, sm
+            )
+            counts = jnp.asarray(ns, jnp.float32)
+            self._val_cache = (batches, counts)
+        return self._val_cache
 
     # ------------------------------------------------------------------
     def fit(self, n_rounds: int) -> list[RoundRecord]:
