@@ -1,0 +1,106 @@
+"""Tests for non-IID tooling: samplers (utils/sampler.py parity) and the
+Dirichlet allocation partitioner (utils/partitioners.py parity)."""
+
+import numpy as np
+import pytest
+
+from fl4health_tpu.datasets.partitioners import DirichletLabelBasedAllocation
+from fl4health_tpu.datasets.samplers import (
+    DirichletLabelBasedSampler,
+    MinorityLabelBasedSampler,
+)
+
+
+def _data(n=1000, n_classes=5, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, n_classes, size=n)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    return x, y
+
+
+def test_minority_sampler_downsamples_only_minority_labels():
+    x, y = _data()
+    sampler = MinorityLabelBasedSampler(
+        list(range(5)), downsampling_ratio=0.2, minority_labels={0, 1}, hash_key=3
+    )
+    sx, sy = sampler.subsample(x, y)
+    for label in range(5):
+        orig = int((y == label).sum())
+        kept = int((sy == label).sum())
+        if label in (0, 1):
+            assert kept == int(orig * 0.2)
+        else:
+            assert kept == orig
+    assert sx.shape[0] == sy.shape[0]
+
+
+def test_dirichlet_sampler_total_count_and_skew():
+    x, y = _data(n=2000)
+    sampler = DirichletLabelBasedSampler(
+        list(range(5)), hash_key=11, sample_percentage=0.5, beta=0.1
+    )
+    sx, sy = sampler.subsample(x, y)
+    assert sy.shape[0] == 1000  # exact sample_percentage * n
+    # low beta -> heavily skewed label marginal
+    counts = np.bincount(sy, minlength=5) / sy.shape[0]
+    assert counts.max() > 0.4
+    # high beta -> near-uniform
+    uniform = DirichletLabelBasedSampler(
+        list(range(5)), hash_key=11, sample_percentage=0.5, beta=1000
+    )
+    _, uy = uniform.subsample(x, y)
+    ucounts = np.bincount(uy, minlength=5) / uy.shape[0]
+    assert abs(ucounts.max() - 0.2) < 0.05
+
+
+def test_dirichlet_sampler_deterministic_with_hash_key():
+    x, y = _data()
+    a = DirichletLabelBasedSampler(list(range(5)), hash_key=5, beta=1.0)
+    b = DirichletLabelBasedSampler(list(range(5)), hash_key=5, beta=1.0)
+    np.testing.assert_array_equal(a.subsample(x, y)[1], b.subsample(x, y)[1])
+
+
+def test_partitioner_covers_data_disjointly():
+    x, y = _data(n=1200)
+    part = DirichletLabelBasedAllocation(
+        number_of_partitions=4, unique_labels=list(range(5)), beta=5.0,
+        min_label_examples=1, hash_key=0,
+    )
+    parts, probs = part.partition_dataset(x, y)
+    assert len(parts) == 4
+    assert set(probs) == set(range(5))
+    total = sum(p[0].shape[0] for p in parts)
+    # floor() rounding discards a small remainder per label (reference
+    # "fill partition" semantics, partitioners.py:155-165)
+    assert 1200 - 4 * 5 * 2 <= total <= 1200
+    # every partitioned example's (x, y) pair exists in the source
+    for px, py in parts:
+        assert px.shape[0] == py.shape[0]
+
+
+def test_partitioner_min_label_retry_raises_when_infeasible():
+    x, y = _data(n=60)
+    part = DirichletLabelBasedAllocation(
+        number_of_partitions=10, unique_labels=list(range(5)), beta=0.01,
+        min_label_examples=5, hash_key=0,
+    )
+    with pytest.raises(ValueError, match="retries"):
+        part.partition_dataset(x, y, max_retries=3)
+
+
+def test_partitioner_prior_distribution_reuse():
+    x, y = _data(n=1000)
+    part = DirichletLabelBasedAllocation(
+        number_of_partitions=3, unique_labels=list(range(5)), beta=1.0, hash_key=9
+    )
+    _, probs = part.partition_dataset(x, y)
+    # partition a "test set" with the train priors (partitioners.py:120-135)
+    xt, yt = _data(n=500, seed=1)
+    reuse = DirichletLabelBasedAllocation(
+        number_of_partitions=3, unique_labels=list(range(5)),
+        prior_distribution=probs, hash_key=9,
+    )
+    parts, probs2 = reuse.partition_dataset(xt, yt)
+    assert len(parts) == 3
+    for label in range(5):
+        np.testing.assert_allclose(probs[label], probs2[label])

@@ -1,0 +1,98 @@
+"""Tests for the on-disk vision loaders (utils/load_data.py parity): IDX and
+npz MNIST formats, pickle-batch CIFAR-10, reproducible splits, federated
+client-dataset construction."""
+
+import gzip
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from fl4health_tpu.datasets.partitioners import DirichletLabelBasedAllocation
+from fl4health_tpu.datasets.vision import (
+    federated_client_datasets,
+    load_cifar10_arrays,
+    load_mnist_arrays,
+    split_data_and_targets,
+    synthetic_mnist_arrays,
+)
+
+
+def _write_idx(path, arr: np.ndarray, compress=False):
+    dtype_codes = {np.uint8: 0x08}
+    header = struct.pack(">HBB", 0, 0x08, arr.ndim)
+    header += struct.pack(">" + "I" * arr.ndim, *arr.shape)
+    payload = header + arr.astype(np.uint8).tobytes()
+    if compress:
+        with gzip.open(path, "wb") as f:
+            f.write(payload)
+    else:
+        with open(path, "wb") as f:
+            f.write(payload)
+
+
+@pytest.mark.parametrize("compress", [False, True])
+def test_mnist_idx_roundtrip(tmp_path, compress):
+    images = np.random.default_rng(0).integers(0, 256, (20, 28, 28)).astype(np.uint8)
+    labels = np.random.default_rng(1).integers(0, 10, (20,)).astype(np.uint8)
+    suffix = ".gz" if compress else ""
+    _write_idx(tmp_path / f"train-images-idx3-ubyte{suffix}", images, compress)
+    _write_idx(tmp_path / f"train-labels-idx1-ubyte{suffix}", labels, compress)
+    x, y = load_mnist_arrays(tmp_path, train=True)
+    assert x.shape == (20, 28, 28, 1)
+    assert x.dtype == np.float32
+    np.testing.assert_array_equal(y, labels.astype(np.int32))
+    # Normalize((0.5),(0.5)) parity: pixel 0 -> -1, pixel 255 -> ~1
+    np.testing.assert_allclose(x.min(), (images.min() / 255.0 - 0.5) / 0.5, atol=1e-6)
+
+
+def test_mnist_npz_fallback(tmp_path):
+    x0 = np.random.default_rng(0).integers(0, 256, (12, 28, 28)).astype(np.uint8)
+    y0 = np.arange(12) % 10
+    np.savez(tmp_path / "mnist.npz", x_train=x0, y_train=y0, x_test=x0[:4], y_test=y0[:4])
+    x, y = load_mnist_arrays(tmp_path, train=True)
+    assert x.shape == (12, 28, 28, 1)
+    xt, yt = load_mnist_arrays(tmp_path, train=False)
+    assert xt.shape[0] == 4
+
+
+def test_mnist_missing_raises_informative(tmp_path):
+    with pytest.raises(FileNotFoundError, match="synthetic"):
+        load_mnist_arrays(tmp_path)
+
+
+def test_cifar10_pickle_batches(tmp_path):
+    batch_dir = tmp_path / "cifar-10-batches-py"
+    batch_dir.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(1, 6):
+        data = rng.integers(0, 256, (10, 3072)).astype(np.uint8)
+        labels = rng.integers(0, 10, (10,)).tolist()
+        with open(batch_dir / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+    x, y = load_cifar10_arrays(tmp_path, train=True)
+    assert x.shape == (50, 32, 32, 3)
+    assert y.shape == (50,)
+    assert -1.0 <= x.min() and x.max() <= 1.0
+
+
+def test_split_reproducible_and_disjoint():
+    x, y = synthetic_mnist_arrays(n=100, seed=0)
+    xt1, yt1, xv1, yv1 = split_data_and_targets(x, y, 0.2, hash_key=5)
+    xt2, yt2, xv2, yv2 = split_data_and_targets(x, y, 0.2, hash_key=5)
+    np.testing.assert_array_equal(yt1, yt2)
+    np.testing.assert_array_equal(yv1, yv2)
+    assert xt1.shape[0] == 80 and xv1.shape[0] == 20
+
+
+def test_federated_client_datasets_partitioned():
+    x, y = synthetic_mnist_arrays(n=400, seed=0)
+    partitioner = DirichletLabelBasedAllocation(
+        number_of_partitions=4, unique_labels=list(range(10)), beta=2.0, hash_key=0
+    )
+    ds = federated_client_datasets(x, y, 4, partitioner=partitioner, hash_key=1)
+    assert len(ds) == 4
+    for d in ds:
+        assert d.x_train.shape[0] > 0 and d.x_val.shape[0] > 0
+        assert d.x_train.shape[1:] == (28, 28, 1)

@@ -1,0 +1,177 @@
+"""Golden-metric smoke harness.
+
+Role of /root/reference/tests/smoke_tests/run_smoke_test.py (:294): run a
+seeded end-to-end federated config and compare per-round metrics against
+golden JSON files with per-metric tolerances
+(basic_server_metrics.json:21-style ``target_value``/``custom_tolerance``).
+
+The reference spawns server+client OS processes and scrapes JsonReporter
+output; here the simulated cohort is one SPMD program, so a config runs
+in-process and the history IS the report. Goldens are recorded on the CPU
+platform (``python tests/smoke/harness.py record``) — the same platform the
+test suite forces — and assert convergence trajectories, not just "better
+than random".
+
+Real-data note: this environment has zero egress, so configs use the
+deterministic MNIST-shaped synthetic corpus with Dirichlet label-skew
+partitioning (the reference smoke tests' non-IID shape). When real MNIST is
+present on disk, ``fl4health_tpu.datasets.vision.load_mnist_arrays`` plugs
+into the same harness.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.fedprox import FedProxClientLogic
+from fl4health_tpu.clients.scaffold import ScaffoldClientLogic
+from fl4health_tpu.datasets.partitioners import DirichletLabelBasedAllocation
+from fl4health_tpu.datasets.vision import federated_client_datasets
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import MnistNet
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.fedprox import FedAvgWithAdaptiveConstraint
+from fl4health_tpu.strategies.scaffold import Scaffold
+
+GOLDEN_DIR = Path(__file__).parent / "goldens"
+N_ROUNDS = 5
+
+
+def _client_datasets():
+    # class_sep 0.55 keeps the task learnable but unsaturated over the run, so
+    # the golden trajectory actually discriminates regressions. 14x14 images:
+    # the per-client-weights vmapped convs lower to grouped convolutions,
+    # which XLA:CPU runs slowly — quarter-size spatial dims keep the smoke
+    # suite fast while exercising the same conv code paths. (On TPU, sharding
+    # the clients axis turns these back into ordinary convs per chip.)
+    from fl4health_tpu.datasets.synthetic import synthetic_classification
+
+    x, y = synthetic_classification(
+        jax.random.PRNGKey(0), 960, (14, 14, 1), 10, class_sep=0.55
+    )
+    x, y = np.asarray(x), np.asarray(y)
+    partitioner = DirichletLabelBasedAllocation(
+        number_of_partitions=4, unique_labels=list(range(10)), beta=0.8,
+        min_label_examples=1, hash_key=42,
+    )
+    return federated_client_datasets(
+        x, y, n_clients=4, partitioner=partitioner, hash_key=7
+    )
+
+
+def _base(logic, strategy, tx):
+    return FederatedSimulation(
+        logic=logic,
+        tx=tx,
+        strategy=strategy,
+        datasets=_client_datasets(),
+        batch_size=32,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1,
+        seed=2024,
+    )
+
+
+def _mnist_model():
+    return engine.from_flax(MnistNet(hidden=32))
+
+
+def fedavg_mnist():
+    return _base(
+        engine.ClientLogic(_mnist_model(), engine.masked_cross_entropy),
+        FedAvg(),
+        optax.sgd(0.05),
+    )
+
+
+def scaffold_mnist():
+    return _base(
+        ScaffoldClientLogic(_mnist_model(), engine.masked_cross_entropy,
+                            learning_rate=0.05),
+        Scaffold(learning_rate=1.0),
+        optax.sgd(0.05),
+    )
+
+
+def fedprox_mnist():
+    return _base(
+        FedProxClientLogic(_mnist_model(), engine.masked_cross_entropy),
+        FedAvgWithAdaptiveConstraint(initial_drift_penalty_weight=0.1),
+        optax.sgd(0.05),
+    )
+
+
+CONFIGS = {
+    "fedavg_mnist": fedavg_mnist,
+    "scaffold_mnist": scaffold_mnist,
+    "fedprox_mnist": fedprox_mnist,
+}
+
+# Per-metric tolerances (reference custom_tolerance concept): losses compare
+# tightly; accuracy is quantized by the val-set size so it gets a wider band.
+TOLERANCES = {
+    "eval_accuracy": {"atol": 0.03},
+    "eval_loss": {"atol": 0.02, "rtol": 0.02},
+    "fit_loss": {"atol": 0.02, "rtol": 0.02},
+}
+
+
+def run_config(name: str) -> list[dict]:
+    sim = CONFIGS[name]()
+    history = sim.fit(N_ROUNDS)
+    return [
+        {
+            "eval_accuracy": round(h.eval_metrics["accuracy"], 6),
+            "eval_loss": round(h.eval_losses["checkpoint"], 6),
+            "fit_loss": round(h.fit_losses["backward"], 6),
+        }
+        for h in history
+    ]
+
+
+def record_goldens() -> None:
+    GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+    for name in CONFIGS:
+        rounds = run_config(name)
+        with open(GOLDEN_DIR / f"{name}.json", "w") as f:
+            json.dump({"rounds": rounds}, f, indent=2)
+        print(f"recorded {name}: final acc "
+              f"{rounds[-1]['eval_accuracy']:.4f}")
+
+
+def compare_to_golden(name: str, rounds: list[dict]) -> list[str]:
+    """-> list of mismatch descriptions (empty = pass)."""
+    with open(GOLDEN_DIR / f"{name}.json") as f:
+        golden = json.load(f)["rounds"]
+    errors = []
+    if len(golden) != len(rounds):
+        return [f"round count {len(rounds)} != golden {len(golden)}"]
+    for r, (got, want) in enumerate(zip(rounds, golden)):
+        for key, tol in TOLERANCES.items():
+            atol = tol.get("atol", 0.0)
+            rtol = tol.get("rtol", 0.0)
+            bound = atol + rtol * abs(want[key])
+            if abs(got[key] - want[key]) > bound:
+                errors.append(
+                    f"round {r + 1} {key}: got {got[key]:.6f}, "
+                    f"golden {want[key]:.6f} (tol {bound:.6f})"
+                )
+    return errors
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "record":
+        # Record on the CPU platform — the platform the test suite forces.
+        jax.config.update("jax_platforms", "cpu")
+        record_goldens()
+    else:
+        print("usage: python tests/smoke/harness.py record")

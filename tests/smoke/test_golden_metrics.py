@@ -1,0 +1,25 @@
+"""Golden-metric convergence regression tests (the reference's smoke-test
+assertions, tests/smoke_tests/basic_server_metrics.json:21 et al.): every
+tracked config must reproduce its recorded per-round metric trajectory within
+per-metric tolerances — not merely beat a random baseline."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+import harness  # noqa: E402
+
+
+@pytest.mark.parametrize("name", sorted(harness.CONFIGS))
+def test_golden_metrics(name):
+    golden_file = harness.GOLDEN_DIR / f"{name}.json"
+    assert golden_file.exists(), (
+        f"missing golden for {name}; run `python tests/smoke/harness.py record`"
+    )
+    rounds = harness.run_config(name)
+    errors = harness.compare_to_golden(name, rounds)
+    assert not errors, "\n".join(errors)
+    # the trajectory itself must show learning, not just match a recording
+    assert rounds[-1]["eval_accuracy"] > rounds[0]["eval_accuracy"]
