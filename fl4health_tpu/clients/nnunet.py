@@ -1,0 +1,108 @@
+"""nnU-Net federated segmentation client logic.
+
+Parity surface (/root/reference/fl4health/clients/nnunet_client.py:71
+``NnunetClient``, /root/reference/fl4health/clients/flexible/nnunet.py:85):
+deep-supervision forward (:624 predict), per-scale weighted Dice+CE with
+ignore-label masking (:659,:703), grad-norm clip 12 + polyLR SGD recipe
+(:214,:334,:338 — provided here by ``nnunet.plans.nnunet_optimizer``), and
+the ``get_properties`` plans handshake (:826: fingerprint extraction + plans
+creation on request).
+
+TPU-native design: the training loop is the shared compiled engine; this
+logic only contributes the multi-scale loss (pure mask arithmetic) and the
+host-side properties provider. AMP/GradScaler has no equivalent — bf16 on
+TPU needs no loss scaling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+from fl4health_tpu.clients.engine import Batch, ClientLogic, ModelDef
+from fl4health_tpu.losses.segmentation import (
+    deep_supervision_loss,
+    masked_dice_ce_loss,
+)
+from fl4health_tpu.nnunet.plans import (
+    extract_fingerprint,
+    generate_plans,
+    plans_to_bytes,
+)
+
+
+class NnunetClientLogic(ClientLogic):
+    """Deep-supervision segmentation on the shared engine."""
+
+    extra_loss_keys = ("dice", "ce")
+    eval_loss_keys = ("dice", "ce")
+
+    def __init__(
+        self,
+        model: ModelDef,
+        ds_strides: Sequence[Sequence[int]],
+        ignore_label: int | None = None,
+    ):
+        super().__init__(model, criterion=None)
+        self.ds_strides = tuple(tuple(int(f) for f in s) for s in ds_strides)
+        self.ignore_label = ignore_label
+
+    def training_loss(self, preds, features, batch: Batch, params, state, ctx):
+        total, dice, ce = deep_supervision_loss(
+            preds, batch.y, batch.example_mask, self.ds_strides, self.ignore_label
+        )
+        return total, {"dice": dice, "ce": ce}
+
+    def eval_loss(self, preds, features, batch: Batch, params, state, ctx):
+        total, dice, ce = masked_dice_ce_loss(
+            preds["prediction"], batch.y, batch.example_mask, self.ignore_label
+        )
+        return total, {"dice": dice, "ce": ce}
+
+
+def make_nnunet_properties_provider(
+    volumes: Sequence[np.ndarray],
+    spacings: Sequence[Sequence[float]],
+    segmentations: Sequence[np.ndarray],
+    num_classes: int | None = None,
+    dataset_name: str = "client_dataset",
+    configuration: str | None = None,
+    max_patch_voxels: int | None = None,
+    ignore_label: int | None = None,
+) -> Callable[[Mapping[str, Any]], dict[str, Any]]:
+    """The client half of the plans-negotiation handshake
+    (nnunet_client.py:826 ``get_properties``): on request, extract the local
+    fingerprint, build plans from it, and return
+    {nnunet_plans, num_input_channels, num_segmentation_heads}.
+
+    The fingerprint is computed lazily (only when the server actually asks)
+    and cached, mirroring ``maybe_extract_fingerprint`` (:521).
+    """
+    cache: dict[str, Any] = {}
+
+    def provider(request: Mapping[str, Any]) -> dict[str, Any]:
+        if "plans" not in cache:
+            fingerprint = extract_fingerprint(volumes, spacings, segmentations)
+            cache["fingerprint"] = fingerprint
+            cache["plans"] = generate_plans(
+                fingerprint,
+                dataset_name=dataset_name,
+                configuration=configuration,
+                max_patch_voxels=max_patch_voxels,
+            )
+        n_classes = num_classes
+        if n_classes is None:
+            # Highest real label + 1; the ignore label is a masking device,
+            # not a class, and must not grow the segmentation head.
+            labels = np.unique(np.concatenate([np.unique(s) for s in segmentations]))
+            if ignore_label is not None:
+                labels = labels[labels != ignore_label]
+            n_classes = int(labels.max()) + 1
+        return {
+            "nnunet_plans": plans_to_bytes(cache["plans"]),
+            "num_input_channels": int(cache["fingerprint"]["num_channels"]),
+            "num_segmentation_heads": n_classes,
+        }
+
+    return provider

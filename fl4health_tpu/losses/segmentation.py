@@ -1,0 +1,139 @@
+"""Segmentation losses — masked soft Dice + CE with deep supervision.
+
+Parity surface (/root/reference/fl4health/clients/nnunet_client.py:326
+``get_criterion`` -> nnunetv2 DC_and_CE / DC_and_BCE losses; :659
+``compute_loss_and_additional_losses`` applying per-scale deep-supervision
+weights; :703 ``mask_data`` implementing the ignore-label contract).
+
+TPU-native design: everything is mask arithmetic on static shapes. The
+ignore label becomes a per-voxel weight (no boolean indexing — XLA needs
+static shapes); deep-supervision targets are produced by strided slicing
+(exact nearest-neighbour when strides are the pooling factors, so no
+jax.image resampling pass); dice is the memory-efficient batch formulation
+(one running numerator/denominator per class, background excluded).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _voxel_weights(target: jax.Array, example_mask: jax.Array,
+                   ignore_label: int | None) -> jax.Array:
+    """[B, *S] weights: 0 on padded examples and ignore-labelled voxels."""
+    w = jnp.broadcast_to(
+        example_mask.reshape((-1,) + (1,) * (target.ndim - 1)),
+        target.shape,
+    ).astype(jnp.float32)
+    if ignore_label is not None:
+        w = w * (target != ignore_label).astype(jnp.float32)
+    return w
+
+
+def masked_soft_dice_loss(
+    logits: jax.Array,
+    target: jax.Array,
+    weights: jax.Array,
+    include_background: bool = False,
+    smooth: float = 1e-5,
+) -> jax.Array:
+    """Batch soft Dice loss: 1 - mean-over-classes of the dataset-batch dice.
+
+    logits [B, *S, C]; target [B, *S] int; weights [B, *S] in {0,1}. The
+    batch (not per-image) formulation matches nnU-Net's ``batch_dice=True``
+    regional default; background (class 0) excluded unless asked for.
+    """
+    n_classes = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(
+        jnp.clip(target, 0, n_classes - 1), n_classes, dtype=probs.dtype
+    )
+    w = weights[..., None]
+    axes = tuple(range(probs.ndim - 1))  # sum over batch + spatial
+    inter = jnp.sum(probs * onehot * w, axis=axes)
+    denom = jnp.sum(probs * w, axis=axes) + jnp.sum(onehot * w, axis=axes)
+    dice = (2.0 * inter + smooth) / (denom + smooth)
+    if not include_background and n_classes > 1:
+        dice = dice[1:]
+    return 1.0 - jnp.mean(dice)
+
+
+def masked_voxel_cross_entropy(
+    logits: jax.Array, target: jax.Array, weights: jax.Array
+) -> jax.Array:
+    """Mean CE over valid voxels."""
+    n_classes = logits.shape[-1]
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.clip(target, 0, n_classes - 1)
+    )
+    return jnp.sum(per * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+def masked_dice_ce_loss(
+    logits: jax.Array,
+    target: jax.Array,
+    example_mask: jax.Array,
+    ignore_label: int | None = None,
+    dice_weight: float = 1.0,
+    ce_weight: float = 1.0,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """-> (total, dice_term, ce_term). The DC_and_CE combination with the
+    reference's ignore-label masking (nnunet_client.py:703-730) folded into
+    voxel weights."""
+    w = _voxel_weights(target, example_mask, ignore_label)
+    dice = masked_soft_dice_loss(logits, target, w)
+    ce = masked_voxel_cross_entropy(logits, target, w)
+    return dice_weight * dice + ce_weight * ce, dice, ce
+
+
+def downsample_target(target: jax.Array, factor: Sequence[int]) -> jax.Array:
+    """Nearest-neighbour pool of an integer map by strided slicing. Exact for
+    pooling factors that divide the extent (the planner guarantees this)."""
+    slices = (slice(None),) + tuple(slice(None, None, int(f)) for f in factor)
+    return target[slices]
+
+
+def deep_supervision_weights(n_outputs: int) -> list[float]:
+    """Per-scale loss weights 1, 1/2, 1/4, ... with the LOWEST resolution
+    zeroed (when there is more than one output) and the rest normalized to
+    sum to 1 — the nnU-Net deep-supervision convention the reference
+    delegates to nnunetv2."""
+    w = [1.0 / (2.0**i) for i in range(n_outputs)]
+    if n_outputs > 1:
+        w[-1] = 0.0
+    total = sum(w)
+    return [x / total for x in w]
+
+
+def deep_supervision_loss(
+    preds: dict[str, jax.Array],
+    target: jax.Array,
+    example_mask: jax.Array,
+    ds_strides: Sequence[Sequence[int]],
+    ignore_label: int | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Weighted multi-scale Dice+CE over {"prediction", "ds_1", ...}.
+
+    ``ds_strides[i-1]`` is the cumulative downsampling factor of ``ds_i``
+    (models/unet.py deep_supervision_strides). Returns (total, dice, ce)
+    where dice/ce are the full-resolution terms (the ones worth reporting).
+    """
+    n_outputs = 1 + len(ds_strides)
+    weights = deep_supervision_weights(n_outputs)
+    total, full_dice, full_ce = masked_dice_ce_loss(
+        preds["prediction"], target, example_mask, ignore_label
+    )
+    loss = weights[0] * total
+    for i, factor in enumerate(ds_strides, start=1):
+        if weights[i] == 0.0:
+            continue
+        t = downsample_target(target, factor)
+        term, _, _ = masked_dice_ce_loss(
+            preds[f"ds_{i}"], t, example_mask, ignore_label
+        )
+        loss = loss + weights[i] * term
+    return loss, full_dice, full_ce

@@ -237,3 +237,46 @@ def binned_auc(n_thresholds: int = 200, name: str = "roc_auc") -> Metric:
         )
 
     return Metric(name, init, update, compute)
+
+
+def segmentation_dice(
+    n_classes: int, ignore_label: int | None = None, name: str = "seg_dice"
+) -> Metric:
+    """Hard per-class Dice over dense segmentation maps, streamed as counts.
+
+    preds are logits [B, *spatial, C]; targets are integer maps [B, *spatial];
+    mask is per-example [B]. Background (class 0) is excluded from the mean,
+    matching the reference's dice conventions for nnU-Net workloads
+    (metrics/efficient_metrics.py MultiClassDice with do_bg=False semantics).
+    Voxels carrying ``ignore_label`` are excluded entirely (the nnU-Net
+    ignore-label contract, nnunet_client.py:703).
+    """
+
+    def init():
+        return jnp.zeros((n_classes, 3), jnp.float32)  # tp, fp, fn per class
+
+    def update(state, preds, targets, mask):
+        pred_lbl = jnp.argmax(preds, axis=-1)
+        t = targets.astype(jnp.int32)
+        m = jnp.broadcast_to(
+            mask.reshape((-1,) + (1,) * (t.ndim - 1)), t.shape
+        ).astype(jnp.float32)
+        if ignore_label is not None:
+            m = m * (t != ignore_label).astype(jnp.float32)
+        pred_oh = jax.nn.one_hot(pred_lbl, n_classes, dtype=jnp.float32)
+        true_oh = jax.nn.one_hot(t, n_classes, dtype=jnp.float32)
+        axes = tuple(range(t.ndim))
+        tp = jnp.sum(pred_oh * true_oh * m[..., None], axis=axes)
+        fp = jnp.sum(pred_oh * (1 - true_oh) * m[..., None], axis=axes)
+        fn = jnp.sum((1 - pred_oh) * true_oh * m[..., None], axis=axes)
+        return state + jnp.stack([tp, fp, fn], axis=-1)
+
+    def compute(state):
+        tp, fp, fn = state[:, 0], state[:, 1], state[:, 2]
+        dice = 2 * tp / jnp.maximum(2 * tp + fp + fn, 1.0)
+        present = (tp + fn > 0).astype(jnp.float32)
+        if n_classes > 1:
+            dice, present = dice[1:], present[1:]
+        return jnp.sum(dice * present) / jnp.maximum(jnp.sum(present), 1.0)
+
+    return Metric(name, init, update, compute)

@@ -1,0 +1,174 @@
+"""Dynamic plain-conv U-Net with deep supervision — flax, channels-last.
+
+Parity surface: the nnU-Net network the reference builds from plans
+(/root/reference/fl4health/servers/nnunet_server.py:133
+``initialize_server_model`` -> nnunetv2 ``build_network_architecture``;
+client forward with deep-supervision list outputs,
+/root/reference/fl4health/clients/nnunet_client.py:624 ``predict``).
+
+TPU-native design: one nn.Module parameterized entirely by static plan
+numbers (stages, features, strides, kernels) so a plans dict compiles to a
+fixed XLA program. Layout is channels-last ([B, *spatial, C]) so convs lower
+straight onto the MXU; InstanceNorm + LeakyReLU follow the nnU-Net recipe.
+Deep supervision heads emit logits at every decoder scale as a dict
+({"prediction", "ds_1", ...}) — the reference's list<->dict converters
+(utils/nnunet_utils.py:167,195) collapse into this one contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ConvBlock(nn.Module):
+    """Conv -> InstanceNorm -> LeakyReLU (the nnU-Net basic block)."""
+
+    features: int
+    kernel_size: Sequence[int]
+    strides: Sequence[int] | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features,
+            tuple(self.kernel_size),
+            strides=tuple(self.strides) if self.strides else None,
+            padding="SAME",
+            use_bias=True,
+        )(x)
+        x = nn.InstanceNorm(epsilon=1e-5)(x)
+        return nn.leaky_relu(x, negative_slope=0.01)
+
+
+class StackedConvs(nn.Module):
+    features: int
+    kernel_size: Sequence[int]
+    n_convs: int
+    first_stride: Sequence[int] | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.n_convs):
+            x = ConvBlock(
+                self.features,
+                self.kernel_size,
+                strides=self.first_stride if i == 0 else None,
+            )(x)
+        return x
+
+
+class PlainConvUNet(nn.Module):
+    """N-dimensional U-Net assembled from plan numbers.
+
+    features_per_stage / strides / kernel_sizes all have length ``n_stages``;
+    ``strides[0]`` must be all-ones (stage 0 keeps full resolution). Spatial
+    rank is inferred from the kernel-size rank, so the same class serves the
+    2d and 3d_fullres configurations.
+    """
+
+    features_per_stage: tuple[int, ...]
+    strides: tuple[tuple[int, ...], ...]
+    kernel_sizes: tuple[tuple[int, ...], ...]
+    n_classes: int
+    n_conv_per_stage: int = 2
+    deep_supervision: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        n_stages = len(self.features_per_stage)
+        ndim = len(self.kernel_sizes[0])
+        assert x.ndim == ndim + 2, (
+            f"expected [B, *spatial({ndim}), C] input, got shape {x.shape}"
+        )
+
+        # Encoder: keep every stage's output for skips.
+        skips = []
+        for s in range(n_stages):
+            x = StackedConvs(
+                self.features_per_stage[s],
+                self.kernel_sizes[s],
+                self.n_conv_per_stage,
+                first_stride=self.strides[s] if s > 0 else None,
+            )(x)
+            skips.append(x)
+
+        # Decoder: transpose-conv upsample, concat skip, conv stack, seg head.
+        ds_logits = []  # highest resolution LAST while building
+        x = skips[-1]
+        for s in range(n_stages - 2, -1, -1):
+            up_stride = tuple(self.strides[s + 1])
+            x = nn.ConvTranspose(
+                self.features_per_stage[s],
+                kernel_size=up_stride,
+                strides=up_stride,
+                padding="VALID",
+            )(x)
+            x = jnp.concatenate([x, skips[s]], axis=-1)
+            x = StackedConvs(
+                self.features_per_stage[s],
+                self.kernel_sizes[s],
+                self.n_conv_per_stage,
+            )(x)
+            if self.deep_supervision or s == 0:
+                head = nn.Conv(self.n_classes, (1,) * ndim, use_bias=True)(x)
+                ds_logits.append(head)
+
+        # Highest resolution is the final decoder stage's head.
+        preds = {"prediction": ds_logits[-1]}
+        if self.deep_supervision:
+            for i, logits in enumerate(reversed(ds_logits[:-1]), start=1):
+                preds[f"ds_{i}"] = logits
+        return preds, {}
+
+
+def unet_from_plans(
+    plans: dict[str, Any],
+    num_input_channels: int,
+    num_classes: int,
+    configuration: str | None = None,
+    deep_supervision: bool = True,
+) -> PlainConvUNet:
+    """Instantiate the network a plans dict describes (the
+    ``build_network_architecture`` equivalent, nnunet_server.py:145-152).
+    ``num_input_channels`` is accepted for interface parity (the handshake
+    ships it, nnunet_server.py:228) though flax infers input channels lazily.
+    """
+    del num_input_channels  # flax modules are input-shape polymorphic at init
+    if configuration is None:
+        from fl4health_tpu.nnunet.plans import default_configuration
+
+        configuration = default_configuration(plans)
+    cfg = plans["configurations"][configuration]
+    return PlainConvUNet(
+        features_per_stage=tuple(cfg["features_per_stage"]),
+        strides=tuple(tuple(s) for s in cfg["strides"]),
+        kernel_sizes=tuple(tuple(k) for k in cfg["kernel_sizes"]),
+        n_classes=num_classes,
+        n_conv_per_stage=int(cfg.get("n_conv_per_stage", 2)),
+        deep_supervision=deep_supervision,
+    )
+
+
+def deep_supervision_strides(plans: dict[str, Any], configuration: str | None = None):
+    """Cumulative per-axis downsampling factor for each deep-supervision
+    output, ordered to match the prediction dict: index 0 is "ds_1" (half the
+    scale of "prediction"), etc. Used to pool targets for the DS loss."""
+    if configuration is None:
+        from fl4health_tpu.nnunet.plans import default_configuration
+
+        configuration = default_configuration(plans)
+    strides = plans["configurations"][configuration]["strides"]
+    cumulative = []
+    running = [1] * len(strides[0])
+    for s in strides[1:]:
+        running = [r * si for r, si in zip(running, s)]
+        cumulative.append(tuple(running))
+    # Decoder emits heads at stages n-2 .. 0; "prediction" is stage 0 (full
+    # res), ds_i is stage i for i = 1..n-2. The bottleneck (stage n-1) has no
+    # head, so its cumulative factor is dropped; a 2-stage net has no DS
+    # outputs at all.
+    return cumulative[:-1]

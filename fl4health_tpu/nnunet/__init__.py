@@ -1,0 +1,36 @@
+"""nnU-Net-class federated 3D segmentation, TPU-native.
+
+Replaces the reference's nnunetv2/MONAI integration
+(/root/reference/fl4health/clients/nnunet_client.py,
+servers/nnunet_server.py, utils/nnunet_utils.py) with a self-contained
+stack: numpy experiment planner + fingerprint (plans.py), host-side
+normalization/patching (data.py), a flax plain-conv U-Net with deep
+supervision (models/unet.py), masked multi-scale Dice+CE
+(losses/segmentation.py), and the plans-negotiation protocol
+(clients/nnunet.py + server/nnunet.py).
+"""
+
+from fl4health_tpu.nnunet.data import extract_patch_dataset, normalize_volume
+from fl4health_tpu.nnunet.plans import (
+    default_configuration,
+    extract_fingerprint,
+    generate_plans,
+    localize_plans,
+    nnunet_optimizer,
+    plans_from_bytes,
+    plans_to_bytes,
+    poly_lr_schedule,
+)
+
+__all__ = [
+    "default_configuration",
+    "extract_fingerprint",
+    "generate_plans",
+    "localize_plans",
+    "nnunet_optimizer",
+    "plans_from_bytes",
+    "plans_to_bytes",
+    "poly_lr_schedule",
+    "extract_patch_dataset",
+    "normalize_volume",
+]

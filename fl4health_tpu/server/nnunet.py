@@ -1,0 +1,118 @@
+"""NnunetServer — plans negotiation + federated segmentation orchestration.
+
+Parity surface (/root/reference/fl4health/servers/nnunet_server.py:54
+``NnunetServer``): ``update_before_fit`` (:156) polls ONE random client via
+``get_properties`` when the config carries no ``nnunet_plans``, stores the
+returned plans bytes + channel counts, redistributes the plans through the
+per-round config, and builds the global model from the plans so it can be
+checkpointed (:133 ``initialize_server_model``).
+
+TPU-native design: the handshake is the in-process polling protocol
+(server/servers.py poll_clients); plans travel as JSON bytes (never pickle);
+the "global model" is the flax module + its param pytree, built once and
+handed to the FederatedSimulation factory.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Mapping, Sequence
+
+from fl4health_tpu.models.unet import unet_from_plans
+from fl4health_tpu.server.servers import poll_clients
+from fl4health_tpu.server.simulation import FederatedSimulation
+
+logger = logging.getLogger(__name__)
+
+
+class NnunetServer:
+    """Negotiates plans, then runs the federated segmentation job.
+
+    ``property_providers`` are the clients' ``get_properties`` handlers (one
+    per client — clients.nnunet.make_nnunet_properties_provider).
+    ``sim_builder(plans, num_input_channels, num_segmentation_heads)`` builds
+    the FederatedSimulation once the architecture is known; deferring
+    construction mirrors the reference's lazy model finalization.
+    """
+
+    def __init__(
+        self,
+        config: dict[str, Any],
+        property_providers: Sequence[Callable[[Mapping[str, Any]], Mapping[str, Any]]],
+        sim_builder: Callable[[dict[str, Any], int, int], FederatedSimulation],
+        seed: int = 0,
+    ):
+        self.config = dict(config)
+        self.property_providers = list(property_providers)
+        self.sim_builder = sim_builder
+        self.seed = seed
+        self.plans: dict[str, Any] | None = None
+        self.num_input_channels: int | None = None
+        self.num_segmentation_heads: int | None = None
+        self.global_model = None
+        self.sim: FederatedSimulation | None = None
+
+    # ------------------------------------------------------------------
+    def update_before_fit(self) -> None:
+        """The pre-round-1 handshake (nnunet_server.py:156-233)."""
+        from fl4health_tpu.nnunet.plans import plans_from_bytes
+
+        plans_bytes = self.config.get("nnunet_plans")
+        if plans_bytes is None:
+            logger.info(
+                "[PRE-INIT] no nnunet_plans in config — requesting properties "
+                "from one random client via get_properties"
+            )
+            # Sample one client (the reference samples via the client
+            # manager; a seeded host RNG is the in-process equivalent).
+            import numpy as np
+
+            idx = int(
+                np.random.default_rng(self.seed).integers(len(self.property_providers))
+            )
+            props = poll_clients(
+                [self.property_providers[idx]], dict(self.config)
+            )[0]
+            plans_bytes = props["nnunet_plans"]
+            self.num_input_channels = int(props["num_input_channels"])
+            self.num_segmentation_heads = int(props["num_segmentation_heads"])
+            logger.info("Received plans from client %d", idx)
+        else:
+            # Plans supplied by config; channel counts must come with them or
+            # from a poll (the reference polls whenever checkpointing needs a
+            # constructible model — here the sim always needs one).
+            if "num_input_channels" in self.config and "num_segmentation_heads" in self.config:
+                self.num_input_channels = int(self.config["num_input_channels"])
+                self.num_segmentation_heads = int(self.config["num_segmentation_heads"])
+            else:
+                props = poll_clients(
+                    [self.property_providers[0]], dict(self.config)
+                )[0]
+                self.num_input_channels = int(props["num_input_channels"])
+                self.num_segmentation_heads = int(props["num_segmentation_heads"])
+
+        self.plans = plans_from_bytes(plans_bytes)
+        # Redistribute: subsequent rounds' client config carries the plans
+        # (nnunet_server.py:233 sets the config for later configure_fit).
+        self.config["nnunet_plans"] = plans_bytes
+        # initialize_server_model (:133): a constructible global architecture.
+        self.global_model = unet_from_plans(
+            self.plans, self.num_input_channels, self.num_segmentation_heads
+        )
+
+    # ------------------------------------------------------------------
+    def fit(self, n_rounds: int):
+        if self.plans is None:
+            self.update_before_fit()
+        assert self.plans is not None
+        assert self.num_input_channels is not None
+        assert self.num_segmentation_heads is not None
+        self.sim = self.sim_builder(
+            self.plans, self.num_input_channels, self.num_segmentation_heads
+        )
+        return self.sim.fit(n_rounds)
+
+    @property
+    def global_params(self):
+        assert self.sim is not None, "fit() has not run"
+        return self.sim.global_params

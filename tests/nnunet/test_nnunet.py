@@ -1,0 +1,364 @@
+"""nnU-Net slice tests: planner invariants, U-Net shapes, DS loss masking,
+polyLR, and the end-to-end plans-negotiation + federated segmentation round.
+
+Reference test model: the nnunet smoke configs
+(/root/reference/tests/smoke_tests/nnunet_config_2d.yaml) and the unit
+coverage of utils/nnunet_utils.py; here everything runs on tiny synthetic
+volumes over virtual CPU devices.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.nnunet import (
+    NnunetClientLogic,
+    make_nnunet_properties_provider,
+)
+from fl4health_tpu.losses.segmentation import (
+    deep_supervision_loss,
+    deep_supervision_weights,
+    masked_dice_ce_loss,
+)
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.metrics.efficient import segmentation_dice
+from fl4health_tpu.models.unet import (
+    deep_supervision_strides,
+    unet_from_plans,
+)
+from fl4health_tpu.nnunet import (
+    extract_fingerprint,
+    extract_patch_dataset,
+    generate_plans,
+    localize_plans,
+    nnunet_optimizer,
+    plans_from_bytes,
+    plans_to_bytes,
+    poly_lr_schedule,
+)
+from fl4health_tpu.server.nnunet import NnunetServer
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+
+def synth_volumes(n, shape, n_classes=2, seed=0):
+    """Spheres-on-noise synthetic segmentation data, channels-last."""
+    rng = np.random.default_rng(seed)
+    vols, segs = [], []
+    for _ in range(n):
+        coords = np.stack(
+            np.meshgrid(*[np.arange(s) for s in shape], indexing="ij"), axis=-1
+        ).astype(np.float64)
+        center = np.asarray([rng.uniform(s * 0.3, s * 0.7) for s in shape])
+        radius = min(shape) * rng.uniform(0.15, 0.3)
+        dist = np.sqrt(np.sum((coords - center) ** 2, axis=-1))
+        seg = (dist < radius).astype(np.int32)
+        if n_classes > 2:
+            seg += (dist < radius / 2).astype(np.int32)
+        vol = rng.normal(0, 0.3, shape)[..., None] + seg[..., None] * 1.0
+        vols.append(vol.astype(np.float32))
+        segs.append(seg)
+    return vols, segs
+
+
+# ---------------------------------------------------------------------------
+# Planner
+# ---------------------------------------------------------------------------
+
+class TestPlans:
+    def test_fingerprint_and_plan_invariants(self):
+        vols, segs = synth_volumes(5, (20, 24, 18))
+        spacings = [(1.0, 0.8, 1.2)] * 5
+        fp = extract_fingerprint(vols, spacings, segs)
+        assert fp["num_channels"] == 1 and fp["num_cases"] == 5
+        props = fp["foreground_intensity_properties_per_channel"]["0"]
+        # foreground is seg>=1 which carries the +1.0 shift
+        assert props["mean"] > 0.5
+
+        plans = generate_plans(fp, dataset_name="DatasetTest")
+        cfg = plans["configurations"]["3d_fullres"]
+        patch = np.asarray(cfg["patch_size"])
+        factor = np.prod(np.asarray(cfg["strides"]), axis=0)
+        assert np.all(patch % factor == 0), "patch must divide by pooling"
+        assert cfg["batch_size"] >= 2
+        assert cfg["features_per_stage"][0] == 32
+        assert max(cfg["features_per_stage"]) <= 320
+        assert len(cfg["strides"]) == cfg["n_stages"]
+        assert cfg["strides"][0] == [1, 1, 1]
+
+    def test_plans_wire_roundtrip_is_json_not_pickle(self):
+        vols, segs = synth_volumes(2, (8, 8, 8))
+        plans = generate_plans(extract_fingerprint(vols, [(1, 1, 1)] * 2, segs))
+        data = plans_to_bytes(plans)
+        assert data[:1] in (b"{",), "wire format must be JSON"
+        assert plans_from_bytes(data) == plans
+
+    def test_localize_plans_keeps_architecture_swaps_stats(self):
+        vols, segs = synth_volumes(3, (16, 16, 16), seed=1)
+        global_plans = generate_plans(
+            extract_fingerprint(vols, [(1, 1, 1)] * 3, segs), plans_name="glob"
+        )
+        lvols, lsegs = synth_volumes(3, (16, 16, 16), seed=2)
+        lfp = extract_fingerprint(lvols, [(1, 1, 1)] * 3, lsegs)
+        local = localize_plans(global_plans, lfp, "client7")
+        cfg_g = global_plans["configurations"]["3d_fullres"]
+        cfg_l = local["configurations"]["3d_fullres"]
+        # architecture decisions survive localization
+        assert cfg_l["patch_size"] == cfg_g["patch_size"]
+        assert cfg_l["strides"] == cfg_g["strides"]
+        # identity + intensity stats are local
+        assert local["dataset_name"] == "client7"
+        assert local["source_plans_name"] == "glob"
+        assert (
+            local["foreground_intensity_properties_per_channel"]
+            == lfp["foreground_intensity_properties_per_channel"]
+        )
+
+    def test_poly_lr_matches_published_form(self):
+        sched = poly_lr_schedule(1e-2, 100, exponent=0.9)
+        assert float(sched(0)) == pytest.approx(1e-2)
+        assert float(sched(50)) == pytest.approx(1e-2 * 0.5**0.9, rel=1e-6)
+        assert float(sched(100)) == pytest.approx(0.0, abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+class TestUNet:
+    def test_forward_shapes_and_ds_outputs_3d(self):
+        vols, segs = synth_volumes(3, (16, 16, 16))
+        plans = generate_plans(
+            extract_fingerprint(vols, [(1, 1, 1)] * 3, segs), max_stages=3
+        )
+        cfg = plans["configurations"]["3d_fullres"]
+        patch = tuple(cfg["patch_size"])
+        net = unet_from_plans(plans, 1, 3)
+        x = jnp.zeros((2, *patch, 1))
+        variables = net.init(jax.random.PRNGKey(0), x, train=False)
+        preds, _ = net.apply(variables, x, train=False)
+        assert preds["prediction"].shape == (2, *patch, 3)
+        ds = deep_supervision_strides(plans)
+        assert len(ds) == cfg["n_stages"] - 2
+        for i, factor in enumerate(ds, start=1):
+            expect = tuple(p // f for p, f in zip(patch, factor))
+            assert preds[f"ds_{i}"].shape == (2, *expect, 3)
+
+    def test_two_stage_net_has_no_ds_heads(self):
+        vols, segs = synth_volumes(2, (8, 8, 8))
+        plans = generate_plans(
+            extract_fingerprint(vols, [(1, 1, 1)] * 2, segs), max_stages=2
+        )
+        net = unet_from_plans(plans, 1, 2)
+        patch = tuple(plans["configurations"]["3d_fullres"]["patch_size"])
+        x = jnp.zeros((1, *patch, 1))
+        preds, _ = net.apply(net.init(jax.random.PRNGKey(0), x, train=False), x, train=False)
+        assert set(preds) == {"prediction"}
+        assert deep_supervision_strides(plans) == []
+
+    def test_2d_configuration(self):
+        rng = np.random.default_rng(0)
+        vols = [rng.normal(size=(32, 32, 1)).astype(np.float32) for _ in range(3)]
+        segs = [(v[..., 0] > 0.5).astype(np.int32) for v in vols]
+        plans = generate_plans(
+            extract_fingerprint(vols, [(1.0, 1.0)] * 3, segs), max_stages=3
+        )
+        assert "2d" in plans["configurations"]
+        net = unet_from_plans(plans, 1, 2)
+        patch = tuple(plans["configurations"]["2d"]["patch_size"])
+        x = jnp.zeros((2, *patch, 1))
+        preds, _ = net.apply(net.init(jax.random.PRNGKey(0), x, train=False), x, train=False)
+        assert preds["prediction"].shape == (2, *patch, 2)
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+class TestSegmentationLoss:
+    def test_ignore_label_voxels_do_not_contribute(self):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (2, 8, 8, 3))
+        target = jax.random.randint(jax.random.PRNGKey(1), (2, 8, 8), 0, 2)
+        mask = jnp.ones((2,))
+        ignored = target.at[:, :4].set(2)  # label 2 = ignore
+        base, _, _ = masked_dice_ce_loss(logits, ignored, mask, ignore_label=2)
+        # change logits ONLY under ignored voxels -> loss identical
+        bumped = logits.at[:, :4].add(100.0)
+        after, _, _ = masked_dice_ce_loss(bumped, ignored, mask, ignore_label=2)
+        assert float(base) == pytest.approx(float(after), rel=1e-6)
+
+    def test_example_mask_zeroes_padded_rows(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 2))
+        target = jnp.zeros((2, 4, 4), jnp.int32)
+        half = jnp.asarray([1.0, 0.0])
+        l1, _, _ = masked_dice_ce_loss(logits, target, half)
+        poisoned = logits.at[1].set(1e6)
+        l2, _, _ = masked_dice_ce_loss(poisoned, target, half)
+        assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+
+    def test_segmentation_dice_metric_respects_ignore_label(self):
+        """A perfect prediction on valid voxels must score dice 1.0 even when
+        half the voxels carry the ignore label (which one-hot would otherwise
+        count as false positives)."""
+        target = jnp.concatenate(
+            [jnp.ones((1, 4, 4), jnp.int32), jnp.full((1, 4, 4), 2, jnp.int32)],
+            axis=1,
+        )  # [1, 8, 4]; label 2 = ignore
+        logits = jax.nn.one_hot(jnp.ones((1, 8, 4), jnp.int32), 2) * 10.0
+        metric = segmentation_dice(2, ignore_label=2)
+        state = metric.update(metric.init(), logits, target, jnp.ones((1,)))
+        assert float(metric.compute(state)) == pytest.approx(1.0)
+
+    def test_ds_weights_convention(self):
+        assert deep_supervision_weights(1) == [1.0]
+        w3 = deep_supervision_weights(3)
+        assert w3[-1] == 0.0
+        assert sum(w3) == pytest.approx(1.0)
+        assert w3[0] == pytest.approx(2 * w3[1])
+
+    def test_deep_supervision_loss_runs_and_descends_on_fit(self):
+        logits = {
+            "prediction": jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 2)),
+            "ds_1": jax.random.normal(jax.random.PRNGKey(1), (2, 4, 4, 2)),
+        }
+        target = jnp.ones((2, 8, 8), jnp.int32)
+        loss, dice, ce = deep_supervision_loss(
+            logits, target, jnp.ones((2,)), ds_strides=[(2, 2)]
+        )
+        perfect = {
+            "prediction": jax.nn.one_hot(target, 2) * 20.0,
+            "ds_1": jax.nn.one_hot(target[:, ::2, ::2], 2) * 20.0,
+        }
+        good, _, _ = deep_supervision_loss(
+            perfect, target, jnp.ones((2,)), ds_strides=[(2, 2)]
+        )
+        assert float(good) < float(loss)
+        assert float(good) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# Data pipeline
+# ---------------------------------------------------------------------------
+
+class TestPatchPipeline:
+    def test_patch_extraction_shapes_and_fg_oversampling(self):
+        vols, segs = synth_volumes(4, (14, 14, 14), seed=3)
+        plans = generate_plans(extract_fingerprint(vols, [(1, 1, 1)] * 4, segs))
+        x, y = extract_patch_dataset(vols, segs, plans, n_patches=12, seed=0)
+        patch = tuple(plans["configurations"]["3d_fullres"]["patch_size"])
+        assert x.shape == (12, *patch, 1) and y.shape == (12, *patch)
+        # forced-foreground rule: >= 1/3 of patches contain foreground
+        frac_fg = np.mean([(yy > 0).any() for yy in y])
+        assert frac_fg >= 0.3
+        # normalization happened: foreground voxels (the stats source) sit
+        # near 0; background lands a few stds negative — just bound the scale
+        fg_vals = x[..., 0][y > 0]
+        assert abs(float(fg_vals.mean())) < 1.0
+        assert abs(float(x.mean())) < 5.0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: handshake + federated segmentation
+# ---------------------------------------------------------------------------
+
+def _build_sim_factory(client_volumes, n_rounds, local_steps, batch_size):
+    def sim_builder(plans, num_input_channels, num_heads):
+        from fl4health_tpu.clients.engine import from_flax
+
+        net = unet_from_plans(plans, num_input_channels, num_heads)
+        model = from_flax(net)
+        logic = NnunetClientLogic(
+            model, ds_strides=deep_supervision_strides(plans)
+        )
+        datasets = []
+        for i, (vols, segs) in enumerate(client_volumes):
+            x, y = extract_patch_dataset(vols, segs, plans, n_patches=10, seed=i)
+            datasets.append(
+                ClientDataset(
+                    x_train=x[:8], y_train=y[:8], x_val=x[8:], y_val=y[8:]
+                )
+            )
+        tx = nnunet_optimizer(
+            initial_lr=5e-3, max_steps=n_rounds * local_steps, grad_clip_norm=12.0
+        )
+        return FederatedSimulation(
+            logic=logic,
+            tx=tx,
+            strategy=FedAvg(),
+            datasets=datasets,
+            batch_size=batch_size,
+            metrics=MetricManager((segmentation_dice(num_heads),)),
+            local_steps=local_steps,
+            seed=0,
+            extra_loss_keys=("dice", "ce"),
+        )
+
+    return sim_builder
+
+
+class TestFederatedSegmentation:
+    def test_plans_negotiation_and_training_round(self):
+        """The §3.5 handshake: server has no plans, polls a client, builds the
+        model from the returned plans, and the federated job trains."""
+        client_volumes = [
+            synth_volumes(4, (12, 12, 12), seed=10),
+            synth_volumes(4, (12, 12, 12), seed=20),
+        ]
+        providers = [
+            make_nnunet_properties_provider(v, [(1.0, 1.0, 1.0)] * len(v), s)
+            for v, s in client_volumes
+        ]
+        server = NnunetServer(
+            config={"n_server_rounds": 2},
+            property_providers=providers,
+            sim_builder=_build_sim_factory(
+                client_volumes, n_rounds=2, local_steps=4, batch_size=2
+            ),
+        )
+        assert server.plans is None
+        history = server.fit(n_rounds=2)
+
+        # handshake outcomes (nnunet_server.py:156-233 semantics)
+        assert server.plans is not None
+        assert server.num_input_channels == 1
+        assert server.num_segmentation_heads == 2
+        assert server.config["nnunet_plans"] is not None, "plans redistributed via config"
+        assert server.global_model is not None
+
+        assert len(history) == 2
+        for rec in history:
+            assert np.isfinite(rec.fit_losses["backward"])
+            assert "dice" in rec.fit_losses and "ce" in rec.fit_losses
+            assert " - seg_dice" in rec.eval_metrics or "seg_dice" in rec.eval_metrics
+
+    def test_plans_supplied_by_config_skips_generation_poll(self):
+        vols, segs = synth_volumes(3, (12, 12, 12), seed=5)
+        fp = extract_fingerprint(vols, [(1.0, 1.0, 1.0)] * 3, segs)
+        plans = generate_plans(fp)
+        calls = {"n": 0}
+
+        def counting_provider(request):
+            calls["n"] += 1
+            return {
+                "nnunet_plans": plans_to_bytes(plans),
+                "num_input_channels": 1,
+                "num_segmentation_heads": 2,
+            }
+
+        server = NnunetServer(
+            config={
+                "nnunet_plans": plans_to_bytes(plans),
+                "num_input_channels": 1,
+                "num_segmentation_heads": 2,
+            },
+            property_providers=[counting_provider],
+            sim_builder=_build_sim_factory(
+                [(vols, segs)], n_rounds=1, local_steps=2, batch_size=2
+            ),
+        )
+        server.update_before_fit()
+        assert calls["n"] == 0, "config-supplied plans must not trigger a poll"
+        assert server.plans == plans
