@@ -113,3 +113,29 @@ def dirichlet_partition(
         sel = jnp.asarray(np.sort(np.asarray(part, dtype=np.int64)))
         out.append((x[sel], y[sel]))
     return out
+
+
+def synthetic_text_classification(
+    rng: PRNGKey,
+    n: int,
+    vocab_size: int = 512,
+    seq_len: int = 32,
+    n_classes: int = 4,
+    class_sep: float = 2.0,
+) -> tuple[jax.Array, jax.Array]:
+    """AG-News-shaped synthetic token sequences for transformer configs
+    (role of /root/reference/examples/bert_finetuning_example's AG-News data
+    under zero egress; research/ag_news is the cluster-scale counterpart).
+
+    Each class has its own token distribution (a Dirichlet-ish softmax over
+    the vocab, temperature ``class_sep``); sequences carry ragged lengths so
+    pad-mask handling is exercised. Token id 0 is PAD.
+    """
+    k_logits, k_y, k_tok, k_len = jax.random.split(rng, 4)
+    class_logits = jax.random.normal(k_logits, (n_classes, vocab_size - 1)) * class_sep
+    y = jax.random.randint(k_y, (n,), 0, n_classes)
+    toks = jax.random.categorical(k_tok, class_logits[y], axis=-1, shape=(seq_len, n)).T
+    toks = toks + 1  # reserve 0 for PAD
+    lengths = jax.random.randint(k_len, (n,), seq_len // 2, seq_len + 1)
+    mask = jnp.arange(seq_len)[None, :] < lengths[:, None]
+    return jnp.where(mask, toks, 0).astype(jnp.int32), y.astype(jnp.int32)

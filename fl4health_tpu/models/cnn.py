@@ -38,23 +38,29 @@ class MnistNet(nn.Module):
 
 
 class CifarNet(nn.Module):
-    """CIFAR-10 CNN (examples/models/cnn_model.py Net equivalent)."""
+    """CIFAR-10 CNN (examples/models/cnn_model.py Net equivalent).
+
+    ``dtype`` sets the compute dtype (params stay fp32): bf16 here is the
+    TPU mixed-precision path — MXU-native matmuls/convs, fp32 logits out.
+    """
 
     n_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         # x: [B, 32, 32, 3]
-        x = nn.Conv(32, (5, 5))(x)
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(64, (5, 5))(x)
+        x = nn.Conv(64, (5, 5), dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        features = nn.relu(nn.Dense(128)(x))
-        logits = nn.Dense(self.n_classes)(features)
-        return {"prediction": logits}, {"features": features}
+        features = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        logits = nn.Dense(self.n_classes, dtype=self.dtype)(features)
+        return {"prediction": logits.astype(jnp.float32)}, {"features": features}
 
 
 class Mlp(nn.Module):

@@ -1,0 +1,174 @@
+"""Transformer encoder for federated sequence classification (BERT-class).
+
+Parity surface: the reference's BERT fine-tuning capability
+(/root/reference/examples/bert_finetuning_example — HF
+``BertForSequenceClassification`` trained under BasicClient;
+/root/reference/research/ag_news — dynamic-layer/sparse exchange on BERT;
+/root/reference/examples/fedllm_example — LoRA fine-tuning via peft).
+
+TPU-native design: a from-scratch flax encoder whose matmuls are shaped for
+the MXU (d_model/d_ff multiples of 128 by default) with a ``dtype`` knob for
+bf16 compute at fp32 params (the TPU mixed-precision recipe — no GradScaler
+needed). Projection modules carry stable names (q_proj/k_proj/v_proj/o_proj,
+ff_in/ff_out) so tensor-parallel sharding rules (parallel/tp.py) and
+LoRA/PEFT path filters (utils/peft.py) can key on paths instead of module
+classes. LoRA lives in ``LoraDense``: frozen-by-mask base kernel + low-rank
+``lora_a @ lora_b`` delta, the pytree equivalent of peft's adapter injection
+(/root/reference/fl4health/utils/peft_parameter_extraction.py:7).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class LoraDense(nn.Module):
+    """Dense with an additive low-rank adapter: y = xW + s * (x A) B.
+
+    ``lora_b`` initializes to zero so the adapted model starts exactly at the
+    base model (the published LoRA recipe). The base kernel/bias stay in the
+    params tree (frozen via the optimizer mask, utils/peft.py) so the SAME
+    pytree serves full fine-tuning and PEFT — only the mask and the
+    exchanger's path filter change.
+    """
+
+    features: int
+    rank: int = 0
+    alpha: float = 16.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (in_features, self.features)
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        y = x.astype(self.dtype) @ kernel.astype(self.dtype) + bias.astype(self.dtype)
+        if self.rank > 0:
+            lora_a = self.param(
+                "lora_a",
+                nn.initializers.normal(stddev=1.0 / self.rank),
+                (in_features, self.rank),
+            )
+            lora_b = self.param(
+                "lora_b", nn.initializers.zeros, (self.rank, self.features)
+            )
+            scale = self.alpha / self.rank
+            y = y + scale * (
+                (x.astype(self.dtype) @ lora_a.astype(self.dtype))
+                @ lora_b.astype(self.dtype)
+            )
+        return y
+
+
+class MultiHeadSelfAttention(nn.Module):
+    d_model: int
+    n_heads: int
+    lora_rank: int = 0
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, pad_mask, train: bool):
+        # x: [B, T, D]; pad_mask: [B, T] 1=token, 0=pad
+        assert self.d_model % self.n_heads == 0, (
+            f"d_model={self.d_model} must divide by n_heads={self.n_heads}"
+        )
+        head_dim = self.d_model // self.n_heads
+        dense = lambda name: LoraDense(  # noqa: E731
+            self.d_model, rank=self.lora_rank, dtype=self.dtype, name=name
+        )
+        q = dense("q_proj")(x)
+        k = dense("k_proj")(x)
+        v = dense("v_proj")(x)
+
+        def split(t):
+            return t.reshape(*t.shape[:-1], self.n_heads, head_dim)
+
+        q, k, v = split(q), split(k), split(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(head_dim, self.dtype)
+        )
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
+        scores = jnp.where(pad_mask[:, None, None, :] > 0, scores, neg)
+        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(self.dtype)
+        if train and self.dropout_rate > 0:
+            attn = nn.Dropout(self.dropout_rate, deterministic=False)(attn)
+        out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        out = out.reshape(*out.shape[:-2], self.d_model)
+        return dense("o_proj")(out)
+
+
+class EncoderBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    lora_rank: int = 0
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, pad_mask, train: bool):
+        # Pre-LN (stable at small scale, standard for from-scratch training).
+        h = nn.LayerNorm(name="ln_attn")(x)
+        h = MultiHeadSelfAttention(
+            self.d_model, self.n_heads, self.lora_rank, self.dtype,
+            self.dropout_rate, name="attn",
+        )(h, pad_mask, train)
+        if train and self.dropout_rate > 0:
+            h = nn.Dropout(self.dropout_rate, deterministic=False)(h)
+        x = x + h
+        h = nn.LayerNorm(name="ln_mlp")(x)
+        h = LoraDense(self.d_ff, rank=self.lora_rank, dtype=self.dtype, name="ff_in")(h)
+        h = nn.gelu(h)
+        h = LoraDense(
+            self.d_model, rank=self.lora_rank, dtype=self.dtype, name="ff_out"
+        )(h)
+        if train and self.dropout_rate > 0:
+            h = nn.Dropout(self.dropout_rate, deterministic=False)(h)
+        return x + h
+
+
+class TransformerClassifier(nn.Module):
+    """Encoder + mean-pool + classifier head, the AG-News/BERT-shaped model.
+
+    Input: integer token ids [B, T]; id 0 is the pad token (mask derived
+    in-model, so the engine's (x, y) batch contract holds unchanged).
+    """
+
+    vocab_size: int
+    n_classes: int
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    max_len: int = 128
+    lora_rank: int = 0
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        pad_mask = (x > 0).astype(jnp.float32)
+        tok = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(x)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (self.max_len, self.d_model),
+        )
+        h = (tok + pos[None, : x.shape[1]]).astype(self.dtype)
+        for i in range(self.n_layers):
+            h = EncoderBlock(
+                self.d_model, self.n_heads, self.d_ff, self.lora_rank,
+                self.dtype, self.dropout_rate, name=f"layer_{i}",
+            )(h, pad_mask, train)
+        h = nn.LayerNorm(name="ln_final")(h.astype(jnp.float32))
+        denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1.0)
+        pooled = (h * pad_mask[..., None]).sum(axis=1) / denom
+        logits = nn.Dense(self.n_classes, name="classifier")(pooled)
+        return {"prediction": logits.astype(jnp.float32)}, {"features": pooled}

@@ -39,6 +39,16 @@ def hybrid_mesh(n_clients_axis: int, n_model_axis: int = 1, devices=None) -> Mes
     return Mesh(mesh_devices, ("clients", "model"))
 
 
+def client_data_mesh(n_clients_axis: int, n_data_axis: int = 1, devices=None) -> Mesh:
+    """2-D (clients, data) mesh: client DP on the outer axis, within-client
+    batch data parallelism on the inner one (SURVEY §2.1 item b)."""
+    devices = devices if devices is not None else jax.devices()
+    mesh_devices = mesh_utils.create_device_mesh(
+        (n_clients_axis, n_data_axis), devices=devices[: n_clients_axis * n_data_axis]
+    )
+    return Mesh(mesh_devices, ("clients", "data"))
+
+
 def shard_over_clients(tree: PyTree, mesh: Mesh) -> PyTree:
     """Place a client-stacked pytree with its leading axis split over the
     'clients' mesh axis (the SPMD 'wire')."""

@@ -1,0 +1,110 @@
+"""Tensor-parallel sharding rules for transformer params (hybrid mesh).
+
+The reference's only big-model scaling is DeepSpeed ZeRO config JSON in the
+FedLLM example (/root/reference/examples/fedllm_example, SURVEY §2.1) — no
+in-repo tensor parallelism. For the TPU build, TP is a first-class axis:
+``hybrid_mesh(clients, model)`` (parallel/mesh.py:32) splits every client's
+transformer across the "model" axis with the standard Megatron pairing —
+column-parallel into the nonlinearity, row-parallel out of it — so each
+attention/MLP block needs exactly one psum on its output, which XLA inserts
+from the shardings.
+
+These are RULES (path -> PartitionSpec), not a parallel module zoo: the same
+flax model runs unsharded on one chip or TP-sharded on a mesh purely by
+changing the placement of its pytree (models/transformer.py names its
+projections to be keyed on here).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fl4health_tpu.core.types import PyTree
+
+# Column-parallel: output features sharded (kernel [in, out] -> P(None, ax)).
+COLUMN_PARALLEL = ("q_proj", "k_proj", "v_proj", "ff_in")
+# Row-parallel: input features sharded (kernel [in, out] -> P(ax, None)).
+ROW_PARALLEL = ("o_proj", "ff_out")
+
+
+def tp_spec(path: str, ndim: int, axis: str = "model") -> P:
+    """PartitionSpec for one transformer param leaf (unstacked shape)."""
+    segs = path.split(".")
+    module = segs[-2] if len(segs) >= 2 else ""
+    leaf = segs[-1]
+    if module in COLUMN_PARALLEL:
+        if leaf in ("kernel", "lora_b") and ndim == 2:
+            return P(None, axis)
+        if leaf == "bias" and ndim == 1:
+            return P(axis)
+        # lora_a of a column-parallel layer stays replicated (it's rank-r).
+        return P(*([None] * ndim))
+    if module in ROW_PARALLEL:
+        if leaf in ("kernel", "lora_a") and ndim == 2:
+            return P(axis, None)
+        # row-parallel bias adds after the psum -> replicated.
+        return P(*([None] * ndim))
+    # Embeddings, layer norms, classifier head: replicated over "model".
+    return P(*([None] * ndim))
+
+
+def shard_transformer_params(
+    params: PyTree,
+    mesh: Mesh,
+    axis: str = "model",
+    client_axis: str | None = None,
+) -> PyTree:
+    """Place a transformer param pytree by the TP rules. With ``client_axis``
+    set, leaves are client-stacked ([clients, ...]) and the leading dim is
+    sharded over that axis — the hybrid (clients x model) layout."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    placed = []
+    for key_path, leaf in flat:
+        dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
+        if client_axis is not None:
+            spec = tp_spec(dotted, leaf.ndim - 1, axis)
+            spec = P(client_axis, *spec)
+        else:
+            spec = tp_spec(dotted, leaf.ndim, axis)
+        placed.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def shard_like_params(tree: PyTree, params_template: PyTree, mesh: Mesh,
+                      axis: str = "model", client_axis: str | None = None) -> PyTree:
+    """Shard a tree holding params-shaped sub-trees (optimizer momenta, drift
+    anchors) by the same TP rules.
+
+    Leaves are matched to template params by dotted-path SUFFIX — an adam
+    ``mu`` leaf at ``0.mu.layer_0.attn.o_proj.kernel`` inherits the rule of
+    ``layer_0.attn.o_proj.kernel``. Path matching (not shape matching) keeps
+    same-shaped leaves with different rules distinct (q/k/v vs o_proj are all
+    [d, d] but shard on opposite axes). Unmatched leaves (step counts, EMA
+    scalars) replicate.
+    """
+    flat_t, _ = jax.tree_util.tree_flatten_with_path(params_template)
+    param_specs: list[tuple[str, Any, P]] = []
+    for key_path, leaf in flat_t:
+        dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
+        if client_axis is not None:
+            spec = P(client_axis, *tp_spec(dotted, leaf.ndim - 1, axis))
+        else:
+            spec = tp_spec(dotted, leaf.ndim, axis)
+        param_specs.append((dotted, leaf.shape, spec))
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    placed = []
+    for key_path, leaf in flat:
+        dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
+        spec = P()
+        for ppath, pshape, pspec in param_specs:
+            if (dotted == ppath or dotted.endswith("." + ppath)) and (
+                getattr(leaf, "shape", ()) == pshape
+            ):
+                spec = pspec
+                break
+        placed.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, placed)

@@ -1,0 +1,84 @@
+"""PEFT / LoRA parameter filtering — the pytree equivalent of peft adapters.
+
+Parity surface (/root/reference/fl4health/utils/peft_parameter_extraction.py:7
+``get_all_peft_parameters_from_model``: collects the adapter-injected
+parameters from a HF peft model so only they cross the wire;
+/root/reference/examples/fedllm_example trains LoRA adapters federally).
+
+TPU-native design: adapters are ordinary params named ``lora_a``/``lora_b``
+(models/transformer.py LoraDense). "PEFT" is then two orthogonal filters on
+the SAME pytree:
+
+- the exchanger filter (what crosses the wire) — ``lora_exchanger()``,
+- the optimizer mask (what trains locally)     — ``lora_trainable_mask`` +
+  ``masked_optimizer``.
+
+No module surgery, no adapter classes: path predicates compose with every
+existing exchanger/strategy because the param structure never changes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import optax
+
+from fl4health_tpu.core import pytree as ptu
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.exchange.exchanger import FixedLayerExchanger
+
+# Path segments that mark PEFT-trainable leaves: the LoRA factors plus the
+# task head (peft convention: `modules_to_save=["classifier"]`).
+LORA_MARKERS: tuple[str, ...] = ("lora_a", "lora_b", "classifier")
+
+
+def peft_parameter_paths(params: Params, markers: Sequence[str] = LORA_MARKERS) -> list[str]:
+    """Dotted paths of all PEFT parameters (get_all_peft_parameters_from_model
+    equivalent — returns paths rather than tensors because pytree leaves are
+    addressed, not owned)."""
+    marks = tuple(markers)
+    paths = []
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for key_path, _ in flat:
+        dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
+        if any(m in dotted.split(".") for m in marks):
+            paths.append(dotted)
+    return paths
+
+
+def lora_exchanger(markers: Sequence[str] = LORA_MARKERS) -> FixedLayerExchanger:
+    """Wire filter: only adapters (+ head) cross the wire — the federated
+    LoRA exchange the fedllm example gets from peft's state-dict filtering.
+
+    Matches whole path SEGMENTS (like ``lora_trainable_mask``), not raw
+    substrings: a module merely named "aux_classifier_head" must not leak
+    onto the wire while staying frozen locally.
+    """
+    marks = tuple(markers)
+    return FixedLayerExchanger(
+        include=lambda path: any(m in path.split(".") for m in marks)
+    )
+
+
+def lora_trainable_mask(params: Params, markers: Sequence[str] = LORA_MARKERS):
+    """Bool pytree: True where the leaf should train (adapters + head)."""
+    marks = tuple(markers)
+    return ptu.select_by_path(
+        params, lambda path: any(m in path.split(".") for m in marks)
+    )
+
+
+def masked_optimizer(
+    tx: optax.GradientTransformation, trainable_mask
+) -> optax.GradientTransformation:
+    """Freeze untrainable leaves: real updates where mask is True, zeros
+    elsewhere (optax.multi_transform over the bool mask). The frozen base
+    weights still live in params, so exchangers/checkpointers see the full
+    model."""
+    labels = jax.tree_util.tree_map(
+        lambda t: "train" if t else "freeze", trainable_mask
+    )
+    return optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()}, labels
+    )
