@@ -1,0 +1,110 @@
+"""Flash client — gamma-thresholded per-epoch early stopping, compiled.
+
+Parity surface (/root/reference/fl4health/clients/flash_client.py:18
+``FlashClient``): epoch-wise training only (step-wise raises, :71-95); after
+every local epoch the client validates and STOPS when the validation-loss
+improvement falls below ``gamma / (epoch + 1)`` (:152-160). Unlike the
+generic EarlyStopper there is no best-state restore — Flash simply breaks
+out of the epoch loop and returns the current state.
+
+TPU-native design: the epoch loop is a ``lax.scan`` over [n_epochs,
+steps_per_epoch] chunks; the stop decision is a carried flag that zeroes the
+step_mask of later epochs (full no-ops), replacing the Python ``break`` with
+mask arithmetic — the same compilation pattern as
+engine.make_local_train_with_early_stopping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
+from fl4health_tpu.losses.containers import LossMeter
+from fl4health_tpu.metrics.base import MetricManager
+
+
+@dataclasses.dataclass(frozen=True)
+class FlashEarlyStopConfig:
+    """gamma: the improvement threshold (flash_client.py:66); None disables
+    the stop rule entirely (the client then behaves exactly like
+    BasicClient, :117-118 — all epochs run).
+    n_epochs must match the simulation's local_epochs — the stop rule is
+    defined per epoch. With heterogeneous client data sizes the chunk
+    boundaries follow the cohort-padded max length."""
+
+    gamma: float | None
+    n_epochs: int
+
+
+def make_flash_local_train(
+    logic: ClientLogic,
+    tx,
+    metric_manager: MetricManager,
+    config: FlashEarlyStopConfig,
+    loss_keys: tuple[str, ...] = ("backward",),
+):
+    """Returns train(state, ctx, batches, val_batches) with the engine's
+    standard outputs (state, loss_dict, metric_dict, n_steps)."""
+    step_fn = engine.make_train_step(logic, tx)
+    evaluate = engine.make_local_eval(logic, metric_manager)
+    meter_proto = LossMeter.create(loss_keys)
+    n_epochs = config.n_epochs
+
+    def train(state: TrainState, ctx: Any, batches: Batch, val_batches: Batch):
+        total = batches.step_mask.shape[0]
+        steps_per_epoch = total // n_epochs
+        assert steps_per_epoch * n_epochs == total, (
+            f"batch stream ({total} steps) must divide into n_epochs={n_epochs}"
+        )
+        chunked = jax.tree_util.tree_map(
+            lambda x: x.reshape((n_epochs, steps_per_epoch) + x.shape[1:]), batches
+        )
+
+        def epoch_body(carry, chunk: Batch):
+            st, meter, mstate, prev_loss, stopped, epochs_run, executed = carry
+            chunk = chunk.replace(step_mask=chunk.step_mask * (1.0 - stopped))
+
+            def body(c, b):
+                st2, meter2, ms2 = c
+                st2, out = step_fn(st2, ctx, b)
+                meter2 = meter2.update(out.losses, weight=out.step_mask)
+                ms2 = metric_manager.update(ms2, out.preds, out.targets, out.example_mask)
+                return (st2, meter2, ms2), None
+
+            (st, meter, mstate), _ = jax.lax.scan(body, (st, meter, mstate), chunk)
+            executed = executed + jnp.sum(chunk.step_mask)
+
+            val_losses, _ = evaluate(st, ctx, val_batches)
+            current = val_losses["checkpoint"]
+            live = stopped < 0.5
+            if config.gamma is not None:
+                # stop rule denominator = this LIVE epoch's 0-based index + 1
+                # (flash_client.py:152 `gamma / (local_epoch + 1)`)
+                threshold = config.gamma / (epochs_run + 1.0)
+                should_stop = ((prev_loss - current) < threshold) & live
+                stopped = jnp.maximum(stopped, should_stop.astype(jnp.float32))
+            prev_loss = jnp.where(live, current, prev_loss)
+            epochs_run = epochs_run + live.astype(jnp.float32)
+            return (st, meter, mstate, prev_loss, stopped, epochs_run, executed), current
+
+        init = (
+            state,
+            meter_proto,
+            metric_manager.init(),
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+        )
+        (state, meter, mstate, _, _, _, executed), _ = jax.lax.scan(
+            epoch_body, init, chunked
+        )
+        state = logic.finalize_round(state, ctx, executed)
+        return state, meter.compute(), metric_manager.compute(mstate), executed
+
+    return train

@@ -122,6 +122,7 @@ class FederatedSimulation:
         model_checkpointers: Sequence[tuple[Any, Any]] = (),
         state_checkpointer: Any = None,
         early_stopping: engine.EarlyStoppingConfig | None = None,
+        flash_early_stopping: Any = None,
         failure_policy: FailurePolicy | None = None,
     ):
         if (local_epochs is None) == (local_steps is None):
@@ -145,6 +146,19 @@ class FederatedSimulation:
         self.model_checkpointers = list(model_checkpointers)
         self.state_checkpointer = state_checkpointer
         self.early_stopping = early_stopping
+        self.flash_early_stopping = flash_early_stopping
+        if flash_early_stopping is not None:
+            # Flash is epoch-defined (flash_client.py:71-95 rejects step-wise)
+            if local_epochs is None:
+                raise ValueError("flash_early_stopping requires local_epochs")
+            if early_stopping is not None:
+                raise ValueError("flash_early_stopping and early_stopping are exclusive")
+            if flash_early_stopping.n_epochs != local_epochs:
+                raise ValueError(
+                    f"flash_early_stopping.n_epochs={flash_early_stopping.n_epochs} "
+                    f"must equal local_epochs={local_epochs}: the gamma rule is "
+                    "defined per true local epoch"
+                )
         self.failure_policy = failure_policy or FailurePolicy()
         self.rng = jax.random.PRNGKey(seed)
         self.sample_counts = jnp.asarray(
@@ -185,6 +199,13 @@ class FederatedSimulation:
         if self.early_stopping is not None:
             es_train = engine.make_local_train_with_early_stopping(
                 logic, tx, self.metrics, self.early_stopping, loss_keys
+            )
+            train = None
+        elif self.flash_early_stopping is not None:
+            from fl4health_tpu.clients.flash import make_flash_local_train
+
+            es_train = make_flash_local_train(
+                logic, tx, self.metrics, self.flash_early_stopping, loss_keys
             )
             train = None
         else:

@@ -1,7 +1,8 @@
 """FedDG-GA — generalization-adjustment aggregation weights.
 
-Parity: /root/reference/fl4health/strategies/feddg_ga.py:98 (+ the adaptive-
-constraint combination, feddg_ga_with_adaptive_constraint.py:15).
+Parity: /root/reference/fl4health/strategies/feddg_ga.py:98; the adaptive-
+constraint combination (feddg_ga_with_adaptive_constraint.py:15) is
+``FedDgGaAdaptiveConstraint`` below.
 
 Semantics (verified against weight_and_aggregate_results :333 and
 update_weights_by_ga :382-451):
@@ -62,10 +63,24 @@ class FedDgGa(Strategy):
         )
 
     def aggregate(self, server_state: FedDgGaState, results: FitResults, round_idx):
-        new_params = weighted_mean(results.packets, server_state.adjustment_weights)
+        # The reference forces full participation (:205-210), but the NaN
+        # failure screen (simulation.py fit_round) can still zero a client's
+        # mask row — its poisoned params/val-loss must not enter the average.
+        w = server_state.adjustment_weights * results.mask
+        w = w / jnp.maximum(jnp.sum(w), 1e-12)
+        new_params = weighted_mean(results.packets, w)
+        new_val = jnp.where(
+            results.mask > 0,
+            results.train_losses["val_checkpoint_post_fit"],
+            server_state.local_val_losses,
+        )
+        any_client = jnp.sum(results.mask) > 0
+        new_params = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(any_client, n, o), new_params, server_state.params
+        )
         return server_state.replace(
             params=new_params,
-            local_val_losses=results.train_losses["val_checkpoint_post_fit"],
+            local_val_losses=new_val,
             round_idx=round_idx,
         )
 
@@ -83,3 +98,109 @@ class FedDgGa(Strategy):
         w = jnp.clip(server_state.adjustment_weights + delta, 0.0, 1.0)
         w = w / jnp.maximum(jnp.sum(w), 1e-12)
         return server_state.replace(adjustment_weights=w)
+
+
+@struct.dataclass
+class FedDgGaAdaptiveConstraintState:
+    params: Params
+    adjustment_weights: jax.Array
+    local_val_losses: jax.Array
+    round_idx: jax.Array
+    drift_penalty_weight: jax.Array  # mu
+    previous_loss: jax.Array
+    loss_drop_streak: jax.Array
+
+
+class FedDgGaAdaptiveConstraint(Strategy):
+    """FedDG-GA aggregation + FedProx-style mu adaptation.
+
+    Parity: /root/reference/fl4health/strategies/
+    feddg_ga_with_adaptive_constraint.py:15 — clients run the adaptive-drift
+    constraint (packing their vanilla train loss next to the weights,
+    clients/fedprox.py), parameters aggregate with the GA adjustment weights,
+    and the drift penalty weight adapts from the aggregated train-loss
+    trajectory exactly as in FedAvgWithAdaptiveConstraint (:216-231 rules).
+    """
+
+    evaluate_after_fit = True
+
+    def __init__(
+        self,
+        n_clients: int,
+        num_rounds: int,
+        adjustment_weight_step_size: float = 0.2,
+        signal: float = 1.0,
+        initial_drift_penalty_weight: float = 0.1,
+        adapt_loss_weight: bool = True,
+        loss_weight_delta: float = 0.1,
+        loss_weight_patience: int = 5,
+        weighted_train_losses: bool = True,
+    ):
+        self.ga = FedDgGa(
+            n_clients, num_rounds, adjustment_weight_step_size, signal
+        )
+        self.mu0 = initial_drift_penalty_weight
+        self.adapt = adapt_loss_weight
+        self.delta = loss_weight_delta
+        self.patience = loss_weight_patience
+        self.weighted_train_losses = weighted_train_losses
+
+    def init(self, params: Params) -> FedDgGaAdaptiveConstraintState:
+        ga = self.ga.init(params)
+        return FedDgGaAdaptiveConstraintState(
+            params=ga.params,
+            adjustment_weights=ga.adjustment_weights,
+            local_val_losses=ga.local_val_losses,
+            round_idx=ga.round_idx,
+            drift_penalty_weight=jnp.asarray(self.mu0, jnp.float32),
+            previous_loss=jnp.asarray(jnp.inf, jnp.float32),
+            loss_drop_streak=jnp.zeros((), jnp.int32),
+        )
+
+    def client_payload(self, server_state, round_idx):
+        from fl4health_tpu.strategies.fedprox import AdaptiveConstraintPayload
+
+        return AdaptiveConstraintPayload(
+            params=server_state.params,
+            drift_penalty_weight=server_state.drift_penalty_weight,
+        )
+
+    def aggregate(self, server_state, results: FitResults, round_idx):
+        from fl4health_tpu.core import aggregate as agg
+        from fl4health_tpu.strategies.fedprox import adapt_drift_penalty
+
+        packets = results.packets  # AdaptiveConstraintPacket
+        w = server_state.adjustment_weights * results.mask
+        w = w / jnp.maximum(jnp.sum(w), 1e-12)
+        new_params = weighted_mean(packets.params, w)
+        train_loss = agg.aggregate_losses(
+            packets.loss_for_adaptation, results.sample_counts, results.mask,
+            self.weighted_train_losses,
+        )
+        mu, streak = adapt_drift_penalty(
+            server_state.drift_penalty_weight, server_state.loss_drop_streak,
+            train_loss, server_state.previous_loss, self.patience, self.delta,
+            self.adapt,
+        )
+        any_client = jnp.sum(results.mask) > 0
+        new_params = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(any_client, n, o), new_params, server_state.params
+        )
+        new_val = jnp.where(
+            results.mask > 0,
+            results.train_losses["val_checkpoint_post_fit"],
+            server_state.local_val_losses,
+        )
+        return server_state.replace(
+            params=new_params,
+            local_val_losses=new_val,
+            round_idx=round_idx,
+            drift_penalty_weight=mu,
+            previous_loss=jnp.where(any_client, train_loss, server_state.previous_loss),
+            loss_drop_streak=streak,
+        )
+
+    def update_after_eval(self, server_state, eval_losses, eval_metrics, mask):
+        # Same GA rule; FedDgGa.update_after_eval only reads fields the combo
+        # state also carries and returns it via .replace, so delegate.
+        return self.ga.update_after_eval(server_state, eval_losses, eval_metrics, mask)

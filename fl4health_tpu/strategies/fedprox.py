@@ -34,6 +34,28 @@ class AdaptiveConstraintPayload:
     drift_penalty_weight: jax.Array
 
 
+def adapt_drift_penalty(
+    mu: jax.Array,
+    streak: jax.Array,
+    train_loss: jax.Array,
+    previous_loss: jax.Array,
+    patience: int,
+    delta: float,
+    adapt: bool,
+) -> tuple[jax.Array, jax.Array]:
+    """The shared mu/streak rules (:216-231): drop mu after ``patience``
+    consecutive loss improvements, raise it on any increase. Used by
+    FedAvgWithAdaptiveConstraint and FedDgGaAdaptiveConstraint."""
+    improved = train_loss <= previous_loss
+    streak = jnp.where(improved, streak + 1, 0)
+    if adapt:
+        hit = streak >= patience
+        mu = jnp.where(hit, jnp.maximum(mu - delta, 0.0), mu)
+        mu = jnp.where(~improved, mu + delta, mu)
+        streak = jnp.where(hit, 0, streak)
+    return mu, streak
+
+
 class FedAvgWithAdaptiveConstraint(Strategy):
     def __init__(
         self,
@@ -75,15 +97,11 @@ class FedAvgWithAdaptiveConstraint(Strategy):
             packets.loss_for_adaptation, results.sample_counts, results.mask,
             self.weighted_train_losses,
         )
-        improved = train_loss <= server_state.previous_loss
-        streak = jnp.where(improved, server_state.loss_drop_streak + 1, 0)
-        mu = server_state.drift_penalty_weight
-        if self.adapt:
-            # patience hit -> decrease mu, reset streak; any increase -> raise mu
-            hit = streak >= self.patience
-            mu = jnp.where(hit, jnp.maximum(mu - self.delta, 0.0), mu)
-            mu = jnp.where(~improved, mu + self.delta, mu)
-            streak = jnp.where(hit, 0, streak)
+        mu, streak = adapt_drift_penalty(
+            server_state.drift_penalty_weight, server_state.loss_drop_streak,
+            train_loss, server_state.previous_loss, self.patience, self.delta,
+            self.adapt,
+        )
         any_client = jnp.sum(results.mask) > 0
         new_params = jax.tree_util.tree_map(
             lambda n, o: jnp.where(any_client, n, o), new_params, server_state.params

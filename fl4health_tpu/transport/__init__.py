@@ -1,0 +1,21 @@
+"""Cross-silo transport: wire codec (native C++ framing + JSON/array
+payloads), COO boundary for sparse packets, and a host RPC loopback.
+
+See codec.py for the wire contract and SURVEY §2.14 for the role split:
+in-process simulation rides the device mesh (XLA collectives); this package
+is the host-level seam for deployments that cannot share a mesh.
+"""
+
+from fl4health_tpu.transport.codec import (
+    decode,
+    decode_sparse,
+    encode,
+    encode_sparse,
+)
+from fl4health_tpu.transport.loopback import LoopbackServer, call
+from fl4health_tpu.transport.native import FrameError, get_framing
+
+__all__ = [
+    "encode", "decode", "encode_sparse", "decode_sparse",
+    "LoopbackServer", "call", "FrameError", "get_framing",
+]

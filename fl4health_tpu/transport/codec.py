@@ -1,0 +1,168 @@
+"""Pytree <-> wire codec for the cross-silo transport.
+
+Parity surface (SURVEY §2.14): the reference's wire format is Flower's
+``Parameters`` — a list of NumPy arrays serialized per round over gRPC
+(strategies own pack/unpack; grpcio's C core does the byte handling). For
+cross-silo deployments (real hospitals, no shared mesh) the TPU build keeps
+a host-level wire with the same contract.
+
+Design:
+- header = JSON metadata (dotted leaf paths, shapes, dtypes) — code never
+  executes from the wire (no pickle);
+- payload = the raw little-endian array bytes, concatenated in path order;
+- framing (magic/version/flags/lengths/CRC-32) is the native C++ codec
+  (transport/native.py) with a byte-identical Python fallback;
+- sparse packets cross as real COO (values + int32 indices) — the dense
+  0/1-mask encoding used on-device (exchange/packer.py SparseMaskPacket)
+  converts at this host boundary, reproducing the reference's
+  SparseCooParameterPacker wire compactness (parameter_packer.py:94,124);
+- ``decode(data, like=template)`` restores the EXACT pytree structure
+  (flax struct dataclasses included) by unflattening into the template's
+  treedef; without a template the result is nested dicts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import jax
+import numpy as np
+
+from fl4health_tpu.core.types import PyTree
+from fl4health_tpu.exchange.packer import SparseMaskPacket
+from fl4health_tpu.transport.native import get_framing
+
+FLAG_COO = 1
+
+
+def _paths_and_leaves(tree: PyTree) -> list[tuple[str, np.ndarray]]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for key_path, leaf in flat:
+        dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
+        out.append((dotted, np.asarray(leaf)))
+    return out
+
+
+def encode(tree: PyTree) -> bytes:
+    """Dense pytree -> one wire frame."""
+    entries = _paths_and_leaves(tree)
+    meta, chunks = [], []
+    for path, arr in entries:
+        data = np.ascontiguousarray(arr)
+        if data.dtype.byteorder == ">":
+            data = data.astype(data.dtype.newbyteorder("<"))
+        # dtype recorded AFTER the little-endian conversion — the header must
+        # describe the payload bytes, not the caller's original layout.
+        meta.append({"path": path, "shape": list(arr.shape), "dtype": str(data.dtype)})
+        chunks.append(data.tobytes())
+    header = json.dumps({"leaves": meta}).encode("utf-8")
+    return get_framing().frame(header, b"".join(chunks), flags=0)
+
+
+def _rebuild_nested(items: list[tuple[str, np.ndarray]]) -> dict:
+    root: dict = {}
+    for path, arr in items:
+        node = root
+        parts = path.split(".")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = arr
+    return root
+
+
+def decode(data: bytes, like: PyTree | None = None) -> PyTree:
+    """Wire frame -> pytree. With ``like``, leaves are unflattened into the
+    template's exact treedef (paths must match); otherwise nested dicts."""
+    header, payload, flags = get_framing().unframe(data)
+    meta = json.loads(header.decode("utf-8"))
+    if flags & FLAG_COO:
+        raise ValueError("COO frame: use decode_sparse()")
+    items: list[tuple[str, np.ndarray]] = []
+    off = 0
+    for entry in meta["leaves"]:
+        dt = np.dtype(entry["dtype"])
+        n = int(np.prod(entry["shape"], dtype=np.int64)) if entry["shape"] else 1
+        nbytes = n * dt.itemsize
+        arr = np.frombuffer(payload, dt, count=n, offset=off).reshape(entry["shape"])
+        items.append((entry["path"], arr))
+        off += nbytes
+    if like is None:
+        return _rebuild_nested(items)
+    flat_t, treedef = jax.tree_util.tree_flatten_with_path(like)
+    by_path = dict(items)
+    leaves = []
+    for key_path, template_leaf in flat_t:
+        dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
+        if dotted not in by_path:
+            raise ValueError(f"wire frame missing leaf {dotted!r}")
+        leaves.append(by_path[dotted])
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Sparse (COO) boundary
+# ---------------------------------------------------------------------------
+
+def encode_sparse(packet: SparseMaskPacket) -> bytes:
+    """SparseMaskPacket (dense 0/1 element masks, the device encoding) ->
+    COO wire frame shipping only selected values + their flat indices."""
+    params = _paths_and_leaves(packet.params)
+    masks = dict(_paths_and_leaves(packet.element_mask))
+    meta, chunks = [], []
+    for path, arr in params:
+        mask = masks[path]
+        flat_idx = np.nonzero(mask.ravel() > 0)[0].astype(np.int32)
+        values = np.ascontiguousarray(arr.ravel()[flat_idx])
+        meta.append(
+            {
+                "path": path,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "nnz": int(flat_idx.size),
+            }
+        )
+        chunks.append(flat_idx.tobytes())
+        chunks.append(values.tobytes())
+    header = json.dumps({"coo": meta}).encode("utf-8")
+    return get_framing().frame(header, b"".join(chunks), flags=FLAG_COO)
+
+
+def decode_sparse(data: bytes, like: SparseMaskPacket | None = None) -> SparseMaskPacket:
+    """COO wire frame -> dense params + element masks (zeros where absent)."""
+    header, payload, flags = get_framing().unframe(data)
+    if not flags & FLAG_COO:
+        raise ValueError("dense frame: use decode()")
+    meta = json.loads(header.decode("utf-8"))
+    items, mask_items = [], []
+    off = 0
+    for entry in meta["coo"]:
+        dt = np.dtype(entry["dtype"])
+        nnz = entry["nnz"]
+        idx = np.frombuffer(payload, np.int32, count=nnz, offset=off)
+        off += nnz * 4
+        vals = np.frombuffer(payload, dt, count=nnz, offset=off)
+        off += nnz * dt.itemsize
+        n = int(np.prod(entry["shape"], dtype=np.int64)) if entry["shape"] else 1
+        dense = np.zeros((n,), dt)
+        dense[idx] = vals
+        mask = np.zeros((n,), np.float32)
+        mask[idx] = 1.0
+        items.append((entry["path"], dense.reshape(entry["shape"])))
+        mask_items.append((entry["path"], mask.reshape(entry["shape"])))
+    if like is None:
+        return SparseMaskPacket(
+            params=_rebuild_nested(items), element_mask=_rebuild_nested(mask_items)
+        )
+    flat_t, treedef = jax.tree_util.tree_flatten_with_path(like.params)
+    by_path, by_path_m = dict(items), dict(mask_items)
+    leaves, mask_leaves = [], []
+    for key_path, _ in flat_t:
+        dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
+        leaves.append(by_path[dotted])
+        mask_leaves.append(by_path_m[dotted])
+    return SparseMaskPacket(
+        params=jax.tree_util.tree_unflatten(treedef, leaves),
+        element_mask=jax.tree_util.tree_unflatten(treedef, mask_leaves),
+    )

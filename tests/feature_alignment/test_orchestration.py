@@ -1,0 +1,158 @@
+"""Two-poll feature-alignment orchestration: pandas in, federated round out
+(reference: servers/tabular_feature_alignment_server.py:27,113,
+clients/tabular_data_client.py:22)."""
+
+import numpy as np
+import optax
+import pandas as pd
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.feature_alignment.orchestration import (
+    FEATURE_INFO,
+    INPUT_DIMENSION,
+    OUTPUT_DIMENSION,
+    SOURCE_SPECIFIED,
+    TabularDataClient,
+    TabularFeatureAlignmentServer,
+)
+from fl4health_tpu.feature_alignment.schema import TabularFeaturesInfoEncoder
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+
+def client_frame(n, seed, drop_column=False, extra_column=False):
+    """Heterogeneous hospital-style frames: same underlying task, ragged
+    schemas (a column missing here, an extra local-only column there)."""
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(20, 90, n).round(1)
+    pressure = rng.uniform(90, 180, n).round(1)
+    sex = rng.choice(["F", "M"], n)
+    score = (age / 90 + (pressure - 90) / 90 + (sex == "M") * 0.3) / 2.3
+    outcome = (score + rng.normal(0, 0.15, n) > 0.55).astype(int).astype(str)
+    data = {
+        "patient_id": np.arange(n),
+        "age": age,
+        "pressure": pressure,
+        "sex": sex,
+        "outcome": outcome,
+    }
+    if drop_column:
+        del data["pressure"]
+    if extra_column:
+        data["local_only_notes_id"] = rng.integers(0, 5, n)
+    return pd.DataFrame(data)
+
+
+def make_clients():
+    return [
+        TabularDataClient(client_frame(60, 1), "patient_id", ["outcome"]),
+        TabularDataClient(client_frame(60, 2, drop_column=True), "patient_id", ["outcome"]),
+        TabularDataClient(client_frame(60, 3, extra_column=True), "patient_id", ["outcome"]),
+    ]
+
+
+def sim_builder(input_dim, output_dim, clients):
+    datasets = []
+    for c in clients:
+        x, y = c.aligned_arrays()
+        y = y.astype(np.int32)
+        split = int(0.8 * len(x))
+        datasets.append(
+            ClientDataset(
+                x_train=x[:split], y_train=y[:split],
+                x_val=x[split:], y_val=y[split:],
+            )
+        )
+    return FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(16,), n_outputs=output_dim)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.adam(5e-3),
+        strategy=FedAvg(),
+        datasets=datasets,
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=5,
+        seed=0,
+    )
+
+
+class TestClientProtocol:
+    def test_poll1_offers_schema_poll2_aligns_and_reports_dims(self):
+        client = make_clients()[0]
+        props1 = client.get_properties({SOURCE_SPECIFIED: False})
+        assert FEATURE_INFO in props1
+        schema = TabularFeaturesInfoEncoder.from_json(props1[FEATURE_INFO])
+        assert "age" in schema.get_feature_columns()
+        assert schema.get_target_columns() == ["outcome"]
+
+        props2 = client.get_properties(
+            {SOURCE_SPECIFIED: True, FEATURE_INFO: props1[FEATURE_INFO]}
+        )
+        assert props2[INPUT_DIMENSION] > 0
+        assert props2[OUTPUT_DIMENSION] == 2  # binary outcome -> 2 classes
+
+    def test_alignment_imputes_missing_and_drops_local_only(self):
+        """The client missing 'pressure' and the client with a local-only
+        column must both land on the SAME encoded width."""
+        clients = make_clients()
+        schema_json = clients[0].get_properties({SOURCE_SPECIFIED: False})[FEATURE_INFO]
+        widths = set()
+        for c in clients:
+            x, _ = c.align(schema_json)
+            widths.add(x.shape[1])
+        assert len(widths) == 1
+
+
+class TestServerOrchestration:
+    def test_two_polls_then_federated_round(self):
+        clients = make_clients()
+        server = TabularFeatureAlignmentServer(
+            config={"n_server_rounds": 3},
+            clients=clients,
+            sim_builder=sim_builder,
+        )
+        history = server.fit(3)
+
+        # protocol outcomes
+        assert server.initial_polls_complete
+        assert server.source_info_gathered
+        assert FEATURE_INFO in server.config, "schema redistributed via config"
+        assert server.dimension_info[OUTPUT_DIMENSION] == 2
+        # all clients aligned (the second poll touches every client)
+        assert all(c.aligned is not None for c in clients)
+
+        assert len(history) == 3
+        assert history[-1].fit_losses["backward"] < history[0].fit_losses["backward"]
+        assert history[-1].eval_metrics["accuracy"] > 0.5
+
+    def test_supplied_source_of_truth_skips_poll1(self):
+        clients = make_clients()
+        # source of truth from a frame that has every column
+        truth = TabularFeaturesInfoEncoder.encoder_from_dataframe(
+            client_frame(30, 9), "patient_id", ["outcome"]
+        ).to_json()
+        calls = {"n": 0}
+        orig = clients[0].get_properties
+
+        def counting(request):
+            calls["n"] += 1
+            assert request.get(SOURCE_SPECIFIED, False), (
+                "with a supplied source of truth, only the dimension poll may run"
+            )
+            return orig(request)
+
+        clients[0].get_properties = counting
+        server = TabularFeatureAlignmentServer(
+            config={},
+            clients=clients,
+            sim_builder=sim_builder,
+            feature_info_source=truth,
+        )
+        server.fit(1)
+        assert calls["n"] == 1  # dimension poll only

@@ -1,0 +1,231 @@
+"""Flash recursion (rounds 2-3 against the reference equations), the Flash
+client's gamma early stop, and the FedDgGa + adaptive-constraint combo.
+
+Reference: strategies/flash.py:125-142 (_update_parameters),
+clients/flash_client.py:18,152 (gamma rule),
+strategies/feddg_ga_with_adaptive_constraint.py:15.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.fedprox import FedProxClientLogic
+from fl4health_tpu.clients.flash import FlashEarlyStopConfig, make_flash_local_train
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.base import FitResults
+from fl4health_tpu.strategies.feddg_ga import FedDgGaAdaptiveConstraint
+from fl4health_tpu.strategies.flash import Flash
+
+
+def _results(packets, n=2):
+    return FitResults(
+        packets=packets,
+        sample_counts=jnp.ones((n,)),
+        train_losses={},
+        train_metrics={},
+        mask=jnp.ones((n,)),
+    )
+
+
+class NumpyFlashReference:
+    """Direct transcription of the REFERENCE equations (flash.py:125-142):
+    per-round x_bar -> delta -> m, v, beta3, d -> x update. Kept in numpy so
+    the strategy under test is compared against independent math."""
+
+    def __init__(self, x0, eta=0.1, b1=0.9, b2=0.99, tau=1e-3):
+        self.x = np.asarray(x0, np.float64)
+        self.m = np.zeros_like(self.x)
+        self.v = np.zeros_like(self.x)
+        self.d = np.zeros_like(self.x)
+        self.eta, self.b1, self.b2, self.tau = eta, b1, b2, tau
+
+    def round(self, x_bar):
+        delta = np.asarray(x_bar, np.float64) - self.x
+        d2 = np.square(delta)
+        self.m = self.b1 * self.m + (1 - self.b1) * delta
+        v_new = self.b2 * self.v + (1 - self.b2) * d2
+        norm_v_prev = np.abs(self.v)
+        norm_diff = np.abs(d2 - v_new)
+        with np.errstate(invalid="ignore"):
+            b3 = norm_v_prev / (norm_diff + norm_v_prev)
+        b3 = np.nan_to_num(b3)  # 0/0 only when v_prev=0 AND d2=v_new
+        self.v = v_new
+        self.d = b3 * self.d + (1 - b3) * (d2 - self.v)
+        self.x = self.x + self.eta * self.m / (np.sqrt(self.v) - self.d + self.tau)
+        return self.x
+
+
+class TestFlashRecursion:
+    def test_beta3_d_recursion_matches_reference_rounds_1_to_3(self):
+        """The drift-aware third moment — the entire point of Flash — checked
+        through THREE rounds of the recursion, not just round 1."""
+        strat = Flash(eta=0.1, beta_1=0.9, beta_2=0.99, tau=1e-3)
+        state = strat.init({"w": jnp.zeros((3,))})
+        ref = NumpyFlashReference(np.zeros((3,)))
+
+        # Drifting client updates: different x_bar each round, per-element
+        # differences so beta3 is a genuine matrix, not a scalar.
+        xbars = [
+            np.asarray([1.0, -0.5, 0.25]),
+            np.asarray([0.8, -0.9, 0.5]),
+            np.asarray([1.2, -0.2, -0.3]),
+        ]
+        for r, xb in enumerate(xbars, start=1):
+            packets = {"w": jnp.stack([jnp.asarray(xb), jnp.asarray(xb)])}
+            state = strat.aggregate(state, _results(packets), r)
+            expected = ref.round(xb)
+            np.testing.assert_allclose(
+                np.asarray(state.params["w"]), expected, rtol=1e-5, atol=1e-7,
+                err_msg=f"divergence from reference recursion at round {r}",
+            )
+        # the aux moments themselves must match, not just x
+        np.testing.assert_allclose(np.asarray(state.m["w"]), ref.m, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(state.v["w"]), ref.v, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(state.d["w"]), ref.d, rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Flash client: gamma early stop
+# ---------------------------------------------------------------------------
+
+def _flash_setup(n=48, n_epochs=4, batch=8):
+    rng = jax.random.PRNGKey(0)
+    x, y = synthetic_classification(rng, n + 16, (6,), 3, class_sep=2.0)
+    logic = engine.ClientLogic(
+        engine.from_flax(Mlp(features=(16,), n_outputs=3)),
+        engine.masked_cross_entropy,
+    )
+    tx = optax.sgd(0.05)
+    state = engine.create_train_state(logic, tx, rng, x[:1])
+    # [n_epochs * steps_per_epoch] batch stream + val batches
+    per_epoch = [
+        engine.epoch_batches(jax.random.fold_in(rng, e), x[:n], y[:n], batch)
+        for e in range(n_epochs)
+    ]
+    batches = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *per_epoch
+    )
+    val_batches = engine.epoch_batches(rng, x[n:], y[n:], batch, shuffle=False)
+    metrics = MetricManager((efficient.accuracy(),))
+    return logic, tx, state, batches, val_batches, metrics, n_epochs
+
+
+class TestFlashClientGamma:
+    def test_tiny_gamma_runs_all_epochs(self):
+        logic, tx, state, batches, val_batches, metrics, n_epochs = _flash_setup()
+        train = make_flash_local_train(
+            logic, tx, metrics, FlashEarlyStopConfig(gamma=1e-9, n_epochs=n_epochs)
+        )
+        _, _, _, executed = train(state, None, batches, val_batches)
+        assert float(executed) == batches.step_mask.shape[0], (
+            "improving training with a tiny gamma must not stop early"
+        )
+
+    def test_huge_gamma_stops_after_second_epoch(self):
+        """Epoch 0 can never stop (prev_loss = inf); epoch 1's improvement is
+        finite and below a huge gamma/2 threshold, so training halts with
+        exactly two epochs executed (flash_client.py:152 semantics)."""
+        logic, tx, state, batches, val_batches, metrics, n_epochs = _flash_setup()
+        train = make_flash_local_train(
+            logic, tx, metrics, FlashEarlyStopConfig(gamma=1e6, n_epochs=n_epochs)
+        )
+        _, _, _, executed = train(state, None, batches, val_batches)
+        steps_per_epoch = batches.step_mask.shape[0] // n_epochs
+        assert float(executed) == 2 * steps_per_epoch
+
+    def test_flash_sim_integration(self):
+        """flash_early_stopping wires into the simulation and trains."""
+        datasets = []
+        for i in range(2):
+            x, y = synthetic_classification(jax.random.PRNGKey(i), 40, (6,), 3)
+            datasets.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+        sim = FederatedSimulation(
+            logic=engine.ClientLogic(
+                engine.from_flax(Mlp(features=(16,), n_outputs=3)),
+                engine.masked_cross_entropy,
+            ),
+            tx=optax.sgd(0.05),
+            strategy=Flash(eta=0.05),
+            datasets=datasets,
+            batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_epochs=3,
+            flash_early_stopping=FlashEarlyStopConfig(gamma=1e-9, n_epochs=3),
+            seed=0,
+        )
+        history = sim.fit(2)
+        assert len(history) == 2
+        assert np.isfinite(history[-1].fit_losses["backward"])
+
+    def test_flash_rejects_step_wise_training(self):
+        """flash_client.py:71-95: FLASH is not defined for step-wise runs."""
+        x, y = synthetic_classification(jax.random.PRNGKey(0), 20, (4,), 2)
+        with pytest.raises(ValueError, match="local_epochs"):
+            FederatedSimulation(
+                logic=engine.ClientLogic(
+                    engine.from_flax(Mlp(features=(8,), n_outputs=2)),
+                    engine.masked_cross_entropy,
+                ),
+                tx=optax.sgd(0.05),
+                strategy=Flash(),
+                datasets=[ClientDataset(x[:16], y[:16], x[16:], y[16:])],
+                batch_size=4,
+                metrics=MetricManager((efficient.accuracy(),)),
+                local_steps=3,
+                flash_early_stopping=FlashEarlyStopConfig(gamma=0.1, n_epochs=1),
+                seed=0,
+            )
+
+
+# ---------------------------------------------------------------------------
+# FedDgGa + adaptive constraint combo
+# ---------------------------------------------------------------------------
+
+class TestFedDgGaAdaptiveConstraint:
+    def test_combo_adapts_mu_and_ga_weights(self):
+        datasets = []
+        for i in range(3):
+            x, y = synthetic_classification(
+                jax.random.PRNGKey(20 + i), 40, (6,), 3, class_sep=2.5
+            )
+            datasets.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+        strat = FedDgGaAdaptiveConstraint(
+            n_clients=3,
+            num_rounds=4,
+            initial_drift_penalty_weight=0.1,
+            loss_weight_patience=1,  # adapt fast so the test sees motion
+            loss_weight_delta=0.05,
+        )
+        sim = FederatedSimulation(
+            logic=FedProxClientLogic(
+                engine.from_flax(Mlp(features=(16,), n_outputs=3)),
+                engine.masked_cross_entropy,
+            ),
+            tx=optax.sgd(0.05),
+            strategy=strat,
+            datasets=datasets,
+            batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_steps=4,
+            seed=1,
+            extra_loss_keys=("vanilla", "penalty"),
+        )
+        mu0 = float(sim.server_state.drift_penalty_weight)
+        history = sim.fit(4)
+        state = sim.server_state
+
+        # GA bookkeeping: weights stay a distribution and move off uniform
+        w = np.asarray(state.adjustment_weights)
+        assert w.sum() == pytest.approx(1.0, abs=1e-5)
+        assert w.min() >= 0.0
+        # mu adapted (patience=1 + improving losses -> decreases)
+        assert float(state.drift_penalty_weight) != mu0
+        assert history[-1].fit_losses["backward"] < history[0].fit_losses["backward"]

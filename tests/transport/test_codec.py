@@ -1,0 +1,153 @@
+"""Transport codec + loopback tests (SURVEY §2.14: codec + loopback round
+trip; reference wire role: Flower Parameters over gRPC, COO packing via
+SparseCooParameterPacker, parameter_packer.py:94,124)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.exchange.packer import AdaptiveConstraintPacket, SparseMaskPacket
+from fl4health_tpu.transport import (
+    FrameError,
+    LoopbackServer,
+    call,
+    decode,
+    decode_sparse,
+    encode,
+    encode_sparse,
+)
+from fl4health_tpu.transport.native import NativeFraming, PyFraming, get_native
+
+
+def params_tree():
+    return {
+        "dense": {"kernel": jnp.arange(12.0).reshape(3, 4), "bias": jnp.ones((4,))},
+        "head": {"kernel": jnp.full((4, 2), 0.5)},
+    }
+
+
+class TestFraming:
+    def test_python_roundtrip_and_corruption(self):
+        f = PyFraming()
+        frame = f.frame(b'{"k":1}', b"\x01\x02\x03")
+        h, p, flags = f.unframe(frame)
+        assert (h, p, flags) == (b'{"k":1}', b"\x01\x02\x03", 0)
+        corrupted = frame[:-5] + bytes([frame[-5] ^ 0xFF]) + frame[-4:]
+        with pytest.raises(FrameError, match="crc"):
+            f.unframe(corrupted)
+        with pytest.raises(FrameError, match="magic"):
+            f.unframe(b"XXXX" + frame[4:])
+
+    def test_native_matches_python_bytes(self):
+        """The C++ codec and the Python twin must be byte-identical (CRC-32
+        polynomial and layout agree) so silos can mix implementations."""
+        lib = get_native()
+        if lib is None:
+            pytest.skip("no C++ toolchain available")
+        nat, py = NativeFraming(lib), PyFraming()
+        header, payload = b'{"leaves":[]}', bytes(range(256)) * 3
+        assert nat.frame(header, payload, 1) == py.frame(header, payload, 1)
+        assert nat.crc32(payload) == py.crc32(payload)
+        # cross-decode
+        h, p, fl = py.unframe(nat.frame(header, payload, 1))
+        assert (h, p, fl) == (header, payload, 1)
+        h, p, fl = nat.unframe(py.frame(header, payload, 0))
+        assert (h, p, fl) == (header, payload, 0)
+        with pytest.raises(FrameError):
+            nat.unframe(py.frame(header, payload)[:-2])
+
+
+class TestPytreeCodec:
+    def test_dense_roundtrip_with_template(self):
+        tree = params_tree()
+        out = decode(encode(tree), like=tree)
+        for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(out)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_dense_roundtrip_without_template_gives_nested_dicts(self):
+        out = decode(encode(params_tree()))
+        assert out["dense"]["kernel"].shape == (3, 4)
+        assert out["head"]["kernel"].dtype == np.float32
+
+    def test_struct_packet_roundtrip(self):
+        packet = AdaptiveConstraintPacket(
+            params=params_tree(), loss_for_adaptation=jnp.asarray(1.25)
+        )
+        out = decode(encode(packet), like=packet)
+        assert isinstance(out, AdaptiveConstraintPacket)
+        assert float(out.loss_for_adaptation) == 1.25
+
+    def test_dtype_preservation(self):
+        tree = {
+            "f32": jnp.ones((2,), jnp.float32),
+            "i32": jnp.asarray([1, 2], jnp.int32),
+            "bf16": jnp.ones((2,), jnp.bfloat16),
+            "bool": jnp.asarray([True, False]),
+        }
+        out = decode(encode(tree), like=tree)
+        for k in tree:
+            assert np.asarray(out[k]).dtype == np.asarray(tree[k]).dtype, k
+
+    def test_missing_leaf_raises(self):
+        data = encode({"a": jnp.ones((2,))})
+        with pytest.raises(ValueError, match="missing leaf"):
+            decode(data, like={"a": jnp.ones((2,)), "b": jnp.ones((2,))})
+
+
+class TestSparseCoo:
+    def test_coo_roundtrip_and_wire_compactness(self):
+        rng = np.random.default_rng(0)
+        dense = rng.normal(size=(64, 64)).astype(np.float32)
+        mask = (rng.uniform(size=dense.shape) < 0.05).astype(np.float32)
+        packet = SparseMaskPacket(
+            params={"layer": jnp.asarray(dense * mask)},
+            element_mask={"layer": jnp.asarray(mask)},
+        )
+        wire = encode_sparse(packet)
+        # COO must beat the dense frame at 5% density
+        dense_wire = encode({"layer": jnp.asarray(dense)})
+        assert len(wire) < 0.5 * len(dense_wire)
+
+        out = decode_sparse(wire, like=packet)
+        np.testing.assert_allclose(
+            np.asarray(out.params["layer"]), dense * mask, atol=0
+        )
+        np.testing.assert_array_equal(np.asarray(out.element_mask["layer"]), mask)
+
+    def test_sparse_frame_rejected_by_dense_decoder(self):
+        packet = SparseMaskPacket(
+            params={"w": jnp.ones((4,))},
+            element_mask={"w": jnp.asarray([1.0, 0.0, 1.0, 0.0])},
+        )
+        with pytest.raises(ValueError, match="COO"):
+            decode(encode_sparse(packet))
+
+
+class TestLoopback:
+    def test_loopback_fit_round_trip(self):
+        """A cross-silo 'fit' exchange: server ships global params; the far
+        silo trains (here: adds 1) and ships back an adaptive packet."""
+        template = AdaptiveConstraintPacket(
+            params=params_tree(), loss_for_adaptation=jnp.asarray(0.0)
+        )
+
+        def far_silo(request: bytes) -> bytes:
+            received = decode(request, like=params_tree())
+            trained = jax.tree_util.tree_map(lambda x: x + 1.0, received)
+            return encode(
+                AdaptiveConstraintPacket(
+                    params=trained, loss_for_adaptation=jnp.asarray(0.5)
+                )
+            )
+
+        server = LoopbackServer(far_silo)
+        try:
+            reply = call(server.host, server.port, encode(params_tree()))
+        finally:
+            server.close()
+        packet = decode(reply, like=template)
+        np.testing.assert_allclose(
+            np.asarray(packet.params["dense"]["bias"]), np.full((4,), 2.0)
+        )
+        assert float(packet.loss_for_adaptation) == 0.5
