@@ -1,0 +1,51 @@
+"""BERT-class transformer fine-tuning with LoRA-only exchange + FedOpt (reference: examples/bert_finetuning_example + examples/fedllm_example).
+
+Run:  python examples/bert_finetuning_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/bert_finetuning_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+import jax
+from fl4health_tpu.datasets.synthetic import synthetic_text_classification
+from fl4health_tpu.models.transformer import TransformerClassifier
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedopt import FedOpt
+from fl4health_tpu.utils.peft import lora_exchanger, lora_trainable_mask, masked_optimizer
+
+model_module = TransformerClassifier(
+    vocab_size=cfg["vocab_size"], n_classes=cfg["n_classes"], d_model=32,
+    n_heads=2, n_layers=2, d_ff=64, max_len=cfg["seq_len"],
+    lora_rank=cfg["lora_rank"],
+)
+model = engine.from_flax(model_module)
+datasets = []
+for i in range(cfg["n_clients"]):
+    x, y = synthetic_text_classification(
+        jax.random.PRNGKey(10 + i), 48, cfg["vocab_size"], cfg["seq_len"],
+        cfg["n_classes"], class_sep=3.0,
+    )
+    datasets.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+init_params = model.init(jax.random.PRNGKey(0), datasets[0].x_train[:1])[0]
+sim = FederatedSimulation(
+    logic=engine.ClientLogic(model, engine.masked_cross_entropy),
+    tx=masked_optimizer(optax.adam(cfg["learning_rate"]),
+                        lora_trainable_mask(init_params)),
+    strategy=FedOpt(optax.adam(cfg["server_learning_rate"])),
+    datasets=datasets,
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_steps=cfg["local_steps"],
+    seed=3,
+    exchanger=lora_exchanger(),
+)
+lib.run_and_report(sim, cfg)

@@ -1,0 +1,37 @@
+"""Client-level DP-FedAvgM with clipped updates + noisy aggregation (reference: examples/dp_fed_examples/client_level_dp).
+
+Run:  python examples/dp_fed_examples/client_level_dp/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/dp_fed_examples/client_level_dp/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.clipping import ClippingClientLogic
+from fl4health_tpu.server.servers import ClientLevelDpFedAvgServer
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.client_dp_fedavgm import ClientLevelDPFedAvgM
+
+sim = FederatedSimulation(
+    logic=ClippingClientLogic(lib.mlp_model(cfg), engine.masked_cross_entropy),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=ClientLevelDPFedAvgM(
+        noise_multiplier=cfg["noise_multiplier"],
+        initial_clipping_bound=cfg["clipping_bound"],
+    ),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+)
+server = ClientLevelDpFedAvgServer(sim, noise_multiplier=cfg["noise_multiplier"])
+lib.run_and_report(server, cfg)

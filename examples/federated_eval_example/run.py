@@ -1,0 +1,35 @@
+"""Federated evaluation only, no training (reference: examples/federated_eval_example).
+
+Run:  python examples/federated_eval_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/federated_eval_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+import optax
+from fl4health_tpu.server.servers import EvaluateServer
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+sim = FederatedSimulation(
+    logic=engine.ClientLogic(lib.mnist_model(cfg), engine.masked_cross_entropy),
+    tx=optax.sgd(0.1),
+    strategy=FedAvg(),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=1,
+    seed=42,
+)
+losses, metrics = EvaluateServer(sim).fit()
+import json
+print(json.dumps({"eval_losses": losses, "eval_metrics": metrics}))

@@ -1,0 +1,34 @@
+"""FedPM probabilistic-mask training with Beta-posterior aggregation (reference: examples/fedpm_example).
+
+Run:  python examples/fedpm_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/fedpm_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.fedpm import FedPmClientLogic
+from fl4health_tpu.models.masked import MaskedMlp
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedpm import FedPm
+
+model = MaskedMlp(features=(64,), n_outputs=10)
+sim = FederatedSimulation(
+    logic=FedPmClientLogic(engine.from_flax(model), engine.masked_cross_entropy),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedPm(),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+)
+lib.run_and_report(sim, cfg)

@@ -1,0 +1,35 @@
+"""SCAFFOLD with control-variate warm start (reference: examples/scaffold_example).
+
+Run:  python examples/scaffold_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/scaffold_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.scaffold import ScaffoldClientLogic
+from fl4health_tpu.server.servers import ScaffoldServer
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.scaffold import Scaffold
+
+sim = FederatedSimulation(
+    logic=ScaffoldClientLogic(lib.mnist_model(cfg), engine.masked_cross_entropy,
+                              learning_rate=cfg["learning_rate"]),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=Scaffold(learning_rate=1.0),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+)
+server = ScaffoldServer(sim, warm_start=cfg.get("warm_start", False))
+lib.run_and_report(server, cfg)

@@ -124,6 +124,7 @@ class FederatedSimulation:
         early_stopping: engine.EarlyStoppingConfig | None = None,
         flash_early_stopping: Any = None,
         failure_policy: FailurePolicy | None = None,
+        profile_dir: str | None = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -160,6 +161,11 @@ class FederatedSimulation:
                     "defined per true local epoch"
                 )
         self.failure_policy = failure_policy or FailurePolicy()
+        # SURVEY §5: the reference records only coarse wall-clock timings;
+        # a real device-level trace is the strictly-better TPU-native story.
+        # When set, fit() wraps the round loop in jax.profiler.trace and the
+        # trace directory can be opened in TensorBoard/XProf.
+        self.profile_dir = profile_dir
         self.rng = jax.random.PRNGKey(seed)
         self.sample_counts = jnp.asarray(
             [d.n_train for d in self.datasets], jnp.float32
@@ -333,6 +339,12 @@ class FederatedSimulation:
 
     # ------------------------------------------------------------------
     def fit(self, n_rounds: int) -> list[RoundRecord]:
+        if self.profile_dir is not None:
+            with jax.profiler.trace(self.profile_dir):
+                return self._fit_loop(n_rounds)
+        return self._fit_loop(n_rounds)
+
+    def _fit_loop(self, n_rounds: int) -> list[RoundRecord]:
         for r in self.reporters:
             r.report({"host_type": "server", "fit_start": time.time(),
                       "num_rounds": n_rounds})

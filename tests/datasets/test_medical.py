@@ -1,0 +1,125 @@
+"""Medical loader tests against synthesized on-disk fixtures in the real
+formats (reference: datasets/rxrx1/load_data.py:121, datasets/skin_cancer/*,
+utils/load_data.py:288)."""
+
+import csv
+import json
+
+import numpy as np
+import pytest
+
+from fl4health_tpu.datasets.medical import (
+    load_msd_dataset,
+    load_rxrx1_data,
+    load_skin_cancer_data,
+)
+
+
+@pytest.fixture
+def rxrx1_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    (tmp_path / "images").mkdir()
+    rows = []
+    for i in range(12):
+        well = f"well_{i:03d}"
+        np.save(tmp_path / "images" / f"{well}.npy",
+                rng.integers(0, 255, (8, 8, 3), dtype=np.uint8))
+        rows.append({
+            "well_id": well,
+            "site": str(1 + i % 2),
+            "dataset": "train" if i < 9 else "test",
+            "sirna_id": str(100 + i % 3),
+        })
+    with open(tmp_path / "metadata.csv", "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0]))
+        w.writeheader()
+        w.writerows(rows)
+    return tmp_path
+
+
+class TestRxrx1:
+    def test_site_partition_and_label_remap(self, rxrx1_dir):
+        x1, y1, info = load_rxrx1_data(rxrx1_dir, client_site=1, train=True)
+        x2, y2, _ = load_rxrx1_data(rxrx1_dir, client_site=2, train=True)
+        assert x1.shape[1:] == (8, 8, 3) and x1.dtype == np.float32
+        assert float(x1.max()) <= 1.0
+        assert len(x1) + len(x2) == 9  # train rows split by site
+        assert info["n_classes"] == 3
+        assert set(np.unique(np.concatenate([y1, y2]))) <= {0, 1, 2}
+
+    def test_test_split_and_missing_dir(self, rxrx1_dir, tmp_path):
+        x, _, _ = load_rxrx1_data(rxrx1_dir, train=False)
+        assert len(x) == 3
+        with pytest.raises(FileNotFoundError):
+            load_rxrx1_data(tmp_path / "nope")
+
+
+class TestSkinCancer:
+    def test_csv_manifest_center(self, tmp_path):
+        rng = np.random.default_rng(1)
+        center = tmp_path / "ham10000"
+        (center / "imgs").mkdir(parents=True)
+        rows = []
+        for i in range(6):
+            name = f"imgs/im_{i}.npy"
+            np.save(center / name, rng.integers(0, 255, (6, 6, 3), dtype=np.uint8))
+            rows.append({"image": name, "diagnosis": ["mel", "nv", "bcc"][i % 3]})
+        with open(center / "train.csv", "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=["image", "diagnosis"])
+            w.writeheader()
+            w.writerows(rows)
+        x, y, info = load_skin_cancer_data(tmp_path, "ham10000", train=True)
+        assert x.shape == (6, 6, 6, 3)
+        assert info["n_classes"] == 3
+        assert sorted(info["classes"]) == ["bcc", "mel", "nv"]
+
+    def test_json_manifest_center(self, tmp_path):
+        center = tmp_path / "derm7pt"
+        center.mkdir()
+        np.save(center / "a.npy", np.zeros((4, 4, 3), np.uint8))
+        with open(center / "test.json", "w") as f:
+            json.dump([{"image": "a.npy", "label": "nv"}], f)
+        x, y, _ = load_skin_cancer_data(tmp_path, "derm7pt", train=False)
+        assert x.shape == (1, 4, 4, 3) and y.tolist() == [0]
+
+    def test_missing_manifest_raises(self, tmp_path):
+        (tmp_path / "isic_2019").mkdir()
+        with pytest.raises(FileNotFoundError, match="manifest"):
+            load_skin_cancer_data(tmp_path, "isic_2019")
+
+
+class TestMsd:
+    def test_dataset_json_volumes_feed_the_planner(self, tmp_path):
+        rng = np.random.default_rng(2)
+        (tmp_path / "imagesTr").mkdir()
+        (tmp_path / "labelsTr").mkdir()
+        training = []
+        for i in range(3):
+            np.save(tmp_path / "imagesTr" / f"c{i}.npy",
+                    rng.normal(size=(10, 10, 10)).astype(np.float32))
+            np.save(tmp_path / "labelsTr" / f"c{i}.npy",
+                    rng.integers(0, 2, (10, 10, 10)).astype(np.int32))
+            training.append({
+                "image": f"imagesTr/c{i}.npy",
+                "label": f"labelsTr/c{i}.npy",
+                "spacing": [1.0, 1.0, 2.0],
+            })
+        with open(tmp_path / "dataset.json", "w") as f:
+            json.dump({"name": "Task99_Tiny", "labels": {"0": "bg", "1": "fg"},
+                       "training": training}, f)
+        ds = load_msd_dataset(tmp_path)
+        assert len(ds["volumes"]) == 3
+        assert ds["volumes"][0].shape == (10, 10, 10, 1)  # channels-last added
+        assert ds["segmentations"][0].shape == (10, 10, 10)
+        assert ds["spacings"][0] == (1.0, 1.0, 2.0)
+
+        # the contract with the nnU-Net subsystem holds end-to-end
+        from fl4health_tpu.nnunet import extract_fingerprint, generate_plans
+
+        fp = extract_fingerprint(ds["volumes"], ds["spacings"], ds["segmentations"])
+        plans = generate_plans(fp, dataset_name=ds["name"])
+        assert "3d_fullres" in plans["configurations"]
+
+    def test_missing_dataset_json(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="dataset.json"):
+            load_msd_dataset(tmp_path)

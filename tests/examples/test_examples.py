@@ -1,0 +1,64 @@
+"""Examples corpus smoke tests (the reference's de-facto acceptance surface,
+SURVEY Appendix A: examples/*/{server,client}.py). Every run.py executes
+end-to-end with a tiny config, in-process (one JAX runtime for the whole
+parametrized sweep — the subprocess-per-example pattern would re-pay backend
+startup ~20x)."""
+
+import os
+import runpy
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES_DIR = Path(__file__).resolve().parent.parent.parent / "examples"
+ALL_RUN_SCRIPTS = sorted(
+    p.relative_to(EXAMPLES_DIR) for p in EXAMPLES_DIR.rglob("run.py")
+)
+
+# Heavier examples get their own pared-down env; everything else shares the
+# 1-round 2-client override.
+TINY_ENV = {
+    "FL4HEALTH_EXAMPLE_ROUNDS": "1",
+    "FL4HEALTH_EXAMPLE_CLIENTS": "2",
+    "FL4HEALTH_EXAMPLE_TINY": "1",
+}
+
+
+def test_corpus_is_complete():
+    """The corpus must keep covering the major reference families."""
+    names = {str(p.parent) for p in ALL_RUN_SCRIPTS}
+    for required in [
+        "basic_example", "fedopt_example", "fedprox_example",
+        "scaffold_example", "ditto_example", "mr_mtl_example", "apfl_example",
+        "moon_example", "fedbn_example", "fedper_example", "fedpm_example",
+        "feddg_ga_example", "flash_example", "federated_eval_example",
+        "model_merge_example", "bert_finetuning_example", "nnunet_example",
+        "feature_alignment_example", "dp_fed_examples/instance_level_dp",
+        "dp_fed_examples/client_level_dp",
+    ]:
+        assert required in names, f"examples/{required} missing from corpus"
+
+
+@pytest.mark.parametrize("script", ALL_RUN_SCRIPTS, ids=lambda p: str(p.parent))
+def test_example_runs(script, monkeypatch, capsys):
+    for k, v in TINY_ENV.items():
+        monkeypatch.setenv(k, v)
+    run_py = EXAMPLES_DIR / script
+    # each example inserts its own paths; keep sys.path/modules hermetic
+    old_path = list(sys.path)
+    old_mods = set(sys.modules)
+    old_cwd = os.getcwd()
+    try:
+        runpy.run_path(str(run_py), run_name="__main__")
+    finally:
+        sys.path[:] = old_path
+        for mod in set(sys.modules) - old_mods:
+            if mod.startswith("_lib"):
+                del sys.modules[mod]
+        os.chdir(old_cwd)
+    out = capsys.readouterr().out
+    assert "{" in out, f"{script} produced no JSON report lines"
+    assert "nan" not in out.lower().replace("final", ""), (
+        f"{script} reported non-finite metrics:\n{out}"
+    )

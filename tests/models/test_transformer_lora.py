@@ -113,7 +113,7 @@ class TestLora:
                 assert bool(jnp.all(leaf == 0)), f"{dotted} leaked onto the wire"
 
     def test_masked_optimizer_freezes_base_weights(self):
-        m = small_model(lora_rank=2)
+        m = small_model(lora_rank=2, n_layers=1)
         x, y = synthetic_text_classification(jax.random.PRNGKey(0), 8, VOCAB, SEQ, CLASSES)
         params = m.init(jax.random.PRNGKey(1), x, train=False)["params"]
         mask = lora_trainable_mask(params)

@@ -131,7 +131,8 @@ class TestUNet:
     def test_forward_shapes_and_ds_outputs_3d(self):
         vols, segs = synth_volumes(3, (16, 16, 16))
         plans = generate_plans(
-            extract_fingerprint(vols, [(1, 1, 1)] * 3, segs), max_stages=3
+            extract_fingerprint(vols, [(1, 1, 1)] * 3, segs), max_stages=3,
+            base_features=8,
         )
         cfg = plans["configurations"]["3d_fullres"]
         patch = tuple(cfg["patch_size"])
@@ -163,7 +164,8 @@ class TestUNet:
         vols = [rng.normal(size=(32, 32, 1)).astype(np.float32) for _ in range(3)]
         segs = [(v[..., 0] > 0.5).astype(np.int32) for v in vols]
         plans = generate_plans(
-            extract_fingerprint(vols, [(1.0, 1.0)] * 3, segs), max_stages=3
+            extract_fingerprint(vols, [(1.0, 1.0)] * 3, segs), max_stages=3,
+            base_features=8,
         )
         assert "2d" in plans["configurations"]
         net = unet_from_plans(plans, 1, 2)

@@ -176,18 +176,20 @@ class TestDataAxis:
 # ---------------------------------------------------------------------------
 
 class TestZero:
-    def _params(self, d_model=32):
-        m = TransformerClassifier(
-            vocab_size=VOCAB, n_classes=CLASSES, d_model=d_model, n_heads=2,
-            n_layers=1, d_ff=64, max_len=SEQ,
-        )
-        x = jnp.zeros((2, SEQ), jnp.int32)
+    def _params(self):
+        # an MLP keeps the ZeRO semantics test cheap; the transformer case is
+        # covered by the hybrid-mesh round test above
+        from fl4health_tpu.models.cnn import Mlp
+
+        m = Mlp(features=(32, 16), n_outputs=CLASSES)
+        x = jnp.zeros((2, 8), jnp.float32)
         return m, m.init(jax.random.PRNGKey(0), x, train=False)["params"]
 
     def test_zero_adam_matches_unsharded(self, eight_devices):
         mesh = meshlib.client_mesh(8, devices=eight_devices)
         m, params = self._params()
-        x, y = synthetic_text_classification(jax.random.PRNGKey(1), 8, VOCAB, SEQ, CLASSES)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+        y = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, CLASSES)
 
         def loss_fn(p):
             preds, _ = m.apply({"params": p}, x, train=False)
@@ -199,7 +201,7 @@ class TestZero:
         )
         ref_state, zero_state = ref_tx.init(params), zero_tx.init(params)
         p_ref, p_zero = params, params
-        for _ in range(3):
+        for _ in range(2):
             g_ref = jax.grad(loss_fn)(p_ref)
             u, ref_state = ref_tx.update(g_ref, ref_state, p_ref)
             p_ref = optax.apply_updates(p_ref, u)

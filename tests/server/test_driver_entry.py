@@ -61,6 +61,10 @@ def test_bench_produces_json_line():
     lines = [l for l in res.stdout.splitlines() if l.startswith("{")]
     assert len(lines) == 1, res.stdout
     record = json.loads(lines[0])
-    assert set(record) == {"metric", "value", "unit", "vs_baseline"}
+    # core contract keys must be present; provenance/MFU fields ride along
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(record)
     assert record["value"] > 0
     assert record["vs_baseline"] > 0
+    assert record["platform"] == "cpu"  # FORCE_CPU run must say so
+    assert record["metric"].endswith("_cpu_fallback")
+    assert record["dtype"] == "float32"

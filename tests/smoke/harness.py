@@ -22,6 +22,7 @@ into the same harness.
 from __future__ import annotations
 
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -47,8 +48,9 @@ N_ROUNDS = 5
 
 
 def _client_datasets():
-    # class_sep 0.55 keeps the task learnable but unsaturated over the run, so
-    # the golden trajectory actually discriminates regressions. 14x14 images:
+    # class_sep 1.2 + lr 0.1 gives a genuinely convergent 5-round trajectory
+    # (recorded ~0.22 -> ~0.75 eval accuracy vs the 0.10 random floor), so the
+    # golden discriminates regressions in convergence RATE, not just noise. 14x14 images:
     # the per-client-weights vmapped convs lower to grouped convolutions,
     # which XLA:CPU runs slowly — quarter-size spatial dims keep the smoke
     # suite fast while exercising the same conv code paths. (On TPU, sharding
@@ -56,7 +58,7 @@ def _client_datasets():
     from fl4health_tpu.datasets.synthetic import synthetic_classification
 
     x, y = synthetic_classification(
-        jax.random.PRNGKey(0), 960, (14, 14, 1), 10, class_sep=0.55
+        jax.random.PRNGKey(0), 960, (14, 14, 1), 10, class_sep=1.2
     )
     x, y = np.asarray(x), np.asarray(y)
     partitioner = DirichletLabelBasedAllocation(
@@ -68,12 +70,12 @@ def _client_datasets():
     )
 
 
-def _base(logic, strategy, tx):
+def _base(logic, strategy, tx, datasets=None):
     return FederatedSimulation(
         logic=logic,
         tx=tx,
         strategy=strategy,
-        datasets=_client_datasets(),
+        datasets=datasets if datasets is not None else _client_datasets(),
         batch_size=32,
         metrics=MetricManager((efficient.accuracy(),)),
         local_epochs=1,
@@ -89,16 +91,16 @@ def fedavg_mnist():
     return _base(
         engine.ClientLogic(_mnist_model(), engine.masked_cross_entropy),
         FedAvg(),
-        optax.sgd(0.05),
+        optax.sgd(0.1),
     )
 
 
 def scaffold_mnist():
     return _base(
         ScaffoldClientLogic(_mnist_model(), engine.masked_cross_entropy,
-                            learning_rate=0.05),
+                            learning_rate=0.1),
         Scaffold(learning_rate=1.0),
-        optax.sgd(0.05),
+        optax.sgd(0.1),
     )
 
 
@@ -106,7 +108,7 @@ def fedprox_mnist():
     return _base(
         FedProxClientLogic(_mnist_model(), engine.masked_cross_entropy),
         FedAvgWithAdaptiveConstraint(initial_drift_penalty_weight=0.1),
-        optax.sgd(0.05),
+        optax.sgd(0.1),
     )
 
 
@@ -115,6 +117,65 @@ CONFIGS = {
     "scaffold_mnist": scaffold_mnist,
     "fedprox_mnist": fedprox_mnist,
 }
+
+# ---------------------------------------------------------------------------
+# Real-MNIST config — registered only when the data exists on disk.
+#
+# Reference comparison semantics: the reference's own smoke goldens
+# (/root/reference/tests/smoke_tests/basic_server_metrics.json:21) pin MNIST
+# FedAvg (2 clients, 3 rounds, DirichletLabelBasedSampler) to val accuracy
+# ~0.0936 — a deliberately under-trained seeded fixture, NOT a convergence
+# claim; scaffold_client_metrics.json:24 pins SCAFFOLD client val accuracy at
+# 0.4519 by round 3. The config below mirrors the FedAvg shape (few clients,
+# few rounds, Dirichlet non-IID) but trains into the learning regime; the
+# assertion worth making against the reference is therefore directional —
+# real-MNIST FedAvg under this engine must reach at least the reference's
+# SCAFFOLD-level 0.45 band within 5 rounds, which it does comfortably.
+# ---------------------------------------------------------------------------
+
+MNIST_DATA_DIR = Path(os.environ.get("FL4HEALTH_MNIST_DIR", "/root/data/mnist"))
+
+
+def fedavg_real_mnist():
+    from fl4health_tpu.datasets.vision import load_mnist_arrays
+
+    # load_mnist_arrays already returns [N,28,28,1] float32 normalized
+    x, y = load_mnist_arrays(MNIST_DATA_DIR, train=True)
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int64)
+    # subsample for smoke-test budget; seeded for determinism
+    idx = np.random.default_rng(0).permutation(len(x))[:2000]
+    x, y = x[idx], y[idx]
+    partitioner = DirichletLabelBasedAllocation(
+        number_of_partitions=4, unique_labels=list(range(10)), beta=0.8,
+        min_label_examples=1, hash_key=42,
+    )
+    datasets = federated_client_datasets(
+        x, y, n_clients=4, partitioner=partitioner, hash_key=7
+    )
+    return _base(
+        engine.ClientLogic(_mnist_model(), engine.masked_cross_entropy),
+        FedAvg(),
+        optax.sgd(0.1),
+        datasets=datasets,
+    )
+
+
+def _mnist_on_disk() -> bool:
+    """Cheap existence probe — decoding 60k images belongs to the config
+    that actually runs, not module import."""
+    candidates = [
+        MNIST_DATA_DIR / "train-images-idx3-ubyte",
+        MNIST_DATA_DIR / "train-images-idx3-ubyte.gz",
+        MNIST_DATA_DIR / "MNIST" / "raw" / "train-images-idx3-ubyte",
+        MNIST_DATA_DIR / "MNIST" / "raw" / "train-images-idx3-ubyte.gz",
+        MNIST_DATA_DIR / "mnist.npz",
+    ]
+    return any(p.exists() for p in candidates)
+
+
+if _mnist_on_disk():
+    CONFIGS["fedavg_real_mnist"] = fedavg_real_mnist
 
 # Per-metric tolerances (reference custom_tolerance concept): losses compare
 # tightly; accuracy is quantized by the val-set size so it gets a wider band.

@@ -21,5 +21,11 @@ def test_golden_metrics(name):
     rounds = harness.run_config(name)
     errors = harness.compare_to_golden(name, rounds)
     assert not errors, "\n".join(errors)
-    # the trajectory itself must show learning, not just match a recording
-    assert rounds[-1]["eval_accuracy"] > rounds[0]["eval_accuracy"]
+    # The trajectory itself must show CONVERGENCE, not noise above a
+    # recording: final accuracy well clear of the 10-class random floor and
+    # a near-monotone climb (one dip tolerated — small-val-set quantization).
+    accs = [r["eval_accuracy"] for r in rounds]
+    assert accs[-1] >= 2 * 0.10, f"final accuracy {accs[-1]} not >= 2x random floor"
+    dips = sum(1 for a, b in zip(accs, accs[1:]) if b < a - 1e-9)
+    assert dips <= 1, f"trajectory not near-monotone: {accs}"
+    assert accs[-1] > accs[0] + 0.15, f"too little learning over the run: {accs}"
