@@ -54,6 +54,15 @@ def _load_image_array(path: Path) -> np.ndarray:
     return np.asarray(Image.open(path))
 
 
+def _normalize_image(arr: np.ndarray) -> np.ndarray:
+    """Integer-typed images scale by 255; float images pass through. Decided
+    from dtype, never per-image content — a nearly-black uint8 frame must not
+    end up 255x hotter than its neighbors."""
+    if np.issubdtype(arr.dtype, np.integer):
+        return arr.astype(np.float32) / 255.0
+    return arr.astype(np.float32)
+
+
 # ---------------------------------------------------------------------------
 # rxrx1 — fluorescence microscopy, site-partitioned (rxrx1/load_data.py:121)
 # ---------------------------------------------------------------------------
@@ -76,9 +85,13 @@ def load_rxrx1_data(
     if not meta_path.exists():
         raise FileNotFoundError(f"rxrx1: no metadata.csv under {data_dir}")
     want_split = "train" if train else "test"
-    rows = []
+    rows, all_labels = [], set()
     with open(meta_path) as f:
         for row in csv.DictReader(f):
+            # the label space comes from the FULL metadata (every site, every
+            # split) — federated clients must agree on class indices even
+            # when a site is missing some sirnas locally
+            all_labels.add(int(row["sirna_id"]))
             if row.get("dataset", "train") != want_split:
                 continue
             if client_site is not None and int(row["site"]) != client_site:
@@ -91,15 +104,12 @@ def load_rxrx1_data(
     images, labels = [], []
     for row in rows:
         rel = row.get("path") or f"images/{row['well_id']}.npy"
-        arr = _load_image_array(data_dir / rel).astype(np.float32)
-        if arr.max() > 1.5:
-            arr = arr / 255.0
-        images.append(arr)
+        images.append(_normalize_image(_load_image_array(data_dir / rel)))
         labels.append(int(row["sirna_id"]))
     x = np.stack(images)
     if x.ndim == 3:
         x = x[..., None]
-    classes = sorted(set(labels))
+    classes = sorted(all_labels)
     remap = {c: i for i, c in enumerate(classes)}
     y = np.asarray([remap[v] for v in labels], np.int32)
     return x, y, {"n_classes": len(classes), "sirna_ids": classes}
@@ -112,11 +122,36 @@ def load_rxrx1_data(
 SKIN_CANCER_CENTERS = ("isic_2019", "ham10000", "pad_ufes_20", "derm7pt")
 
 
+def _read_manifest(center_dir: Path, split: str) -> list[dict[str, Any]]:
+    csv_path = center_dir / f"{split}.csv"
+    json_path = center_dir / f"{split}.json"
+    if csv_path.exists():
+        with open(csv_path) as f:
+            return list(csv.DictReader(f))
+    if json_path.exists():
+        with open(json_path) as f:
+            return json.load(f)
+    raise FileNotFoundError(
+        f"skin-cancer: no {split}.csv/.json manifest under {center_dir}"
+    )
+
+
+def _record_label(rec: dict[str, Any], label_column: str, source: Path) -> str:
+    label = rec.get(label_column, rec.get("label"))
+    if label is None:
+        raise KeyError(
+            f"{source}: record {rec.get('image', rec)!r} has neither "
+            f"{label_column!r} nor 'label' — refusing to invent a class"
+        )
+    return str(label)
+
+
 def load_skin_cancer_data(
     data_dir: Path | str,
     center: str,
     train: bool = True,
     label_column: str = "diagnosis",
+    classes: Sequence[str] | None = None,
 ) -> tuple[np.ndarray, np.ndarray, dict[str, Any]]:
     """-> (images [N,H,W,3] float32 in [0,1], labels [N] int32, info).
 
@@ -124,36 +159,44 @@ def load_skin_cancer_data(
     ``<center>/<split>.csv`` (columns ``image``, ``<label_column>``) or
     ``<center>/<split>.json`` (list of {image, label} records), with image
     arrays resolved relative to the center directory.
+
+    ``classes`` fixes the global label order for federated runs; when None
+    it is derived from the UNION of every center manifest present under
+    ``data_dir`` (both splits), so centers missing a diagnosis locally still
+    agree on class indices.
     """
     data_dir = Path(data_dir)
     center_dir = data_dir / center
     split = "train" if train else "test"
-    records: list[dict[str, Any]] = []
-    csv_path = center_dir / f"{split}.csv"
-    json_path = center_dir / f"{split}.json"
-    if csv_path.exists():
-        with open(csv_path) as f:
-            records = list(csv.DictReader(f))
-    elif json_path.exists():
-        with open(json_path) as f:
-            records = json.load(f)
+    records = _read_manifest(center_dir, split)
+
+    if classes is None:
+        seen = set()
+        for other in sorted(p for p in data_dir.iterdir() if p.is_dir()):
+            for other_split in ("train", "test"):
+                try:
+                    for rec in _read_manifest(other, other_split):
+                        seen.add(_record_label(rec, label_column, other))
+                except FileNotFoundError:
+                    continue
+        classes = sorted(seen)
     else:
-        raise FileNotFoundError(
-            f"skin-cancer: no {split}.csv/.json manifest under {center_dir}"
-        )
+        classes = list(classes)
+
     images, labels = [], []
     for rec in records:
-        arr = _load_image_array(center_dir / rec["image"]).astype(np.float32)
-        if arr.max() > 1.5:
-            arr = arr / 255.0
-        images.append(arr)
-        labels.append(str(rec.get(label_column, rec.get("label"))))
-    classes = sorted(set(labels))
+        images.append(_normalize_image(_load_image_array(center_dir / rec["image"])))
+        labels.append(_record_label(rec, label_column, center_dir))
     remap = {c: i for i, c in enumerate(classes)}
+    missing = sorted(set(labels) - set(classes))
+    if missing:
+        raise ValueError(
+            f"skin-cancer: labels {missing} in {center} not in the class set {classes}"
+        )
     return (
         np.stack(images),
         np.asarray([remap[v] for v in labels], np.int32),
-        {"n_classes": len(classes), "classes": classes, "center": center},
+        {"n_classes": len(classes), "classes": list(classes), "center": center},
     )
 
 

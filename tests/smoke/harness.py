@@ -177,6 +177,90 @@ def _mnist_on_disk() -> bool:
 if _mnist_on_disk():
     CONFIGS["fedavg_real_mnist"] = fedavg_real_mnist
 
+
+# ---------------------------------------------------------------------------
+# nnU-Net golden config: plans negotiation + federated 3D segmentation on
+# synthetic spheres (the nnunet smoke config role,
+# /root/reference/tests/smoke_tests/nnunet_config_2d.yaml).
+# ---------------------------------------------------------------------------
+
+def nnunet_synthetic():
+    from fl4health_tpu.clients.nnunet import (
+        NnunetClientLogic,
+        make_nnunet_properties_provider,
+    )
+    from fl4health_tpu.models.unet import deep_supervision_strides, unet_from_plans
+    from fl4health_tpu.nnunet import extract_patch_dataset, nnunet_optimizer
+    from fl4health_tpu.server.nnunet import NnunetServer
+    from fl4health_tpu.server.simulation import ClientDataset
+
+    def synth(n, size, seed):
+        rng = np.random.default_rng(seed)
+        vols, segs = [], []
+        for _ in range(n):
+            coords = np.stack(
+                np.meshgrid(*[np.arange(size)] * 3, indexing="ij"), -1
+            ).astype(float)
+            c = np.asarray([rng.uniform(size * 0.3, size * 0.7) for _ in range(3)])
+            r = size * rng.uniform(0.2, 0.3)
+            seg = (np.sqrt(((coords - c) ** 2).sum(-1)) < r).astype(np.int32)
+            vols.append(
+                (rng.normal(0, 0.3, (size,) * 3)[..., None] + seg[..., None]).astype(
+                    np.float32
+                )
+            )
+            segs.append(seg)
+        return vols, segs
+
+    client_data = [synth(4, 12, 10), synth(4, 12, 20)]
+    providers = [
+        make_nnunet_properties_provider(
+            v, [(1.0, 1.0, 1.0)] * len(v), s, max_patch_voxels=12**3
+        )
+        for v, s in client_data
+    ]
+
+    def sim_builder(plans, n_in, n_heads):
+        # shrink features for the CPU smoke budget; architecture code paths
+        # (deep supervision, strides) are unchanged
+        cfg = plans["configurations"]["3d_fullres"]
+        cfg["features_per_stage"] = [
+            max(f // 4, 8) for f in cfg["features_per_stage"]
+        ]
+        net = unet_from_plans(plans, n_in, n_heads)
+        logic = NnunetClientLogic(
+            engine.from_flax(net), ds_strides=deep_supervision_strides(plans)
+        )
+        datasets = []
+        for i, (v, s) in enumerate(client_data):
+            x, y = extract_patch_dataset(v, s, plans, n_patches=10, seed=i)
+            datasets.append(
+                ClientDataset(x_train=x[:8], y_train=y[:8], x_val=x[8:], y_val=y[8:])
+            )
+        return FederatedSimulation(
+            logic=logic,
+            tx=nnunet_optimizer(5e-3, N_ROUNDS * 4),
+            strategy=FedAvg(),
+            datasets=datasets,
+            batch_size=2,
+            metrics=MetricManager((efficient.segmentation_dice(n_heads),)),
+            local_steps=4,
+            seed=0,
+            extra_loss_keys=("dice", "ce"),
+        )
+
+    return NnunetServer(
+        config={"n_server_rounds": N_ROUNDS},
+        property_providers=providers,
+        sim_builder=sim_builder,
+    )
+
+
+CONFIGS["nnunet_synthetic"] = nnunet_synthetic
+
+# Headline eval metric per config ("accuracy" unless stated).
+METRIC_KEYS = {"nnunet_synthetic": "seg_dice"}
+
 # Per-metric tolerances (reference custom_tolerance concept): losses compare
 # tightly; accuracy is quantized by the val-set size so it gets a wider band.
 TOLERANCES = {
@@ -189,9 +273,10 @@ TOLERANCES = {
 def run_config(name: str) -> list[dict]:
     sim = CONFIGS[name]()
     history = sim.fit(N_ROUNDS)
+    metric = METRIC_KEYS.get(name, "accuracy")
     return [
         {
-            "eval_accuracy": round(h.eval_metrics["accuracy"], 6),
+            "eval_accuracy": round(h.eval_metrics[metric], 6),
             "eval_loss": round(h.eval_losses["checkpoint"], 6),
             "fit_loss": round(h.fit_losses["backward"], 6),
         }
