@@ -127,16 +127,20 @@ def run_and_report(sim_or_server, cfg: dict, **fit_kwargs):
         history, epsilon = history
         print(json.dumps({"epsilon": round(float(epsilon), 4)}))
 
-    def headline_metric(metrics: dict) -> tuple[str, float]:
+    def headline_metric(rec) -> tuple[str, float]:
         # accuracy when present; otherwise the config's own lead metric
-        # (e.g. seg_dice for the nnU-Net example)
+        # (e.g. seg_dice for the nnU-Net example); metric-less SSL configs
+        # report their eval loss
+        metrics = rec.eval_metrics
         if "accuracy" in metrics:
             return "accuracy", metrics["accuracy"]
-        key = sorted(metrics)[0] if metrics else "metric"
-        return key, metrics.get(key, float("nan"))
+        if metrics:
+            key = sorted(metrics)[0]
+            return key, metrics[key]
+        return "loss", rec.eval_losses.get("checkpoint", float("nan"))
 
     for rec in history:
-        name, value = headline_metric(rec.eval_metrics)
+        name, value = headline_metric(rec)
         print(
             json.dumps(
                 {
@@ -147,7 +151,7 @@ def run_and_report(sim_or_server, cfg: dict, **fit_kwargs):
                 }
             )
         )
-    name, value = headline_metric(history[-1].eval_metrics)
+    name, value = headline_metric(history[-1])
     print(
         json.dumps(
             {"final": True, "rounds": len(history), f"eval_{name}": round(value, 5)}

@@ -1,0 +1,67 @@
+"""Federated VAE training (reference: examples/ae_examples).
+
+Run:  python examples/ae_examples/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/ae_examples/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from fl4health_tpu.models.autoencoders import VariationalAe, make_vae_loss
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+latent = cfg["latent_dim"]
+base = lib.mnist_client_datasets(cfg)
+flat_dim = int(jnp.prod(jnp.asarray(base[0].x_train.shape[1:])))
+datasets = [
+    ClientDataset(
+        x_train=jnp.asarray(d.x_train).reshape(len(d.x_train), -1),
+        y_train=jnp.asarray(d.x_train).reshape(len(d.x_train), -1),
+        x_val=jnp.asarray(d.x_val).reshape(len(d.x_val), -1),
+        y_val=jnp.asarray(d.x_val).reshape(len(d.x_val), -1),
+    )
+    for d in base
+]
+
+class Enc(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        h = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(latent)(h), nn.Dense(latent)(h)
+
+class Dec(nn.Module):
+    @nn.compact
+    def __call__(self, z, train=True):
+        return nn.Dense(flat_dim)(nn.relu(nn.Dense(32)(z)))
+
+def mse(preds, targets, mask):
+    per = jnp.mean((preds - targets) ** 2, axis=-1)
+    return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+sim = FederatedSimulation(
+    logic=engine.ClientLogic(
+        engine.from_flax(VariationalAe(encoder=Enc(), decoder=Dec())),
+        make_vae_loss(latent, mse),
+    ),
+    tx=optax.adam(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=datasets,
+    batch_size=cfg["batch_size"],
+    metrics=MetricManager(()),
+    local_epochs=cfg["local_epochs"],
+    seed=11,
+)
+lib.run_and_report(sim, cfg)

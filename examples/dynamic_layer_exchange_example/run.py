@@ -1,0 +1,34 @@
+"""Per-round drift-ranked layer selection (reference: examples/dynamic_layer_exchange_example).
+
+Run:  python examples/dynamic_layer_exchange_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/dynamic_layer_exchange_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.exchange.exchanger import DynamicLayerExchanger
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.dynamic_layer import FedAvgDynamicLayer
+
+sim = FederatedSimulation(
+    logic=engine.ClientLogic(lib.mnist_model(cfg), engine.masked_cross_entropy),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedAvgDynamicLayer(),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+    exchanger=DynamicLayerExchanger(mode="topk",
+                                    exchange_fraction=cfg["exchange_fraction"]),
+)
+lib.run_and_report(sim, cfg)

@@ -1,0 +1,38 @@
+"""Federated ensembles trained simultaneously (reference: examples/ensemble_example).
+
+Run:  python examples/ensemble_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/ensemble_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.ensemble import EnsembleClientLogic
+from fl4health_tpu.models import bases
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+members = (Mlp(features=(32,), n_outputs=10), Mlp(features=(24,), n_outputs=10))
+model = bases.EnsembleModel(members=members)
+sim = FederatedSimulation(
+    logic=EnsembleClientLogic(engine.from_flax(model), engine.masked_cross_entropy,
+                              n_members=len(members)),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+    extra_loss_keys=("member_0", "member_1"),
+)
+lib.run_and_report(sim, cfg)

@@ -1,0 +1,40 @@
+"""FedRep alternating head/representation phases (reference: examples/fedrep_example).
+
+Run:  python examples/fedrep_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/fedrep_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.fedrep import FedRepClientLogic
+from fl4health_tpu.exchange.exchanger import FixedLayerExchanger
+from fl4health_tpu.models import bases
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+model = bases.FedRepModel(
+    features_module=bases.DenseFeatures((32,)),
+    head_module=bases.DenseHead(10),
+)
+sim = FederatedSimulation(
+    logic=FedRepClientLogic(engine.from_flax(model), engine.masked_cross_entropy,
+                            head_steps=cfg["head_steps"]),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_steps=cfg["local_steps"],
+    seed=42,
+    exchanger=FixedLayerExchanger(bases.SequentiallySplitModel.exchange_features_only),
+)
+lib.run_and_report(sim, cfg)

@@ -1,0 +1,50 @@
+"""FedSimCLR SSL pretraining with NT-Xent (reference: examples/fedsimclr_example).
+
+Run:  python examples/fedsimclr_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/fedsimclr_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+import jax
+import numpy as np
+from fl4health_tpu.clients.fedsimclr import FedSimClrClientLogic
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models import bases
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+# SSL pretraining pairs: y carries the augmented view of x.
+base = lib.mnist_client_datasets(cfg)
+datasets = []
+for i, d in enumerate(base):
+    rng = np.random.default_rng(i)
+    aug = lambda a: a + 0.05 * rng.normal(size=np.asarray(a).shape).astype(np.float32)  # noqa: E731
+    datasets.append(ClientDataset(
+        x_train=d.x_train, y_train=aug(d.x_train),
+        x_val=d.x_val, y_val=aug(d.x_val),
+    ))
+model = bases.FedSimClrModel(
+    encoder=bases.DenseFeatures((64,)), projection_head=bases.DenseHead(32),
+    pretrain=True,
+)
+sim = FederatedSimulation(
+    logic=FedSimClrClientLogic(engine.from_flax(model), temperature=0.5),
+    tx=optax.adam(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=datasets,
+    batch_size=cfg["batch_size"],
+    metrics=MetricManager(()),
+    local_epochs=cfg["local_epochs"],
+    seed=7,
+)
+lib.run_and_report(sim, cfg)

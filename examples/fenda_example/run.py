@@ -1,0 +1,45 @@
+"""Constrained FENDA: parallel local/global extractors with cosine + contrastive constraints (reference: examples/fenda_example).
+
+Run:  python examples/fenda_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/fenda_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.fenda import ConstrainedFendaClientLogic
+from fl4health_tpu.exchange.exchanger import FixedLayerExchanger
+from fl4health_tpu.models import bases
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+model = bases.FendaModel(
+    first_feature_extractor=bases.DenseFeatures((32,)),
+    second_feature_extractor=bases.DenseFeatures((32,)),
+    head_module=bases.HeadModule(head=bases.DenseHead(10)),
+)
+sim = FederatedSimulation(
+    logic=ConstrainedFendaClientLogic(
+        engine.from_flax(model), engine.masked_cross_entropy,
+        cos_sim_loss_weight=cfg["cos_sim_weight"],
+        contrastive_loss_weight=cfg["contrastive_weight"],
+    ),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+    exchanger=FixedLayerExchanger(bases.ParallelSplitModel.exchange_global_extractor),
+    extra_loss_keys=("vanilla", "cos_sim", "contrastive"),
+)
+lib.run_and_report(sim, cfg)

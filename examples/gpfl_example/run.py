@@ -1,0 +1,40 @@
+"""GPFL class-embedding personalization (reference: examples/gpfl_example).
+
+Run:  python examples/gpfl_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/gpfl_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.gpfl import GpflClientLogic, gpfl_model_def
+from fl4health_tpu.exchange.exchanger import FixedLayerExchanger
+from fl4health_tpu.models import bases
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+module = bases.GpflModel(
+    base_module=bases.DenseFeatures((32,)), n_classes=10, feature_dim=16,
+)
+sim = FederatedSimulation(
+    logic=GpflClientLogic(gpfl_model_def(module), engine.masked_cross_entropy,
+                          n_classes=10, lam=cfg["lam"], mu=cfg["mu"]),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+    exchanger=FixedLayerExchanger(bases.GpflModel.exchange_shared),
+    extra_loss_keys=("prediction_ce", "gce_softmax", "magnitude"),
+)
+lib.run_and_report(sim, cfg)

@@ -1,0 +1,38 @@
+"""MR-MTL with MK-MMD feature alignment (reference: examples/mr_mtl_mkmmd_example family).
+
+Run:  python examples/mkmmd_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/mkmmd_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.mmd import MrMtlMkMmdClientLogic
+from fl4health_tpu.clients.ditto import KeepLocalExchanger
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+sim = FederatedSimulation(
+    logic=MrMtlMkMmdClientLogic(
+        lib.mnist_model(cfg), engine.masked_cross_entropy,
+        lam=cfg["lam"], mkmmd_loss_weight=cfg["mkmmd_weight"],
+    ),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_steps=cfg["local_steps"],
+    seed=42,
+    exchanger=KeepLocalExchanger(),
+    extra_loss_keys=("vanilla", "penalty", "mkmmd"),
+)
+lib.run_and_report(sim, cfg)

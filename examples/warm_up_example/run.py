@@ -1,0 +1,54 @@
+"""Pre-FL warm-up weight injection (reference: examples/warm_up_example).
+
+Run:  python examples/warm_up_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/warm_up_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+import jax
+from fl4health_tpu.preprocessing.warm_up import WarmedUpModule
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+# Phase 1: local (non-federated) warm-up on client 0's data.
+datasets = lib.mnist_client_datasets(cfg)
+model = lib.mnist_model(cfg)
+warm_sim = FederatedSimulation(
+    logic=engine.ClientLogic(model, engine.masked_cross_entropy),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=datasets[:1],
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=1,
+)
+warm_sim.fit(1)
+pretrained = jax.device_get(warm_sim.global_params)
+
+# Phase 2: federated run warm-started from the pretrained weights
+# (warmed_up_module.py injection semantics).
+sim = FederatedSimulation(
+    logic=engine.ClientLogic(model, engine.masked_cross_entropy),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=datasets,
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+)
+warm = WarmedUpModule(pretrained)
+warmed = warm.load_from_pretrained(jax.device_get(sim.global_params))
+sim.server_state = sim.server_state.replace(params=warmed)
+lib.run_and_report(sim, cfg)

@@ -145,7 +145,13 @@ class DynamicLayerExchanger:
         if self.mode == "threshold":
             sel = (scores > self.threshold).astype(jnp.float32)
         else:
-            k = max(1, int(jnp.ceil(self.exchange_fraction * len(flat_norms))))
+            import math
+
+            # static python math: k must be a trace-time constant for the
+            # [:k] slice (int() of a jnp value fails under jit in jax>=0.9).
+            # epsilon keeps mathematically-integral products (0.1*30) from
+            # ceiling up one extra leaf on binary round-off.
+            k = max(1, math.ceil(self.exchange_fraction * len(flat_norms) - 1e-9))
             top = jnp.argsort(-scores)[:k]
             sel = jnp.zeros((len(flat_norms),), jnp.float32).at[top].set(1.0)
         leaf_mask = jax.tree_util.tree_unflatten(
@@ -156,7 +162,14 @@ class DynamicLayerExchanger:
         )
         return LayerMaskPacket(params=masked, leaf_mask=leaf_mask)
 
-    def pull(self, payload: LayerMaskPacket, local: Params) -> Params:
+    def pull(self, payload: LayerMaskPacket | Params, local: Params) -> Params:
+        # The server->client broadcast is DENSE (the strategy aggregates into
+        # full params, fedavg_dynamic_layer.py semantics); masked packets
+        # arrive only on the client->server leg or peer-to-peer transports.
+        if not isinstance(payload, LayerMaskPacket):
+            return jax.tree_util.tree_map(
+                lambda srv, loc: srv.astype(loc.dtype), payload, local
+            )
         return jax.tree_util.tree_map(
             lambda m, srv, loc: (m * srv + (1.0 - m) * loc).astype(loc.dtype),
             payload.leaf_mask,
@@ -201,7 +214,12 @@ class SparseExchanger:
         )
         return SparseMaskPacket(params=masked, element_mask=mask)
 
-    def pull(self, payload: SparseMaskPacket, local: Params) -> Params:
+    def pull(self, payload: SparseMaskPacket | Params, local: Params) -> Params:
+        # Dense server broadcast (see DynamicLayerExchanger.pull note).
+        if not isinstance(payload, SparseMaskPacket):
+            return jax.tree_util.tree_map(
+                lambda srv, loc: srv.astype(loc.dtype), payload, local
+            )
         return jax.tree_util.tree_map(
             lambda m, srv, loc: (m * srv + (1.0 - m) * loc).astype(loc.dtype),
             payload.element_mask,

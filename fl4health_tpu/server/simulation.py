@@ -136,6 +136,8 @@ class FederatedSimulation:
         self.n_clients = len(self.datasets)
         self.batch_size = batch_size
         self.metrics = metrics
+        self._extra_loss_keys = tuple(extra_loss_keys)
+        self._eval_loss_keys = tuple(eval_loss_keys)
         self.local_epochs = local_epochs
         self.local_steps = local_steps
         self.exchanger = exchanger or FullExchanger()
@@ -303,9 +305,14 @@ class FederatedSimulation:
         self._eval_round = jax.jit(eval_round)
 
     def _extra_keys(self):
+        # explicit constructor keys win; else the logic's declared keys
+        if self._extra_loss_keys:
+            return self._extra_loss_keys
         return getattr(self.logic, "extra_loss_keys", ())
 
     def _eval_keys(self):
+        if self._eval_loss_keys:
+            return self._eval_loss_keys
         return getattr(self.logic, "eval_loss_keys", ())
 
     # ------------------------------------------------------------------

@@ -35,7 +35,11 @@ def test_corpus_is_complete():
         "feddg_ga_example", "flash_example", "federated_eval_example",
         "model_merge_example", "bert_finetuning_example", "nnunet_example",
         "feature_alignment_example", "dp_fed_examples/instance_level_dp",
-        "dp_fed_examples/client_level_dp",
+        "dp_fed_examples/client_level_dp", "fenda_example", "perfcl_example",
+        "fedrep_example", "gpfl_example", "ensemble_example",
+        "fedsimclr_example", "dynamic_layer_exchange_example",
+        "sparse_tensor_partial_exchange_example", "warm_up_example",
+        "fedpca_example", "ae_examples", "mkmmd_example",
     ]:
         assert required in names, f"examples/{required} missing from corpus"
 
