@@ -33,6 +33,5 @@ sim = FederatedSimulation(
     metrics=lib.accuracy_metrics(),
     local_epochs=cfg["local_epochs"],
     seed=42,
-    extra_loss_keys=("member_0", "member_1"),
 )
 lib.run_and_report(sim, cfg)

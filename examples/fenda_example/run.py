@@ -40,6 +40,5 @@ sim = FederatedSimulation(
     local_epochs=cfg["local_epochs"],
     seed=42,
     exchanger=FixedLayerExchanger(bases.ParallelSplitModel.exchange_global_extractor),
-    extra_loss_keys=("vanilla", "cos_sim", "contrastive"),
 )
 lib.run_and_report(sim, cfg)

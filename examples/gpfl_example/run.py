@@ -35,6 +35,5 @@ sim = FederatedSimulation(
     local_epochs=cfg["local_epochs"],
     seed=42,
     exchanger=FixedLayerExchanger(bases.GpflModel.exchange_shared),
-    extra_loss_keys=("prediction_ce", "gce_softmax", "magnitude"),
 )
 lib.run_and_report(sim, cfg)

@@ -33,6 +33,5 @@ sim = FederatedSimulation(
     local_steps=cfg["local_steps"],
     seed=42,
     exchanger=KeepLocalExchanger(),
-    extra_loss_keys=("vanilla", "penalty", "mkmmd"),
 )
 lib.run_and_report(sim, cfg)

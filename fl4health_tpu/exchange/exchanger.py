@@ -125,6 +125,9 @@ class DynamicLayerExchanger:
     threshold: float = 0.1
     exchange_fraction: float = 0.5
     normalized: bool = True
+    # the simulation hands pull() the strategy's FULL payload (a packet with
+    # the updated-leaf mask), not just its params — retention needs the mask
+    wants_packet_payload = True
 
     def __post_init__(self):
         if self.mode not in ("threshold", "topk"):
@@ -163,9 +166,11 @@ class DynamicLayerExchanger:
         return LayerMaskPacket(params=masked, leaf_mask=leaf_mask)
 
     def pull(self, payload: LayerMaskPacket | Params, local: Params) -> Params:
-        # The server->client broadcast is DENSE (the strategy aggregates into
-        # full params, fedavg_dynamic_layer.py semantics); masked packets
-        # arrive only on the client->server leg or peer-to-peer transports.
+        # The strategy's payload is a LayerMaskPacket whose leaf_mask marks
+        # server leaves refreshed by aggregation: only those replace local
+        # weights; everything else stays client-local (the reference ships
+        # only the aggregated layer subset back, fedavg_dynamic_layer.py).
+        # A bare params payload (e.g. a checkpoint restore) replaces fully.
         if not isinstance(payload, LayerMaskPacket):
             return jax.tree_util.tree_map(
                 lambda srv, loc: srv.astype(loc.dtype), payload, local
@@ -189,6 +194,7 @@ class SparseExchanger:
 
     sparsity_level: float = 0.1
     score_fn: Callable[[Params, Params], PyTree] = None  # type: ignore[assignment]
+    wants_packet_payload = True
 
     def _scores(self, params: Params, initial: Params) -> PyTree:
         if self.score_fn is not None:
@@ -215,7 +221,8 @@ class SparseExchanger:
         return SparseMaskPacket(params=masked, element_mask=mask)
 
     def pull(self, payload: SparseMaskPacket | Params, local: Params) -> Params:
-        # Dense server broadcast (see DynamicLayerExchanger.pull note).
+        # element_mask marks server elements refreshed by aggregation (see
+        # DynamicLayerExchanger.pull note); bare params replace fully.
         if not isinstance(payload, SparseMaskPacket):
             return jax.tree_util.tree_map(
                 lambda srv, loc: srv.astype(loc.dtype), payload, local

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for hot ops (interpret-mode fallback elsewhere)."""
+
+from fl4health_tpu.kernels.dp_clip import (
+    fused_clipped_masked_sum,
+    per_example_sq_norms,
+    scaled_masked_sum,
+)
+
+__all__ = ["fused_clipped_masked_sum", "per_example_sq_norms", "scaled_masked_sum"]

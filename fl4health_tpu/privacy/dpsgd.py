@@ -54,17 +54,32 @@ def noisy_clipped_mean_grads(
     rng: PRNGKey,
     clipping_bound: float,
     noise_multiplier: float,
+    use_fused_kernel: bool = False,
 ) -> Params:
     """DP-SGD gradient: clip each example to C, masked-sum, add N(0, (sigma C)^2)
     per coordinate, divide by the number of real examples (Opacus' mean-loss
-    semantics with the actual batch size)."""
-    clipped, _ = clip_per_example(per_example_grads, clipping_bound)
+    semantics with the actual batch size).
+
+    ``use_fused_kernel`` routes the clip+reduce through the Pallas kernels
+    (kernels/dp_clip.py): two passes over the [B, D] per-example tensor
+    instead of three, no materialized clipped intermediate. Opt-in because
+    the engine vmaps client logic over the clients axis and pallas_call
+    batching support depends on the backend; the XLA path is always safe.
+    """
     m = example_mask.astype(jnp.float32)
+    if use_fused_kernel:
+        from fl4health_tpu.kernels.dp_clip import fused_clipped_masked_sum
 
-    def masked_sum(g):
-        return jnp.sum(g * m.reshape((-1,) + (1,) * (g.ndim - 1)), axis=0)
+        summed = fused_clipped_masked_sum(
+            per_example_grads, m, clipping_bound
+        )
+    else:
+        clipped, _ = clip_per_example(per_example_grads, clipping_bound)
 
-    summed = jax.tree_util.tree_map(masked_sum, clipped)
+        def masked_sum(g):
+            return jnp.sum(g * m.reshape((-1,) + (1,) * (g.ndim - 1)), axis=0)
+
+        summed = jax.tree_util.tree_map(masked_sum, clipped)
     noise = gaussian_noise_like(rng, summed, noise_multiplier * clipping_bound)
     denom = jnp.maximum(jnp.sum(m), 1.0)
     return jax.tree_util.tree_map(lambda s, n: (s + n) / denom, summed, noise)

@@ -223,11 +223,14 @@ class FederatedSimulation:
 
         evaluate_after_fit = getattr(strategy, "evaluate_after_fit", False)
 
+        wants_packet = getattr(exchanger, "wants_packet_payload", False)
+
         def client_fit(state: TrainState, payload, batches: Batch, participate,
                        val_batches: Batch):
             orig = state
             payload_params = payload.params if hasattr(payload, "params") else payload
-            pulled = exchanger.pull(payload_params, state.params)
+            pull_src = payload if wants_packet else payload_params
+            pulled = exchanger.pull(pull_src, state.params)
             state = state.replace(params=pulled)
             ctx = logic.init_round_context(state, payload)
             if es_train is not None:
@@ -283,7 +286,8 @@ class FederatedSimulation:
 
         def client_eval(state: TrainState, payload, batches: Batch):
             payload_params = payload.params if hasattr(payload, "params") else payload
-            pulled = exchanger.pull(payload_params, state.params)
+            pull_src = payload if wants_packet else payload_params
+            pulled = exchanger.pull(pull_src, state.params)
             st = state.replace(params=pulled)
             ctx = logic.init_round_context(st, payload)
             losses, metrics = evaluate(st, ctx, batches)

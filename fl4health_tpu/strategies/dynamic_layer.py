@@ -19,14 +19,21 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from fl4health_tpu.core.types import Params
+from fl4health_tpu.core.types import Params, PyTree
 from fl4health_tpu.exchange.packer import LayerMaskPacket, SparseMaskPacket
 from fl4health_tpu.strategies.base import FitResults, Strategy
 
 
 @struct.dataclass
 class MaskedAvgState:
+    """``updated`` records which leaves/elements the LAST aggregation
+    actually refreshed; the client payload carries it so pulls replace only
+    refreshed entries and keep everything else client-local — the
+    local-retention contract of partial exchange (the reference ships only
+    the aggregated layer subset back, fedavg_dynamic_layer.py)."""
+
     params: Params
+    updated: PyTree
 
 
 class FedAvgDynamicLayer(Strategy):
@@ -37,7 +44,19 @@ class FedAvgDynamicLayer(Strategy):
         self.weighted_aggregation = weighted_aggregation
 
     def init(self, params: Params) -> MaskedAvgState:
-        return MaskedAvgState(params=params)
+        # nothing aggregated yet: round-1 pulls keep client-local weights
+        # (identical to the server broadcast at init by construction)
+        return MaskedAvgState(
+            params=params,
+            updated=jax.tree_util.tree_map(
+                lambda _: jnp.zeros((), jnp.float32), params
+            ),
+        )
+
+    def client_payload(self, server_state: MaskedAvgState, round_idx):
+        return LayerMaskPacket(
+            params=server_state.params, leaf_mask=server_state.updated
+        )
 
     def aggregate(self, server_state: MaskedAvgState, results: FitResults, round_idx):
         packets: LayerMaskPacket = results.packets
@@ -59,7 +78,11 @@ class FedAvgDynamicLayer(Strategy):
         new_params = jax.tree_util.tree_map(
             agg_leaf, packets.params, packets.leaf_mask, server_state.params
         )
-        return MaskedAvgState(params=new_params)
+        updated = jax.tree_util.tree_map(
+            lambda sel: (jnp.sum(cohort * sel) > 0).astype(jnp.float32),
+            packets.leaf_mask,
+        )
+        return MaskedAvgState(params=new_params, updated=updated)
 
 
 class FedAvgSparse(Strategy):
@@ -69,7 +92,15 @@ class FedAvgSparse(Strategy):
         self.weighted_aggregation = weighted_aggregation
 
     def init(self, params: Params) -> MaskedAvgState:
-        return MaskedAvgState(params=params)
+        return MaskedAvgState(
+            params=params,
+            updated=jax.tree_util.tree_map(jnp.zeros_like, params),
+        )
+
+    def client_payload(self, server_state: MaskedAvgState, round_idx):
+        return SparseMaskPacket(
+            params=server_state.params, element_mask=server_state.updated
+        )
 
     def aggregate(self, server_state: MaskedAvgState, results: FitResults, round_idx):
         packets: SparseMaskPacket = results.packets
@@ -90,4 +121,10 @@ class FedAvgSparse(Strategy):
         new_params = jax.tree_util.tree_map(
             agg_leaf, packets.params, packets.element_mask, server_state.params
         )
-        return MaskedAvgState(params=new_params)
+        def elem_updated(stacked_mask):
+            wb = cohort.reshape((-1,) + (1,) * (stacked_mask.ndim - 1))
+            return (jnp.sum(stacked_mask.astype(jnp.float32) * wb, axis=0) > 0
+                    ).astype(jnp.float32)
+
+        updated = jax.tree_util.tree_map(elem_updated, packets.element_mask)
+        return MaskedAvgState(params=new_params, updated=updated)

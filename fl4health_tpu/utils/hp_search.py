@@ -1,0 +1,116 @@
+"""Hyperparameter sweep + selection — the research-harness role.
+
+Parity surface (/root/reference/research/*/find_best_hp.py, e.g.
+research/flamby/find_best_hp.py:36 ``main``: walk a sweep directory of
+hp_folders each holding Run*/server.out logs, average the final weighted
+loss over runs, pick the folder with the lowest mean): the reference selects
+hyperparameters by scraping per-run log files produced by Slurm jobs.
+
+TPU-native design: runs are in-process simulations, so the sweep is a
+function — `sweep(builder, grid, n_seeds)` executes every config x seed,
+aggregates the selection metric over seeds, and returns the ranked results.
+A directory-walking twin (`find_best_hp_dir`) keeps the reference's
+file-based contract for sweeps executed as separate jobs that dropped
+JsonReporter outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+from pathlib import Path
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class HpResult:
+    params: dict[str, Any]
+    scores: list[float]  # one per seed
+
+    @property
+    def mean_score(self) -> float:
+        return float(np.mean(self.scores))
+
+
+def hp_grid(**axes: Sequence[Any]) -> list[dict[str, Any]]:
+    """Cartesian product of named axes -> list of hp dicts."""
+    names = sorted(axes)
+    return [
+        dict(zip(names, combo))
+        for combo in itertools.product(*(axes[n] for n in names))
+    ]
+
+
+def sweep(
+    builder: Callable[..., Any],
+    grid: Sequence[Mapping[str, Any]],
+    n_rounds: int,
+    n_seeds: int = 1,
+    score: Callable[[Any], float] | None = None,
+    minimize: bool = True,
+) -> list[HpResult]:
+    """Run every hp dict (x seeds), rank by the mean selection score.
+
+    ``builder(seed=..., **hp)`` returns a FederatedSimulation (or any object
+    with ``fit(n_rounds) -> history``); ``score(history)`` defaults to the
+    final round's checkpoint eval loss (the reference's weighted-loss
+    selection). Results come back sorted best-first.
+    """
+    if score is None:
+        score = lambda history: float(history[-1].eval_losses["checkpoint"])  # noqa: E731
+    results = []
+    for hp in grid:
+        scores = []
+        for seed in range(n_seeds):
+            sim = builder(seed=seed, **hp)
+            history = sim.fit(n_rounds)
+            if isinstance(history, tuple):  # DP servers: (history, epsilon)
+                history = history[0]
+            scores.append(score(history))
+        results.append(HpResult(params=dict(hp), scores=scores))
+    return sorted(results, key=lambda r: r.mean_score if minimize else -r.mean_score)
+
+
+def find_best_hp_dir(
+    sweep_dir: str | Path,
+    metric: str = "eval_loss",
+    minimize: bool = True,
+) -> tuple[Path | None, float | None]:
+    """File-based selection (find_best_hp.py:36 semantics): each hp folder
+    holds Run*/metrics.json files (one JSON object per line or a single
+    object; the last record's ``metric`` counts); the folder with the best
+    mean over runs wins."""
+    sweep_dir = Path(sweep_dir)
+    best_folder, best_score = None, None
+    for hp_folder in sorted(p for p in sweep_dir.iterdir() if p.is_dir()):
+        run_scores = []
+        for run in sorted(hp_folder.glob("Run*")):
+            metrics_file = run / "metrics.json"
+            if not metrics_file.exists():
+                continue
+            text = metrics_file.read_text()
+            try:
+                # single (possibly pretty-printed/multi-line) JSON document —
+                # the JsonReporter output format (reporting/base.py json.dump)
+                doc = json.loads(text)
+                lines = doc if isinstance(doc, list) else [doc]
+            except json.JSONDecodeError:
+                # JSONL: one object per line
+                lines = [
+                    json.loads(line) for line in text.splitlines() if line.strip()
+                ]
+            records = [rec for rec in lines if metric in rec]
+            if records:
+                run_scores.append(float(records[-1][metric]))
+        if not run_scores:
+            continue
+        mean = float(np.mean(run_scores))
+        better = best_score is None or (
+            mean <= best_score if minimize else mean >= best_score
+        )
+        if better:
+            best_folder, best_score = hp_folder, mean
+    return best_folder, best_score

@@ -1,5 +1,6 @@
 """Exchanger behavior tests (reference: tests/parameter_exchange/)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -130,3 +131,83 @@ def test_dynamic_push_requires_initial():
 
     with pytest.raises(ValueError):
         ex.DynamicLayerExchanger().push({"w": jnp.ones(2)})
+
+
+def test_dynamic_exchange_retains_local_progress_when_nothing_sent():
+    """Partial-exchange retention (the reference keeps unsent layers local,
+    fedavg_dynamic_layer.py): with a threshold no drift can exceed, the server
+    never refreshes anything — clients must KEEP their locally-trained
+    weights across rounds, not be reset by the broadcast."""
+    import optax
+
+    from fl4health_tpu.clients import engine
+    from fl4health_tpu.datasets.synthetic import synthetic_classification
+    from fl4health_tpu.metrics import efficient as eff
+    from fl4health_tpu.metrics.base import MetricManager
+    from fl4health_tpu.models.cnn import Mlp
+    from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+    from fl4health_tpu.strategies.dynamic_layer import FedAvgDynamicLayer
+
+    datasets = []
+    for i in range(2):
+        x, y = synthetic_classification(jax.random.PRNGKey(i), 40, (6,), 3)
+        datasets.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+    sim = FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(12,), n_outputs=3)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.1),
+        strategy=FedAvgDynamicLayer(),
+        datasets=datasets,
+        batch_size=8,
+        metrics=MetricManager((eff.accuracy(),)),
+        local_steps=4,
+        seed=5,
+        exchanger=ex.DynamicLayerExchanger(mode="threshold", threshold=1e9),
+    )
+    hist = sim.fit(3)
+    # local training must accumulate across rounds: round-3 fit loss below
+    # round-1 (a broadcast reset would freeze it)
+    assert hist[-1].fit_losses["backward"] < hist[0].fit_losses["backward"] - 0.05
+    # and the two clients' weights legitimately diverged (nothing exchanged)
+    flat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(
+        sim.client_states.params
+    )
+    assert float(jnp.max(jnp.abs(flat[0] - flat[1]))) > 1e-4
+
+
+def test_dynamic_exchange_topk_shares_selected_leaves():
+    """top-k mode: selected leaves aggregate and broadcast; unselected stay
+    local. After a round, clients agree on refreshed leaves only."""
+    import optax
+
+    from fl4health_tpu.clients import engine
+    from fl4health_tpu.datasets.synthetic import synthetic_classification
+    from fl4health_tpu.metrics import efficient as eff
+    from fl4health_tpu.metrics.base import MetricManager
+    from fl4health_tpu.models.cnn import Mlp
+    from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+    from fl4health_tpu.strategies.dynamic_layer import FedAvgDynamicLayer
+
+    datasets = []
+    for i in range(2):
+        x, y = synthetic_classification(jax.random.PRNGKey(10 + i), 40, (6,), 3)
+        datasets.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+    sim = FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(12,), n_outputs=3)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.1),
+        strategy=FedAvgDynamicLayer(),
+        datasets=datasets,
+        batch_size=8,
+        metrics=MetricManager((eff.accuracy(),)),
+        local_steps=4,
+        seed=6,
+        exchanger=ex.DynamicLayerExchanger(mode="topk", exchange_fraction=1.0),
+    )
+    hist = sim.fit(2)
+    assert np.isfinite(hist[-1].eval_losses["checkpoint"])
+    assert hist[-1].fit_losses["backward"] < hist[0].fit_losses["backward"]

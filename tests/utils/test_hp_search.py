@@ -1,0 +1,72 @@
+"""HP sweep/selection tests (reference: research/*/find_best_hp.py)."""
+
+import json
+
+import jax
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.utils.hp_search import find_best_hp_dir, hp_grid, sweep
+
+
+def test_hp_grid_cartesian():
+    grid = hp_grid(lr=[0.1, 0.01], mu=[0.0, 1.0, 2.0])
+    assert len(grid) == 6
+    assert {"lr": 0.1, "mu": 2.0} in grid
+
+
+def test_sweep_ranks_learning_rate():
+    """An absurd lr must rank below a sane one on final eval loss."""
+
+    def builder(seed, lr):
+        datasets = []
+        for i in range(2):
+            x, y = synthetic_classification(
+                jax.random.PRNGKey(10 * seed + i), 40, (6,), 3, class_sep=2.0
+            )
+            datasets.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+        return FederatedSimulation(
+            logic=engine.ClientLogic(
+                engine.from_flax(Mlp(features=(12,), n_outputs=3)),
+                engine.masked_cross_entropy,
+            ),
+            tx=optax.sgd(lr),
+            strategy=FedAvg(),
+            datasets=datasets,
+            batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_steps=4,
+            seed=seed,
+        )
+
+    results = sweep(builder, hp_grid(lr=[0.05, 50.0]), n_rounds=3, n_seeds=2)
+    assert results[0].params["lr"] == 0.05
+    assert results[0].mean_score < results[-1].mean_score
+    assert len(results[0].scores) == 2
+
+
+def test_find_best_hp_dir(tmp_path):
+    for hp, losses in [("lr_0.1", [0.4, 0.5]), ("lr_1.0", [1.2, 1.1])]:
+        for i, loss in enumerate(losses):
+            run = tmp_path / hp / f"Run{i}"
+            run.mkdir(parents=True)
+            lines = [
+                json.dumps({"round": 1, "eval_loss": loss + 0.3}),
+                json.dumps({"round": 2, "eval_loss": loss}),
+            ]
+            (run / "metrics.json").write_text("\n".join(lines))
+    best, score = find_best_hp_dir(tmp_path)
+    assert best.name == "lr_0.1"
+    assert score == pytest.approx(0.45)
+
+
+def test_find_best_hp_dir_empty(tmp_path):
+    best, score = find_best_hp_dir(tmp_path)
+    assert best is None and score is None
