@@ -7,8 +7,9 @@ re-read a [B, D] intermediate — pure HBM bandwidth, the dominant cost for
 big models (D ~ 10^6-10^8 per batch). These kernels do it in TWO passes and
 never materialize the clipped tensor:
 
-    pass 1  sq_norms:   [B, D] -> [B]    (tiled over D, accumulated in VMEM)
-    pass 2  scaled sum: [B, D] -> [D]    (scale folded into the reduction)
+    pass 1  sq_norms:   per leaf [B, W] -> [B]  (tiled over W, summed
+                                                 across leaves)
+    pass 2  scaled sum: per leaf [B, W] -> [W]  (clip scale folded in)
 
 Both kernels tile D into lane-aligned blocks with the whole batch resident
 per block (B is small in DP training; the [B, TILE] block fits VMEM). On
@@ -121,25 +122,6 @@ def scaled_masked_sum(
 # The fused DP reduction over a gradient pytree
 # ---------------------------------------------------------------------------
 
-def _flatten_batch(tree: Params) -> tuple[jax.Array, list]:
-    """[B, ...]-leaved pytree -> ([B, D] matrix, reassembly spec)."""
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    mats = [l.reshape(l.shape[0], -1) for l in leaves]
-    spec = (treedef, [l.shape[1:] for l in leaves], [m.shape[1] for m in mats])
-    return jnp.concatenate(mats, axis=1), spec
-
-
-def _unflatten_sum(vec: jax.Array, spec) -> Params:
-    # sums stay f32 regardless of input dtype — the XLA path promotes via
-    # the f32 mask multiply, and DP noise must be added at full precision
-    treedef, shapes, widths = spec
-    out, off = [], 0
-    for shape, width in zip(shapes, widths):
-        out.append(vec[off : off + width].reshape(shape))
-        off += width
-    return jax.tree_util.tree_unflatten(treedef, out)
-
-
 def fused_clipped_masked_sum(
     per_example_grads: Params,
     example_mask: jax.Array,
@@ -149,11 +131,29 @@ def fused_clipped_masked_sum(
 ) -> Params:
     """sum_i mask[i] * min(1, C/||g_i||) * g_i over a [B,...]-leaved pytree,
     without materializing the clipped per-example tensor (the fused
-    replacement for dpsgd.clip_per_example + masked sum)."""
-    flat, spec = _flatten_batch(per_example_grads)
-    sq = per_example_sq_norms(flat, tile=tile, interpret=interpret)
+    replacement for dpsgd.clip_per_example + masked sum).
+
+    Kernels run PER LEAF on [B, leaf_width] views (reshape of a contiguous
+    leaf is metadata, not a copy) with the squared-norm partials accumulated
+    across leaves — concatenating the tree into one [B, D] matrix first
+    would itself write+read the full tensor and forfeit the bandwidth win.
+    Leaf sums come back f32 regardless of input dtype (the XLA path promotes
+    via the f32 mask multiply, and DP noise must be added at full precision).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(per_example_grads)
+    mats = [leaf.reshape(leaf.shape[0], -1) for leaf in leaves]
+
+    sq = sum(
+        per_example_sq_norms(m, tile=tile, interpret=interpret) for m in mats
+    )
     norms = jnp.sqrt(jnp.maximum(sq, 0.0))
     factor = jnp.minimum(1.0, clipping_bound / jnp.maximum(norms, 1e-12))
     scale = factor * example_mask.astype(jnp.float32)
-    summed = scaled_masked_sum(flat, scale, tile=tile, interpret=interpret)
-    return _unflatten_sum(summed, spec)
+
+    sums = [
+        scaled_masked_sum(m, scale, tile=tile, interpret=interpret).reshape(
+            leaf.shape[1:]
+        )
+        for leaf, m in zip(leaves, mats)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, sums)

@@ -67,11 +67,21 @@ class LoraDense(nn.Module):
 
 
 class MultiHeadSelfAttention(nn.Module):
+    """``attention_fn`` swaps the score/softmax/value core for an alternative
+    implementation called as ``attention_fn(q, k, v, pad_mask=mask) -> out``
+    (q/k/v/out all [B, T, H, D]) — e.g.
+    ``functools.partial(parallel.ring_attention.ring_self_attention, mesh=m)``
+    for long-context sequence parallelism over a (seq,) mesh. Attention
+    dropout only applies to the default dense core (ring attention streams
+    blocks and never materializes the score matrix).
+    """
+
     d_model: int
     n_heads: int
     lora_rank: int = 0
     dtype: Any = jnp.float32
     dropout_rate: float = 0.0
+    attention_fn: Any = None
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool):
@@ -91,15 +101,20 @@ class MultiHeadSelfAttention(nn.Module):
             return t.reshape(*t.shape[:-1], self.n_heads, head_dim)
 
         q, k, v = split(q), split(k), split(v)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
-            jnp.asarray(head_dim, self.dtype)
-        )
-        neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
-        scores = jnp.where(pad_mask[:, None, None, :] > 0, scores, neg)
-        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(self.dtype)
-        if train and self.dropout_rate > 0:
-            attn = nn.Dropout(self.dropout_rate, deterministic=False)(attn)
-        out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v, pad_mask=pad_mask)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                jnp.asarray(head_dim, self.dtype)
+            )
+            neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
+            scores = jnp.where(pad_mask[:, None, None, :] > 0, scores, neg)
+            attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+                self.dtype
+            )
+            if train and self.dropout_rate > 0:
+                attn = nn.Dropout(self.dropout_rate, deterministic=False)(attn)
+            out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
         out = out.reshape(*out.shape[:-2], self.d_model)
         return dense("o_proj")(out)
 
@@ -111,6 +126,7 @@ class EncoderBlock(nn.Module):
     lora_rank: int = 0
     dtype: Any = jnp.float32
     dropout_rate: float = 0.0
+    attention_fn: Any = None
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool):
@@ -118,7 +134,7 @@ class EncoderBlock(nn.Module):
         h = nn.LayerNorm(name="ln_attn")(x)
         h = MultiHeadSelfAttention(
             self.d_model, self.n_heads, self.lora_rank, self.dtype,
-            self.dropout_rate, name="attn",
+            self.dropout_rate, self.attention_fn, name="attn",
         )(h, pad_mask, train)
         if train and self.dropout_rate > 0:
             h = nn.Dropout(self.dropout_rate, deterministic=False)(h)
@@ -151,6 +167,7 @@ class TransformerClassifier(nn.Module):
     lora_rank: int = 0
     dtype: Any = jnp.float32
     dropout_rate: float = 0.0
+    attention_fn: Any = None  # e.g. ring attention for long contexts
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -165,7 +182,8 @@ class TransformerClassifier(nn.Module):
         for i in range(self.n_layers):
             h = EncoderBlock(
                 self.d_model, self.n_heads, self.d_ff, self.lora_rank,
-                self.dtype, self.dropout_rate, name=f"layer_{i}",
+                self.dtype, self.dropout_rate, self.attention_fn,
+                name=f"layer_{i}",
             )(h, pad_mask, train)
         h = nn.LayerNorm(name="ln_final")(h.astype(jnp.float32))
         denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1.0)

@@ -92,9 +92,13 @@ class FedAvgSparse(Strategy):
         self.weighted_aggregation = weighted_aggregation
 
     def init(self, params: Params) -> MaskedAvgState:
+        # masks are f32 in EVERY round (aggregate returns f32) — a params-
+        # dtype round-1 mask would change the jit signature and recompile
         return MaskedAvgState(
             params=params,
-            updated=jax.tree_util.tree_map(jnp.zeros_like, params),
+            updated=jax.tree_util.tree_map(
+                lambda prm: jnp.zeros(prm.shape, jnp.float32), params
+            ),
         )
 
     def client_payload(self, server_state: MaskedAvgState, round_idx):

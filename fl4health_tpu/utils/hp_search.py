@@ -74,37 +74,69 @@ def sweep(
     return sorted(results, key=lambda r: r.mean_score if minimize else -r.mean_score)
 
 
+def _lookup(record: Mapping[str, Any], dotted: str) -> float | None:
+    """Resolve a dotted metric path ("eval_losses.checkpoint") in a record."""
+    node: Any = record
+    for part in dotted.split("."):
+        if not isinstance(node, Mapping) or part not in node:
+            return None
+        node = node[part]
+    try:
+        return float(node)
+    except (TypeError, ValueError):
+        return None
+
+
+def _final_metric_from_doc(doc: Any, metric: str) -> float | None:
+    """Last-record metric from any supported document shape:
+    - JsonReporter dump: {"rounds": {"1": {...}, ...}} — last round's record,
+      metric as a dotted path (e.g. "eval_losses.checkpoint");
+    - a list of flat records (JSONL-style) — last record carrying the metric.
+    """
+    if isinstance(doc, Mapping) and isinstance(doc.get("rounds"), Mapping):
+        rounds = doc["rounds"]
+        for key in sorted(rounds, key=lambda k: int(k), reverse=True):
+            value = _lookup(rounds[key], metric)
+            if value is not None:
+                return value
+        return None
+    records = doc if isinstance(doc, list) else [doc]
+    for rec in reversed(records):
+        value = _lookup(rec, metric)
+        if value is not None:
+            return value
+    return None
+
+
 def find_best_hp_dir(
     sweep_dir: str | Path,
-    metric: str = "eval_loss",
+    metric: str = "eval_losses.checkpoint",
     minimize: bool = True,
 ) -> tuple[Path | None, float | None]:
     """File-based selection (find_best_hp.py:36 semantics): each hp folder
-    holds Run*/metrics.json files (one JSON object per line or a single
-    object; the last record's ``metric`` counts); the folder with the best
-    mean over runs wins."""
+    holds per-run JSON files — JsonReporter dumps (any name, nested
+    {"rounds": ...}; reporting/base.py) or JSONL metric records. The last
+    record's ``metric`` (a dotted path) counts per run; the folder with the
+    best mean over runs wins."""
     sweep_dir = Path(sweep_dir)
     best_folder, best_score = None, None
     for hp_folder in sorted(p for p in sweep_dir.iterdir() if p.is_dir()):
         run_scores = []
-        for run in sorted(hp_folder.glob("Run*")):
-            metrics_file = run / "metrics.json"
-            if not metrics_file.exists():
-                continue
-            text = metrics_file.read_text()
-            try:
-                # single (possibly pretty-printed/multi-line) JSON document —
-                # the JsonReporter output format (reporting/base.py json.dump)
-                doc = json.loads(text)
-                lines = doc if isinstance(doc, list) else [doc]
-            except json.JSONDecodeError:
-                # JSONL: one object per line
-                lines = [
-                    json.loads(line) for line in text.splitlines() if line.strip()
-                ]
-            records = [rec for rec in lines if metric in rec]
-            if records:
-                run_scores.append(float(records[-1][metric]))
+        run_dirs = sorted(hp_folder.glob("Run*")) or [hp_folder]
+        for run in run_dirs:
+            for metrics_file in sorted(run.glob("*.json")):
+                text = metrics_file.read_text()
+                try:
+                    doc = json.loads(text)
+                except json.JSONDecodeError:
+                    doc = [
+                        json.loads(line)
+                        for line in text.splitlines()
+                        if line.strip()
+                    ]
+                value = _final_metric_from_doc(doc, metric)
+                if value is not None:
+                    run_scores.append(value)
         if not run_scores:
             continue
         mean = float(np.mean(run_scores))

@@ -211,3 +211,12 @@ def test_dynamic_exchange_topk_shares_selected_leaves():
     hist = sim.fit(2)
     assert np.isfinite(hist[-1].eval_losses["checkpoint"])
     assert hist[-1].fit_losses["backward"] < hist[0].fit_losses["backward"]
+    # fraction=1.0: every leaf aggregated and broadcast, so after the final
+    # eval pull both clients hold the SAME weights — the positive half of
+    # the retention contract (refreshed leaves really do replace local).
+    flat = jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(
+        sim.client_states.params
+    )
+    np.testing.assert_allclose(
+        np.asarray(flat[0]), np.asarray(flat[1]), atol=1e-6
+    )

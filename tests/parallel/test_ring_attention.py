@@ -94,3 +94,28 @@ class TestRingAttention:
         np.testing.assert_allclose(
             np.asarray(g_ring), np.asarray(g_dense), atol=2e-4
         )
+
+
+def test_transformer_with_ring_attention_matches_dense(eight_devices):
+    """The long-context path: TransformerClassifier(attention_fn=ring) on a
+    (seq,) mesh reproduces the dense-attention model's logits."""
+    import functools
+
+    from fl4health_tpu.models.transformer import TransformerClassifier
+
+    mesh = _mesh(eight_devices, 8)
+    kw = dict(vocab_size=64, n_classes=3, d_model=16, n_heads=2, n_layers=2,
+              d_ff=32, max_len=32)
+    dense_model = TransformerClassifier(**kw)
+    ring_model = TransformerClassifier(
+        **kw,
+        attention_fn=functools.partial(ring_self_attention, mesh=mesh),
+    )
+    x = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 1, 64)
+    variables = dense_model.init(jax.random.PRNGKey(1), x, train=False)
+    out_dense, _ = dense_model.apply(variables, x, train=False)
+    out_ring, _ = ring_model.apply(variables, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_dense["prediction"]), np.asarray(out_ring["prediction"]),
+        atol=2e-5,
+    )

@@ -62,9 +62,27 @@ def test_find_best_hp_dir(tmp_path):
                 json.dumps({"round": 2, "eval_loss": loss}),
             ]
             (run / "metrics.json").write_text("\n".join(lines))
-    best, score = find_best_hp_dir(tmp_path)
+    best, score = find_best_hp_dir(tmp_path, metric="eval_loss")
     assert best.name == "lr_0.1"
     assert score == pytest.approx(0.45)
+
+
+def test_find_best_hp_dir_consumes_json_reporter_dumps(tmp_path):
+    """The reporter-file contract: JsonReporter-dumped runs (uuid-named,
+    nested rounds dict) select via a dotted metric path."""
+    from fl4health_tpu.reporting.base import JsonReporter
+
+    for hp, losses in [("mu_0.1", [0.3, 0.4]), ("mu_1.0", [0.9, 1.0])]:
+        for i, loss in enumerate(losses):
+            run_dir = tmp_path / hp / f"Run{i}"
+            run_dir.mkdir(parents=True)
+            rep = JsonReporter(output_folder=str(run_dir))
+            rep.report({"eval_losses": {"checkpoint": loss + 0.2}}, round=1)
+            rep.report({"eval_losses": {"checkpoint": loss}}, round=2)
+            rep.dump()
+    best, score = find_best_hp_dir(tmp_path)  # default: eval_losses.checkpoint
+    assert best.name == "mu_0.1"
+    assert score == pytest.approx(0.35)
 
 
 def test_find_best_hp_dir_empty(tmp_path):
