@@ -20,8 +20,6 @@ drop-in used by privacy.dpsgd when enabled.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -63,6 +61,13 @@ def _sq_norm_kernel(g_ref, out_ref):
         out_ref[:] += partial
 
 
+def _effective_tile(width: int, tile: int) -> int:
+    """Clamp the tile to the leaf's lane-rounded width: a [B, 10] bias pads
+    to one 128-lane tile, not a full 2048 — small leaves must not reduce
+    thousands of zero columns per pass."""
+    return min(tile, max(_LANE, -(-width // _LANE) * _LANE))
+
+
 def per_example_sq_norms(
     flat_grads: jax.Array, tile: int = 2048, interpret: bool | None = None
 ) -> jax.Array:
@@ -70,6 +75,7 @@ def per_example_sq_norms(
     if interpret is None:
         interpret = _interpret_default()
     b, d = flat_grads.shape
+    tile = _effective_tile(d, tile)
     g = _pad_to(flat_grads, 1, tile)
     n_tiles = g.shape[1] // tile
     out = pl.pallas_call(
@@ -102,6 +108,7 @@ def scaled_masked_sum(
     if interpret is None:
         interpret = _interpret_default()
     b, d = flat_grads.shape
+    tile = _effective_tile(d, tile)
     g = _pad_to(flat_grads, 1, tile)
     n_tiles = g.shape[1] // tile
     out = pl.pallas_call(

@@ -95,7 +95,15 @@ def _final_metric_from_doc(doc: Any, metric: str) -> float | None:
     """
     if isinstance(doc, Mapping) and isinstance(doc.get("rounds"), Mapping):
         rounds = doc["rounds"]
-        for key in sorted(rounds, key=lambda k: int(k), reverse=True):
+        # non-integer round keys (stray config/summary files swept up by the
+        # *.json glob) make the file invalid, not the whole sweep
+        int_keys = []
+        for key in rounds:
+            try:
+                int_keys.append((int(key), key))
+            except (TypeError, ValueError):
+                continue
+        for _, key in sorted(int_keys, reverse=True):
             value = _lookup(rounds[key], metric)
             if value is not None:
                 return value
@@ -124,19 +132,29 @@ def find_best_hp_dir(
         run_scores = []
         run_dirs = sorted(hp_folder.glob("Run*")) or [hp_folder]
         for run in run_dirs:
-            for metrics_file in sorted(run.glob("*.json")):
+            # ONE score per run: the newest parseable dump wins, so a stale
+            # reporter file left beside a re-run's dump cannot double-count
+            candidates = sorted(
+                run.glob("*.json"), key=lambda f: f.stat().st_mtime,
+                reverse=True,
+            )
+            for metrics_file in candidates:
                 text = metrics_file.read_text()
                 try:
                     doc = json.loads(text)
                 except json.JSONDecodeError:
-                    doc = [
-                        json.loads(line)
-                        for line in text.splitlines()
-                        if line.strip()
-                    ]
+                    try:
+                        doc = [
+                            json.loads(line)
+                            for line in text.splitlines()
+                            if line.strip()
+                        ]
+                    except json.JSONDecodeError:
+                        continue
                 value = _final_metric_from_doc(doc, metric)
                 if value is not None:
                     run_scores.append(value)
+                    break
         if not run_scores:
             continue
         mean = float(np.mean(run_scores))

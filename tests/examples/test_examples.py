@@ -40,6 +40,7 @@ def test_corpus_is_complete():
         "fedsimclr_example", "dynamic_layer_exchange_example",
         "sparse_tensor_partial_exchange_example", "warm_up_example",
         "fedpca_example", "ae_examples", "mkmmd_example", "cross_silo_example",
+        "fl_plus_local_ft_example",
     ]:
         assert required in names, f"examples/{required} missing from corpus"
 
