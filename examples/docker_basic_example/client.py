@@ -1,0 +1,22 @@
+"""Client container entrypoint: serve one silo on a fixed port.
+
+Env: FL_PORT (default 8081), FL_SEED (default 1), FL_BATCH_SIZE, FL_LOCAL_STEPS,
+FL_LEARNING_RATE.
+"""
+
+import os
+import time
+
+import fl_nodes
+
+server = fl_nodes.serve_silo(
+    seed=int(os.environ.get("FL_SEED", 1)),
+    batch_size=int(os.environ.get("FL_BATCH_SIZE", 8)),
+    local_steps=int(os.environ.get("FL_LOCAL_STEPS", 5)),
+    learning_rate=float(os.environ.get("FL_LEARNING_RATE", 0.1)),
+    host="0.0.0.0",
+    port=int(os.environ.get("FL_PORT", 8081)),
+)
+print(f"silo ready on :{server.port}", flush=True)
+while True:
+    time.sleep(3600)

@@ -1,0 +1,122 @@
+"""Shared node logic for the dockerized basic example (reference:
+examples/docker_basic_example — the basic FedAvg example packaged as one
+server + N client containers).
+
+Both deployment shapes use exactly this code:
+- ``run.py`` hosts the silos as in-process threads (CI-testable);
+- ``client.py`` / ``server.py`` run them as real processes/containers over
+  the same TCP wire (transport/loopback.py + codec frames).
+"""
+
+from __future__ import annotations
+
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.transport import LoopbackServer, call, decode, encode
+
+N_CLASSES = 3
+DIM = 6
+
+
+def build_logic():
+    module = Mlp(features=(16,), n_outputs=N_CLASSES)
+    return engine.ClientLogic(engine.from_flax(module), engine.masked_cross_entropy)
+
+
+def make_silo_handler(seed: int, batch_size: int, local_steps: int,
+                      learning_rate: float):
+    """One hospital's request handler: pull global params, train locally,
+    return update + sample count + metrics."""
+    logic = build_logic()
+    tx = optax.sgd(learning_rate)
+    x, y = synthetic_classification(
+        jax.random.PRNGKey(seed), 64, (DIM,), N_CLASSES, class_sep=2.0
+    )
+    state = engine.create_train_state(logic, tx, jax.random.PRNGKey(seed), x[:1])
+    train = jax.jit(
+        engine.make_local_train(logic, tx, MetricManager((efficient.accuracy(),)))
+    )
+    n = 48  # train split; x[n:] is the held-out eval slice
+
+    @jax.jit
+    def holdout_accuracy(params, model_state):
+        (preds, _), _ = logic.model.apply(
+            params, model_state, x[n:], train=False, rng=jax.random.PRNGKey(0)
+        )
+        return jnp.mean(
+            (jnp.argmax(preds["prediction"], axis=-1) == y[n:]).astype(jnp.float32)
+        )
+
+    def handler(frame: bytes) -> bytes:
+        nonlocal state
+        global_params = decode(frame, like=state.params)
+        state = state.replace(params=global_params)
+        batches = engine.epoch_batches(
+            state.rng, x[:n], y[:n], batch_size, n_steps=local_steps
+        )
+        state, losses, _, _ = train(state, None, batches)
+        return encode({
+            "params": state.params,
+            "n": jnp.asarray(float(n)),
+            "loss": losses["backward"],
+            "accuracy": holdout_accuracy(state.params, state.model_state),
+        })
+
+    return handler
+
+
+def serve_silo(seed: int, batch_size: int, local_steps: int,
+               learning_rate: float, host: str = "0.0.0.0", port: int = 0):
+    handler = make_silo_handler(seed, batch_size, local_steps, learning_rate)
+    return LoopbackServer(handler, host=host, port=port)
+
+
+def coordinate_round(addrs: list[tuple[str, int]], global_params):
+    """One FedAvg round over the wire: broadcast → local fit → weighted merge.
+    Silo RPCs fan out concurrently (the containers train in parallel; round
+    latency is the slowest silo, not the sum)."""
+    frame = encode(global_params)
+    like = {"params": global_params, "n": jnp.asarray(0.0),
+            "loss": jnp.asarray(0.0), "accuracy": jnp.asarray(0.0)}
+    with ThreadPoolExecutor(max_workers=len(addrs)) as pool:
+        results = list(pool.map(
+            lambda addr: decode(call(addr[0], addr[1], frame, timeout=120.0),
+                                like=like),
+            addrs,
+        ))
+    weights = np.asarray([float(r["n"]) for r in results])
+    weights = weights / weights.sum()
+    merged = jax.tree_util.tree_map(
+        lambda *leaves: sum(w * leaf for w, leaf in zip(weights, leaves)),
+        *[r["params"] for r in results],
+    )
+    stats = {
+        "fit_loss": float(np.average([float(r["loss"]) for r in results],
+                                     weights=weights)),
+        "accuracy": float(np.average([float(r["accuracy"]) for r in results],
+                                     weights=weights)),
+    }
+    return merged, stats
+
+
+def init_global_params(seed: int = 0):
+    logic = build_logic()
+    x = np.zeros((1, DIM), np.float32)
+    params, _ = logic.model.init(jax.random.PRNGKey(seed), x)
+    return params

@@ -1,0 +1,47 @@
+"""Dockerized basic example, exercised in-process (reference: examples/docker_basic_example).
+
+The SAME node code (fl_nodes.py) that the Dockerfile/compose deployment runs
+as containers is hosted here as threads over real TCP sockets, so the wire
+path is identical — only the process packaging differs.
+
+Run:  python examples/docker_basic_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/docker_basic_example/run.py
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import _lib as lib  # noqa: E402
+import fl_nodes  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+silos = [
+    fl_nodes.serve_silo(
+        seed=10 + i,
+        batch_size=cfg["batch_size"],
+        local_steps=cfg["local_steps"],
+        learning_rate=cfg["learning_rate"],
+        host="127.0.0.1",
+    )
+    for i in range(cfg["n_clients"])
+]
+try:
+    addrs = [(s.host, s.port) for s in silos]
+    params = fl_nodes.init_global_params()
+    last = None
+    for rnd in range(1, cfg["n_server_rounds"] + 1):
+        params, stats = fl_nodes.coordinate_round(addrs, params)
+        last = stats
+        print(json.dumps({"round": rnd,
+                          "fit_loss": round(stats["fit_loss"], 5),
+                          "eval_accuracy": round(stats["accuracy"], 5)}))
+    print(json.dumps({"final": True, "rounds": cfg["n_server_rounds"],
+                      "eval_accuracy": round(last["accuracy"], 5)}))
+finally:
+    for s in silos:
+        s.close()

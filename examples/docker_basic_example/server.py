@@ -1,0 +1,35 @@
+"""Server container entrypoint: coordinate FedAvg rounds over client silos.
+
+Env: FL_CLIENTS — comma-separated host:port list (default
+"client1:8081,client2:8081"); FL_ROUNDS (default 5).
+"""
+
+import json
+import os
+import socket
+import time
+
+import fl_nodes
+
+addrs = []
+for spec in os.environ.get("FL_CLIENTS", "client1:8081,client2:8081").split(","):
+    host, port = spec.rsplit(":", 1)
+    addrs.append((host, int(port)))
+
+# Wait for silo containers to come up.
+deadline = time.time() + 120
+for host, port in addrs:
+    while True:
+        try:
+            socket.create_connection((host, port), timeout=2).close()
+            break
+        except OSError:
+            if time.time() > deadline:
+                raise TimeoutError(f"silo {host}:{port} never came up")
+            time.sleep(1)
+
+params = fl_nodes.init_global_params()
+for rnd in range(1, int(os.environ.get("FL_ROUNDS", 5)) + 1):
+    params, stats = fl_nodes.coordinate_round(addrs, params)
+    print(json.dumps({"round": rnd, **stats}), flush=True)
+print(json.dumps({"final": True}), flush=True)

@@ -1,0 +1,42 @@
+"""DP-SCAFFOLD: control variates + instance-level DP-SGD with accounting (reference: examples/dp_scaffold_example).
+
+Run:  python examples/dp_fed_examples/dp_scaffold/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/dp_fed_examples/dp_scaffold/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.instance_level_dp import DpScaffoldClientLogic
+from fl4health_tpu.server.servers import DpScaffoldServer
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.scaffold import Scaffold
+
+sim = FederatedSimulation(
+    logic=DpScaffoldClientLogic(
+        lib.mlp_model(cfg), engine.masked_cross_entropy,
+        learning_rate=cfg["learning_rate"],
+        clipping_bound=cfg["clipping_bound"],
+        noise_multiplier=cfg["noise_multiplier"],
+    ),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=Scaffold(learning_rate=1.0),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+)
+server = DpScaffoldServer(
+    sim, noise_multiplier=cfg["noise_multiplier"], batch_size=cfg["batch_size"],
+    warm_start=cfg.get("warm_start", False),
+)
+lib.run_and_report(server, cfg)

@@ -1,0 +1,47 @@
+"""FENDA + Ditto: twin FENDA models with drift-constrained personal global extractor (reference: examples/fenda_ditto_example).
+
+Run:  python examples/fenda_ditto_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/fenda_ditto_example/run.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+from fl4health_tpu.clients import engine  # noqa: E402
+
+cfg = lib.example_config(Path(__file__).parent)
+
+from fl4health_tpu.clients.fenda import FendaDittoClientLogic
+from fl4health_tpu.exchange.exchanger import FixedLayerExchanger
+from fl4health_tpu.models import bases
+from fl4health_tpu.server.simulation import FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+
+def fenda():
+    return bases.FendaModel(
+        first_feature_extractor=bases.DenseFeatures((32,)),
+        second_feature_extractor=bases.DenseFeatures((32,)),
+        head_module=bases.HeadModule(head=bases.DenseHead(10)),
+    )
+
+
+model = bases.TwinModel(global_model=fenda(), personal_model=fenda())
+sim = FederatedSimulation(
+    logic=FendaDittoClientLogic(engine.from_flax(model),
+                                engine.masked_cross_entropy, lam=cfg["lam"]),
+    tx=optax.sgd(cfg["learning_rate"]),
+    strategy=FedAvg(),
+    datasets=lib.mnist_client_datasets(cfg),
+    batch_size=cfg["batch_size"],
+    metrics=lib.accuracy_metrics(),
+    local_epochs=cfg["local_epochs"],
+    seed=42,
+    exchanger=FixedLayerExchanger(bases.TwinModel.exchange_global_model),
+    extra_loss_keys=("global_ce", "personal_ce", "penalty"),
+)
+lib.run_and_report(sim, cfg)

@@ -1,0 +1,358 @@
+"""Dynamic personalization wrapper — ``make_it_personal`` for client logics.
+
+Parity: /root/reference/fl4health/mixins/personalized/__init__.py:19
+(``make_it_personal(client_class, mode)``) and the Ditto / MR-MTL mixins
+(mixins/personalized/ditto.py, mr_mtl.py): wrap ANY client in a personalized
+variant without writing a combined subclass.  The reference builds a dynamic
+class whose MRO injects the mixin; here personalization is a *logic
+combinator*: ``make_it_personal(base_logic, PersonalizedMode.DITTO)`` returns
+a new ``ClientLogic`` that
+
+- DITTO: twins the base model (exchanged ``global_model`` + private
+  ``personal_model``), runs the base logic's full loss machinery on the
+  personal branch, trains the global branch with the plain criterion, and
+  adds the l2 drift penalty pulling personal weights toward the received
+  global weights (clients/ditto_client.py:20 semantics).
+- MR_MTL: keeps the base model single, never overwrites local weights on
+  pull (pair with ``KeepLocalExchanger``), and adds the drift penalty toward
+  the received aggregate (clients/mr_mtl_client.py:18 semantics).
+
+Scope: the wrapper composes with logics that use the default ``predict``
+path (criterion + training_loss/eval_loss + extra/finalize hooks). Logics
+whose forward signature is bespoke (APFL's alpha-blend, GPFL's conditional
+inputs) are already personalized by construction and don't need wrapping —
+the same boundary the reference's mixins have in practice.
+
+TPU-native design: the twin is built at the ``ModelDef`` level (not a flax
+module wrapper), so any base ModelDef — flax or hand-rolled — twins the same
+way, and the base logic sees plain single-model params/state *views* of the
+twin tree, keeping its own code byte-identical whether wrapped or not.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.clients.ditto import KeepLocalExchanger
+from fl4health_tpu.clients.engine import Batch, ClientLogic, ModelDef, TrainState
+from fl4health_tpu.core.types import Params
+from fl4health_tpu.exchange.packer import AdaptiveConstraintPacket
+from fl4health_tpu.losses.drift import weight_drift_loss
+
+GLOBAL = "global_model"
+PERSONAL = "personal_model"
+
+
+class PersonalizedMode(enum.Enum):
+    DITTO = "ditto"
+    MR_MTL = "mr_mtl"
+
+
+def twin_model_def(base: ModelDef) -> ModelDef:
+    """Two independent copies of a base ModelDef under ``global_model`` /
+    ``personal_model`` subtrees (models.bases.TwinModel layout, but at the
+    ModelDef level so non-flax models twin too)."""
+
+    def init(rng, sample_x):
+        rg, rp = jax.random.split(rng)
+        pg, sg = base.init(rg, sample_x)
+        pp, sp = base.init(rp, sample_x)
+        return {GLOBAL: pg, PERSONAL: pp}, {GLOBAL: sg, PERSONAL: sp}
+
+    def apply(params, model_state, x, train=True, rng=None, **kwargs):
+        # Independent noise per branch (dropout/masks/VAE sampling must not
+        # be correlated between the twins, matching flax TwinModel's
+        # per-submodule rng folding).
+        rng_g = rng_p = None
+        if rng is not None:
+            rng_g, rng_p = jax.random.split(rng)
+        (g_preds, g_feats), g_ms = base.apply(
+            params[GLOBAL], model_state[GLOBAL], x, train=train, rng=rng_g,
+            **kwargs,
+        )
+        (p_preds, p_feats), p_ms = base.apply(
+            params[PERSONAL], model_state[PERSONAL], x, train=train, rng=rng_p,
+            **kwargs,
+        )
+        preds = {
+            "global": g_preds["prediction"],
+            "personal": p_preds["prediction"],
+            # Validation / metrics run on the personal model (ditto_client.py
+            # validate path).
+            "prediction": p_preds["prediction"],
+            "_global_preds": g_preds,
+            "_personal_preds": p_preds,
+        }
+        features = {"global": g_feats, "personal": p_feats}
+        return (preds, features), {GLOBAL: g_ms, PERSONAL: p_ms}
+
+    return ModelDef(init=init, apply=apply)
+
+
+def exchange_global_subtree(path: str) -> bool:
+    """Exchange predicate for the twin tree (TwinModel.exchange_global_model)."""
+    return path.startswith(GLOBAL)
+
+
+@struct.dataclass
+class _DittoWrapCtx:
+    base_ctx: Any
+    received_global: Params
+    drift_penalty_weight: Any
+
+
+class DittoPersonalizedLogic(ClientLogic):
+    """``base`` logic on the personal branch + vanilla global branch + drift
+    penalty. Pair with ``FixedLayerExchanger(exchange_global_subtree)``."""
+
+    def __init__(self, base: ClientLogic, lam: float = 1.0, adaptive: bool = False):
+        super().__init__(twin_model_def(base.model), base.criterion)
+        self.base = base
+        self.lam = lam
+        self.adaptive = adaptive
+        self.extra_loss_keys = ("global_loss", "penalty") + tuple(
+            f"personal_{k}" for k in getattr(base, "extra_loss_keys", ())
+        )
+        self.eval_loss_keys = tuple(
+            f"personal_{k}" for k in getattr(base, "eval_loss_keys", ())
+        )
+
+    # -- personal-branch views ---------------------------------------------
+    def _view(self, state: TrainState, params: Params | None = None) -> TrainState:
+        p = params if params is not None else state.params
+        return state.replace(params=p[PERSONAL], model_state=state.model_state[PERSONAL])
+
+    def init_extra(self, params: Params):
+        return self.base.init_extra(params[PERSONAL])
+
+    def init_round_context(self, state: TrainState, payload) -> _DittoWrapCtx:
+        lam = getattr(payload, "drift_penalty_weight", None)
+        if lam is None:
+            lam = jnp.asarray(self.lam, jnp.float32)
+        payload_params = payload.params if hasattr(payload, "params") else payload
+        received = payload_params[GLOBAL]
+        # The base logic sees the received global weights as ITS payload
+        # (the reference mixin's base client snapshots the received model).
+        base_ctx = self.base.init_round_context(self._view(state), received)
+        return _DittoWrapCtx(
+            base_ctx=base_ctx,
+            received_global=received,
+            drift_penalty_weight=lam,
+        )
+
+    def training_loss(self, preds, features, batch: Batch, params, state,
+                      ctx: _DittoWrapCtx):
+        if self.criterion is not None:
+            global_loss = self.criterion(preds["global"], batch.y,
+                                         batch.example_mask)
+        else:
+            # Criterion-less logics (e.g. nnU-Net's deep-supervision
+            # composite): the global branch trains with the base's own
+            # vanilla training loss, like the reference's nnunet_pfl combo.
+            global_view = state.replace(
+                params=params[GLOBAL], model_state=state.model_state[GLOBAL]
+            )
+            global_loss, _ = self.base.training_loss(
+                preds["_global_preds"], features["global"], batch,
+                params[GLOBAL], global_view, ctx.base_ctx,
+            )
+        personal_loss, personal_extra = self.base.training_loss(
+            preds["_personal_preds"], features["personal"], batch,
+            params[PERSONAL], self._view(state, params), ctx.base_ctx,
+        )
+        penalty = 0.5 * weight_drift_loss(
+            params[PERSONAL], ctx.received_global, ctx.drift_penalty_weight
+        )
+        total = global_loss + personal_loss + penalty
+        out = {"global_loss": global_loss, "penalty": penalty}
+        out.update({f"personal_{k}": v for k, v in personal_extra.items()})
+        return total, out
+
+    def eval_loss(self, preds, features, batch: Batch, params, state, ctx):
+        base_ctx = ctx.base_ctx if isinstance(ctx, _DittoWrapCtx) else ctx
+        loss, extra = self.base.eval_loss(
+            preds["_personal_preds"], features["personal"], batch,
+            params[PERSONAL], self._view(state, params), base_ctx,
+        )
+        return loss, {f"personal_{k}": v for k, v in extra.items()}
+
+    def transform_gradients(self, grads: Params, state: TrainState,
+                            ctx: _DittoWrapCtx) -> Params:
+        personal = self.base.transform_gradients(
+            grads[PERSONAL], self._view(state), ctx.base_ctx
+        )
+        return {**grads, PERSONAL: personal}
+
+    def _merge_hook(self, state: TrainState, new_view: TrainState) -> TrainState:
+        # Hooks mutate extra/rng/step — params stay with the engine's step.
+        return state.replace(extra=new_view.extra, rng=new_view.rng)
+
+    def update_before_step(self, state, ctx: _DittoWrapCtx, batch):
+        return self._merge_hook(
+            state, self.base.update_before_step(self._view(state), ctx.base_ctx, batch)
+        )
+
+    def update_after_step(self, state, ctx: _DittoWrapCtx, batch, preds=None):
+        base_preds = None if preds is None else preds["_personal_preds"]
+        return self._merge_hook(
+            state,
+            self.base.update_after_step(
+                self._view(state), ctx.base_ctx, batch, base_preds
+            ),
+        )
+
+    def finalize_round(self, state, ctx: _DittoWrapCtx, local_steps):
+        return self._merge_hook(
+            state,
+            self.base.finalize_round(self._view(state), ctx.base_ctx, local_steps),
+        )
+
+    def pack(self, state: TrainState, pushed_params, train_losses):
+        if not self.adaptive:
+            return pushed_params
+        return AdaptiveConstraintPacket(
+            params=pushed_params,
+            loss_for_adaptation=train_losses["global_loss"],
+        )
+
+
+@struct.dataclass
+class _MrMtlWrapCtx:
+    base_ctx: Any
+    initial_params: Params
+    drift_penalty_weight: Any
+
+
+class MrMtlPersonalizedLogic(ClientLogic):
+    """``base`` logic + drift penalty toward the received aggregate; pair
+    with ``KeepLocalExchanger`` so local weights are never overwritten.
+
+    This generalizes ``MrMtlClientLogic`` (clients/ditto.py, kept separate
+    for its reference-parity loss-key names); the two are pinned numerically
+    identical on a plain base by
+    tests/clients/test_make_it_personal.py::test_mr_mtl_personalized_plain_matches_mr_mtl_logic,
+    so a change to the MR-MTL math in either place fails that test."""
+
+    def __init__(self, base: ClientLogic, lam: float = 1.0, adaptive: bool = False):
+        super().__init__(base.model, base.criterion)
+        self.base = base
+        self.lam = lam
+        self.adaptive = adaptive
+        # Base extras are namespaced (a base that itself reports "penalty",
+        # e.g. FedProx, must not shadow the MR-MTL drift penalty).
+        self.extra_loss_keys = ("base_loss", "penalty") + tuple(
+            f"base_{k}" for k in getattr(base, "extra_loss_keys", ())
+        )
+        self.eval_loss_keys = tuple(getattr(base, "eval_loss_keys", ()))
+
+    def init_extra(self, params: Params):
+        return self.base.init_extra(params)
+
+    def init_round_context(self, state: TrainState, payload) -> _MrMtlWrapCtx:
+        lam = getattr(payload, "drift_penalty_weight", None)
+        if lam is None:
+            lam = jnp.asarray(self.lam, jnp.float32)
+        payload_params = payload.params if hasattr(payload, "params") else payload
+        base_ctx = self.base.init_round_context(state, payload)
+        return _MrMtlWrapCtx(
+            base_ctx=base_ctx,
+            initial_params=payload_params,
+            drift_penalty_weight=lam,
+        )
+
+    def predict(self, params, model_state, batch, rng, train, extra=None, ctx=None):
+        base_ctx = ctx.base_ctx if isinstance(ctx, _MrMtlWrapCtx) else ctx
+        return self.base.predict(params, model_state, batch, rng, train,
+                                 extra=extra, ctx=base_ctx)
+
+    def training_loss(self, preds, features, batch: Batch, params, state,
+                      ctx: _MrMtlWrapCtx):
+        base_loss, base_extra = self.base.training_loss(
+            preds, features, batch, params, state, ctx.base_ctx
+        )
+        penalty = 0.5 * weight_drift_loss(
+            params, ctx.initial_params, ctx.drift_penalty_weight
+        )
+        out = {"base_loss": base_loss, "penalty": penalty}
+        out.update({f"base_{k}": v for k, v in base_extra.items()})
+        return base_loss + penalty, out
+
+    def eval_loss(self, preds, features, batch: Batch, params, state, ctx):
+        base_ctx = ctx.base_ctx if isinstance(ctx, _MrMtlWrapCtx) else ctx
+        return self.base.eval_loss(preds, features, batch, params, state, base_ctx)
+
+    def transform_gradients(self, grads, state, ctx: _MrMtlWrapCtx):
+        return self.base.transform_gradients(grads, state, ctx.base_ctx)
+
+    def update_before_step(self, state, ctx: _MrMtlWrapCtx, batch):
+        return self.base.update_before_step(state, ctx.base_ctx, batch)
+
+    def update_after_step(self, state, ctx: _MrMtlWrapCtx, batch, preds=None):
+        return self.base.update_after_step(state, ctx.base_ctx, batch, preds)
+
+    def finalize_round(self, state, ctx: _MrMtlWrapCtx, local_steps):
+        return self.base.finalize_round(state, ctx.base_ctx, local_steps)
+
+    def pack(self, state: TrainState, pushed_params, train_losses):
+        if not self.adaptive:
+            return pushed_params
+        return AdaptiveConstraintPacket(
+            params=pushed_params,
+            loss_for_adaptation=train_losses["base_loss"],
+        )
+
+
+def make_it_personal(
+    base: ClientLogic,
+    mode: PersonalizedMode,
+    lam: float = 1.0,
+    adaptive: bool = False,
+) -> ClientLogic:
+    """Wrap ``base`` into its personalized variant
+    (mixins/personalized/__init__.py:19).
+
+    Returns the wrapped logic; wire the matching exchanger:
+    ``FixedLayerExchanger(exchange_global_subtree)`` for DITTO,
+    ``KeepLocalExchanger()`` for MR_MTL (exported here for convenience).
+    """
+    # The wrappers compose via training_loss/eval_loss/hooks. A base that
+    # overrides the gradient computation itself (DP logics' per-example
+    # clip+noise) or — for DITTO — the forward, would be SILENTLY bypassed;
+    # make that a loud error rather than e.g. a run that drops its privacy
+    # guarantee.
+    if type(base).value_and_grads is not ClientLogic.value_and_grads:
+        raise TypeError(
+            f"make_it_personal cannot wrap {type(base).__name__}: it overrides "
+            "value_and_grads (e.g. DP per-example gradients), which the "
+            "personalization wrapper would silently discard. Compose DP with "
+            "the dedicated client instead (e.g. DittoClientLogic + "
+            "InstanceLevelDpMixin)."
+        )
+    if mode is PersonalizedMode.DITTO:
+        if type(base).predict is not ClientLogic.predict:
+            raise TypeError(
+                f"make_it_personal(DITTO) cannot wrap {type(base).__name__}: "
+                "it overrides predict; the twin forward calls the base MODEL "
+                "directly, so a bespoke forward (APFL/GPFL-style) would be "
+                "bypassed. Those logics are already personalized by design."
+            )
+        return DittoPersonalizedLogic(base, lam=lam, adaptive=adaptive)
+    if mode is PersonalizedMode.MR_MTL:
+        return MrMtlPersonalizedLogic(base, lam=lam, adaptive=adaptive)
+    raise ValueError(f"unknown personalization mode: {mode}")
+
+
+__all__ = [
+    "PersonalizedMode",
+    "make_it_personal",
+    "DittoPersonalizedLogic",
+    "MrMtlPersonalizedLogic",
+    "twin_model_def",
+    "exchange_global_subtree",
+    "KeepLocalExchanger",
+]

@@ -159,8 +159,11 @@ class InstanceLevelDpServer:
         )
         return self.accountant
 
-    def fit(self, n_rounds: int):
-        self.setup_accountant(n_rounds)
+    def fit(self, n_rounds: int, accounted_rounds: int | None = None):
+        # accounted_rounds: privacy-budget rounds when they exceed training
+        # rounds (e.g. DP-SCAFFOLD's warm-start pass also touches data).
+        accounted = accounted_rounds if accounted_rounds is not None else n_rounds
+        self.setup_accountant(accounted)
         assert self.accountant is not None
         # Default delta = 1/total_samples across the federation
         # (instance_level_dp_server.py:163) — NOT 1/max(client size), which
@@ -168,11 +171,40 @@ class InstanceLevelDpServer:
         delta = self.delta if self.delta is not None else 1.0 / sum(
             poll_sample_counts(self.sim)
         )
-        epsilon = self.accountant.get_epsilon(n_rounds, delta)
+        epsilon = self.accountant.get_epsilon(accounted, delta)
         logger.info("Instance-level DP run: epsilon=%.4f at delta=%.2e over %d rounds",
-                    epsilon, delta, n_rounds)
+                    epsilon, delta, accounted)
         history = self.sim.fit(n_rounds)
         return history, epsilon
+
+
+class DpScaffoldServer(InstanceLevelDpServer):
+    """DP-SCAFFOLD orchestration (scaffold_server.py:184 ``DPScaffoldServer``):
+    SCAFFOLD control-variate warm start composed with instance-level DP
+    accounting — the warm-start pass runs under the same DP-SGD client logic,
+    matching the reference's ordering (warm start, then accountant setup +
+    training rounds)."""
+
+    def __init__(self, sim: FederatedSimulation, noise_multiplier: float,
+                 batch_size: int, warm_start: bool = False, **kwargs):
+        from fl4health_tpu.strategies.scaffold import Scaffold
+
+        assert isinstance(sim.strategy, Scaffold), (
+            "DpScaffoldServer requires the Scaffold strategy"
+        )
+        super().__init__(sim, noise_multiplier, batch_size, **kwargs)
+        self.warm_start = warm_start
+
+    def fit(self, n_rounds: int):
+        if self.warm_start:
+            scaffold_warm_start(self.sim)
+        # The warm-start pass is a full DP-SGD sweep over private data whose
+        # control variates ARE later exchanged, so it spends one round of
+        # privacy budget; count it (the reference DPScaffoldServer omits it —
+        # its printed epsilon understates the true spend when warm-starting).
+        return super().fit(
+            n_rounds, accounted_rounds=n_rounds + 1 if self.warm_start else None
+        )
 
 
 class ClientLevelDpFedAvgServer:

@@ -45,11 +45,14 @@ def recv_frame(conn: socket.socket) -> bytes:
 class LoopbackServer:
     """One-thread request/response server: handler(frame_bytes) -> frame_bytes."""
 
-    def __init__(self, handler: Callable[[bytes], bytes], host: str = "127.0.0.1"):
+    def __init__(self, handler: Callable[[bytes], bytes], host: str = "127.0.0.1",
+                 port: int = 0):
+        # port=0: OS-assigned (in-process silos); fixed port for real
+        # cross-host deployment (e.g. the docker_basic_example containers).
         self.handler = handler
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind((host, 0))
+        self.sock.bind((host, port))
         self.sock.listen(8)
         self.host, self.port = self.sock.getsockname()
         self._stop = threading.Event()

@@ -6,6 +6,7 @@ clients share one process and are sharded over 8 virtual CPU devices instead.
 """
 
 import os
+from pathlib import Path
 
 # The axon sitecustomize imports jax at interpreter boot and forces
 # jax_platforms="axon,cpu" (see /root/.axon_site/axon/register/pjrt.py:112), so
@@ -14,9 +15,21 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# XLA's CPU AOT cache loader logs a benign machine-feature-mismatch error per
+# cached executable (tuning flags like prefer-no-scatter are compared as
+# features); silence C++ logging before the backend loads.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: this box is single-core, so XLA compiles
+# dominate suite wall time; repeated runs (local iteration, CI re-runs) hit
+# the on-disk cache instead. Delete .jax_test_cache to force cold compiles.
+_CACHE_DIR = Path(__file__).resolve().parent.parent / ".jax_test_cache"
+jax.config.update("jax_compilation_cache_dir", str(_CACHE_DIR))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

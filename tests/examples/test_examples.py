@@ -40,7 +40,9 @@ def test_corpus_is_complete():
         "fedsimclr_example", "dynamic_layer_exchange_example",
         "sparse_tensor_partial_exchange_example", "warm_up_example",
         "fedpca_example", "ae_examples", "mkmmd_example", "cross_silo_example",
-        "fl_plus_local_ft_example",
+        "fl_plus_local_ft_example", "dp_fed_examples/dp_scaffold",
+        "fenda_ditto_example", "fedllm_example", "nnunet_pfl_example",
+        "docker_basic_example",
     ]:
         assert required in names, f"examples/{required} missing from corpus"
 
@@ -58,8 +60,12 @@ def test_example_runs(script, monkeypatch, capsys):
         runpy.run_path(str(run_py), run_name="__main__")
     finally:
         sys.path[:] = old_path
+        # Drop every module the example imported from under examples/ —
+        # example-local helpers (e.g. _lib, docker's fl_nodes) must not leak
+        # into the next example's import of a same-named file.
         for mod in set(sys.modules) - old_mods:
-            if mod.startswith("_lib"):
+            mod_file = getattr(sys.modules.get(mod), "__file__", None) or ""
+            if mod_file.startswith(str(EXAMPLES_DIR)):
                 del sys.modules[mod]
         os.chdir(old_cwd)
     out = capsys.readouterr().out
