@@ -26,6 +26,7 @@ from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from fl4health_tpu.checkpointing.checkpointer import CheckpointMode
@@ -305,8 +306,10 @@ class FederatedSimulation:
             agg_metrics = aggregate_metrics(metrics, eval_counts)
             return new_states, agg_losses, agg_metrics, losses, metrics
 
+        self._fit_round_fn = fit_round  # raw (un-jitted) for the chunked scan
         self._fit_round = jax.jit(fit_round)
         self._eval_round = jax.jit(eval_round)
+        self._chunked_fit = None  # compiled lazily by make_chunked_fit
 
     def _extra_keys(self):
         # explicit constructor keys win; else the logic's declared keys
@@ -320,20 +323,88 @@ class FederatedSimulation:
         return getattr(self.logic, "eval_loss_keys", ())
 
     # ------------------------------------------------------------------
-    def _round_batches(self, round_idx: int) -> Batch:
+    def _round_plan(self, round_idx: int):
+        """Host-side index plan (numpy idx/example_mask/step_mask) for one
+        round — the same plan whether gathered per round (``fit``) or stacked
+        for the on-device multi-round scan (``fit_chunk``)."""
         entropies = [
             [*self._base_entropy, 1000 + round_idx, i] for i in range(self.n_clients)
         ]
-        idx, em, sm = engine.multi_client_index_plans(
+        return engine.multi_client_index_plans(
             entropies,
             [d.n_train for d in self.datasets],
             self.batch_size,
             n_steps=self.local_steps,
             local_epochs=self.local_epochs,
         )
+
+    def _round_batches(self, round_idx: int) -> Batch:
+        idx, em, sm = self._round_plan(round_idx)
         return engine.gather_batches(
             self._x_train_stack, self._y_train_stack, idx, em, sm
         )
+
+    # ------------------------------------------------------------------
+    def make_chunked_fit(self):
+        """Compile a multi-round scan: ONE dispatch executes k federated
+        rounds entirely on device, gathering each round's batches inside the
+        scan from the resident data stacks. Each round's math is exactly
+        ``_fit_round``'s on the same host index plans — under FULL
+        participation (or any constant mask) the trajectory matches the
+        per-round path bit-for-bit (tests/server/test_chunked_fit.py).
+
+        NOT a drop-in for ``fit`` beyond that: the participation mask is
+        frozen for the whole chunk (``fit`` redraws it per round), and the
+        per-round failure-policy check / checkpointing / reporting —
+        host-sync work — do not run inside the scan.
+
+        This is the SURVEY §7 "keep entire rounds (or multi-round chunks)
+        on-device" lever: over a tunneled/remote TPU each dispatch costs a
+        host round trip, and amortizing it across k rounds removes the
+        per-round dispatch latency from the hot loop. Used by ``fit_chunk``
+        and the bench.
+        """
+        if self._chunked_fit is not None:
+            return self._chunked_fit
+        fit_round = self._fit_round_fn
+
+        def chunk(server_state, client_states, x_stack, y_stack, idx, em, sm,
+                  mask, start_round, val_batches):
+            def body(carry, per_round):
+                server_state, client_states, r = carry
+                idx_r, em_r, sm_r = per_round
+                batches = engine.gather_batches(x_stack, y_stack, idx_r, em_r, sm_r)
+                server_state, client_states, losses, metrics, _ = fit_round(
+                    server_state, client_states, batches, mask, r, val_batches
+                )
+                return (server_state, client_states, r + 1), (losses, metrics)
+
+            (server_state, client_states, _), (losses, metrics) = jax.lax.scan(
+                body, (server_state, client_states, start_round), (idx, em, sm)
+            )
+            return server_state, client_states, losses, metrics
+
+        self._chunked_fit = jax.jit(chunk)
+        return self._chunked_fit
+
+    def fit_chunk(self, start_round: int, k: int, mask=None):
+        """Run rounds [start_round, start_round+k) in one compiled dispatch.
+        Returns per-round stacked (losses, metrics) dicts; updates the
+        simulation state in place. Full participation unless ``mask`` given."""
+        chunked = self.make_chunked_fit()
+        plans = [self._round_plan(start_round + i) for i in range(k)]
+        idx = jnp.asarray(np.stack([p[0] for p in plans]))
+        em = jnp.asarray(np.stack([p[1] for p in plans]))
+        sm = jnp.asarray(np.stack([p[2] for p in plans]))
+        if mask is None:
+            mask = self.client_manager.sample_all()
+        val_batches, _ = self._val_batches()
+        self.server_state, self.client_states, losses, metrics = chunked(
+            self.server_state, self.client_states,
+            self._x_train_stack, self._y_train_stack, idx, em, sm, mask,
+            jnp.asarray(start_round, jnp.int32), val_batches,
+        )
+        return losses, metrics
 
     def _val_batches(self) -> tuple[Batch, jax.Array]:
         if self._val_cache is None:

@@ -1,0 +1,22 @@
+"""The research-harness sweep script runs end-to-end in tiny mode
+(reference: research/*/find_best_hp.py selection flow)."""
+
+import runpy
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+
+def test_cifar10_sweep_tiny(monkeypatch, capsys):
+    monkeypatch.setenv("FL4HEALTH_SWEEP_TINY", "1")
+    old_path = list(sys.path)
+    try:
+        runpy.run_path(str(REPO / "research" / "cifar10" / "sweep.py"),
+                       run_name="__main__")
+    finally:
+        sys.path[:] = old_path
+    out = capsys.readouterr().out
+    assert '"best"' in out
+    # ranked results include both algorithms
+    assert '"fedavg"' in out and '"fedprox"' in out
