@@ -107,6 +107,14 @@ def make_sim(model_kind: str = "cifar_cnn"):
             )
     else:  # transformer: the BERT-shaped AG-News config (SURVEY §6)
         seq = int(os.environ.get("FL4HEALTH_BENCH_SEQ", 128))
+        attention_fn = None
+        if os.environ.get("FL4HEALTH_BENCH_FLASH") == "1":
+            import functools
+
+            from fl4health_tpu.kernels.flash_attention import flash_attention
+
+            attention_fn = functools.partial(flash_attention, block_q=128,
+                                             block_k=128)
         module = TransformerClassifier(
             vocab_size=int(os.environ.get("FL4HEALTH_BENCH_VOCAB", 16384)),
             n_classes=4,
@@ -122,6 +130,7 @@ def make_sim(model_kind: str = "cifar_cnn"):
             d_ff=int(os.environ.get("FL4HEALTH_BENCH_DFF", 3072)),
             max_len=seq,
             dtype=dtype,
+            attention_fn=attention_fn,
         )
         n_clients = int(os.environ.get("FL4HEALTH_BENCH_TRANSFORMER_CLIENTS", 4))
         for i in range(n_clients):

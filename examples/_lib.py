@@ -18,21 +18,15 @@ import sys
 from pathlib import Path
 
 import jax
-
-# The axon sitecustomize forces jax_platforms="axon,cpu" at interpreter boot,
-# overriding the JAX_PLATFORMS env var; honor an explicit cpu-FIRST request
-# before the backend initializes (same handling as __graft_entry__.py).
-if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
-
 import numpy as np
 
 REPO = Path(__file__).resolve().parent.parent
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
+
+from fl4health_tpu.utils.bootstrap import honor_cpu_platform_request  # noqa: E402
+
+honor_cpu_platform_request()
 
 from fl4health_tpu.clients import engine  # noqa: E402
 from fl4health_tpu.datasets.partitioners import DirichletLabelBasedAllocation  # noqa: E402

@@ -1,0 +1,294 @@
+"""Pallas flash-attention kernel — fused softmax attention for the
+transformer hot path.
+
+The XLA path (parallel/ring_attention.py ``_dense_attention``) materializes
+the [B, H, T, T] score tensor in HBM twice (softmax in, probabilities out) —
+O(T^2) HBM traffic that dominates attention cost once T outgrows VMEM. This
+kernel is the standard flash recipe on the MXU: stream K/V blocks through
+VMEM against a resident Q block, maintain the online-softmax state (running
+max, normalizer, weighted accumulator) in registers, and write only the
+[T, D] output plus a [T] logsumexp. The backward pass recomputes
+probabilities blockwise from the saved logsumexp (two kernels: dQ over query
+blocks, dK/dV over key blocks) — nothing quadratic ever touches HBM.
+
+Scope: per-device exact attention with key-padding masks (the shape the
+transformer and the ring-attention local block need). The sequence axis
+beyond one device is ring attention's job; this kernel is the fast local
+block. K/V for one (batch, head) must fit VMEM — T up to ~8k at D=128 —
+which the ring sharding guarantees by construction.
+
+On non-TPU backends the kernels run in Pallas interpret mode so the CPU
+suite exercises the same code path (house rule from kernels/dp_clip.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANE = 128
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_axis(x: jax.Array, axis: int, multiple: int, value=0.0) -> jax.Array:
+    pad = (-x.shape[axis]) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k, scale):
+    q = q_ref[0].astype(jnp.float32) * scale  # [Bq, Dp]
+    bq = q.shape[0]
+    n_kblocks = k_ref.shape[1] // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        mk = mask_ref[0, pl.dslice(j * block_k, block_k)]  # [Bk]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bq, Bk]
+        s = jnp.where(mk[None, :] > 0, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(mk[None, :] > 0, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    a0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m0, l0, a0))
+    denom = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / denom[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(denom)
+
+
+def _fwd_call(q, k, v, mask, block_q, block_k, scale, interpret):
+    bh, tp, dp = q.shape
+    grid = (bh, tp // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tp, dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tp, dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tp), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tp, dp), q.dtype),
+            jax.ShapeDtypeStruct((bh, tp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, block_k, scale):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [Bq]
+    delta = delta_ref[0]  # [Bq] = rowsum(dO * O)
+    n_kblocks = k_ref.shape[1] // block_k
+
+    def body(j, dq):
+        kb = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        mk = mask_ref[0, pl.dslice(j * block_k, block_k)]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(mk[None, :] > 0, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(
+        0, n_kblocks, body, jnp.zeros_like(q)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, scale):
+    kb = k_ref[0].astype(jnp.float32)  # [Bk, Dp]
+    vb = v_ref[0].astype(jnp.float32)
+    mk = mask_ref[0]  # [Bk]
+    n_qblocks = q_ref.shape[1] // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - lse[:, None])  # [Bq, Bk]
+        p = jnp.where(mk[None, :] > 0, p, 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale  # [Bq, Bk]
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros_like(kb)
+    dv0 = jnp.zeros_like(vb)
+    dk, dv = jax.lax.fori_loop(0, n_qblocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, mask, o, lse, do, block_q, block_k, scale, interpret):
+    bh, tp, dp = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, tp // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tp, dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tp, dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tp), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, block_q, dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tp, dp), q.dtype),
+        interpret=interpret,
+    )(q, k, v, mask, do, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=block_q, scale=scale)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, tp // block_k),
+        in_specs=[
+            pl.BlockSpec((1, tp, dp), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k), lambda b, j: (b, j)),
+            pl.BlockSpec((1, tp, dp), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, tp), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, tp), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, dp), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tp, dp), k.dtype),
+            jax.ShapeDtypeStruct((bh, tp, dp), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp over padded [BH, Tp, Dp] internals
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_padded(q, k, v, mask, block_q, block_k, scale, interpret):
+    out, _ = _fwd_call(q, k, v, mask, block_q, block_k, scale, interpret)
+    return out
+
+
+def _flash_padded_fwd(q, k, v, mask, block_q, block_k, scale, interpret):
+    out, lse = _fwd_call(q, k, v, mask, block_q, block_k, scale, interpret)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _flash_padded_bwd(block_q, block_k, scale, interpret, res, do):
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, mask, out, lse, do, block_q, block_k,
+                           scale, interpret)
+    return dq, dk, dv, None
+
+
+_flash_padded.defvjp(_flash_padded_fwd, _flash_padded_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    pad_mask: jax.Array | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Exact softmax attention, flash-style. q,k,v: [B, T, H, D];
+    pad_mask: [B, T] with 1 = real token (key positions); returns
+    [B, T, H, D]. Drop-in for ring_attention._dense_attention."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, h, d = q.shape
+    if pad_mask is None:
+        pad_mask = jnp.ones((b, t), jnp.float32)
+    scale = 1.0 / (d ** 0.5)
+
+    # [B,T,H,D] -> [B*H, T, D]; pad T to the block grid, D to the lane width.
+    # T must divide by BOTH block sizes (the q grid tiles by block_q while
+    # each kernel loops T/block_k key blocks) — lcm, not max: padding only to
+    # max(block_q, block_k) would silently drop trailing key blocks for
+    # non-dividing pairs like 48/32.
+    t_multiple = math.lcm(block_q, block_k)
+
+    def to_bh(x):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+        x = _pad_axis(_pad_axis(x, 2, _LANE), 1, t_multiple)
+        return x
+
+    qp, kp, vp = to_bh(q), to_bh(k), to_bh(v)
+    maskp = _pad_axis(pad_mask.astype(jnp.float32), 1, t_multiple)
+    maskp = jnp.repeat(maskp, h, axis=0)  # [B*H, Tp] (B-major like to_bh)
+
+    out = _flash_padded(qp, kp, vp, maskp, block_q, block_k, scale, interpret)
+    out = out[:, :t, :d].reshape(b, h, t, d)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

@@ -1,0 +1,28 @@
+"""Process-boot platform handling shared by every entry script.
+
+The axon sitecustomize imports jax at interpreter boot and forces
+``jax_platforms="axon,cpu"``, overriding the JAX_PLATFORMS env var — so a
+script that wants the CPU backend (tests, sweeps, examples on a host whose
+TPU tunnel may be absent or wedged) must override via jax.config BEFORE the
+backend initializes. One implementation here instead of a copy per script
+(examples/_lib.py, research sweeps, __graft_entry__.py all need it).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_platform_request() -> bool:
+    """If the environment asks for cpu FIRST (``JAX_PLATFORMS=cpu,...``),
+    force the cpu backend before initialization. Returns True if forced.
+    Call before any jax computation; safe to call repeatedly."""
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] != "cpu":
+        return False
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        return False
+    return True

@@ -15,14 +15,9 @@ sys.path.insert(0, str(REPO))
 
 import jax
 
-# The axon sitecustomize forces jax_platforms="axon,cpu" at interpreter boot;
-# honor an explicit cpu-FIRST request before the backend initializes (same
-# handling as examples/_lib.py).
-if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+from fl4health_tpu.utils.bootstrap import honor_cpu_platform_request
+
+honor_cpu_platform_request()
 import numpy as np
 import optax
 

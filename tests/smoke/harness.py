@@ -112,10 +112,33 @@ def fedprox_mnist():
     )
 
 
+def moon_mnist():
+    # Personalization-family trajectory regression: MOON's contrastive term
+    # is zero in round 1 (empty buffer) and active after — the golden pins
+    # both the convergence rate and that activation pattern.
+    from fl4health_tpu.clients.moon import MoonClientLogic
+    from fl4health_tpu.models import bases
+
+    # a deliberately small extractor + low lr: an MLP saturates the synthetic
+    # corpus in one round at lr 0.1, which would record an unfalsifiable
+    # all-1.0 golden; this shape keeps the trajectory in the learning regime.
+    model = bases.MoonModel(
+        base_module=bases.DenseFeatures((16,)),
+        head_module=bases.DenseHead(10),
+    )
+    return _base(
+        MoonClientLogic(engine.from_flax(model), engine.masked_cross_entropy,
+                        contrastive_weight=1.0, buffer_len=1),
+        FedAvg(),
+        optax.sgd(0.02),
+    )
+
+
 CONFIGS = {
     "fedavg_mnist": fedavg_mnist,
     "scaffold_mnist": scaffold_mnist,
     "fedprox_mnist": fedprox_mnist,
+    "moon_mnist": moon_mnist,
 }
 
 # ---------------------------------------------------------------------------
