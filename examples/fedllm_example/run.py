@@ -20,6 +20,14 @@ from fl4health_tpu.clients import engine  # noqa: E402
 
 cfg = lib.example_config(Path(__file__).parent)
 
+import os
+
+if os.environ.get("FL4HEALTH_EXAMPLE_TINY"):
+    # smoke-suite budget: shrink the model, keep every code path (LoRA
+    # exchange, masked Adam, ZeRO-1 demo)
+    cfg.update(d_model=32, n_heads=2, n_layers=1, d_ff=64, vocab_size=64,
+               seq_len=16, local_steps=2)
+
 import jax
 from fl4health_tpu.datasets.synthetic import synthetic_text_classification
 from fl4health_tpu.models.transformer import TransformerClassifier
@@ -84,7 +92,7 @@ if n_model_shards > 1 and len(jax.devices()) >= n_model_shards:
     logic = engine.ClientLogic(model, engine.masked_cross_entropy)
     x, y = datasets[0].x_train, datasets[0].y_train
     state = engine.create_train_state(logic, zero_tx, jax.random.PRNGKey(0), x[:1])
-    step = engine.make_train_step(logic, zero_tx)
+    step = jax.jit(engine.make_train_step(logic, zero_tx))
     for i in range(2):
         xb, yb = x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8]
         batch = Batch(x=xb, y=yb,

@@ -75,12 +75,18 @@ def test_gradients_match_dense():
 def test_jit_and_vmap_compose():
     # engine usage: jitted loss over a vmapped client axis
     q, k, v = _qkv(jax.random.PRNGKey(4), 3, 32, 1, 16)
+    cq = jnp.stack([q, q * 0.5])  # [clients, B, T, H, D]
+    ck, cv = jnp.stack([k, k]), jnp.stack([v, v])
 
     @jax.jit
-    def f(q, k, v):
-        return flash_attention(q, k, v, block_q=16, block_k=16).sum()
+    @jax.vmap
+    def per_client(q, k, v):
+        return flash_attention(q, k, v, block_q=16, block_k=16)
 
-    assert np.isfinite(float(f(q, k, v)))
+    out = per_client(cq, ck, cv)
+    assert out.shape == cq.shape
+    _assert_close(out[0], _dense_attention(q, k, v))
+    _assert_close(out[1], _dense_attention(q * 0.5, k, v))
 
 
 def test_transformer_with_flash_attention_matches_dense():

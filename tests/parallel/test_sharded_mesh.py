@@ -174,3 +174,25 @@ def test_partial_participation_sharded(eight_devices):
         jax.device_get(sim.strategy.global_params(out_8d[0])),
     )
     _assert_trees_close(jax.device_get(out_1d[2]), jax.device_get(out_8d[2]))
+
+
+def test_chunked_fit_sharded_matches_single_device(eight_devices):
+    """The multi-round scan (fit_chunk) composes with the clients-axis
+    sharding: k rounds in one dispatch on an 8-device mesh must equal the
+    same k rounds on one device."""
+    mesh = meshlib.client_mesh(8, devices=eight_devices)
+
+    def run(shard):
+        sim = _sim(engine.ClientLogic(_model(), engine.masked_cross_entropy),
+                   FedAvg())
+        if shard:
+            sim.client_states = meshlib.shard_over_clients(sim.client_states, mesh)
+            sim.server_state = meshlib.replicate(sim.server_state, mesh)
+        losses, _ = sim.fit_chunk(start_round=1, k=3)
+        return (jax.device_get(sim.strategy.global_params(sim.server_state)),
+                jax.device_get(losses))
+
+    params_1d, losses_1d = run(shard=False)
+    params_8d, losses_8d = run(shard=True)
+    _assert_trees_close(params_1d, params_8d)
+    _assert_trees_close(losses_1d, losses_8d)
