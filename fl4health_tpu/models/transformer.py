@@ -168,6 +168,10 @@ class TransformerClassifier(nn.Module):
     dtype: Any = jnp.float32
     dropout_rate: float = 0.0
     attention_fn: Any = None  # e.g. ring attention for long contexts
+    remat: bool = False  # rematerialize each encoder block on the backward
+    # pass: activation memory drops from O(n_layers * T * d_model) to one
+    # layer's worth at the cost of a second forward — the standard TPU
+    # HBM-for-FLOPs trade for big-model configs (jax.checkpoint).
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -179,8 +183,10 @@ class TransformerClassifier(nn.Module):
             (self.max_len, self.d_model),
         )
         h = (tok + pos[None, : x.shape[1]]).astype(self.dtype)
+        # static_argnums counts the module itself: (self, h, pad_mask, train)
+        block_cls = nn.remat(EncoderBlock, static_argnums=(3,)) if self.remat else EncoderBlock
         for i in range(self.n_layers):
-            h = EncoderBlock(
+            h = block_cls(
                 self.d_model, self.n_heads, self.d_ff, self.lora_rank,
                 self.dtype, self.dropout_rate, self.attention_fn,
                 name=f"layer_{i}",

@@ -185,3 +185,25 @@ class TestFederatedLora:
         np.testing.assert_allclose(base_before, base_after, atol=1e-7)
         assert history[-1].fit_losses["backward"] < history[0].fit_losses["backward"]
         assert history[-1].eval_metrics["accuracy"] > 0.3  # 0.25 = chance
+
+
+class TestRemat:
+    def test_remat_gradients_match_unremat(self):
+        # remat=True must be a pure memory/FLOPs trade: same params tree,
+        # same gradients (jax.checkpoint recomputes, never changes math)
+        from jax.flatten_util import ravel_pytree
+
+        a, b = small_model(), small_model(remat=True)
+        x, _ = synthetic_text_classification(
+            jax.random.PRNGKey(2), 4, VOCAB, SEQ, CLASSES
+        )
+        v = a.init(jax.random.PRNGKey(3), x, train=False)
+
+        def sq(model):
+            return jax.grad(lambda p: jnp.sum(jnp.square(
+                model.apply(p, x, train=False)[0]["prediction"])))(v)
+
+        fa = ravel_pytree(sq(a))[0]
+        fb = ravel_pytree(sq(b))[0]
+        np.testing.assert_allclose(np.asarray(fa), np.asarray(fb),
+                                   atol=1e-5, rtol=1e-5)
