@@ -353,10 +353,10 @@ class FederatedSimulation:
         participation (or any constant mask) the trajectory matches the
         per-round path bit-for-bit (tests/server/test_chunked_fit.py).
 
-        NOT a drop-in for ``fit`` beyond that: the participation mask is
-        frozen for the whole chunk (``fit`` redraws it per round), and the
-        per-round failure-policy check / checkpointing / reporting —
-        host-sync work — do not run inside the scan.
+        NOT a drop-in for ``fit`` beyond that: the per-round failure-policy
+        check / checkpointing / reporting — host-sync work — do not run
+        inside the scan. Participation DOES match ``fit``: per-round masks
+        are drawn host-side with the same PRNG stream and scanned over.
 
         The returned callable DONATES its first two arguments (server_state,
         client_states): on TPU the passed-in buffers are invalidated — always
@@ -374,18 +374,19 @@ class FederatedSimulation:
         fit_round = self._fit_round_fn
 
         def chunk(server_state, client_states, x_stack, y_stack, idx, em, sm,
-                  mask, start_round, val_batches):
+                  masks, start_round, val_batches):
             def body(carry, per_round):
                 server_state, client_states, r = carry
-                idx_r, em_r, sm_r = per_round
+                idx_r, em_r, sm_r, mask_r = per_round
                 batches = engine.gather_batches(x_stack, y_stack, idx_r, em_r, sm_r)
                 server_state, client_states, losses, metrics, _ = fit_round(
-                    server_state, client_states, batches, mask, r, val_batches
+                    server_state, client_states, batches, mask_r, r, val_batches
                 )
                 return (server_state, client_states, r + 1), (losses, metrics)
 
             (server_state, client_states, _), (losses, metrics) = jax.lax.scan(
-                body, (server_state, client_states, start_round), (idx, em, sm)
+                body, (server_state, client_states, start_round),
+                (idx, em, sm, masks),
             )
             return server_state, client_states, losses, metrics
 
@@ -400,18 +401,33 @@ class FederatedSimulation:
     def fit_chunk(self, start_round: int, k: int, mask=None):
         """Run rounds [start_round, start_round+k) in one compiled dispatch.
         Returns per-round stacked (losses, metrics) dicts; updates the
-        simulation state in place. Full participation unless ``mask`` given."""
+        simulation state in place.
+
+        Participation matches ``fit``: each round's mask is drawn from the
+        same PRNG stream (fold_in(rng, 2000+round)) via the client manager.
+        Pass ``mask`` ([clients] or [k, clients]) to pin it instead."""
         chunked = self.make_chunked_fit()
         plans = [self._round_plan(start_round + i) for i in range(k)]
         idx = jnp.asarray(np.stack([p[0] for p in plans]))
         em = jnp.asarray(np.stack([p[1] for p in plans]))
         sm = jnp.asarray(np.stack([p[2] for p in plans]))
         if mask is None:
-            mask = self.client_manager.sample_all()
+            masks = jnp.stack([
+                self.client_manager.sample(
+                    jax.random.fold_in(self.rng, 2000 + start_round + i),
+                    start_round + i,
+                )
+                for i in range(k)
+            ])
+        else:
+            mask = jnp.asarray(mask)
+            masks = mask if mask.ndim == 2 else jnp.broadcast_to(
+                mask, (k,) + mask.shape
+            )
         val_batches, _ = self._val_batches()
         self.server_state, self.client_states, losses, metrics = chunked(
             self.server_state, self.client_states,
-            self._x_train_stack, self._y_train_stack, idx, em, sm, mask,
+            self._x_train_stack, self._y_train_stack, idx, em, sm, masks,
             jnp.asarray(start_round, jnp.int32), val_batches,
         )
         return losses, metrics

@@ -97,10 +97,22 @@ class TestDice:
     def test_binary_soft_dice_closed_form(self):
         preds = np.asarray([[1.0, 0.0], [0.5, 0.5]], np.float32)
         tgt = np.asarray([[1.0, 0.0], [1.0, 0.0]], np.float32)
-        # per-example dice: 2*1/(1+1)=1 ; 2*0.5/(1+1)=0.5 -> handled per impl;
-        # just pin against an independently computed value
+        # dataset-level soft dice (ratio of sums):
+        # inter = 1 + 0.5 = 1.5 ; denom = (1+0.5+0.5) + (1+1) = 4
+        # -> 2*1.5/4 = 0.75. (This case agrees with mean-of-per-example by
+        # construction; the asymmetric case below separates the reductions.)
         got = _run(efficient.binary_soft_dice(), preds, tgt)
-        assert 0.0 < got <= 1.0
+        np.testing.assert_allclose(got, 0.75, atol=1e-5)
+        # asymmetric masses: ratio-of-sums != mean-of-per-example
+        preds2 = np.asarray([[1.0, 1.0, 1.0, 1.0], [0.5, 0.0, 0.0, 0.0]],
+                            np.float32)
+        tgt2 = np.asarray([[1.0, 1.0, 1.0, 1.0], [1.0, 0.0, 0.0, 0.0]],
+                          np.float32)
+        # inter = 4 + 0.5 ; denom = (4 + 0.5) + (4 + 1) = 9.5 -> 9/9.5
+        got2 = _run(efficient.binary_soft_dice(), preds2, tgt2)
+        np.testing.assert_allclose(got2, 9.0 / 9.5, atol=1e-5)
+        # mean-of-per-example would be (1.0 + 2*0.5/1.5)/2 = 0.8333 != 9/9.5
+        assert abs(got2 - (1.0 + 2 * 0.5 / 1.5) / 2) > 1e-3
         # perfect prediction -> exactly 1 (up to epsilon)
         perfect = _run(efficient.binary_soft_dice(), tgt, tgt)
         np.testing.assert_allclose(perfect, 1.0, atol=1e-5)

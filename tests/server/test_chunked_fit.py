@@ -103,3 +103,33 @@ def test_chunked_then_fit_continues():
     sim.fit_chunk(start_round=1, k=2)
     hist = sim.fit(2)
     assert np.isfinite(hist[-1].eval_losses["checkpoint"])
+
+
+def test_chunked_partial_participation_matches_per_round():
+    # per-round masks inside the scan must equal fit()'s PRNG stream
+    from fl4health_tpu.server.client_manager import FixedFractionManager
+
+    def make():
+        sim = _sim()
+        sim.client_manager = FixedFractionManager(sim.n_clients, 0.5)
+        return sim
+
+    rounds = 3
+    a, b = make(), make()
+    # manual per-round loop drawing the same masks fit()/fit_chunk use
+    val_batches, _ = a._val_batches()
+    for r in range(1, rounds + 1):
+        mask = a.client_manager.sample(
+            jax.random.fold_in(a.rng, 2000 + r), r
+        )
+        batches = a._round_batches(r)
+        (a.server_state, a.client_states, _, _, _) = a._fit_round(
+            a.server_state, a.client_states, batches, mask,
+            jnp.asarray(r, jnp.int32), val_batches,
+        )
+    b.fit_chunk(start_round=1, k=rounds)
+    np.testing.assert_allclose(
+        _flat(a.strategy.global_params(a.server_state)),
+        _flat(b.strategy.global_params(b.server_state)),
+        atol=1e-6,
+    )
