@@ -67,3 +67,29 @@ def sim_builder(plans, n_in, n_heads):
 server = NnunetServer(config=dict(cfg), property_providers=providers,
                       sim_builder=sim_builder)
 lib.run_and_report(server, cfg)
+
+# Full-volume prediction with the trained global model: sliding-window
+# tiling + Gaussian blending (nnunetv2's predict_sliding_window role).
+import json
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.nnunet import normalize_volume, sliding_window_predict
+from fl4health_tpu.nnunet.plans import default_configuration
+
+sim = server.sim
+vol, seg = client_data[0][0][0], client_data[0][1][0]
+config = server.plans["configurations"][default_configuration(server.plans)]
+props = server.plans["foreground_intensity_properties_per_channel"]
+model_state = jax.tree_util.tree_map(lambda x: x[0], sim.client_states.model_state)
+logits = sliding_window_predict(
+    sim.logic.model.apply, sim.global_params,
+    model_state,
+    jnp.asarray(normalize_volume(vol, props)),
+    patch_size=config["patch_size"],
+)
+pred = jnp.argmax(logits, -1)
+inter = float(jnp.sum((pred == 1) & (jnp.asarray(seg) == 1)))
+denom = float(jnp.sum(pred == 1) + jnp.sum(jnp.asarray(seg) == 1))
+print(json.dumps({"sliding_window_dice": round(2 * inter / max(denom, 1), 4)}))

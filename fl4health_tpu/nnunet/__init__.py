@@ -11,6 +11,10 @@ supervision (models/unet.py), masked multi-scale Dice+CE
 """
 
 from fl4health_tpu.nnunet.data import extract_patch_dataset, normalize_volume
+from fl4health_tpu.nnunet.inference import (
+    gaussian_importance_map,
+    sliding_window_predict,
+)
 from fl4health_tpu.nnunet.plans import (
     default_configuration,
     extract_fingerprint,
@@ -33,4 +37,6 @@ __all__ = [
     "poly_lr_schedule",
     "extract_patch_dataset",
     "normalize_volume",
+    "gaussian_importance_map",
+    "sliding_window_predict",
 ]

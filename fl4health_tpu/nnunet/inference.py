@@ -1,0 +1,139 @@
+"""Sliding-window inference — nnU-Net's full-volume prediction path.
+
+Parity surface: nnU-Net predicts whole volumes by tiling them with patches
+at ``tile_step_size`` overlap and blending the patch logits under a Gaussian
+importance map (nnunetv2's ``predict_sliding_window_return_logits``, used by
+the reference through ``NnunetClient``'s trainer; the patch pipeline in
+``nnunet/data.py`` covers training, this module covers prediction).
+
+TPU-native design: window positions are static (volume and patch shapes are
+concrete at trace time), so the tiling unrolls inside one jit — each window
+is a batched model apply and a ``dynamic_update_slice`` accumulation onto
+logit/weight canvases; no host round-trips per window. The Gaussian map
+(sigma = patch/8, nnU-Net's constant) downweights window borders so
+overlapping predictions blend smoothly instead of seaming.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _window_starts(size: int, patch: int, step_fraction: float) -> list[int]:
+    """nnU-Net-style start positions: stride = patch * step_fraction,
+    final window clamped flush to the far edge so coverage is exact."""
+    if size <= patch:
+        return [0]
+    step = max(int(round(patch * step_fraction)), 1)
+    starts = list(range(0, size - patch, step))
+    starts.append(size - patch)
+    return sorted(set(starts))
+
+
+def gaussian_importance_map(patch_size: Sequence[int],
+                            sigma_scale: float = 1.0 / 8.0) -> np.ndarray:
+    """Separable Gaussian centered in the patch (nnunetv2's importance map):
+    border predictions contribute less than center ones."""
+    axes = []
+    for p in patch_size:
+        coords = np.arange(p, dtype=np.float64) - (p - 1) / 2.0
+        sigma = max(p * sigma_scale, 1e-8)
+        axes.append(np.exp(-0.5 * (coords / sigma) ** 2))
+    out = np.ones((), np.float64)
+    for a in axes:
+        out = np.multiply.outer(out, a)
+    out = out / out.max()
+    # nnU-Net clamps zeros so fully-covered-by-one-window voxels still divide
+    out[out == 0] = np.min(out[out > 0])
+    return out.astype(np.float32)
+
+
+def sliding_window_predict(
+    apply_fn: Callable[..., Any],
+    params,
+    model_state,
+    volume: jax.Array,
+    patch_size: Sequence[int],
+    step_fraction: float = 0.5,
+    gaussian: bool = True,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Full-volume logits [*spatial, n_classes] from patch-wise application.
+
+    apply_fn: the ModelDef.apply ((params, model_state, x[B,*patch,C], ...)
+    -> ((preds, feats), state)) — the engine's forward contract; volume:
+    [*spatial, C]. Spatial dims smaller than the patch are zero-padded and
+    cropped back.
+    """
+    patch_size = tuple(int(p) for p in patch_size)
+    spatial = volume.shape[:-1]
+    assert len(spatial) == len(patch_size), (
+        f"volume spatial rank {len(spatial)} != patch rank {len(patch_size)}"
+    )
+    # pad up to patch size where the volume is smaller
+    pad = [(0, max(p - s, 0)) for s, p in zip(spatial, patch_size)]
+    padded = jnp.pad(volume, pad + [(0, 0)])
+    pspatial = padded.shape[:-1]
+
+    weight = (
+        jnp.asarray(gaussian_importance_map(patch_size))
+        if gaussian else jnp.ones(patch_size, jnp.float32)
+    )
+
+    starts = [
+        _window_starts(s, p, step_fraction) for s, p in zip(pspatial, patch_size)
+    ]
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    # One compiled program per (apply_fn, geometry): the unrolled multi-window
+    # graph is expensive to trace, and a per-call closure would defeat the jit
+    # cache — a 50-volume test set must compile once, not 50 times.
+    cache_key = (apply_fn, pspatial, patch_size, step_fraction, bool(gaussian))
+    cached = _COMPILED_PREDICTORS.get(cache_key)
+    if cached is not None:
+        out = cached(params, model_state, padded, rng)
+        crop = tuple(slice(0, s) for s in spatial)
+        return out[crop]
+
+    def predict_all(params, model_state, padded, rng):
+        logits = None
+        norm = jnp.zeros(pspatial + (1,), jnp.float32)
+        for corner in itertools.product(*starts):
+            patch = jax.lax.dynamic_slice(
+                padded, corner + (0,), patch_size + (padded.shape[-1],)
+            )
+            (preds, _), _ = apply_fn(
+                params, model_state, patch[None], train=False, rng=rng
+            )
+            contrib = preds["prediction"][0].astype(jnp.float32) * weight[..., None]
+            if logits is None:  # canvas shape known after the first forward
+                logits = jnp.zeros(pspatial + (contrib.shape[-1],), jnp.float32)
+            logits = jax.lax.dynamic_update_slice(
+                logits,
+                jax.lax.dynamic_slice(logits, corner + (0,),
+                                      contrib.shape) + contrib,
+                corner + (0,),
+            )
+            norm = jax.lax.dynamic_update_slice(
+                norm,
+                jax.lax.dynamic_slice(norm, corner + (0,),
+                                      patch_size + (1,)) + weight[..., None],
+                corner + (0,),
+            )
+        return logits / jnp.maximum(norm, 1e-8)
+
+    compiled = jax.jit(predict_all)
+    _COMPILED_PREDICTORS[cache_key] = compiled
+    out = compiled(params, model_state, padded, rng)
+    # crop padding back off
+    crop = tuple(slice(0, s) for s in spatial)
+    return out[crop]
+
+
+_COMPILED_PREDICTORS: dict = {}

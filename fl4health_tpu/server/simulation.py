@@ -349,9 +349,10 @@ class FederatedSimulation:
         """Compile a multi-round scan: ONE dispatch executes k federated
         rounds entirely on device, gathering each round's batches inside the
         scan from the resident data stacks. Each round's math is exactly
-        ``_fit_round``'s on the same host index plans — under FULL
-        participation (or any constant mask) the trajectory matches the
-        per-round path bit-for-bit (tests/server/test_chunked_fit.py).
+        ``_fit_round``'s on the same host index plans and the same per-round
+        participation masks, so the trajectory matches the per-round path
+        bit-for-bit — including sampled partial participation
+        (tests/server/test_chunked_fit.py).
 
         NOT a drop-in for ``fit`` beyond that: the per-round failure-policy
         check / checkpointing / reporting — host-sync work — do not run
