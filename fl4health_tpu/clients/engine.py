@@ -634,7 +634,7 @@ def multi_client_index_plans(
     return idx_all, em_all, sm_all
 
 
-def pad_and_stack_data(arrays: list[jax.Array]) -> jax.Array:
+def pad_and_stack_data(arrays: list[jax.Array], name: str = "data") -> jax.Array:
     """Zero-pad along axis 0 to the max length and stack -> [C, max_n, ...].
 
     Setup-time only; padding rows are never selected by a valid index plan.
@@ -643,8 +643,28 @@ def pad_and_stack_data(arrays: list[jax.Array]) -> jax.Array:
     Pass numpy arrays in ClientDataset to avoid any device round-trip.
     """
     host = [np.asarray(a) for a in arrays]
+    # The cohort shares one compiled program: every client's example shape
+    # and dtype must agree. Name the offending client and array instead of
+    # letting numpy's broadcast error (or a silent cast — float labels
+    # truncated into an int slot) surface from deep inside setup.
+    base = host[0].shape[1:]
+    for i, a in enumerate(host):
+        if a.shape[1:] != base:
+            raise ValueError(
+                f"client {i}'s {name} has per-example shape {a.shape[1:]} "
+                f"but client 0 has {base}; all clients in a cohort must "
+                "share one example shape (align features before building "
+                "the simulation — e.g. the tabular feature-alignment "
+                "protocol)."
+            )
+        if a.dtype != host[0].dtype:
+            raise ValueError(
+                f"client {i}'s {name} has dtype {a.dtype} but client 0 has "
+                f"{host[0].dtype}; stacking would silently cast — convert "
+                "the clients' data to one dtype first."
+            )
     max_n = max(a.shape[0] for a in host)
-    stack = np.zeros((len(host), max_n, *host[0].shape[1:]), host[0].dtype)
+    stack = np.zeros((len(host), max_n, *base), host[0].dtype)
     for i, a in enumerate(host):
         stack[i, : a.shape[0]] = a
     return jnp.asarray(stack)

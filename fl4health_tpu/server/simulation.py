@@ -177,10 +177,10 @@ class FederatedSimulation:
 
         # Pre-stacked per-client data (one-time, device-resident) feeding the
         # per-round single-gather batch construction (engine.gather_batches).
-        self._x_train_stack = engine.pad_and_stack_data([d.x_train for d in self.datasets])
-        self._y_train_stack = engine.pad_and_stack_data([d.y_train for d in self.datasets])
-        self._x_val_stack = engine.pad_and_stack_data([d.x_val for d in self.datasets])
-        self._y_val_stack = engine.pad_and_stack_data([d.y_val for d in self.datasets])
+        self._x_train_stack = engine.pad_and_stack_data([d.x_train for d in self.datasets], "x_train")
+        self._y_train_stack = engine.pad_and_stack_data([d.y_train for d in self.datasets], "y_train")
+        self._x_val_stack = engine.pad_and_stack_data([d.x_val for d in self.datasets], "x_val")
+        self._y_val_stack = engine.pad_and_stack_data([d.y_val for d in self.datasets], "y_val")
         self._base_entropy = engine._entropy_from_key(self.rng)
         self._val_cache: tuple[Batch, jax.Array] | None = None
 
