@@ -175,6 +175,20 @@ class FederatedSimulation:
         )
         self.history: list[RoundRecord] = []
 
+        # x/y row counts must agree within each client and split: n_train is
+        # derived from x, so a short y would silently pair tail examples with
+        # zero-padded labels after stacking.
+        for i, d in enumerate(self.datasets):
+            for xs, ys, split in ((d.x_train, d.y_train, "train"),
+                                  (d.x_val, d.y_val, "val")):
+                if np.asarray(xs).shape[0] != np.asarray(ys).shape[0]:
+                    raise ValueError(
+                        f"client {i}: x_{split} has "
+                        f"{np.asarray(xs).shape[0]} rows but y_{split} has "
+                        f"{np.asarray(ys).shape[0]}; each client's features and "
+                        "labels must pair one-to-one."
+                    )
+
         # Pre-stacked per-client data (one-time, device-resident) feeding the
         # per-round single-gather batch construction (engine.gather_batches).
         self._x_train_stack = engine.pad_and_stack_data([d.x_train for d in self.datasets], "x_train")

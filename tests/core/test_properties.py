@@ -1,0 +1,129 @@
+"""Property-based invariants (hypothesis) for the wire codec, the
+aggregation kernel, and the batch index plans — contracts that unit cases
+alone under-sample."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from fl4health_tpu.clients.engine import epoch_index_plan
+from fl4health_tpu.core.aggregate import aggregate, effective_weights
+from fl4health_tpu.transport.codec import decode, encode
+
+SETTINGS = dict(max_examples=25, deadline=None)
+
+# -- codec ------------------------------------------------------------------
+
+_dtypes = st.sampled_from([np.float32, np.float64, np.int32, np.int64, np.uint8])
+
+
+@st.composite
+def pytrees(draw):
+    """Nested dict pytrees with 1-6 array leaves of assorted shapes/dtypes."""
+    n_leaves = draw(st.integers(1, 6))
+    tree = {}
+    for i in range(n_leaves):
+        depth = draw(st.integers(0, 2))
+        shape = tuple(draw(st.lists(st.integers(1, 5), min_size=0, max_size=3)))
+        dtype = draw(_dtypes)
+        if np.issubdtype(dtype, np.floating):
+            arr = draw(st.integers(-1000, 1000)) * np.ones(shape, dtype) * 0.37
+        else:
+            arr = (draw(st.integers(-100, 100)) * np.ones(shape, np.int64)).astype(dtype)
+        node = tree
+        for d in range(depth):
+            node = node.setdefault(f"level{d}", {})
+        node[f"leaf{i}"] = arr
+    return tree
+
+
+@given(tree=pytrees())
+@settings(**SETTINGS)
+def test_codec_roundtrip_identity(tree):
+    out = decode(encode(tree))
+    flat_a, def_a = jax.tree_util.tree_flatten_with_path(tree)
+    flat_b, def_b = jax.tree_util.tree_flatten_with_path(out)
+    assert def_a == def_b
+    for (pa, va), (pb, vb) in zip(flat_a, flat_b):
+        assert pa == pb
+        assert np.asarray(va).dtype == np.asarray(vb).dtype
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+@given(tree=pytrees())
+@settings(**SETTINGS)
+def test_codec_roundtrip_with_template(tree):
+    out = decode(encode(tree), like=tree)
+    to64 = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: np.asarray(x, np.float64), t
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.flatten_util.ravel_pytree(to64(out))[0]),
+        np.asarray(jax.flatten_util.ravel_pytree(to64(tree))[0]),
+    )
+
+
+# -- aggregation ------------------------------------------------------------
+
+@given(
+    values=st.lists(st.floats(-100, 100), min_size=2, max_size=8),
+    counts=st.lists(st.integers(1, 50), min_size=2, max_size=8),
+    mask_bits=st.lists(st.booleans(), min_size=2, max_size=8),
+    weighted=st.booleans(),
+)
+@settings(**SETTINGS)
+def test_aggregate_is_convex_combination(values, counts, mask_bits, weighted):
+    n = min(len(values), len(counts), len(mask_bits))
+    v = jnp.asarray(values[:n], jnp.float32)[:, None]
+    c = jnp.asarray(counts[:n], jnp.float32)
+    m = jnp.asarray([1.0 if b else 0.0 for b in mask_bits[:n]])
+    w = effective_weights(c, m, weighted)
+    # weights: nonnegative, sum to 1 (or all-zero for an empty cohort)
+    assert float(jnp.min(w)) >= 0.0
+    total = float(jnp.sum(w))
+    if float(jnp.sum(m)) > 0:
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+        agg = aggregate({"x": v}, c, m, weighted)["x"]
+        kept = [values[i] for i in range(n) if mask_bits[i]]
+        assert min(kept) - 1e-3 <= float(agg[0]) <= max(kept) + 1e-3
+        # masked-out clients must not influence the result
+        v_poisoned = jnp.where(m[:, None] > 0, v, 1e9)
+        agg2 = aggregate({"x": v_poisoned}, c, m, weighted)["x"]
+        np.testing.assert_allclose(float(agg2[0]), float(agg[0]), rtol=1e-4)
+    else:
+        assert total == 0.0
+
+
+# -- index plans ------------------------------------------------------------
+
+@given(
+    n=st.integers(1, 40),
+    batch_size=st.integers(1, 16),
+    seed=st.integers(0, 10_000),
+)
+@settings(**SETTINGS)
+def test_epoch_plan_covers_each_example_once(n, batch_size, seed):
+    idx, em, sm = epoch_index_plan([seed], n, batch_size)
+    # every step is real in a plain epoch plan
+    assert np.all(sm == 1.0)
+    valid = idx[em > 0]
+    # exactly one visit per example, indices in range
+    assert sorted(valid.tolist()) == list(range(n))
+    # masked slots (ragged final batch) don't index out of range
+    assert idx.min() >= 0 and idx.max() < n
+
+
+@given(
+    n=st.integers(2, 30),
+    batch_size=st.integers(1, 8),
+    n_steps=st.integers(1, 20),
+    seed=st.integers(0, 10_000),
+)
+@settings(**SETTINGS)
+def test_step_plan_has_exact_step_count_and_valid_indices(n, batch_size, n_steps, seed):
+    idx, em, sm = epoch_index_plan([seed], n, batch_size, n_steps=n_steps)
+    assert idx.shape[0] == n_steps
+    assert np.all((idx >= 0) & (idx < n))
+    # each step has at least one valid example
+    assert np.all(em[sm > 0].sum(axis=-1) >= 1)

@@ -94,7 +94,6 @@ def test_mismatched_client_shapes_raise_clear_error():
     import pytest
 
     from fl4health_tpu.models.cnn import Mlp
-    from fl4health_tpu.strategies.fedavg import FedAvg
 
     x1, y1 = synthetic_classification(jax.random.PRNGKey(0), 24, (6,), 3)
     x2, y2 = synthetic_classification(jax.random.PRNGKey(1), 24, (8,), 3)
@@ -108,6 +107,28 @@ def test_mismatched_client_shapes_raise_clear_error():
             strategy=FedAvg(),
             datasets=[ClientDataset(x1[:16], y1[:16], x1[16:], y1[16:]),
                       ClientDataset(x2[:16], y2[:16], x2[16:], y2[16:])],
+            batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_epochs=1,
+            seed=0,
+        )
+
+
+def test_mismatched_xy_rows_raise_clear_error():
+    import pytest
+
+    from fl4health_tpu.models.cnn import Mlp
+
+    x, y = synthetic_classification(jax.random.PRNGKey(0), 24, (6,), 3)
+    with pytest.raises(ValueError, match="client 0: x_train has 16 rows but y_train has 12"):
+        FederatedSimulation(
+            logic=engine.ClientLogic(
+                engine.from_flax(Mlp(features=(8,), n_outputs=3)),
+                engine.masked_cross_entropy,
+            ),
+            tx=optax.sgd(0.05),
+            strategy=FedAvg(),
+            datasets=[ClientDataset(x[:16], y[:12], x[16:], y[16:])],
             batch_size=8,
             metrics=MetricManager((efficient.accuracy(),)),
             local_epochs=1,
