@@ -181,12 +181,13 @@ class FederatedSimulation:
         for i, d in enumerate(self.datasets):
             for xs, ys, split in ((d.x_train, d.y_train, "train"),
                                   (d.x_val, d.y_val, "val")):
-                if np.asarray(xs).shape[0] != np.asarray(ys).shape[0]:
+                # .shape is metadata — no device->host copy of the data
+                nx, ny = xs.shape[0], ys.shape[0]
+                if nx != ny:
                     raise ValueError(
-                        f"client {i}: x_{split} has "
-                        f"{np.asarray(xs).shape[0]} rows but y_{split} has "
-                        f"{np.asarray(ys).shape[0]}; each client's features and "
-                        "labels must pair one-to-one."
+                        f"client {i}: x_{split} has {nx} rows but y_{split} "
+                        f"has {ny}; each client's features and labels must "
+                        "pair one-to-one."
                     )
 
         # Pre-stacked per-client data (one-time, device-resident) feeding the
