@@ -25,15 +25,20 @@ def load_config(path: str) -> dict:
     return cfg
 
 
+def _positive_int(val: Any) -> bool:
+    # bool is an int subclass; `n_server_rounds: true` must not validate
+    return isinstance(val, int) and not isinstance(val, bool) and val > 0
+
+
 def check_config(config: Mapping[str, Any]) -> None:
     """Required keys + type/positivity checks (utils/config.py:29)."""
     if "n_server_rounds" not in config:
         raise InvalidConfigError("config missing required key n_server_rounds")
-    if not isinstance(config["n_server_rounds"], int) or config["n_server_rounds"] <= 0:
+    if not _positive_int(config["n_server_rounds"]):
         raise InvalidConfigError("n_server_rounds must be a positive integer")
     for key in ("local_epochs", "local_steps", "batch_size"):
         if key in config and config[key] is not None:
-            if not isinstance(config[key], int) or config[key] <= 0:
+            if not _positive_int(config[key]):
                 raise InvalidConfigError(f"{key} must be a positive integer")
 
 

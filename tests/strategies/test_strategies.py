@@ -229,3 +229,20 @@ def test_model_merge_uniform():
                         counts=jnp.asarray([10.0, 1.0])), 1
     )
     np.testing.assert_allclose(float(new.params["w"][0]), 2.0, rtol=1e-6)
+
+
+def test_fed_yogi_and_adagrad_aggregate_finitely_and_learn_direction():
+    # FedOpt family parity rows (reference README: FedAdam/FedYogi/FedAdaGrad
+    # via Flower): the yogi/adagrad server optimizers must consume the
+    # aggregated delta and move params toward the client average.
+    from fl4health_tpu.strategies.fedopt import fed_adagrad, fed_yogi
+
+    for make in (fed_yogi, fed_adagrad):
+        strat = make(lr=0.1)
+        state = strat.init({"w": jnp.zeros((2,))})
+        packets = {"w": jnp.asarray([[1.0, -1.0], [1.0, -1.0]])}
+        for r in range(1, 4):
+            state = strat.aggregate(state, _results(packets), r)
+        w = np.asarray(strat.global_params(state)["w"])
+        assert np.all(np.isfinite(w))
+        assert w[0] > 0 and w[1] < 0, f"{make.__name__} moved wrong way: {w}"

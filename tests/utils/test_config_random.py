@@ -1,0 +1,73 @@
+"""Config validation + reproducibility helpers (reference:
+tests/utils/test_config.py + utils/random.py round-trip semantics)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from fl4health_tpu.utils.config import (
+    InvalidConfigError,
+    check_config,
+    epochs_steps_from_config,
+    load_config,
+    narrow_dict_type,
+)
+from fl4health_tpu.utils.random import (
+    restore_random_state,
+    save_random_state,
+    set_all_random_seeds,
+)
+
+
+class TestConfig:
+    def test_load_config_valid(self, tmp_path):
+        p = tmp_path / "c.yaml"
+        p.write_text("n_server_rounds: 3\nbatch_size: 8\nlocal_epochs: 1\n")
+        cfg = load_config(str(p))
+        assert cfg["n_server_rounds"] == 3
+
+    def test_missing_rounds_raises(self):
+        with pytest.raises(InvalidConfigError, match="n_server_rounds"):
+            check_config({"batch_size": 8})
+
+    @pytest.mark.parametrize("bad", [0, -1, 2.5, "3", True])
+    def test_non_positive_or_non_int_rounds_raise(self, bad):
+        with pytest.raises(InvalidConfigError):
+            check_config({"n_server_rounds": bad})
+
+    def test_positive_int_checks_on_optional_keys(self):
+        with pytest.raises(InvalidConfigError, match="batch_size"):
+            check_config({"n_server_rounds": 1, "batch_size": 0})
+
+    def test_narrow_dict_type(self):
+        assert narrow_dict_type({"a": 3}, "a", int) == 3
+        with pytest.raises(InvalidConfigError, match="should be int"):
+            narrow_dict_type({"a": "x"}, "a", int)
+        with pytest.raises(InvalidConfigError, match="missing key"):
+            narrow_dict_type({}, "a", int)
+
+    def test_epochs_xor_steps(self):
+        assert epochs_steps_from_config(
+            {"n_server_rounds": 1, "local_epochs": 2}) == (2, None)
+        with pytest.raises(InvalidConfigError):
+            epochs_steps_from_config({"local_epochs": 1, "local_steps": 5})
+        with pytest.raises(InvalidConfigError):
+            epochs_steps_from_config({})
+
+
+class TestRandom:
+    def test_set_all_random_seeds_is_deterministic(self):
+        key1 = set_all_random_seeds(7)
+        draws1 = (random.random(), np.random.rand(), np.asarray(key1).tolist())
+        key2 = set_all_random_seeds(7)
+        draws2 = (random.random(), np.random.rand(), np.asarray(key2).tolist())
+        assert draws1 == draws2
+
+    def test_save_restore_round_trips(self):
+        set_all_random_seeds(3)
+        state = save_random_state()
+        a = (random.random(), np.random.rand())
+        restore_random_state(state)
+        b = (random.random(), np.random.rand())
+        assert a == b
