@@ -32,6 +32,12 @@ def _positive_int(val: Any) -> bool:
 
 def check_config(config: Mapping[str, Any]) -> None:
     """Required keys + type/positivity checks (utils/config.py:29)."""
+    if not isinstance(config, Mapping):
+        # yaml.safe_load of an empty file returns None; report it as the
+        # config error it is, not a TypeError from the `in` below
+        raise InvalidConfigError(
+            f"config must be a mapping, got {type(config).__name__}"
+        )
     if "n_server_rounds" not in config:
         raise InvalidConfigError("config missing required key n_server_rounds")
     if not _positive_int(config["n_server_rounds"]):

@@ -71,3 +71,10 @@ class TestRandom:
         restore_random_state(state)
         b = (random.random(), np.random.rand())
         assert a == b
+
+
+def test_empty_yaml_reports_config_error(tmp_path):
+    p = tmp_path / "empty.yaml"
+    p.write_text("")
+    with pytest.raises(InvalidConfigError, match="mapping"):
+        load_config(str(p))
