@@ -178,9 +178,21 @@ class FederatedSimulation:
         # x/y row counts must agree within each client and split: n_train is
         # derived from x, so a short y would silently pair tail examples with
         # zero-padded labels after stacking.
+        have_test = [d.x_test is not None for d in self.datasets]
+        if any(have_test) and not all(have_test):
+            missing = [i for i, h in enumerate(have_test) if not h]
+            raise ValueError(
+                f"clients {missing} have no test split while others do; "
+                "provide x_test/y_test for every client or none."
+            )
+        self._has_test_split = all(have_test) and len(have_test) > 0
         for i, d in enumerate(self.datasets):
-            for xs, ys, split in ((d.x_train, d.y_train, "train"),
-                                  (d.x_val, d.y_val, "val")):
+            splits = [(d.x_train, d.y_train, "train"), (d.x_val, d.y_val, "val")]
+            if self._has_test_split:
+                if d.y_test is None:
+                    raise ValueError(f"client {i}: x_test set but y_test is None")
+                splits.append((d.x_test, d.y_test, "test"))
+            for xs, ys, split in splits:
                 # .shape is metadata — no device->host copy of the data
                 nx, ny = xs.shape[0], ys.shape[0]
                 if nx != ny:
@@ -198,6 +210,7 @@ class FederatedSimulation:
         self._y_val_stack = engine.pad_and_stack_data([d.y_val for d in self.datasets], "y_val")
         self._base_entropy = engine._entropy_from_key(self.rng)
         self._val_cache: tuple[Batch, jax.Array] | None = None
+        self._test_cache: tuple[Batch, jax.Array] | None = None
 
         # --- init client + server state -----------------------------------
         init_rng = jax.random.fold_in(self.rng, 0)
@@ -448,18 +461,41 @@ class FederatedSimulation:
         )
         return losses, metrics
 
+    def _eval_split_batches(self, x_stack, y_stack, ns) -> tuple[Batch, jax.Array]:
+        """Shared val/test eval batching: fixed-order full pass + counts —
+        one implementation so both splits always score under the same rules."""
+        idx, em, sm = engine.multi_client_index_plans(
+            [[0]] * self.n_clients, ns, self.batch_size, shuffle=False
+        )
+        batches = engine.gather_batches(x_stack, y_stack, idx, em, sm)
+        return batches, jnp.asarray(ns, jnp.float32)
+
     def _val_batches(self) -> tuple[Batch, jax.Array]:
         if self._val_cache is None:
-            ns = [d.x_val.shape[0] for d in self.datasets]
-            idx, em, sm = engine.multi_client_index_plans(
-                [[0]] * self.n_clients, ns, self.batch_size, shuffle=False
+            self._val_cache = self._eval_split_batches(
+                self._x_val_stack, self._y_val_stack,
+                [d.x_val.shape[0] for d in self.datasets],
             )
-            batches = engine.gather_batches(
-                self._x_val_stack, self._y_val_stack, idx, em, sm
-            )
-            counts = jnp.asarray(ns, jnp.float32)
-            self._val_cache = (batches, counts)
         return self._val_cache
+
+    def _test_batches(self) -> tuple[Batch, jax.Array] | None:
+        """Separate test split (basic_client.py:867 test loader; metrics ride
+        with eval under a "test - " prefix, base_server.py:545
+        _unpack_metrics). Present only when EVERY client provides one
+        (validated in __init__)."""
+        if not self._has_test_split:
+            return None
+        if self._test_cache is None:
+            x_stack = engine.pad_and_stack_data(
+                [d.x_test for d in self.datasets], "x_test"
+            )
+            y_stack = engine.pad_and_stack_data(
+                [d.y_test for d in self.datasets], "y_test"
+            )
+            self._test_cache = self._eval_split_batches(
+                x_stack, y_stack, [d.x_test.shape[0] for d in self.datasets]
+            )
+        return self._test_cache
 
     # ------------------------------------------------------------------
     def fit(self, n_rounds: int) -> list[RoundRecord]:
@@ -520,6 +556,21 @@ class FederatedSimulation:
             )
             eval_losses = {k: float(v) for k, v in jax.device_get(eval_losses).items()}
             eval_metrics = {k: float(v) for k, v in jax.device_get(eval_metrics).items()}
+            test = self._test_batches()
+            if test is not None:
+                # Separate test loader: same aggregated model, "test - "
+                # prefixed keys alongside the val metrics (base_server.py:545).
+                _, test_losses, test_metrics, _, _ = self._eval_round(
+                    self.server_state, self.client_states, test[0], test[1]
+                )
+                eval_losses.update({
+                    f"test - {k}": float(v)
+                    for k, v in jax.device_get(test_losses).items()
+                })
+                eval_metrics.update({
+                    f"test - {k}": float(v)
+                    for k, v in jax.device_get(test_metrics).items()
+                })
             for mode, ckpt in self.model_checkpointers:
                 if mode == CheckpointMode.POST_AGGREGATION:
                     ckpt.maybe_checkpoint(

@@ -134,3 +134,60 @@ def test_mismatched_xy_rows_raise_clear_error():
             local_epochs=1,
             seed=0,
         )
+
+
+def test_separate_test_split_reports_prefixed_metrics():
+    # reference: BasicClient's separate test loader; metrics ride with eval
+    # under "test - " keys (base_server.py:545 _unpack_metrics)
+    from fl4health_tpu.models.cnn import Mlp
+
+    x, y = synthetic_classification(jax.random.PRNGKey(3), 60, (6,), 3)
+    ds = [ClientDataset(x[:32], y[:32], x[32:48], y[32:48],
+                        x_test=x[48:], y_test=y[48:])
+          for _ in range(2)]
+    sim = FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(8,), n_outputs=3)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=ds,
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1,
+        seed=1,
+    )
+    hist = sim.fit(2)
+    rec = hist[-1]
+    assert "test - accuracy" in rec.eval_metrics
+    assert "test - checkpoint" in rec.eval_losses
+    assert np.isfinite(rec.eval_metrics["test - accuracy"])
+    # plain val metrics still present and unprefixed
+    assert "accuracy" in rec.eval_metrics
+
+
+def test_mixed_test_split_presence_raises():
+    import pytest
+
+    x, y = synthetic_classification(jax.random.PRNGKey(4), 48, (6,), 3)
+    from fl4health_tpu.models.cnn import Mlp
+
+    # validated at construction: the error must not cost a compiled round
+    with pytest.raises(ValueError, match="no test split"):
+        FederatedSimulation(
+            logic=engine.ClientLogic(
+                engine.from_flax(Mlp(features=(8,), n_outputs=3)),
+                engine.masked_cross_entropy),
+            tx=optax.sgd(0.05),
+            strategy=FedAvg(),
+            datasets=[
+                ClientDataset(x[:16], y[:16], x[16:24], y[16:24],
+                              x_test=x[24:32], y_test=y[24:32]),
+                ClientDataset(x[:16], y[:16], x[16:24], y[16:24]),
+            ],
+            batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_epochs=1,
+            seed=1,
+        )
