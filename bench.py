@@ -195,8 +195,10 @@ def timed_chunked_rounds(sim) -> float:
     is amortized away."""
     import jax
 
-    # warmup dispatch compiles the scan and pages it in
-    sim.fit_chunk(start_round=1, k=TIMED_ROUNDS)
+    # warmup dispatch compiles the scan and pages it in; BLOCK on it so the
+    # timed chunk doesn't queue behind still-running async warmup work
+    warm_losses, _ = sim.fit_chunk(start_round=1, k=TIMED_ROUNDS)
+    jax.block_until_ready(warm_losses["backward"])
     t0 = time.perf_counter()
     losses, _ = sim.fit_chunk(start_round=1 + TIMED_ROUNDS, k=TIMED_ROUNDS)
     jax.block_until_ready(losses["backward"])

@@ -129,6 +129,10 @@ def sliding_window_predict(
         return logits / jnp.maximum(norm, 1e-8)
 
     compiled = jax.jit(predict_all)
+    if len(_COMPILED_PREDICTORS) >= _CACHE_LIMIT:
+        # bounded FIFO: heterogeneous volume shapes (a fresh program per
+        # padded geometry) must not grow process memory without limit
+        _COMPILED_PREDICTORS.pop(next(iter(_COMPILED_PREDICTORS)))
     _COMPILED_PREDICTORS[cache_key] = compiled
     out = compiled(params, model_state, padded, rng)
     # crop padding back off
@@ -136,4 +140,5 @@ def sliding_window_predict(
     return out[crop]
 
 
+_CACHE_LIMIT = 32
 _COMPILED_PREDICTORS: dict = {}

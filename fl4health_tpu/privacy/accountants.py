@@ -138,25 +138,50 @@ class FlInstanceLevelAccountant:
             PoissonSampling(client_sampling_rate * b / d)
             for b, d in zip(client_batch_sizes, client_dataset_sizes)
         ]
+        # Full-participation variant (no client-level subsampling): used for
+        # rounds where EVERY client is known to touch data, e.g. the
+        # DP-SCAFFOLD warm start — those rounds get no amplification from
+        # client_sampling_rate and must be composed at rate b/d.
+        self.full_sampling_per_client = [
+            PoissonSampling(b / d)
+            for b, d in zip(client_batch_sizes, client_dataset_sizes)
+        ]
         self.accountant = MomentsAccountant(moment_orders)
 
-    def _per_client(self, fn, server_updates: int, value: float) -> float:
+    def _updates_for(self, rounds: int, n_batches: int) -> int:
+        if self.steps_per_round is not None:
+            return ceil(rounds * self.steps_per_round)
+        return ceil(rounds * self.epochs_per_round * n_batches)
+
+    def _per_client(self, fn, server_updates: int, value: float,
+                    full_participation_rounds: int = 0) -> float:
         results = []
-        for n_batches, sampling in zip(
-            self.num_batches_per_client, self.sampling_per_client
+        for n_batches, sampling, full_sampling in zip(
+            self.num_batches_per_client, self.sampling_per_client,
+            self.full_sampling_per_client,
         ):
-            if self.steps_per_round is not None:
-                total = ceil(server_updates * self.steps_per_round)
+            total = self._updates_for(server_updates, n_batches)
+            if full_participation_rounds:
+                # heterogeneous trajectory: subsampled rounds + full rounds,
+                # composed additively in RDP space (MomentsAccountant lists)
+                extra = self._updates_for(full_participation_rounds, n_batches)
+                results.append(fn(
+                    [sampling, full_sampling], self.noise_multiplier,
+                    [total, extra], value,
+                ))
             else:
-                total = ceil(server_updates * self.epochs_per_round * n_batches)
-            results.append(fn(sampling, self.noise_multiplier, total, value))
+                results.append(fn(sampling, self.noise_multiplier, total, value))
         return max(results)
 
-    def get_epsilon(self, server_updates: int, delta: float) -> float:
-        return self._per_client(self.accountant.get_epsilon, server_updates, delta)
+    def get_epsilon(self, server_updates: int, delta: float,
+                    full_participation_rounds: int = 0) -> float:
+        return self._per_client(self.accountant.get_epsilon, server_updates,
+                                delta, full_participation_rounds)
 
-    def get_delta(self, server_updates: int, epsilon: float) -> float:
-        return self._per_client(self.accountant.get_delta, server_updates, epsilon)
+    def get_delta(self, server_updates: int, epsilon: float,
+                  full_participation_rounds: int = 0) -> float:
+        return self._per_client(self.accountant.get_delta, server_updates,
+                                epsilon, full_participation_rounds)
 
 
 class ClientLevelAccountant(ABC):

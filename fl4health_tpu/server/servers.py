@@ -159,11 +159,11 @@ class InstanceLevelDpServer:
         )
         return self.accountant
 
-    def fit(self, n_rounds: int, accounted_rounds: int | None = None):
-        # accounted_rounds: privacy-budget rounds when they exceed training
-        # rounds (e.g. DP-SCAFFOLD's warm-start pass also touches data).
-        accounted = accounted_rounds if accounted_rounds is not None else n_rounds
-        self.setup_accountant(accounted)
+    def fit(self, n_rounds: int, extra_full_participation_rounds: int = 0):
+        # extra_full_participation_rounds: additional privacy-budget rounds
+        # where EVERY client touches data (no client-subsampling
+        # amplification), e.g. DP-SCAFFOLD's warm-start pass.
+        self.setup_accountant(n_rounds)
         assert self.accountant is not None
         # Default delta = 1/total_samples across the federation
         # (instance_level_dp_server.py:163) — NOT 1/max(client size), which
@@ -171,9 +171,15 @@ class InstanceLevelDpServer:
         delta = self.delta if self.delta is not None else 1.0 / sum(
             poll_sample_counts(self.sim)
         )
-        epsilon = self.accountant.get_epsilon(accounted, delta)
-        logger.info("Instance-level DP run: epsilon=%.4f at delta=%.2e over %d rounds",
-                    epsilon, delta, accounted)
+        epsilon = self.accountant.get_epsilon(
+            n_rounds, delta,
+            full_participation_rounds=extra_full_participation_rounds,
+        )
+        logger.info(
+            "Instance-level DP run: epsilon=%.4f at delta=%.2e over %d rounds"
+            " (+%d full-participation)",
+            epsilon, delta, n_rounds, extra_full_participation_rounds,
+        )
         history = self.sim.fit(n_rounds)
         return history, epsilon
 
@@ -202,8 +208,11 @@ class DpScaffoldServer(InstanceLevelDpServer):
         # control variates ARE later exchanged, so it spends one round of
         # privacy budget; count it (the reference DPScaffoldServer omits it —
         # its printed epsilon understates the true spend when warm-starting).
+        # It runs with EVERY client participating, so it is composed WITHOUT
+        # the client-subsampling amplification the training rounds get.
         return super().fit(
-            n_rounds, accounted_rounds=n_rounds + 1 if self.warm_start else None
+            n_rounds,
+            extra_full_participation_rounds=1 if self.warm_start else 0,
         )
 
 

@@ -178,6 +178,11 @@ class FederatedSimulation:
         # x/y row counts must agree within each client and split: n_train is
         # derived from x, so a short y would silently pair tail examples with
         # zero-padded labels after stacking.
+        for i, d in enumerate(self.datasets):
+            if d.y_test is not None and d.x_test is None:
+                # mirror of the x-without-y case below: silently ignoring the
+                # labels would skip a test evaluation the user asked for
+                raise ValueError(f"client {i}: y_test set but x_test is None")
         have_test = [d.x_test is not None for d in self.datasets]
         if any(have_test) and not all(have_test):
             missing = [i for i, h in enumerate(have_test) if not h]

@@ -1,0 +1,35 @@
+"""Heterogeneous-round accounting: the DP-SCAFFOLD warm start participates
+fully (no client-subsampling amplification), so it must cost MORE budget
+than a subsampled round."""
+
+import pytest
+
+from fl4health_tpu.privacy.accountants import FlInstanceLevelAccountant
+
+
+def _acct(q):
+    return FlInstanceLevelAccountant(
+        client_sampling_rate=q,
+        noise_multiplier=1.0,
+        epochs_per_round=1,
+        client_batch_sizes=[16],
+        client_dataset_sizes=[160],
+    )
+
+
+def test_full_participation_round_costs_more_than_subsampled():
+    a = _acct(q=0.25)
+    base = a.get_epsilon(5, delta=1e-4)
+    with_warm = a.get_epsilon(5, delta=1e-4, full_participation_rounds=1)
+    naive = a.get_epsilon(6, delta=1e-4)  # warm round wrongly amplified by q
+    assert with_warm > base
+    assert with_warm > naive, (
+        "full-participation warm round must cost more than a q-amplified one"
+    )
+
+
+def test_full_participation_matches_plain_when_q_is_one():
+    a = _acct(q=1.0)
+    assert a.get_epsilon(5, delta=1e-4, full_participation_rounds=1) == (
+        pytest.approx(a.get_epsilon(6, delta=1e-4), rel=1e-9)
+    )

@@ -191,3 +191,25 @@ def test_mixed_test_split_presence_raises():
             local_epochs=1,
             seed=1,
         )
+
+
+def test_y_test_without_x_test_raises():
+    import pytest
+
+    from fl4health_tpu.models.cnn import Mlp
+
+    x, y = synthetic_classification(jax.random.PRNGKey(5), 40, (6,), 3)
+    with pytest.raises(ValueError, match="y_test set but x_test is None"):
+        FederatedSimulation(
+            logic=engine.ClientLogic(
+                engine.from_flax(Mlp(features=(8,), n_outputs=3)),
+                engine.masked_cross_entropy),
+            tx=optax.sgd(0.05),
+            strategy=FedAvg(),
+            datasets=[ClientDataset(x[:16], y[:16], x[16:24], y[16:24],
+                                    y_test=y[24:32])],
+            batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_epochs=1,
+            seed=0,
+        )
