@@ -1,0 +1,124 @@
+"""Direct unit tests for the algorithm losses and loss containers
+(reference: tests/losses/* — hand-computed closed forms rather than only
+end-to-end exercise through clients)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fl4health_tpu.losses.containers import LossMeter
+from fl4health_tpu.losses.contrastive import (
+    cosine_similarity_loss,
+    moon_contrastive_loss,
+    ntxent_loss,
+)
+from fl4health_tpu.losses.drift import weight_drift_loss
+from fl4health_tpu.losses.segmentation import (
+    deep_supervision_weights,
+    downsample_target,
+)
+
+
+class TestDrift:
+    def test_closed_form(self):
+        p = {"a": jnp.asarray([1.0, 2.0]), "b": jnp.asarray([[3.0]])}
+        r = {"a": jnp.asarray([0.0, 0.0]), "b": jnp.asarray([[1.0]])}
+        # ||p-r||^2 = 1 + 4 + 4 = 9; weight 0.5 -> 4.5
+        np.testing.assert_allclose(float(weight_drift_loss(p, r, 0.5)), 4.5)
+
+    def test_zero_at_reference(self):
+        p = {"a": jnp.ones((3,))}
+        assert float(weight_drift_loss(p, p, 10.0)) == 0.0
+
+
+class TestMoonContrastive:
+    def test_prefers_positive_alignment(self):
+        d = 8
+        z = jnp.eye(1, d)[0][None]  # [1, D] unit vector
+        pos_aligned = z[None]  # [1, 1, D] identical -> cos 1
+        neg_orthog = jnp.eye(2, d)[1][None][None]  # orthogonal -> cos 0
+        good = float(moon_contrastive_loss(z, pos_aligned, neg_orthog, 0.5))
+        # swap roles: positive orthogonal, negative aligned -> larger loss
+        bad = float(moon_contrastive_loss(z, neg_orthog, pos_aligned, 0.5))
+        assert good < bad
+        # closed form for the good case: -log(e^2 / (e^2 + e^0)), t=0.5
+        expected = -np.log(np.exp(2.0) / (np.exp(2.0) + 1.0))
+        np.testing.assert_allclose(good, expected, rtol=1e-5)
+
+    def test_negative_mask_excludes_slots(self):
+        d = 4
+        z = jnp.eye(1, d)
+        pos = z[None]
+        # two negatives: one aligned (harmful), one orthogonal; masking the
+        # aligned one must lower the loss to the single-orthogonal value
+        negs = jnp.stack([z, jnp.eye(2, d)[1][None]])  # [2, 1, D]
+        masked = float(moon_contrastive_loss(
+            z, pos, negs, 0.5, negative_mask=jnp.asarray([0.0, 1.0])))
+        only_orthog = float(moon_contrastive_loss(
+            z, pos, negs[1:], 0.5))
+        np.testing.assert_allclose(masked, only_orthog, rtol=1e-5)
+
+
+class TestNtXent:
+    def test_identical_views_beat_shuffled_views(self):
+        k = jax.random.PRNGKey(0)
+        z = jax.random.normal(k, (6, 16))
+        aligned = float(ntxent_loss(z, z, 0.5))
+        shuffled = float(ntxent_loss(z, jnp.roll(z, 1, axis=0), 0.5))
+        assert aligned < shuffled
+
+    def test_mask_removes_padded_anchors(self):
+        k = jax.random.PRNGKey(1)
+        z1 = jax.random.normal(k, (4, 8))
+        z2 = z1 + 0.01
+        full = float(ntxent_loss(z1[:3], z2[:3], 0.5))
+        # padding row + mask must reproduce the unpadded loss
+        pad = jnp.zeros((1, 8))
+        masked = float(ntxent_loss(
+            jnp.concatenate([z1[:3], pad]), jnp.concatenate([z2[:3], pad]),
+            0.5, mask=jnp.asarray([1.0, 1.0, 1.0, 0.0])))
+        np.testing.assert_allclose(masked, full, rtol=1e-4)
+
+
+class TestCosineLoss:
+    def test_orthogonal_is_zero_aligned_is_one(self):
+        a = jnp.asarray([[1.0, 0.0]])
+        b = jnp.asarray([[0.0, 1.0]])
+        np.testing.assert_allclose(float(cosine_similarity_loss(a, b)), 0.0,
+                                   atol=1e-6)
+        np.testing.assert_allclose(float(cosine_similarity_loss(a, a)), 1.0,
+                                   rtol=1e-5)
+        # sign-insensitive: anti-aligned also 1 (|cos|)
+        np.testing.assert_allclose(float(cosine_similarity_loss(a, -a)), 1.0,
+                                   rtol=1e-5)
+
+
+class TestDeepSupervision:
+    def test_weights_halve_and_zero_lowest(self):
+        w = deep_supervision_weights(3)
+        # raw 1, 1/2, 0 -> normalized 2/3, 1/3, 0
+        np.testing.assert_allclose(w, [2 / 3, 1 / 3, 0.0], rtol=1e-6)
+        assert deep_supervision_weights(1) == [1.0]
+
+    def test_downsample_is_strided_nearest(self):
+        t = jnp.arange(16).reshape(1, 4, 4)
+        d = downsample_target(t, (2, 2))
+        np.testing.assert_array_equal(np.asarray(d),
+                                      [[[0, 2], [8, 10]]])
+
+
+class TestLossMeter:
+    def test_average_vs_accumulation(self):
+        avg = LossMeter.create(("l",), "AVERAGE")
+        acc = LossMeter.create(("l",), "ACCUMULATION")
+        for v in (1.0, 2.0, 3.0):
+            avg = avg.update({"l": jnp.asarray(v)})
+            acc = acc.update({"l": jnp.asarray(v)})
+        np.testing.assert_allclose(float(avg.compute()["l"]), 2.0)
+        np.testing.assert_allclose(float(acc.compute()["l"]), 6.0)
+
+    def test_weighted_average(self):
+        m = LossMeter.create(("l",), "AVERAGE")
+        m = m.update({"l": jnp.asarray(1.0)}, weight=3.0)
+        m = m.update({"l": jnp.asarray(5.0)}, weight=1.0)
+        np.testing.assert_allclose(float(m.compute()["l"]), 2.0)
