@@ -2,6 +2,13 @@
 
 Run:  python examples/bert_finetuning_example/run.py
 Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/bert_finetuning_example/run.py
+
+Pretrained start: set ``pretrained_checkpoint`` in config.yaml (or the
+FL4HEALTH_PRETRAINED_CHECKPOINT env var) to a .npz/.pt checkpoint; weights
+are injected via the warm-up name surgery before federation begins — the
+reference's "fine-tune an actually-pretrained model" role. The broadcast
+covers the FULL tree, so frozen LoRA base kernels receive the pretrained
+values even though they never cross the wire afterwards.
 """
 
 import sys
@@ -48,4 +55,17 @@ sim = FederatedSimulation(
     seed=3,
     exchanger=lora_exchanger(),
 )
+import os  # noqa: E402
+
+ckpt = os.environ.get("FL4HEALTH_PRETRAINED_CHECKPOINT") or cfg.get(
+    "pretrained_checkpoint"
+)
+if ckpt:
+    from fl4health_tpu.preprocessing.checkpoint_io import warm_up_from_file
+
+    warmed = warm_up_from_file(
+        jax.device_get(sim.global_params), ckpt,
+        torch_linear_convention=str(ckpt).endswith((".pt", ".bin", ".pth")),
+    )
+    sim.set_global_params(warmed)
 lib.run_and_report(sim, cfg)

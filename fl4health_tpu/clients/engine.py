@@ -193,6 +193,15 @@ class ClientLogic:
         """(basic_client.py:1294) — e.g. SCAFFOLD variate correction."""
         return grads
 
+    def augment(self, batch: Batch, rng: PRNGKey, ctx: Any) -> Batch:
+        """Per-step train-time data augmentation (the role of the reference's
+        dataloader-side transform pipelines, e.g. nnunetv2's augmenters behind
+        nnunet_utils.py:307). Runs inside the compiled scan, train only; the
+        key is folded from the step key so the default identity leaves every
+        existing RNG stream untouched."""
+        del rng, ctx
+        return batch
+
     def update_before_step(self, state: TrainState, ctx: Any, batch: Batch) -> TrainState:
         """(basic_client.py:1260 update_before_step) — runs before the
         gradient step; e.g. DeepMMD kernel training on the incoming batch.
@@ -297,6 +306,7 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
             logic.update_before_step(state, ctx, batch), state, batch.step_mask
         )
         rng, step_rng = jax.random.split(state.rng)
+        batch = logic.augment(batch, jax.random.fold_in(step_rng, 0xA6), ctx)
         (backward, (preds, additional, new_model_state)), grads = logic.value_and_grads(
             state, ctx, batch, step_rng
         )

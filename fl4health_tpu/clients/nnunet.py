@@ -43,10 +43,23 @@ class NnunetClientLogic(ClientLogic):
         model: ModelDef,
         ds_strides: Sequence[Sequence[int]],
         ignore_label: int | None = None,
+        augment: bool = True,
     ):
         super().__init__(model, criterion=None)
         self.ds_strides = tuple(tuple(int(f) for f in s) for s in ds_strides)
         self.ignore_label = ignore_label
+        self.augment_enabled = augment
+
+    def augment(self, batch: Batch, rng, ctx):
+        """On-device nnU-Net augmentation inside the scan (the reference's
+        dataloader augmenter pipeline, nnunet_utils.py:307; see
+        nnunet/augment.py). ``augment=False`` restores the raw-patch path."""
+        if not self.augment_enabled:
+            return batch
+        from fl4health_tpu.nnunet.augment import augment_patch_batch
+
+        x, y = augment_patch_batch(batch.x, batch.y, rng)
+        return batch.replace(x=x, y=y)
 
     def training_loss(self, preds, features, batch: Batch, params, state, ctx):
         total, dice, ce = deep_supervision_loss(

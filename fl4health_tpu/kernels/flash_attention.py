@@ -265,7 +265,12 @@ def flash_attention(
 ) -> jax.Array:
     """Exact softmax attention, flash-style. q,k,v: [B, T, H, D];
     pad_mask: [B, T] with 1 = real token (key positions); returns
-    [B, T, H, D]. Drop-in for ring_attention._dense_attention."""
+    [B, T, H, D]. Drop-in for ring_attention._dense_attention.
+
+    pad_mask is NON-differentiable: it is a binary padding indicator, and the
+    custom VJP returns a zero cotangent for it (a soft/learned mask would get
+    silent zero grads here — use the dense path for that; stop_gradient below
+    makes the contract explicit)."""
     if interpret is None:
         interpret = _interpret_default()
     b, t, h, d = q.shape
@@ -286,6 +291,7 @@ def flash_attention(
         return x
 
     qp, kp, vp = to_bh(q), to_bh(k), to_bh(v)
+    pad_mask = jax.lax.stop_gradient(pad_mask)
     maskp = _pad_axis(pad_mask.astype(jnp.float32), 1, t_multiple)
     maskp = jnp.repeat(maskp, h, axis=0)  # [B*H, Tp] (B-major like to_bh)
 

@@ -10,7 +10,12 @@ supervision (models/unet.py), masked multi-scale Dice+CE
 (clients/nnunet.py + server/nnunet.py).
 """
 
-from fl4health_tpu.nnunet.data import extract_patch_dataset, normalize_volume
+from fl4health_tpu.nnunet.augment import augment_patch_batch
+from fl4health_tpu.nnunet.data import (
+    extract_patch_dataset,
+    make_patch_resampler,
+    normalize_volume,
+)
 from fl4health_tpu.nnunet.inference import (
     gaussian_importance_map,
     sliding_window_predict,
@@ -35,7 +40,9 @@ __all__ = [
     "plans_from_bytes",
     "plans_to_bytes",
     "poly_lr_schedule",
+    "augment_patch_batch",
     "extract_patch_dataset",
+    "make_patch_resampler",
     "normalize_volume",
     "gaussian_importance_map",
     "sliding_window_predict",

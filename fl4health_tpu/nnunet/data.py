@@ -6,13 +6,17 @@ Parity surface (/root/reference/fl4health/clients/nnunet_client.py:259-321
 :487 ``maybe_preprocess``).
 
 TPU-native design: preprocessing (clip + z-score from the plans' fingerprint
-stats) and patch extraction are host-side numpy that runs ONCE per client,
-producing a fixed [N, *patch, C] patch tensor that feeds the engine's
-single-gather batch construction. Random crops oversample foreground with
-the nnU-Net 1/3 forced-foreground rule. No multiprocess augmenter pipeline:
-a compiled scan over static patches replaces the worker pool (the workers
-exist in the reference to hide eager-CPU augmentation latency, which a
-pre-staged device-resident tensor does not have).
+stats) and patch extraction are host-side numpy, producing a [N, *patch, C]
+patch tensor that feeds the engine's single-gather batch construction.
+Random crops oversample foreground with the nnU-Net 1/3 forced-foreground
+rule. The reference's multiprocess augmenter pipeline plays two roles: it
+hides eager-CPU transform latency (moot for a device-resident tensor) and it
+*regularizes* — spatial/intensity augmentation changes what the model
+converges to. The second role is kept on-device: ``nnunet/augment.py``
+applies the transform family inside the compiled training scan, keyed per
+step, and ``resample_patches``/per-round ``seed`` here supports refreshing
+the patch bank between rounds so the crop distribution is not frozen at
+setup time.
 """
 
 from __future__ import annotations
@@ -106,3 +110,40 @@ def extract_patch_dataset(
         xs[i] = padded_v[case][sl]
         ys[i] = padded_s[case][sl]
     return xs, ys
+
+
+def make_patch_resampler(
+    volumes_per_client: Sequence[Sequence[np.ndarray]],
+    segmentations_per_client: Sequence[Sequence[np.ndarray]],
+    plans: dict[str, Any],
+    n_patches: int,
+    base_seed: int = 0,
+    every: int = 1,
+    **extract_kwargs: Any,
+) -> Any:
+    """-> ``train_data_provider`` for ``FederatedSimulation``: fresh patch
+    banks per round (the sampling half of nnU-Net's per-iteration random
+    crops — the reference's loaders draw new crops every batch; here the bank
+    refreshes every ``every`` rounds and the compiled scan shuffles within
+    it). Each client's stream is seeded by (base_seed, client, round) so runs
+    are reproducible and clients decorrelated."""
+
+    def provider(round_idx: int):
+        if (round_idx - 1) % every != 0 or round_idx == 1:
+            # round 1 keeps the construction-time bank (seeded identically),
+            # so resampling only changes data from round `1+every` on.
+            return None
+        xs, ys = [], []
+        for ci, (v, s) in enumerate(
+            zip(volumes_per_client, segmentations_per_client)
+        ):
+            x, y = extract_patch_dataset(
+                v, s, plans, n_patches,
+                seed=base_seed + 100_003 * ci + round_idx,
+                **extract_kwargs,
+            )
+            xs.append(x)
+            ys.append(y)
+        return xs, ys
+
+    return provider

@@ -25,14 +25,21 @@ import numpy as np
 
 
 def _window_starts(size: int, patch: int, step_fraction: float) -> list[int]:
-    """nnU-Net-style start positions: stride = patch * step_fraction,
-    final window clamped flush to the far edge so coverage is exact."""
+    """nnU-Net-style start positions, evenly spaced: pick the window count
+    from the target stride (patch * step_fraction), then distribute starts
+    uniformly over [0, size - patch] so first/last windows touch the edges
+    and interior overlap is balanced (matches nnunetv2's
+    ``compute_steps_for_sliding_window`` placement rather than a fixed
+    stride with the last window clamped flush)."""
     if size <= patch:
         return [0]
-    step = max(int(round(patch * step_fraction)), 1)
-    starts = list(range(0, size - patch, step))
-    starts.append(size - patch)
-    return sorted(set(starts))
+    target = max(patch * step_fraction, 1.0)
+    n = int(np.ceil((size - patch) / target)) + 1
+    span = size - patch
+    if n == 1:
+        return [0]
+    actual = span / (n - 1)
+    return sorted({int(round(actual * i)) for i in range(n)})
 
 
 def gaussian_importance_map(patch_size: Sequence[int],

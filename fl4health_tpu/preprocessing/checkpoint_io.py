@@ -1,0 +1,122 @@
+"""Pretrained-checkpoint import: on-disk weights -> flax param pytrees.
+
+Parity surface: the reference fine-tunes from actually-pretrained weights
+(/root/reference/examples/bert_finetuning_example loads HF
+``BertForSequenceClassification``; /root/reference/fl4health/preprocessing/
+warmed_up_module.py:10 injects saved torch state dicts by name). This module
+is the file half of that story for the TPU stack: read a checkpoint file
+into a flat {dotted.path: array} namespace, hand it to ``WarmedUpModule``'s
+name-mapping surgery, and start training from weights instead of noise.
+
+Formats:
+- ``.npz`` — the native format (``save_checkpoint`` writes it): keys are
+  '.'-joined flax tree paths.
+- ``.safetensors`` — read via the ``safetensors`` package when installed
+  (gated import; absent in this image).
+- ``.pt`` / ``.bin`` — torch state dicts (HF checkpoint files) via the baked
+  -in cpu torch, ``weights_only=True`` so loading is data-not-code.
+
+Torch Linear stores ``weight`` as [out, in]; flax Dense kernels are
+[in, out]. ``torch_linear_convention=True`` transposes every 2-D tensor
+whose key ends in ``.weight`` and renames ``.weight``/``.bias`` to
+``.kernel``/``.bias`` so torch-exported dense layers line up with flax
+naming before the prefix surgery runs.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+
+from fl4health_tpu.preprocessing.warm_up import WarmedUpModule, _path_str
+
+
+def flatten_params(params: Any) -> dict[str, np.ndarray]:
+    """Params pytree -> {dotted.path: host array}."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    return {_path_str(path): np.asarray(leaf) for path, leaf in flat}
+
+
+def save_checkpoint(path: str | Path, params: Any) -> Path:
+    """Write a params pytree as a flat .npz checkpoint (the native format
+    ``load_flat_checkpoint`` round-trips)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(path, **flatten_params(params))
+    # np.savez appends .npz when absent; normalize the returned path.
+    return path if path.suffix == ".npz" else path.with_suffix(
+        path.suffix + ".npz"
+    )
+
+
+def load_flat_checkpoint(
+    path: str | Path, torch_linear_convention: bool = False
+) -> dict[str, np.ndarray]:
+    """Read a checkpoint file -> flat {dotted.path: np.ndarray} namespace."""
+    path = Path(path)
+    if not path.exists():
+        raise FileNotFoundError(f"checkpoint not found: {path}")
+    suffix = path.suffix.lower()
+    if suffix == ".npz":
+        with np.load(path) as z:
+            flat = {k: z[k] for k in z.files}
+    elif suffix == ".safetensors":
+        try:
+            from safetensors.numpy import load_file
+        except ImportError as e:  # pragma: no cover - absent in this image
+            raise ImportError(
+                "reading .safetensors requires the safetensors package; "
+                "convert to .npz (save_checkpoint) instead"
+            ) from e
+        flat = dict(load_file(str(path)))
+    elif suffix in (".pt", ".bin", ".pth"):
+        import torch
+
+        state = torch.load(path, map_location="cpu", weights_only=True)
+        if hasattr(state, "state_dict"):
+            state = state.state_dict()
+        flat = {k: v.detach().cpu().numpy() for k, v in state.items()}
+    else:
+        raise ValueError(
+            f"unsupported checkpoint format {suffix!r} "
+            "(expected .npz, .safetensors, .pt, .bin)"
+        )
+    if torch_linear_convention:
+        flat = _torchify_to_flax(flat)
+    return flat
+
+
+def _torchify_to_flax(flat: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Widen the namespace toward flax convention: every key keeps its raw
+    torch form, and 2-D ``*.weight`` tensors ADDITIONALLY appear as a
+    transposed ``*.kernel`` alias (torch Linear is [out, in]; flax Dense is
+    [in, out]) unless the key path mentions an embedding (embedding tables
+    are [num, dim] in BOTH frameworks — transposing one would pass or fail
+    the warm-up shape check for exactly the wrong reason). Keeping the raw
+    key alongside the alias means a caller's ``weights_mapping`` can always
+    target whichever orientation its model needs; WarmedUpModule's shape
+    check arbitrates per leaf."""
+    out: dict[str, np.ndarray] = dict(flat)
+    for k, v in flat.items():
+        if ((k == "weight" or k.endswith(".weight")) and v.ndim == 2
+                and "embed" not in k.lower()):
+            out[k[: -len("weight")] + "kernel"] = v.T
+    return out
+
+
+def warm_up_from_file(
+    params: Any,
+    path: str | Path,
+    weights_mapping: dict[str, str] | None = None,
+    torch_linear_convention: bool = False,
+) -> Any:
+    """One-call warm start: load ``path``, run WarmedUpModule's longest-
+    prefix name surgery, and return ``params`` with every matchable,
+    shape-compatible leaf replaced (mismatches keep fresh init and log —
+    warmed_up_module.py:85-120 semantics)."""
+    flat = load_flat_checkpoint(path, torch_linear_convention)
+    return WarmedUpModule(flat, weights_mapping).load_from_pretrained(params)

@@ -126,6 +126,7 @@ class FederatedSimulation:
         flash_early_stopping: Any = None,
         failure_policy: FailurePolicy | None = None,
         profile_dir: str | None = None,
+        train_data_provider: Any = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -169,6 +170,13 @@ class FederatedSimulation:
         # When set, fit() wraps the round loop in jax.profiler.trace and the
         # trace directory can be opened in TensorBoard/XProf.
         self.profile_dir = profile_dir
+        # Optional per-round host data refresh: callable(round_idx) ->
+        # (x_list, y_list) | None. Called at the top of each fit() round;
+        # shapes must match the originals so the compiled round program
+        # stays valid (no recompile). The nnU-Net pipeline uses this for
+        # fresh patch extraction per round (nnunet.data.make_patch_resampler);
+        # fit_chunk bakes its data at dispatch time and bypasses it.
+        self.train_data_provider = train_data_provider
         self.rng = jax.random.PRNGKey(seed)
         self.sample_counts = jnp.asarray(
             [d.n_train for d in self.datasets], jnp.float32
@@ -233,6 +241,24 @@ class FederatedSimulation:
         self.server_state = strategy.init(proto.params)
 
         self._build_compiled()
+
+    # ------------------------------------------------------------------
+    def set_train_data(self, xs: Sequence[Any], ys: Sequence[Any]) -> None:
+        """Swap every client's training arrays in place — the host half of
+        per-round data refresh (e.g. fresh nnU-Net patch banks). Shapes and
+        dtypes must match the originals: the compiled round program is traced
+        against the stacked layout and must not be invalidated."""
+        new_x = engine.pad_and_stack_data([jnp.asarray(x) for x in xs], "x_train")
+        new_y = engine.pad_and_stack_data([jnp.asarray(y) for y in ys], "y_train")
+        for name, new, old in (("x_train", new_x, self._x_train_stack),
+                               ("y_train", new_y, self._y_train_stack)):
+            if new.shape != old.shape or new.dtype != old.dtype:
+                raise ValueError(
+                    f"set_train_data: {name} stack {new.shape}/{new.dtype} "
+                    f"must match the original {old.shape}/{old.dtype} "
+                    "(per-round refresh may not change the data layout)"
+                )
+        self._x_train_stack, self._y_train_stack = new_x, new_y
 
     # ------------------------------------------------------------------
     def _build_compiled(self):
@@ -455,6 +481,11 @@ class FederatedSimulation:
             ])
         else:
             mask = jnp.asarray(mask)
+            if mask.shape not in ((k, self.n_clients), (self.n_clients,)):
+                raise ValueError(
+                    f"fit_chunk mask must have shape ({k}, {self.n_clients}) "
+                    f"or ({self.n_clients},); got {mask.shape}"
+                )
             masks = mask if mask.ndim == 2 else jnp.broadcast_to(
                 mask, (k,) + mask.shape
             )
@@ -520,6 +551,10 @@ class FederatedSimulation:
             start_round = self.state_checkpointer.load_simulation(self)
         for rnd in range(start_round, n_rounds + 1):
             t0 = time.time()
+            if self.train_data_provider is not None:
+                fresh = self.train_data_provider(rnd)
+                if fresh is not None:
+                    self.set_train_data(*fresh)
             mask = self.client_manager.sample(
                 jax.random.fold_in(self.rng, 2000 + rnd), rnd
             )
@@ -617,3 +652,39 @@ class FederatedSimulation:
     @property
     def global_params(self):
         return self.strategy.global_params(self.server_state)
+
+    def set_global_params(self, params, broadcast_to_clients: bool = True) -> None:
+        """Install externally-produced weights (warm-up injection, pretrained
+        checkpoint import — preprocessing/checkpoint_io.py) as the global
+        model. With ``broadcast_to_clients`` every client's full local tree
+        resets to the same weights, the reference's round-1
+        initialize_all_model_weights broadcast (basic_client.py:205) — the
+        only path by which never-exchanged subtrees (personal layers, frozen
+        LoRA base kernels under a lora_exchanger) can receive pretrained
+        values."""
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        ref = self.strategy.global_params(self.server_state)
+        if (jax.tree_util.tree_structure(params)
+                != jax.tree_util.tree_structure(ref)):
+            raise ValueError(
+                "set_global_params: pytree structure does not match the "
+                "model's params (run the checkpoint through WarmedUpModule/"
+                "warm_up_from_file against this model's init first)"
+            )
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(ref)[0],
+        ):
+            if a.shape != b.shape:
+                raise ValueError(
+                    f"set_global_params: leaf {pa} has shape {a.shape}, "
+                    f"model expects {b.shape}"
+                )
+        self.server_state = self.server_state.replace(params=params)
+        if broadcast_to_clients:
+            n = self.n_clients
+            self.client_states = self.client_states.replace(
+                params=jax.tree_util.tree_map(
+                    lambda x: jnp.stack([x] * n), params
+                )
+            )

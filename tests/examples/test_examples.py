@@ -25,6 +25,8 @@ TINY_ENV = {
 }
 
 
+# Completeness stays in the fast lane (cheap, pure-Python); the 39 e2e runs
+# are the slow lane's biggest line item.
 def test_corpus_is_complete():
     """The corpus must keep covering the major reference families."""
     names = {str(p.parent) for p in ALL_RUN_SCRIPTS}
@@ -47,6 +49,7 @@ def test_corpus_is_complete():
         assert required in names, f"examples/{required} missing from corpus"
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("script", ALL_RUN_SCRIPTS, ids=lambda p: str(p.parent))
 def test_example_runs(script, monkeypatch, capsys):
     for k, v in TINY_ENV.items():

@@ -1,0 +1,218 @@
+"""On-device augmentation tests: label/image consistency under spatial
+transforms, probability gating, determinism, and the engine hook (aug on vs
+off changes training, aug off is bit-identical to the pre-hook engine).
+
+Reference role: nnunetv2's default transform pipeline behind
+/root/reference/fl4health/utils/nnunet_utils.py:307 — the reference trusts
+nnunetv2's own tests for transform correctness; here the jax re-derivation
+carries its own.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.nnunet import NnunetClientLogic
+from fl4health_tpu.nnunet import augment_patch_batch, make_patch_resampler
+from fl4health_tpu.nnunet.augment import _isotropic_pairs
+
+
+def _batch(b=4, shape=(8, 8, 8), c=1, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(b, *shape, c)).astype(np.float32)
+    y = (rng.random((b, *shape)) < 0.3).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+class TestAugmentPatchBatch:
+    def test_shapes_and_dtypes_preserved(self):
+        x, y = _batch()
+        ax, ay = augment_patch_batch(x, y, jax.random.PRNGKey(0))
+        assert ax.shape == x.shape and ax.dtype == x.dtype
+        assert ay.shape == y.shape and ay.dtype == y.dtype
+
+    def test_all_probabilities_zero_is_identity(self):
+        x, y = _batch()
+        ax, ay = augment_patch_batch(
+            x, y, jax.random.PRNGKey(0), p_mirror=0.0, p_rot90=0.0,
+            p_noise=0.0, p_brightness=0.0, p_contrast=0.0, p_gamma=0.0,
+        )
+        np.testing.assert_array_equal(np.asarray(ax), np.asarray(x))
+        np.testing.assert_array_equal(np.asarray(ay), np.asarray(y))
+
+    def test_deterministic_under_same_key(self):
+        x, y = _batch()
+        a1 = augment_patch_batch(x, y, jax.random.PRNGKey(7))
+        a2 = augment_patch_batch(x, y, jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(a1[0]), np.asarray(a2[0]))
+        np.testing.assert_array_equal(np.asarray(a1[1]), np.asarray(a2[1]))
+        a3 = augment_patch_batch(x, y, jax.random.PRNGKey(8))
+        assert not np.array_equal(np.asarray(a1[0]), np.asarray(a3[0]))
+
+    def test_spatial_transforms_move_x_and_y_together(self):
+        """With only spatial transforms on (intensity off), the foreground
+        voxel values must follow the label: x was built as noise + 10*y, so
+        x - 10*y stays pure noise under any consistent flip/rotation —
+        its per-example histogram is permutation-invariant."""
+        rng = np.random.default_rng(3)
+        noise = rng.normal(size=(6, 8, 8, 8, 1)).astype(np.float32)
+        y = (rng.random((6, 8, 8, 8)) < 0.3).astype(np.int32)
+        x = noise + 10.0 * y[..., None]
+        ax, ay = augment_patch_batch(
+            jnp.asarray(x), jnp.asarray(y), jax.random.PRNGKey(1),
+            p_mirror=1.0, p_rot90=1.0, p_noise=0.0, p_brightness=0.0,
+            p_contrast=0.0, p_gamma=0.0,
+        )
+        residual = np.asarray(ax)[..., 0] - 10.0 * np.asarray(ay)
+        # consistent spatial transform => residual is a permutation of noise
+        np.testing.assert_allclose(
+            np.sort(residual.reshape(6, -1), axis=1),
+            np.sort(noise[..., 0].reshape(6, -1), axis=1),
+            rtol=1e-5, atol=1e-5,
+        )
+        # and something actually moved
+        assert not np.array_equal(np.asarray(ay), y)
+
+    def test_intensity_transforms_leave_labels_alone(self):
+        x, y = _batch(seed=5)
+        ax, ay = augment_patch_batch(
+            x, y, jax.random.PRNGKey(2), p_mirror=0.0, p_rot90=0.0,
+            p_noise=1.0, p_brightness=1.0, p_contrast=1.0, p_gamma=1.0,
+        )
+        np.testing.assert_array_equal(np.asarray(ay), np.asarray(y))
+        assert not np.array_equal(np.asarray(ax), np.asarray(x))
+
+    def test_label_set_preserved(self):
+        x, y = _batch(seed=9)
+        _, ay = augment_patch_batch(x, y, jax.random.PRNGKey(4))
+        assert set(np.unique(np.asarray(ay))) <= set(np.unique(np.asarray(y)))
+
+    def test_anisotropic_patch_skips_rot90_but_mirrors(self):
+        """Non-cubic patches have no isotropic pair on the unequal axes; the
+        transform must still compile and mirror correctly."""
+        assert _isotropic_pairs((4, 8, 8)) == ((1, 2),)
+        assert _isotropic_pairs((4, 6, 8)) == ()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 4, 6, 8, 1)).astype(np.float32))
+        y = jnp.asarray((rng.random((2, 4, 6, 8)) < 0.5).astype(np.int32))
+        ax, ay = augment_patch_batch(x, y, jax.random.PRNGKey(0),
+                                     p_rot90=1.0, p_mirror=1.0)
+        assert ax.shape == x.shape and ay.shape == y.shape
+
+    def test_gamma_preserves_channel_range_sign(self):
+        """Gamma operates on the [0,1]-rescaled patch: output stays within
+        the input's per-channel range (no blow-ups on z-scored data)."""
+        x, y = _batch(seed=11)
+        ax, _ = augment_patch_batch(
+            x, y, jax.random.PRNGKey(3), p_mirror=0.0, p_rot90=0.0,
+            p_noise=0.0, p_brightness=0.0, p_contrast=0.0, p_gamma=1.0,
+        )
+        for b in range(x.shape[0]):
+            lo, hi = float(x[b].min()), float(x[b].max())
+            assert float(ax[b].min()) >= lo - 1e-4
+            assert float(ax[b].max()) <= hi + 1e-4
+
+
+class TestEngineAugmentHook:
+    def _logic_and_state(self, augment):
+        import flax.linen as nn
+
+        class TinySeg(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                h = nn.Conv(4, (3, 3, 3))(x)
+                return nn.Conv(2, (1, 1, 1))(nn.relu(h))
+
+        logic = NnunetClientLogic(
+            engine.from_flax(TinySeg()), ds_strides=(),
+            augment=augment,
+        )
+        import optax
+
+        tx = optax.sgd(1e-2)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 6, 6, 6, 1)).astype(np.float32)
+        y = (rng.random((4, 6, 6, 6)) < 0.4).astype(np.int32)
+        state = engine.create_train_state(
+            logic, tx, jax.random.PRNGKey(0), jnp.asarray(x[:1])
+        )
+        batch = engine.Batch(
+            x=jnp.asarray(x), y=jnp.asarray(y),
+            example_mask=jnp.ones(4), step_mask=jnp.asarray(1.0),
+        )
+        return logic, tx, state, batch
+
+    def test_aug_on_differs_from_aug_off(self):
+        results = {}
+        for augment in (False, True):
+            logic, tx, state, batch = self._logic_and_state(augment)
+            step = engine.make_train_step(logic, tx)
+            new_state, out = step(state, None, batch)
+            results[augment] = (
+                jax.tree_util.tree_leaves(new_state.params)[0],
+                float(out.losses["backward"]),
+            )
+        assert not np.allclose(
+            np.asarray(results[False][0]), np.asarray(results[True][0])
+        )
+
+    def test_aug_off_bit_identical_to_default_logic_stream(self):
+        """The identity hook must not consume RNG: an aug-off nnU-Net step
+        produces exactly the same params as the hook-free engine contract
+        (this is what keeps every pre-hook golden valid)."""
+        logic, tx, state, batch = self._logic_and_state(False)
+        step = engine.make_train_step(logic, tx)
+        s1, _ = step(state, None, batch)
+
+        class NoHook(NnunetClientLogic):
+            augment = engine.ClientLogic.augment
+
+        logic2 = NoHook(logic.model, ds_strides=(), augment=False)
+        step2 = engine.make_train_step(logic2, tx)
+        s2, _ = step2(state, None, batch)
+        for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                        jax.tree_util.tree_leaves(s2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestPatchResampler:
+    def _clients(self):
+        rng = np.random.default_rng(0)
+        vols, segs = [], []
+        for _ in range(2):
+            v = [rng.normal(size=(10, 10, 10, 1)).astype(np.float32)
+                 for _ in range(2)]
+            s = [(rng.random((10, 10, 10)) < 0.3).astype(np.int32)
+                 for _ in range(2)]
+            vols.append(v)
+            segs.append(s)
+        from fl4health_tpu.nnunet import extract_fingerprint, generate_plans
+
+        fp = extract_fingerprint(vols[0], [(1.0, 1.0, 1.0)] * 2, segs[0])
+        plans = generate_plans(fp, max_patch_voxels=6 ** 3)
+        return vols, segs, plans
+
+    def test_round1_keeps_construction_bank(self):
+        vols, segs, plans = self._clients()
+        provider = make_patch_resampler(vols, segs, plans, n_patches=6)
+        assert provider(1) is None
+
+    def test_refresh_changes_patches_reproducibly(self):
+        vols, segs, plans = self._clients()
+        provider = make_patch_resampler(vols, segs, plans, n_patches=6)
+        xs2, ys2 = provider(2)
+        xs3, ys3 = provider(3)
+        assert len(xs2) == 2 and xs2[0].shape == xs3[0].shape
+        assert not np.array_equal(xs2[0], xs3[0])
+        xs2b, _ = provider(2)
+        np.testing.assert_array_equal(xs2[0], xs2b[0])
+
+    def test_every_gates_refresh(self):
+        vols, segs, plans = self._clients()
+        provider = make_patch_resampler(vols, segs, plans, n_patches=6,
+                                        every=2)
+        assert provider(1) is None
+        assert provider(2) is None  # (2-1) % 2 == 1
+        assert provider(3) is not None

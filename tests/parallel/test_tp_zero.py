@@ -20,7 +20,10 @@ from fl4health_tpu.metrics.base import MetricManager
 from fl4health_tpu.models.transformer import TransformerClassifier
 from fl4health_tpu.parallel import mesh as meshlib
 from fl4health_tpu.parallel.tp import shard_like_params, shard_transformer_params, tp_spec
-from fl4health_tpu.parallel.zero import zero_sharded_optimizer
+from fl4health_tpu.parallel.zero import (
+    zero2_sharded_optimizer,
+    zero_sharded_optimizer,
+)
 from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
 from fl4health_tpu.strategies.fedavg import FedAvg
 
@@ -231,3 +234,148 @@ class TestZero:
         # the memory claim: per-device bytes are 1/8 of the total
         total = sum(v.size * v.dtype.itemsize for v in vectors)
         assert zero_tx.state_bytes_per_device(state) == total // 8
+
+    def test_construction_probe_rejects_global_norm_clip(self, eight_devices):
+        """The SCOPE contract is enforced, not just documented: wrapping a
+        transform that reduces across ALL parameters (clip_by_global_norm
+        with a binding threshold) must raise at construction."""
+        mesh = meshlib.client_mesh(8, devices=eight_devices)
+        _, params = self._params()
+        bad = optax.chain(optax.clip_by_global_norm(1e-4), optax.sgd(1e-2))
+        with pytest.raises(ValueError, match="parity probe"):
+            zero_sharded_optimizer(bad, mesh, params, axis_name="clients")
+        # validate=False restores the old (documented-hazard) behavior
+        zero_sharded_optimizer(
+            bad, mesh, params, axis_name="clients", validate=False
+        )
+
+    def test_construction_probe_catches_conditionally_binding_clip(
+        self, eight_devices
+    ):
+        """A clip threshold of 1.0 is a no-op at small gradient scales — the
+        large-magnitude probe is what exposes it."""
+        mesh = meshlib.client_mesh(8, devices=eight_devices)
+        _, params = self._params()
+        bad = optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(1e-2))
+        with pytest.raises(ValueError, match="parity probe"):
+            zero_sharded_optimizer(bad, mesh, params, axis_name="clients")
+
+    def test_construction_probe_accepts_adam(self, eight_devices):
+        mesh = meshlib.client_mesh(8, devices=eight_devices)
+        _, params = self._params()
+        zero_sharded_optimizer(optax.adam(1e-2), mesh, params,
+                               axis_name="clients")
+
+
+class TestZero2:
+    def _params(self):
+        from fl4health_tpu.models.cnn import Mlp
+
+        m = Mlp(features=(32, 16), n_outputs=CLASSES)
+        x = jnp.zeros((2, 8), jnp.float32)
+        return m, m.init(jax.random.PRNGKey(0), x, train=False)["params"]
+
+    def test_zero2_matches_unsharded_adam_on_mean_of_local_grads(
+        self, eight_devices
+    ):
+        """8 per-device gradient trees; the reference path averages them on
+        one device and runs plain Adam — ZeRO-2 must produce identical params
+        while never materializing the summed gradient."""
+        mesh = meshlib.client_mesh(8, devices=eight_devices)
+        m, params = self._params()
+        xs = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 8))
+        ys = jax.random.randint(jax.random.PRNGKey(2), (8, 4), 0, CLASSES)
+
+        def loss_fn(p, x, y):
+            preds, _ = m.apply({"params": p}, x, train=False)
+            return engine.masked_cross_entropy(
+                preds["prediction"], y, jnp.ones(y.shape)
+            )
+
+        ref_tx = optax.adam(1e-2)
+        z2_tx = zero2_sharded_optimizer(
+            optax.adam(1e-2), mesh, params, axis_name="clients"
+        )
+        ref_state, z2_state = ref_tx.init(params), z2_tx.init(params)
+        p_ref, p_z2 = params, params
+        for _ in range(2):
+            local_ref = [jax.grad(loss_fn)(p_ref, xs[i], ys[i]) for i in range(8)]
+            g_mean = jax.tree_util.tree_map(
+                lambda *g: sum(g) / 8.0, *local_ref
+            )
+            u, ref_state = ref_tx.update(g_mean, ref_state, p_ref)
+            p_ref = optax.apply_updates(p_ref, u)
+
+            local_z2 = jax.tree_util.tree_map(
+                lambda *g: jnp.stack(g),
+                *[jax.grad(loss_fn)(p_z2, xs[i], ys[i]) for i in range(8)],
+            )
+            u, z2_state = z2_tx.update(local_z2, z2_state, p_z2)
+            p_z2 = optax.apply_updates(p_z2, u)
+        _assert_close(p_ref, p_z2, atol=1e-5)
+
+    def test_zero2_state_and_grads_sharded(self, eight_devices):
+        mesh = meshlib.client_mesh(8, devices=eight_devices)
+        _, params = self._params()
+        z2_tx = zero2_sharded_optimizer(
+            optax.adam(1e-2), mesh, params, axis_name="clients"
+        )
+        state = z2_tx.init(params)
+        vectors = [
+            leaf for leaf in jax.tree_util.tree_leaves(state)
+            if getattr(leaf, "ndim", 0) >= 1
+        ]
+        for v in vectors:
+            assert v.sharding.spec == P("clients")
+        # grad memory introspection: per-device summed-grad bytes are 1/8
+        from fl4health_tpu.core import pytree as ptu
+
+        flat, _ = ptu.ravel(params)
+        padded = -(-flat.shape[0] // 8) * 8
+        assert z2_tx.grad_bytes_per_device() == (padded // 8) * flat.dtype.itemsize
+
+    def test_zero2_lowering_contains_reduce_scatter(self, eight_devices):
+        """The stage-2 claim in the compiled artifact: the gradient reduction
+        lowers to reduce-scatter (not all-reduce) so no device receives the
+        full summed vector."""
+        mesh = meshlib.client_mesh(8, devices=eight_devices)
+        _, params = self._params()
+        z2_tx = zero2_sharded_optimizer(
+            optax.adam(1e-2), mesh, params, axis_name="clients"
+        )
+        state = z2_tx.init(params)
+        stacked = jax.tree_util.tree_map(
+            lambda x: jnp.stack([x] * 8), params
+        )
+        lowered = jax.jit(
+            lambda g, s, p: z2_tx.update(g, s, p)
+        ).lower(stacked, state, params).as_text()
+        assert "reduce_scatter" in lowered
+
+    def test_zero2_probe_rejects_global_norm_clip(self, eight_devices):
+        mesh = meshlib.client_mesh(8, devices=eight_devices)
+        _, params = self._params()
+        bad = optax.chain(optax.clip_by_global_norm(1e-4), optax.sgd(1e-2))
+        with pytest.raises(ValueError, match="parity probe"):
+            zero2_sharded_optimizer(bad, mesh, params, axis_name="clients")
+
+    def test_zero2_sum_reduction(self, eight_devices):
+        mesh = meshlib.client_mesh(8, devices=eight_devices)
+        _, params = self._params()
+        z2_tx = zero2_sharded_optimizer(
+            optax.sgd(1e-2), mesh, params, axis_name="clients", reduce="sum"
+        )
+        state = z2_tx.init(params)
+        g = jax.tree_util.tree_map(jnp.ones_like, params)
+        stacked = jax.tree_util.tree_map(lambda x: jnp.stack([x] * 8), g)
+        u, _ = z2_tx.update(stacked, state, params)
+        # sgd(lr): update = -lr * sum(g) = -1e-2 * 8
+        for leaf in jax.tree_util.tree_leaves(u):
+            np.testing.assert_allclose(np.asarray(leaf), -0.08, rtol=1e-5)
+
+    def test_zero2_rejects_bad_reduce(self, eight_devices):
+        mesh = meshlib.client_mesh(8, devices=eight_devices)
+        _, params = self._params()
+        with pytest.raises(ValueError, match="reduce"):
+            zero2_sharded_optimizer(optax.sgd(1e-2), mesh, params,
+                                    axis_name="clients", reduce="max")

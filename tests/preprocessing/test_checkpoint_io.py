@@ -1,0 +1,204 @@
+"""Pretrained-checkpoint import tests: npz round-trip, torch state-dict
+convention conversion, warm-up surgery from file, and the full-tree
+broadcast into a LoRA simulation (frozen base kernels must receive the
+pretrained values even though the exchanger never moves them).
+
+Reference role: examples/bert_finetuning_example starts from an actually-
+pretrained HF model; preprocessing/warmed_up_module.py:10 injects saved
+state dicts by (remapped) name.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.models.transformer import TransformerClassifier
+from fl4health_tpu.preprocessing.checkpoint_io import (
+    flatten_params,
+    load_flat_checkpoint,
+    save_checkpoint,
+    warm_up_from_file,
+)
+
+
+def tiny_transformer(lora_rank=0):
+    module = TransformerClassifier(
+        vocab_size=17, n_classes=3, d_model=8, n_heads=2, n_layers=1,
+        d_ff=16, max_len=6, lora_rank=lora_rank,
+    )
+    model = engine.from_flax(module)
+    x = jnp.ones((1, 6), jnp.int32)
+    params, _ = model.init(jax.random.PRNGKey(0), x)
+    return model, params
+
+
+class TestRoundTrip:
+    def test_npz_round_trip_restores_every_leaf(self, tmp_path):
+        _, params = tiny_transformer()
+        path = save_checkpoint(tmp_path / "ckpt.npz", params)
+        # fresh init from a different seed differs...
+        model2, params2 = tiny_transformer()
+        params2 = jax.tree_util.tree_map(lambda x: x + 1.0, params2)
+        # ...until the checkpoint is injected with no mapping needed
+        restored = warm_up_from_file(params2, path)
+        for a, b in zip(jax.tree_util.tree_leaves(restored),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_save_appends_npz_suffix(self, tmp_path):
+        _, params = tiny_transformer()
+        path = save_checkpoint(tmp_path / "bare", params)
+        assert path.suffix == ".npz" and path.exists()
+
+    def test_missing_file_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_flat_checkpoint(tmp_path / "nope.npz")
+
+    def test_unknown_format_raises(self, tmp_path):
+        p = tmp_path / "weights.xyz"
+        p.write_bytes(b"junk")
+        with pytest.raises(ValueError, match="unsupported checkpoint"):
+            load_flat_checkpoint(p)
+
+
+class TestTorchConvention:
+    def test_pt_state_dict_adds_transposed_kernel_alias(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        lin = torch.nn.Linear(4, 7)
+        path = tmp_path / "lin.pt"
+        torch.save(lin.state_dict(), path)
+        flat = load_flat_checkpoint(path, torch_linear_convention=True)
+        assert flat["kernel"].shape == (4, 7)  # torch stores [7, 4]
+        np.testing.assert_allclose(
+            flat["kernel"], lin.weight.detach().numpy().T
+        )
+        assert flat["bias"].shape == (7,)
+        # the raw torch key survives alongside the alias, so mappings can
+        # target either orientation
+        assert flat["weight"].shape == (7, 4)
+
+    def test_embedding_weights_get_no_transposed_alias(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        state = {
+            "embeddings.word_embeddings.weight": torch.randn(11, 5),
+            "encoder.dense.weight": torch.randn(3, 5),
+        }
+        path = tmp_path / "emb.pt"
+        torch.save(state, path)
+        flat = load_flat_checkpoint(path, torch_linear_convention=True)
+        # embedding tables are [num, dim] in both frameworks: no alias
+        assert "embeddings.word_embeddings.kernel" not in flat
+        assert flat["embeddings.word_embeddings.weight"].shape == (11, 5)
+        # the dense layer gets one
+        assert flat["encoder.dense.kernel"].shape == (5, 3)
+
+
+class TestWarmUpFromFile:
+    def test_prefix_mapping_renames_namespace(self, tmp_path):
+        _, params = tiny_transformer()
+        flat = flatten_params(params)
+        # save under a foreign prefix, then map it back
+        renamed = {f"backbone.{k}": v for k, v in flat.items()}
+        path = tmp_path / "foreign.npz"
+        np.savez(path, **renamed)
+        fresh = jax.tree_util.tree_map(lambda x: x * 0.0, params)
+        mapping = {top: f"backbone.{top}" for top in params}
+        restored = warm_up_from_file(fresh, path, weights_mapping=mapping)
+        for a, b in zip(jax.tree_util.tree_leaves(restored),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_shape_mismatch_keeps_fresh_init(self, tmp_path):
+        _, params = tiny_transformer()
+        flat = flatten_params(params)
+        key = next(k for k in flat if k.endswith("kernel"))
+        flat[key] = np.zeros((2, 2), np.float32)
+        path = tmp_path / "bad.npz"
+        np.savez(path, **flat)
+        fresh = jax.tree_util.tree_map(lambda x: x * 0.0 + 5.0, params)
+        restored = warm_up_from_file(fresh, path)
+        flat_restored = flatten_params(restored)
+        assert np.all(flat_restored[key] == 5.0)  # kept fresh init
+        # a well-shaped sibling leaf WAS injected (zeros from `flat`)
+        other = next(k for k in flat if k != key and k.endswith("bias"))
+        np.testing.assert_array_equal(flat_restored[other], flat[other])
+
+
+class TestSimulationInjection:
+    def _sim(self, lora_rank=2):
+        from fl4health_tpu.server.simulation import (
+            ClientDataset, FederatedSimulation,
+        )
+        from fl4health_tpu.strategies.fedopt import FedOpt
+        from fl4health_tpu.utils.peft import (
+            lora_exchanger, lora_trainable_mask, masked_optimizer,
+        )
+        from fl4health_tpu.metrics.base import MetricManager
+        from fl4health_tpu.metrics import efficient
+
+        model, params = tiny_transformer(lora_rank)
+        rng = np.random.default_rng(0)
+        datasets = []
+        for _ in range(2):
+            x = rng.integers(1, 17, (8, 6)).astype(np.int32)
+            y = rng.integers(0, 3, (8,)).astype(np.int32)
+            datasets.append(ClientDataset(x[:6], y[:6], x[6:], y[6:]))
+        sim = FederatedSimulation(
+            logic=engine.ClientLogic(model, engine.masked_cross_entropy),
+            tx=masked_optimizer(optax.adam(1e-3),
+                                lora_trainable_mask(params)),
+            strategy=FedOpt(optax.adam(1e-2)),
+            datasets=datasets,
+            batch_size=4,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_steps=2,
+            seed=0,
+            exchanger=lora_exchanger(),
+        )
+        return sim, params
+
+    def test_broadcast_reaches_frozen_base_kernels(self, tmp_path):
+        sim, params = self._sim()
+        pretrained = jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, 0.25), params
+        )
+        path = save_checkpoint(tmp_path / "pre.npz", pretrained)
+        warmed = warm_up_from_file(jax.device_get(sim.global_params), path)
+        sim.set_global_params(warmed)
+        # every client's FULL tree (incl. LoRA base kernels, which the
+        # exchanger never moves) now carries the pretrained constant
+        flat = flatten_params(sim.client_states.params)
+        base_keys = [k for k in flat if "kernel" in k and "lora" not in k]
+        assert base_keys
+        for k in base_keys:
+            np.testing.assert_allclose(flat[k], 0.25)
+
+    def test_structure_mismatch_raises(self):
+        sim, params = self._sim()
+        with pytest.raises(ValueError, match="structure"):
+            sim.set_global_params({"wrong": jnp.zeros(3)})
+
+    def test_shape_mismatch_raises(self):
+        sim, params = self._sim()
+        bad = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape + (1,), x.dtype), params
+        )
+        with pytest.raises(ValueError, match="shape"):
+            sim.set_global_params(bad)
+
+    def test_training_proceeds_from_injected_weights(self, tmp_path):
+        sim, params = self._sim()
+        pretrained = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(
+                np.random.default_rng(7).normal(0, 0.02, x.shape), x.dtype
+            ),
+            params,
+        )
+        path = save_checkpoint(tmp_path / "pre.npz", pretrained)
+        warmed = warm_up_from_file(jax.device_get(sim.global_params), path)
+        sim.set_global_params(warmed)
+        history = sim.fit(1)
+        assert len(history) == 1

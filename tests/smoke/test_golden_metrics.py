@@ -17,6 +17,7 @@ import harness  # noqa: E402
 ENV_CONDITIONAL = {"fedavg_real_mnist"}
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", sorted(harness.CONFIGS))
 def test_golden_metrics(name):
     golden_file = harness.GOLDEN_DIR / f"{name}.json"

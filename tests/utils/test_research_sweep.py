@@ -5,7 +5,11 @@ import runpy
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent.parent
+
+pytestmark = pytest.mark.slow
 
 
 def test_cifar10_sweep_tiny(monkeypatch, capsys):
@@ -20,3 +24,19 @@ def test_cifar10_sweep_tiny(monkeypatch, capsys):
     assert '"best"' in out
     # ranked results include both algorithms
     assert '"fedavg"' in out and '"fedprox"' in out
+
+
+def test_ag_news_sweep_tiny(monkeypatch, capsys):
+    """Dynamic-layer + sparse-COO exchange on TRANSFORMER param trees —
+    the reference's research/ag_news experiment shape (those exchangers
+    otherwise only ever see CNN-sized trees in the suite)."""
+    monkeypatch.setenv("FL4HEALTH_SWEEP_TINY", "1")
+    old_path = list(sys.path)
+    try:
+        runpy.run_path(str(REPO / "research" / "ag_news" / "sweep.py"),
+                       run_name="__main__")
+    finally:
+        sys.path[:] = old_path
+    out = capsys.readouterr().out
+    assert '"best"' in out
+    assert '"dynamic_layer"' in out and '"sparse_coo"' in out and '"full"' in out
