@@ -91,7 +91,15 @@ def make_sim(model_kind: str = "cifar_cnn"):
     dtype = _bench_dtype()
     datasets = []
     if model_kind == "cifar_cnn":
-        module = CifarNet(dtype=dtype)
+        # "mxu" lowers the per-client vmapped convs as im2col + batched
+        # matmul instead of grouped convolutions (models/cnn.py MxuConv) —
+        # the grouped-conv lowering is the suspected TPU MFU limiter
+        # (BENCH_r03 note). Measured on XLA:CPU the im2col TRAIN step is
+        # ~3.4x SLOWER (the patches backward lowers to scatter-add), so the
+        # default stays "lax" until a TPU measurement decides; flip with
+        # FL4HEALTH_BENCH_CONV=mxu and compare conv_impl fields.
+        conv_impl = os.environ.get("FL4HEALTH_BENCH_CONV", "lax")
+        module = CifarNet(dtype=dtype, conv_impl=conv_impl)
         n_clients = N_CLIENTS
         for i in range(n_clients):
             x, y = synthetic_classification(
@@ -379,6 +387,7 @@ def run_measurement() -> None:
         "data_provenance": "synthetic",
         "tflops": cifar["tflops"],
         "mfu_pct": cifar["mfu_pct"],
+        "conv_impl": os.environ.get("FL4HEALTH_BENCH_CONV", "lax"),
         "execution_mode": cifar["execution_mode"],
         "rounds_per_dispatch": cifar["rounds_per_dispatch"],
         "steps_per_sec_single_dispatch": cifar["steps_per_sec_single_dispatch"],

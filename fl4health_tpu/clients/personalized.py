@@ -130,6 +130,12 @@ class DittoPersonalizedLogic(ClientLogic):
     def init_extra(self, params: Params):
         return self.base.init_extra(params[PERSONAL])
 
+    def augment(self, batch: Batch, rng, ctx: _DittoWrapCtx) -> Batch:
+        """Forward the base logic's train-time augmentation (e.g. nnU-Net's
+        on-device transforms) — a personalized wrapper must not silently
+        drop the wrapped algorithm's regularization."""
+        return self.base.augment(batch, rng, ctx.base_ctx)
+
     def init_round_context(self, state: TrainState, payload) -> _DittoWrapCtx:
         lam = getattr(payload, "drift_penalty_weight", None)
         if lam is None:
@@ -252,6 +258,12 @@ class MrMtlPersonalizedLogic(ClientLogic):
 
     def init_extra(self, params: Params):
         return self.base.init_extra(params)
+
+    def augment(self, batch: Batch, rng, ctx) -> Batch:
+        """Forward the base logic's train-time augmentation (see the Ditto
+        wrapper's note)."""
+        base_ctx = ctx.base_ctx if isinstance(ctx, _MrMtlWrapCtx) else ctx
+        return self.base.augment(batch, rng, base_ctx)
 
     def init_round_context(self, state: TrainState, payload) -> _MrMtlWrapCtx:
         lam = getattr(payload, "drift_penalty_weight", None)

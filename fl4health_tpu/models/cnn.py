@@ -37,24 +37,86 @@ class MnistNet(nn.Module):
         return {"prediction": logits}, {"features": features}
 
 
+class MxuConv(nn.Module):
+    """2-D convolution lowered as im2col + matmul, parameter-compatible with
+    ``nn.Conv`` (same HWIO kernel + bias shapes, same output up to float
+    association).
+
+    Why it exists: the cohort engine vmaps local training over a leading
+    [clients] axis of per-client WEIGHTS, which turns every ``nn.Conv`` into
+    a batched-kernel (grouped) convolution — the suspected TPU MFU limiter
+    for the cohort CNN (BENCH_r03 note). Patch extraction
+    (``conv_general_dilated_patches``) is weight-independent, so under the
+    clients-vmap it stays a single unbatched op, and the only batched op
+    left is a plain ``dot_general`` with a leading batch dim — the shape the
+    MXU is built for.
+
+    Measured caveat (2026-07, 8-client vmapped CifarNet train step): on
+    XLA:CPU this path is ~3.4x SLOWER than the grouped-conv lowering — the
+    patches BACKWARD is a col2im scatter-add, which XLA:CPU runs poorly.
+    The TPU comparison is the one that matters and must be measured there
+    (``FL4HEALTH_BENCH_CONV=mxu``); this module is the experiment vehicle,
+    not a universally-better default.
+    """
+
+    features: int
+    kernel_size: tuple[int, int] = (3, 3)
+    padding: str = "SAME"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kh, kw, cin, self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        patches = jax.lax.conv_general_dilated_patches(
+            x.astype(self.dtype), (kh, kw), (1, 1), self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        # patches feature dim is ordered (cin, kh, kw); fold the kernel the
+        # same way so parameters stay interchangeable with nn.Conv.
+        w = jnp.transpose(kernel, (2, 0, 1, 3)).reshape(
+            cin * kh * kw, self.features
+        )
+        y = patches @ w.astype(self.dtype)
+        return y + bias.astype(self.dtype)
+
+
 class CifarNet(nn.Module):
     """CIFAR-10 CNN (examples/models/cnn_model.py Net equivalent).
 
     ``dtype`` sets the compute dtype (params stay fp32): bf16 here is the
     TPU mixed-precision path — MXU-native matmuls/convs, fp32 logits out.
+    ``conv_impl``: "lax" uses ``nn.Conv``; "mxu" uses the im2col ``MxuConv``
+    (identical params/outputs, radically better lowering under the
+    per-client-weights vmap — see MxuConv).
     """
 
     n_classes: int = 10
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str = "lax"
+
+    def _conv(self, features, kernel_size, name):
+        # Explicit names pin BOTH impls to the same param paths ("Conv_0",
+        # "Conv_1" — nn.Conv's auto-names), so the tree structure, the
+        # RNG-keyed initial values, and any checkpoint/exchange path filters
+        # are identical regardless of conv_impl.
+        if self.conv_impl == "mxu":
+            return MxuConv(features, kernel_size, dtype=self.dtype, name=name)
+        return nn.Conv(features, kernel_size, dtype=self.dtype, name=name)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         # x: [B, 32, 32, 3]
         x = x.astype(self.dtype)
-        x = nn.Conv(32, (5, 5), dtype=self.dtype)(x)
+        x = self._conv(32, (5, 5), "Conv_0")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(64, (5, 5), dtype=self.dtype)(x)
+        x = self._conv(64, (5, 5), "Conv_1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))

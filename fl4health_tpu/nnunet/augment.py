@@ -16,8 +16,13 @@ replaced by their grid-exact counterparts (axis mirrors + 90-degree rotations
 on isotropic axis pairs) — the standard lossless subset; everything intensity-
 side (noise/brightness/contrast/gamma) matches the nnU-Net family directly.
 
-Default probabilities follow nnunetv2's defaults: noise p=0.1, brightness
-p=0.15, contrast p=0.15, gamma p=0.3, mirror p=0.5 per axis.
+Default probabilities follow nnunetv2's defaults: noise p=0.1 (variance-
+uniform), brightness p=0.15, contrast p=0.15, gamma p=0.3 (retain_stats)
++ invert-image gamma p=0.1, mirror p=0.5 per axis. Known deviations from
+the nnunetv2 pipeline, by design: free-angle rotation, elastic deformation,
+random scaling/zoom, and low-resolution simulation are omitted (all require
+interpolating resamplers — hostile to static-shape compiled code); mirrors
++ rot90 carry the spatial role.
 """
 
 from __future__ import annotations
@@ -69,12 +74,16 @@ def _rot90_one(x, y, key, pairs, p):
     return jnp.where(do, rx, x), jnp.where(do, ry, y)
 
 
-def _noise_one(x, key, p, sigma_max):
+def _noise_one(x, key, p, variance_max):
+    """Additive Gaussian noise, variance ~ U(0, variance_max) — nnU-Net's
+    GaussianNoiseTransform draws the VARIANCE uniformly (sigma = sqrt(var)),
+    not sigma itself."""
     do = _bernoulli(jax.random.fold_in(key, 0), p)
-    sigma = jax.random.uniform(jax.random.fold_in(key, 1), (), minval=0.0,
-                               maxval=sigma_max)
-    noise = sigma * jax.random.normal(jax.random.fold_in(key, 2), x.shape,
-                                      x.dtype)
+    var = jax.random.uniform(jax.random.fold_in(key, 1), (), minval=0.0,
+                             maxval=variance_max)
+    noise = jnp.sqrt(var) * jax.random.normal(
+        jax.random.fold_in(key, 2), x.shape, x.dtype
+    )
     return jnp.where(do, x + noise, x)
 
 
@@ -99,22 +108,30 @@ def _contrast_one(x, key, p, lo, hi):
     return jnp.where(do, scaled, x)
 
 
-def _gamma_one(x, key, p, lo, hi):
-    """Gamma on the patch rescaled to [0,1] per channel, then mapped back —
-    valid on z-scored (signed) data, nnU-Net's GammaTransform recipe.
-    With p/2, invert first (the invert_image=True variant)."""
+def _gamma_one(x, key, p, lo, hi, invert):
+    """Gamma on the patch rescaled to [0,1] per channel, mapped back, with
+    the per-channel mean/std restored afterwards (nnU-Net's GammaTransform
+    with retain_stats=True — without restoration, gamma shifts the z-scored
+    statistics the normalization established). ``invert`` selects the
+    invert_image=True variant (gamma applied to the negated image)."""
     do = _bernoulli(jax.random.fold_in(key, 0), p)
     gamma = jax.random.uniform(jax.random.fold_in(key, 1), (), minval=lo,
                                maxval=hi)
-    invert = _bernoulli(jax.random.fold_in(key, 2), 0.5)
     spatial = tuple(range(x.ndim - 1))
-    xin = jnp.where(invert, -x, x)
+    mean0 = jnp.mean(x, axis=spatial, keepdims=True)
+    std0 = jnp.std(x, axis=spatial, keepdims=True)
+    xin = -x if invert else x
     mn = jnp.min(xin, axis=spatial, keepdims=True)
     mx = jnp.max(xin, axis=spatial, keepdims=True)
     rng_ = jnp.maximum(mx - mn, 1e-7)
     unit = (xin - mn) / rng_
     out = jnp.power(jnp.maximum(unit, 1e-7), gamma) * rng_ + mn
-    out = jnp.where(invert, -out, out)
+    if invert:
+        out = -out
+    # retain_stats: restore the pre-transform per-channel mean/std
+    mean1 = jnp.mean(out, axis=spatial, keepdims=True)
+    std1 = jnp.std(out, axis=spatial, keepdims=True)
+    out = (out - mean1) / jnp.maximum(std1, 1e-7) * std0 + mean0
     return jnp.where(do, out, x)
 
 
@@ -133,7 +150,7 @@ def _isotropic_pairs(spatial_shape: Sequence[int]) -> tuple:
 @functools.partial(
     jax.jit,
     static_argnames=("p_mirror", "p_rot90", "p_noise", "p_brightness",
-                     "p_contrast", "p_gamma"),
+                     "p_contrast", "p_gamma", "p_gamma_invert"),
 )
 def augment_patch_batch(
     x: jax.Array,
@@ -145,19 +162,24 @@ def augment_patch_batch(
     p_brightness: float = 0.15,
     p_contrast: float = 0.15,
     p_gamma: float = 0.3,
+    p_gamma_invert: float = 0.1,
 ) -> tuple[jax.Array, jax.Array]:
     """Augment one batch: x [B, *spatial, C] float, y [B, *spatial] int.
 
     Spatial transforms (mirror, rot90 on equal-size axis pairs) apply to x
-    and y together; intensity transforms (noise, brightness, contrast, gamma)
-    to x only. Every decision is drawn per example from ``rng``.
+    and y together; intensity transforms (noise, brightness, contrast, two
+    gamma variants) to x only. Every decision is drawn per example from
+    ``rng``. Matches nnunetv2's default intensity family: noise VARIANCE ~
+    U(0, 0.1) at p=0.1, brightness/contrast (0.75, 1.25) at p=0.15,
+    gamma (0.7, 1.5) with retain_stats at p=0.3 plus the separate
+    invert-image gamma at p=0.1.
     """
     spatial = x.shape[1:-1]
     pairs = _isotropic_pairs(spatial)
     spatial_axes = tuple(range(len(spatial)))  # per-example x axes, pre-C
 
     def one(xe, ye, key):
-        keys = jax.random.split(key, 6)
+        keys = jax.random.split(key, 7)
         xe, ye = _mirror_one(
             xe, ye, keys[0], tuple(a for a in spatial_axes), p_mirror
         )
@@ -165,7 +187,8 @@ def augment_patch_batch(
         xe = _noise_one(xe, keys[2], p_noise, 0.1)
         xe = _brightness_one(xe, keys[3], p_brightness, 0.75, 1.25)
         xe = _contrast_one(xe, keys[4], p_contrast, 0.75, 1.25)
-        xe = _gamma_one(xe, keys[5], p_gamma, 0.7, 1.5)
+        xe = _gamma_one(xe, keys[5], p_gamma_invert, 0.7, 1.5, invert=True)
+        xe = _gamma_one(xe, keys[6], p_gamma, 0.7, 1.5, invert=False)
         return xe, ye
 
     keys = jax.random.split(rng, x.shape[0])

@@ -463,9 +463,21 @@ class FederatedSimulation:
         Returns per-round stacked (losses, metrics) dicts; updates the
         simulation state in place.
 
+        Incompatible with ``train_data_provider``: the chunk bakes its data
+        stacks at dispatch time, so per-round host refresh cannot happen
+        inside it — raising beats silently training k rounds on a frozen
+        bank.
+
         Participation matches ``fit``: each round's mask is drawn from the
         same PRNG stream (fold_in(rng, 2000+round)) via the client manager.
         Pass ``mask`` ([clients] or [k, clients]) to pin it instead."""
+        if self.train_data_provider is not None:
+            raise ValueError(
+                "fit_chunk cannot honor train_data_provider (per-round data "
+                "refresh happens on the host, between dispatches); use "
+                "fit(), or chunk with the provider disabled if a frozen "
+                "bank is acceptable"
+            )
         chunked = self.make_chunked_fit()
         plans = [self._round_plan(start_round + i) for i in range(k)]
         idx = jnp.asarray(np.stack([p[0] for p in plans]))

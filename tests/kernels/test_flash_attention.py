@@ -89,6 +89,7 @@ def test_jit_and_vmap_compose():
     _assert_close(out[1], _dense_attention(q * 0.5, k, v))
 
 
+@pytest.mark.slow
 def test_transformer_with_flash_attention_matches_dense():
     # the kernel as the transformer's attention core (models/transformer.py
     # attention_fn seam — same plug point ring attention uses)

@@ -1,0 +1,89 @@
+"""MxuConv (im2col + matmul) must be a drop-in for nn.Conv: identical param
+trees and initial values, matching outputs and gradients, and agreement
+under the per-client-weights vmap that motivates it (the cohort engine's
+grouped-conv hazard, BENCH_r03 note)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from fl4health_tpu.models.cnn import CifarNet, MxuConv
+
+
+def _inputs(b=4, hw=16, c=3, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (b, hw, hw, c))
+
+
+class TestMxuConvParity:
+    def test_same_params_same_output(self):
+        x = _inputs()
+        ref = nn.Conv(8, (5, 5))
+        mxu = MxuConv(8, (5, 5))
+        params = ref.init(jax.random.PRNGKey(1), x)
+        out_ref = ref.apply(params, x)
+        out_mxu = mxu.apply(params, x)  # identical param shapes/names
+        np.testing.assert_allclose(
+            np.asarray(out_mxu), np.asarray(out_ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_gradients_match(self):
+        x = _inputs(seed=2)
+        ref = nn.Conv(8, (3, 3))
+        mxu = MxuConv(8, (3, 3))
+        params = ref.init(jax.random.PRNGKey(1), x)
+
+        def loss(m, p):
+            return jnp.sum(m.apply(p, x) ** 2)
+
+        g_ref = jax.grad(lambda p: loss(ref, p))(params)
+        g_mxu = jax.grad(lambda p: loss(mxu, p))(params)
+        for a, b in zip(jax.tree_util.tree_leaves(g_mxu),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_vmapped_per_client_weights_agree(self):
+        """The motivating case: a [clients] axis on the WEIGHTS. The im2col
+        path must agree with the grouped-conv lowering it replaces."""
+        x = _inputs(b=2, hw=8)
+        ref = nn.Conv(4, (3, 3))
+        mxu = MxuConv(4, (3, 3))
+        stack = jax.vmap(lambda k: ref.init(k, x))(
+            jax.random.split(jax.random.PRNGKey(0), 3)
+        )
+        out_ref = jax.vmap(lambda p: ref.apply(p, x))(stack)
+        out_mxu = jax.vmap(lambda p: mxu.apply(p, x))(stack)
+        np.testing.assert_allclose(
+            np.asarray(out_mxu), np.asarray(out_ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_cifarnet_impls_share_init_and_agree(self):
+        """conv_impl must not change the param tree, the RNG-keyed init
+        values, or (within float tolerance) the forward outputs."""
+        x = _inputs(b=2, hw=32, c=3)
+        lax_net = CifarNet()
+        mxu_net = CifarNet(conv_impl="mxu")
+        v_lax = lax_net.init(jax.random.PRNGKey(3), x, train=False)
+        v_mxu = mxu_net.init(jax.random.PRNGKey(3), x, train=False)
+        assert (jax.tree_util.tree_structure(v_lax)
+                == jax.tree_util.tree_structure(v_mxu))
+        for a, b in zip(jax.tree_util.tree_leaves(v_lax),
+                        jax.tree_util.tree_leaves(v_mxu)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        p_lax, _ = lax_net.apply(v_lax, x, train=False)
+        p_mxu, _ = mxu_net.apply(v_lax, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(p_mxu["prediction"]), np.asarray(p_lax["prediction"]),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_valid_padding(self):
+        x = _inputs(b=2, hw=10, c=2, seed=5)
+        ref = nn.Conv(6, (3, 3), padding="VALID")
+        mxu = MxuConv(6, (3, 3), padding="VALID")
+        params = ref.init(jax.random.PRNGKey(1), x)
+        np.testing.assert_allclose(
+            np.asarray(mxu.apply(params, x)), np.asarray(ref.apply(params, x)),
+            rtol=1e-5, atol=1e-5,
+        )

@@ -112,6 +112,7 @@ class TestLora:
             if not is_peft:
                 assert bool(jnp.all(leaf == 0)), f"{dotted} leaked onto the wire"
 
+    @pytest.mark.slow
     def test_masked_optimizer_freezes_base_weights(self):
         m = small_model(lora_rank=2, n_layers=1)
         x, y = synthetic_text_classification(jax.random.PRNGKey(0), 8, VOCAB, SEQ, CLASSES)
@@ -188,6 +189,7 @@ class TestFederatedLora:
 
 
 class TestRemat:
+    @pytest.mark.slow
     def test_remat_gradients_match_unremat(self):
         # remat=True must be a pure memory/FLOPs trade: same params tree,
         # same gradients (jax.checkpoint recomputes, never changes math)

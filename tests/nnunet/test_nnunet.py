@@ -302,6 +302,7 @@ def _build_sim_factory(client_volumes, n_rounds, local_steps, batch_size):
 
 
 class TestFederatedSegmentation:
+    @pytest.mark.slow
     def test_plans_negotiation_and_training_round(self):
         """The §3.5 handshake: server has no plans, polls a client, builds the
         model from the returned plans, and the federated job trains."""

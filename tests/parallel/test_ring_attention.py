@@ -96,6 +96,7 @@ class TestRingAttention:
         )
 
 
+@pytest.mark.slow
 def test_transformer_with_ring_attention_matches_dense(eight_devices):
     """The long-context path: TransformerClassifier(attention_fn=ring) on a
     (seq,) mesh reproduces the dense-attention model's logits."""

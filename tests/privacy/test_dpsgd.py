@@ -94,6 +94,7 @@ def test_instance_level_dp_step_runs_and_updates():
     assert max(jax.tree_util.tree_leaves(moved)) > 0
 
 
+@pytest.mark.slow
 def test_dp_zero_noise_matches_clipped_nondp_direction():
     """With sigma=0 and a huge bound, DP grads equal the batch-mean gradient."""
     logic = _dp_logic(clipping_bound=1e9, noise_multiplier=0.0)

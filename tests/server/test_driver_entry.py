@@ -12,6 +12,7 @@ import subprocess
 import sys
 
 import jax
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -35,11 +36,13 @@ def test_dryrun_multichip_two_devices(eight_devices):
     mod.dryrun_multichip(2)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_eight_devices(eight_devices):
     mod = _graft_entry()
     mod.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_bench_produces_json_line():
     env = dict(os.environ)
     env.update(

@@ -5,6 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from fl4health_tpu.clients import engine
 from fl4health_tpu.datasets.synthetic import synthetic_classification
@@ -47,6 +48,7 @@ def _sim(**kwargs):
     return FederatedSimulation(**defaults)
 
 
+@pytest.mark.slow
 def test_fedavg_learns_and_records_history():
     sim = _sim()
     history = sim.fit(n_rounds=6)
@@ -59,6 +61,7 @@ def test_fedavg_learns_and_records_history():
     assert max(accs) > 0.6
 
 
+@pytest.mark.slow
 def test_fedavg_deterministic_across_runs():
     h1 = _sim().fit(n_rounds=2)
     h2 = _sim().fit(n_rounds=2)
