@@ -354,6 +354,13 @@ def run_measurement() -> None:
     if os.environ.get("FL4HEALTH_BENCH_ONLY") == "transformer":
         print(json.dumps(_measure_config("transformer", with_eager=False)))
         return
+    if os.environ.get("FL4HEALTH_BENCH_ONLY") == "cifar_noeager":
+        # Alt-config child (e.g. the mxu-conv comparison): compiled
+        # measurement only, no eager baseline.
+        out = _measure_config("cifar_cnn", with_eager=False)
+        out["conv_impl"] = os.environ.get("FL4HEALTH_BENCH_CONV", "lax")
+        print(json.dumps(out))
+        return
 
     cifar = _measure_config("cifar_cnn", with_eager=True)
     # Name reflects the actual config; a CPU-fallback run is labeled as such
@@ -522,6 +529,33 @@ def main() -> None:
             record["transformer"] = json.loads(tf_line)
         else:
             record["transformer"] = {"skipped": "transformer child failed/timed out"}
+
+    # Conv-impl A/B on real TPU (self-deciding: the round-3 question of
+    # whether grouped convs or im2col wins on the MXU gets answered by the
+    # artifact itself, even if no operator is watching when the tunnel is
+    # up). Skipped on the CPU fallback — the answer there is known (lax
+    # wins, see make_sim) and the budget is tight. The A/B only spends
+    # whatever the probe/cifar/transformer children left UNUSED of the total
+    # budget (they rarely exhaust their slices), so worst-case wall time
+    # stays within CHILD_TIMEOUT_S — the headline record must never be lost
+    # to an optional extra.
+    ab_budget = int(CHILD_TIMEOUT_S - (time.monotonic() - t_start)) - 30
+    if (not on_fallback and ab_budget >= 120
+            and "FL4HEALTH_BENCH_CONV" not in os.environ
+            and os.environ.get("FL4HEALTH_BENCH_CONV_AB", "1") == "1"):
+        alt_line = attempt(
+            force_cpu=False, timeout_s=ab_budget,
+            only="cifar_noeager", extra_env={"FL4HEALTH_BENCH_CONV": "mxu"},
+        )
+        if alt_line is not None:
+            record["conv_mxu_alt"] = json.loads(alt_line)
+            alt_sps = record["conv_mxu_alt"].get("steps_per_sec_per_chip", 0)
+            if alt_sps and alt_sps > record["value"]:
+                record["note_conv"] = (
+                    f"mxu conv_impl measured FASTER ({alt_sps} vs "
+                    f"{record['value']} steps/s) — flip the default "
+                    "(FL4HEALTH_BENCH_CONV) next round"
+                )
     print(json.dumps(record))
 
 

@@ -40,3 +40,46 @@ def test_ag_news_sweep_tiny(monkeypatch, capsys):
     out = capsys.readouterr().out
     assert '"best"' in out
     assert '"dynamic_layer"' in out and '"sparse_coo"' in out and '"full"' in out
+
+
+def test_synthetic_data_sweep_tiny(monkeypatch, capsys):
+    """fedavg vs ditto vs mr_mtl on the FedProx alpha/beta synthetic corpus
+    (reference research/synthetic_data shape)."""
+    monkeypatch.setenv("FL4HEALTH_SWEEP_TINY", "1")
+    old_path = list(sys.path)
+    try:
+        runpy.run_path(str(REPO / "research" / "synthetic_data" / "sweep.py"),
+                       run_name="__main__")
+    finally:
+        sys.path[:] = old_path
+    out = capsys.readouterr().out
+    assert '"best"' in out
+    assert '"ditto"' in out and '"mr_mtl"' in out and '"fedavg"' in out
+
+
+def test_rxrx1_sweep_tiny(monkeypatch, capsys):
+    """Site-shifted microscopy corpus, personalization arms (reference
+    research/rxrx1 shape; real data via FL4HEALTH_RXRX1_DIR)."""
+    monkeypatch.setenv("FL4HEALTH_SWEEP_TINY", "1")
+    old_path = list(sys.path)
+    try:
+        runpy.run_path(str(REPO / "research" / "rxrx1" / "sweep.py"),
+                       run_name="__main__")
+    finally:
+        sys.path[:] = old_path
+    out = capsys.readouterr().out
+    assert '"best"' in out and '"ditto"' in out
+
+
+def test_picai_sweep_tiny(monkeypatch, capsys):
+    """Federated nnU-Net lr sweep with plans negotiation (reference
+    research/picai shape; real volumes via FL4HEALTH_PICAI_DIR)."""
+    monkeypatch.setenv("FL4HEALTH_SWEEP_TINY", "1")
+    old_path = list(sys.path)
+    try:
+        runpy.run_path(str(REPO / "research" / "picai" / "sweep.py"),
+                       run_name="__main__")
+    finally:
+        sys.path[:] = old_path
+    out = capsys.readouterr().out
+    assert '"best"' in out and '"dice"' in out
