@@ -52,6 +52,37 @@ PEAK_BF16_FLOPS = {
     "TPU v6 lite": 918e12,  # v6e / Trillium
 }
 
+# FLOP-based bridge to the north star (BASELINE.json: >=10x vs single-A100
+# Flower simulation). The A100 run cannot exist in this environment, so the
+# bridge MODELS it: the per-round FLOPs are identical (same model/config),
+# so speedup = (measured TPU TFLOP/s) / (A100 peak x assumed Flower
+# utilization). The utilization band is an ASSUMPTION, stated in the
+# artifact: Flower's simulation dispatches clients sequentially through
+# eager torch with gRPC/NumPy round-trips per round; small-CNN eager
+# training on big accelerators typically lands at a few percent of peak,
+# and the band's upper end (10%) is deliberately generous to the baseline
+# so the modeled speedup under-claims rather than over-claims.
+A100_PEAK_BF16_FLOPS = 312e12
+FLOWER_A100_UTIL_BAND = (0.01, 0.10)
+
+
+def modeled_vs_a100_flower(achieved_flops: float) -> dict | None:
+    """Assumption-based bridge, not a measurement — returns the modeled
+    speedup band with its assumptions embedded in the record."""
+    if not achieved_flops:
+        return None
+    lo_util, hi_util = FLOWER_A100_UTIL_BAND
+    return {
+        # generous-to-baseline utilization -> LOW end of our speedup
+        "low": round(achieved_flops / (hi_util * A100_PEAK_BF16_FLOPS), 2),
+        "high": round(achieved_flops / (lo_util * A100_PEAK_BF16_FLOPS), 2),
+        "model": (
+            "measured TFLOP/s / (A100 312 TFLOP/s bf16 x assumed Flower "
+            f"utilization {lo_util:.0%}-{hi_util:.0%}); FLOP-parity bridge "
+            "(same model+config), NOT an A100 measurement"
+        ),
+    }
+
 
 def _provenance() -> tuple[str, str]:
     import jax
@@ -325,6 +356,10 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
         "tflops": round(achieved_flops / 1e12, 3),
         "mfu_pct": round(100.0 * achieved_flops / peak, 2) if peak else None,
     }
+    # Only meaningful against a real accelerator measurement: the bridge on
+    # a CPU-fallback number would "model" nothing.
+    if peak:
+        out["vs_a100_flower_modeled"] = modeled_vs_a100_flower(achieved_flops)
     if with_eager:
         eager_time, eager_measured = timed_eager_round(sim)
         eager_sps = steps_per_round / eager_time
@@ -394,6 +429,9 @@ def run_measurement() -> None:
         "data_provenance": "synthetic",
         "tflops": cifar["tflops"],
         "mfu_pct": cifar["mfu_pct"],
+        # Assumption-based bridge to BASELINE.json's >=10x-vs-A100-Flower
+        # north star (see modeled_vs_a100_flower); null off-TPU.
+        "vs_a100_flower_modeled": cifar.get("vs_a100_flower_modeled"),
         "conv_impl": os.environ.get("FL4HEALTH_BENCH_CONV", "lax"),
         "execution_mode": cifar["execution_mode"],
         "rounds_per_dispatch": cifar["rounds_per_dispatch"],

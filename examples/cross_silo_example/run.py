@@ -27,7 +27,13 @@ import numpy as np  # noqa: E402
 from fl4health_tpu.clients import engine  # noqa: E402
 from fl4health_tpu.datasets.synthetic import synthetic_classification  # noqa: E402
 from fl4health_tpu.models.cnn import Mlp  # noqa: E402
-from fl4health_tpu.transport import LoopbackServer, call, decode, encode  # noqa: E402
+from fl4health_tpu.transport import (  # noqa: E402
+    LoopbackServer,
+    broadcast_round,
+    decode,
+    encode,
+    weighted_merge,
+)
 
 cfg = lib.example_config(Path(__file__).parent)
 
@@ -73,16 +79,11 @@ reply_template = {
 global_params = init_params
 try:
     for rnd in range(1, int(cfg["n_server_rounds"]) + 1):
-        replies = [
-            decode(call(srv.host, srv.port, encode(global_params)), like=reply_template)
-            for srv, _ in silos
-        ]
-        weights = np.asarray([float(r["n"]) for r in replies])
-        weights = weights / weights.sum()
-        global_params = jax.tree_util.tree_map(
-            lambda *leaves: sum(w * l for w, l in zip(weights, leaves)),
-            *[r["params"] for r in replies],
+        replies = broadcast_round(
+            [(srv.host, srv.port) for srv, _ in silos],
+            global_params, reply_template,
         )
+        global_params, _ = weighted_merge(replies)
         mean_loss = float(np.mean([float(r["loss"]) for r in replies]))
         print(json.dumps({"round": rnd, "fit_loss": round(mean_loss, 5)}))
 finally:

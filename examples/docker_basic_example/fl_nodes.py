@@ -90,7 +90,11 @@ def serve_silo(seed: int, batch_size: int, local_steps: int,
 def coordinate_round(addrs: list[tuple[str, int]], global_params):
     """One FedAvg round over the wire: broadcast → local fit → weighted merge.
     Silo RPCs fan out concurrently (the containers train in parallel; round
-    latency is the slowest silo, not the sum)."""
+    latency is the slowest silo, not the sum) — hence the thread pool here
+    instead of transport.broadcast_round's sequential loop; the merge IS the
+    shared helper."""
+    from fl4health_tpu.transport import weighted_merge
+
     frame = encode(global_params)
     like = {"params": global_params, "n": jnp.asarray(0.0),
             "loss": jnp.asarray(0.0), "accuracy": jnp.asarray(0.0)}
@@ -100,12 +104,7 @@ def coordinate_round(addrs: list[tuple[str, int]], global_params):
                                 like=like),
             addrs,
         ))
-    weights = np.asarray([float(r["n"]) for r in results])
-    weights = weights / weights.sum()
-    merged = jax.tree_util.tree_map(
-        lambda *leaves: sum(w * leaf for w, leaf in zip(weights, leaves)),
-        *[r["params"] for r in results],
-    )
+    merged, weights = weighted_merge(results)
     stats = {
         "fit_loss": float(np.average([float(r["loss"]) for r in results],
                                      weights=weights)),

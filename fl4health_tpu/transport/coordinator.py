@@ -1,0 +1,51 @@
+"""Coordinator-side primitives for cross-silo rounds over the wire.
+
+The broadcast/gather/weighted-merge core that every host-RPC deployment
+shares (examples/cross_silo_example, examples/docker_basic_example,
+research/fedprox_cluster) — the role Flower's server-side
+``aggregate_fit``/NumPy ndarray plumbing plays in the reference
+(/root/reference/fl4health/strategies/basic_fedavg.py ``aggregate_fit``
+over gRPC results). One implementation so the wire pattern (single
+serialization per round, n-weighted FedAvg over reply trees) has one home.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+
+from fl4health_tpu.transport.codec import decode, encode
+from fl4health_tpu.transport.loopback import call
+
+
+def broadcast_round(
+    silos: Sequence[tuple[str, int]],
+    global_params: Any,
+    reply_template: Mapping[str, Any],
+    timeout: float | None = None,
+) -> list[dict[str, Any]]:
+    """Send the global params to every silo (ONE serialization — the frame
+    is identical) and decode each reply against ``reply_template``."""
+    frame = encode(global_params)
+    kwargs = {} if timeout is None else {"timeout": timeout}
+    return [
+        decode(call(host, port, frame, **kwargs), like=reply_template)
+        for host, port in silos
+    ]
+
+
+def weighted_merge(
+    replies: Sequence[Mapping[str, Any]],
+    params_key: str = "params",
+    weight_key: str = "n",
+) -> tuple[Any, np.ndarray]:
+    """n-weighted FedAvg over reply param trees -> (merged, weights)."""
+    weights = np.asarray([float(r[weight_key]) for r in replies])
+    weights = weights / weights.sum()
+    merged = jax.tree_util.tree_map(
+        lambda *leaves: sum(w * leaf for w, leaf in zip(weights, leaves)),
+        *[r[params_key] for r in replies],
+    )
+    return merged, weights

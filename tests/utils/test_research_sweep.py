@@ -71,6 +71,26 @@ def test_rxrx1_sweep_tiny(monkeypatch, capsys):
     assert '"best"' in out and '"ditto"' in out
 
 
+def test_fedprox_cluster_tiny(monkeypatch, capsys, tmp_path):
+    """The job-per-(mu,run) cluster shape over the cross-silo TCP wire with
+    file-based find_best_hp_dir selection (reference research/fedprox_cluster
+    run_fl_cluster.sh + find_best_hp.py flow)."""
+    monkeypatch.setenv("FL4HEALTH_SWEEP_TINY", "1")
+    monkeypatch.setenv("FL4HEALTH_CLUSTER_DIR", str(tmp_path))
+    old_path = list(sys.path)
+    try:
+        runpy.run_path(
+            str(REPO / "research" / "fedprox_cluster" / "run_local_cluster.py"),
+            run_name="__main__",
+        )
+    finally:
+        sys.path[:] = old_path
+    out = capsys.readouterr().out
+    assert '"best": "mu_0.1"' in out
+    dumps = list(tmp_path.glob("sweep_*/mu_0.1/Run1/server_metrics.json"))
+    assert len(dumps) == 1
+
+
 def test_picai_sweep_tiny(monkeypatch, capsys):
     """Federated nnU-Net lr sweep with plans negotiation (reference
     research/picai shape; real volumes via FL4HEALTH_PICAI_DIR)."""
