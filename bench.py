@@ -121,6 +121,23 @@ def make_sim(model_kind: str = "cifar_cnn"):
 
     dtype = _bench_dtype()
     datasets = []
+
+    def split_train_val(x, y):
+        # shared train/val slicing for every config's ClientDataset
+        n = BATCH * LOCAL_STEPS
+        return ClientDataset(x_train=x[:n], y_train=y[:n],
+                             x_val=x[n:], y_val=y[n:])
+
+    def flash_requested(default: bool) -> bool:
+        """One semantics for FL4HEALTH_BENCH_FLASH across configs:
+        '1'/'true' forces the Pallas kernel, '0'/'false' forces dense,
+        unset/other -> the config's default."""
+        v = os.environ.get("FL4HEALTH_BENCH_FLASH", "").lower()
+        if v in ("1", "true"):
+            return True
+        if v in ("0", "false"):
+            return False
+        return default
     if model_kind == "cifar_cnn":
         # "mxu" lowers the per-client vmapped convs as im2col + batched
         # matmul instead of grouped convolutions (models/cnn.py MxuConv) —
@@ -136,18 +153,34 @@ def make_sim(model_kind: str = "cifar_cnn"):
             x, y = synthetic_classification(
                 jax.random.PRNGKey(i), BATCH * LOCAL_STEPS + 64, (32, 32, 3), 10
             )
-            datasets.append(
-                ClientDataset(
-                    x_train=x[: BATCH * LOCAL_STEPS],
-                    y_train=y[: BATCH * LOCAL_STEPS],
-                    x_val=x[BATCH * LOCAL_STEPS :],
-                    y_val=y[BATCH * LOCAL_STEPS :],
-                )
+            datasets.append(split_train_val(x, y))
+    elif model_kind == "transformer_long":
+        # Long-context config: the flash-attention Pallas kernel carries the
+        # T² score memory (SURVEY: long-context is first-class). Only worth
+        # timing on real TPU — interpret-mode Pallas on CPU is orders slower.
+        import functools
+
+        from fl4health_tpu.kernels.flash_attention import flash_attention
+
+        seq = int(os.environ.get("FL4HEALTH_BENCH_LONGSEQ", 2048))
+        module = TransformerClassifier(
+            vocab_size=8192, n_classes=4, d_model=512, n_heads=8,
+            n_layers=4, d_ff=2048, max_len=seq, dtype=dtype, remat=True,
+            attention_fn=(
+                functools.partial(flash_attention, block_q=128, block_k=128)
+                if flash_requested(default=True) else None
+            ),
+        )
+        for i in range(2):
+            x, y = synthetic_text_classification(
+                jax.random.PRNGKey(i), BATCH * LOCAL_STEPS + 16,
+                module.vocab_size, seq, module.n_classes,
             )
+            datasets.append(split_train_val(x, y))
     else:  # transformer: the BERT-shaped AG-News config (SURVEY §6)
         seq = int(os.environ.get("FL4HEALTH_BENCH_SEQ", 128))
         attention_fn = None
-        if os.environ.get("FL4HEALTH_BENCH_FLASH") == "1":
+        if flash_requested(default=False):
             import functools
 
             from fl4health_tpu.kernels.flash_attention import flash_attention
@@ -177,14 +210,7 @@ def make_sim(model_kind: str = "cifar_cnn"):
                 jax.random.PRNGKey(i), BATCH * LOCAL_STEPS + 32,
                 module.vocab_size, seq, 4,
             )
-            datasets.append(
-                ClientDataset(
-                    x_train=x[: BATCH * LOCAL_STEPS],
-                    y_train=y[: BATCH * LOCAL_STEPS],
-                    x_val=x[BATCH * LOCAL_STEPS :],
-                    y_val=y[BATCH * LOCAL_STEPS :],
-                )
-            )
+            datasets.append(split_train_val(x, y))
     return FederatedSimulation(
         logic=engine.ClientLogic(
             engine.from_flax(module), engine.masked_cross_entropy
@@ -388,6 +414,15 @@ def run_measurement() -> None:
 
     if os.environ.get("FL4HEALTH_BENCH_ONLY") == "transformer":
         print(json.dumps(_measure_config("transformer", with_eager=False)))
+        return
+    if os.environ.get("FL4HEALTH_BENCH_ONLY") == "transformer_long":
+        out = _measure_config("transformer_long", with_eager=False)
+        out["seq_len"] = int(os.environ.get("FL4HEALTH_BENCH_LONGSEQ", 2048))
+        out["attention"] = (
+            "dense" if os.environ.get("FL4HEALTH_BENCH_FLASH") == "0"
+            else "pallas_flash"
+        )
+        print(json.dumps(out))
         return
     if os.environ.get("FL4HEALTH_BENCH_ONLY") == "cifar_noeager":
         # Alt-config child (e.g. the mxu-conv comparison): compiled
@@ -594,6 +629,30 @@ def main() -> None:
                     f"{record['value']} steps/s) — flip the default "
                     "(FL4HEALTH_BENCH_CONV) next round"
                 )
+
+    # Long-context config (seq 2048 through the Pallas flash-attention
+    # kernel) — TPU-only, with whatever budget remains after everything
+    # else; first real-hardware datapoint for the long-context story.
+    lc_budget = int(CHILD_TIMEOUT_S - (time.monotonic() - t_start)) - 30
+    if (not on_fallback
+            and os.environ.get("FL4HEALTH_BENCH_LONGCTX", "1") == "1"):
+        if lc_budget >= 240:
+            lc_line = attempt(force_cpu=False, timeout_s=lc_budget,
+                              only="transformer_long")
+            # A failed datapoint must be visible in the artifact (same
+            # contract as the transformer sibling), not indistinguishable
+            # from the config being disabled.
+            record["transformer_long"] = (
+                json.loads(lc_line) if lc_line is not None
+                else {"skipped": f"long-context child failed/timed out "
+                                 f"(budget {lc_budget}s)"}
+            )
+        else:
+            record["transformer_long"] = {
+                "skipped": f"insufficient leftover budget ({lc_budget}s) — "
+                "raise FL4HEALTH_BENCH_TIMEOUT_S to capture the "
+                "long-context datapoint"
+            }
     print(json.dumps(record))
 
 

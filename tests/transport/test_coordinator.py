@@ -1,0 +1,72 @@
+"""Coordinator primitives: weighted merge math and the one-serialization
+broadcast against live loopback silos (the shared core of every host-RPC
+deployment; reference role: basic_fedavg.py aggregate_fit over gRPC)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fl4health_tpu.transport import (
+    LoopbackServer,
+    broadcast_round,
+    decode,
+    encode,
+    weighted_merge,
+)
+
+
+class TestWeightedMerge:
+    def test_weights_normalize_and_merge_matches_manual(self):
+        replies = [
+            {"params": {"w": jnp.asarray([1.0, 2.0])}, "n": jnp.asarray(1.0)},
+            {"params": {"w": jnp.asarray([3.0, 6.0])}, "n": jnp.asarray(3.0)},
+        ]
+        merged, weights = weighted_merge(replies)
+        np.testing.assert_allclose(weights, [0.25, 0.75])
+        np.testing.assert_allclose(
+            np.asarray(merged["w"]), [0.25 * 1 + 0.75 * 3, 0.25 * 2 + 0.75 * 6]
+        )
+
+    def test_equal_weights_is_plain_mean(self):
+        replies = [
+            {"params": {"w": jnp.asarray(float(i))}, "n": jnp.asarray(5.0)}
+            for i in range(4)
+        ]
+        merged, _ = weighted_merge(replies)
+        np.testing.assert_allclose(float(merged["w"]), 1.5)
+
+
+class TestBroadcastRound:
+    def test_round_trip_against_live_silos(self):
+        """Each silo adds its own offset to the received params; the
+        coordinator must get every reply decoded against the template."""
+        def make_handler(offset):
+            def handler(frame: bytes) -> bytes:
+                params = decode(frame, like={"w": jnp.zeros(2)})
+                return encode({
+                    "params": {"w": params["w"] + offset},
+                    "n": jnp.asarray(float(offset)),
+                })
+            return handler
+
+        silos = [LoopbackServer(make_handler(o)) for o in (1.0, 3.0)]
+        try:
+            replies = broadcast_round(
+                [(s.host, s.port) for s in silos],
+                {"w": jnp.asarray([10.0, 20.0])},
+                {"params": {"w": jnp.zeros(2)}, "n": jnp.zeros(())},
+            )
+        finally:
+            for s in silos:
+                s.close()
+        assert len(replies) == 2
+        np.testing.assert_allclose(np.asarray(replies[0]["params"]["w"]),
+                                   [11.0, 21.0])
+        np.testing.assert_allclose(np.asarray(replies[1]["params"]["w"]),
+                                   [13.0, 23.0])
+        merged, _ = weighted_merge(replies)
+        # weights 1/4, 3/4
+        np.testing.assert_allclose(
+            np.asarray(merged["w"]),
+            [0.25 * 11 + 0.75 * 13, 0.25 * 21 + 0.75 * 23],
+        )
