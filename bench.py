@@ -84,6 +84,18 @@ def modeled_vs_a100_flower(achieved_flops: float) -> dict | None:
     }
 
 
+def flash_requested(default: bool) -> bool:
+    """One semantics for FL4HEALTH_BENCH_FLASH across configs AND artifact
+    labels: '1'/'true' forces the Pallas kernel, '0'/'false' forces dense,
+    unset/other -> the config's default."""
+    v = os.environ.get("FL4HEALTH_BENCH_FLASH", "").lower()
+    if v in ("1", "true"):
+        return True
+    if v in ("0", "false"):
+        return False
+    return default
+
+
 def _provenance() -> tuple[str, str]:
     import jax
 
@@ -128,16 +140,6 @@ def make_sim(model_kind: str = "cifar_cnn"):
         return ClientDataset(x_train=x[:n], y_train=y[:n],
                              x_val=x[n:], y_val=y[n:])
 
-    def flash_requested(default: bool) -> bool:
-        """One semantics for FL4HEALTH_BENCH_FLASH across configs:
-        '1'/'true' forces the Pallas kernel, '0'/'false' forces dense,
-        unset/other -> the config's default."""
-        v = os.environ.get("FL4HEALTH_BENCH_FLASH", "").lower()
-        if v in ("1", "true"):
-            return True
-        if v in ("0", "false"):
-            return False
-        return default
     if model_kind == "cifar_cnn":
         # "mxu" lowers the per-client vmapped convs as im2col + batched
         # matmul instead of grouped convolutions (models/cnn.py MxuConv) —
@@ -418,9 +420,9 @@ def run_measurement() -> None:
     if os.environ.get("FL4HEALTH_BENCH_ONLY") == "transformer_long":
         out = _measure_config("transformer_long", with_eager=False)
         out["seq_len"] = int(os.environ.get("FL4HEALTH_BENCH_LONGSEQ", 2048))
+        # label derives from the SAME predicate that selected the kernel
         out["attention"] = (
-            "dense" if os.environ.get("FL4HEALTH_BENCH_FLASH") == "0"
-            else "pallas_flash"
+            "pallas_flash" if flash_requested(default=True) else "dense"
         )
         print(json.dumps(out))
         return

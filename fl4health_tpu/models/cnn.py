@@ -12,6 +12,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 
 
@@ -37,53 +38,99 @@ class MnistNet(nn.Module):
         return {"prediction": logits}, {"features": features}
 
 
+_CONV_SPATIAL_CHARS = "DHW"  # trailing chars; rank picks the suffix
+
+
+def _conv_dimension_numbers(rank: int) -> tuple[str, str, str]:
+    """Channels-last dimension-number strings for any spatial rank
+    (1D "NWC", 2D "NHWC", 3D "NDHWC")."""
+    spatial = _CONV_SPATIAL_CHARS[-rank:]
+    return (f"N{spatial}C", f"{spatial}IO", f"N{spatial}C")
+
+
 class MxuConv(nn.Module):
-    """2-D convolution lowered as im2col + matmul, parameter-compatible with
-    ``nn.Conv`` (same HWIO kernel + bias shapes, same output up to float
-    association).
+    """N-D convolution lowered as im2col + matmul, parameter-compatible with
+    ``nn.Conv`` (same spatial+IO kernel + bias shapes, same output up to
+    float association). The spatial rank comes from ``len(kernel_size)``
+    (2-D and 3-D are the exercised cases).
 
     Why it exists: the cohort engine vmaps local training over a leading
     [clients] axis of per-client WEIGHTS, which turns every ``nn.Conv`` into
-    a batched-kernel (grouped) convolution — the suspected TPU MFU limiter
-    for the cohort CNN (BENCH_r03 note). Patch extraction
-    (``conv_general_dilated_patches``) is weight-independent, so under the
-    clients-vmap it stays a single unbatched op, and the only batched op
+    a batched-kernel (grouped) convolution. That lowering is the suspected
+    TPU MFU limiter for the cohort CNN (BENCH_r03 note) — and worse: when
+    the clients axis is SHARDED over a mesh, XLA's grouped-conv partitioner
+    can reject the op outright (feature_group_count divisibility,
+    tests/parallel/test_sharded_mesh.py's segmentation round). Patch
+    extraction (``conv_general_dilated_patches``) is weight-independent, so
+    under the clients-vmap it stays an unbatched op, and the only batched op
     left is a plain ``dot_general`` with a leading batch dim — the shape the
-    MXU is built for.
+    MXU is built for, and one that shards over the clients axis without
+    constraint.
 
     Measured caveat (2026-07, 8-client vmapped CifarNet train step): on
     XLA:CPU this path is ~3.4x SLOWER than the grouped-conv lowering — the
     patches BACKWARD is a col2im scatter-add, which XLA:CPU runs poorly.
-    The TPU comparison is the one that matters and must be measured there
-    (``FL4HEALTH_BENCH_CONV=mxu``); this module is the experiment vehicle,
-    not a universally-better default.
+    The TPU comparison must be measured there (``FL4HEALTH_BENCH_CONV=mxu``,
+    the bench's conv A/B child); for sharded-clients meshes it is not an
+    optimization but the path that compiles at all.
     """
 
     features: int
-    kernel_size: tuple[int, int] = (3, 3)
+    kernel_size: tuple[int, ...] = (3, 3)
     padding: str = "SAME"
     dtype: jnp.dtype = jnp.float32
+    strides: tuple[int, ...] | None = None
 
     @nn.compact
     def __call__(self, x):
-        kh, kw = self.kernel_size
+        ks = tuple(self.kernel_size)
+        rank = len(ks)
         cin = x.shape[-1]
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(),
-            (kh, kw, cin, self.features),
+            (*ks, cin, self.features),
         )
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
         patches = jax.lax.conv_general_dilated_patches(
-            x.astype(self.dtype), (kh, kw), (1, 1), self.padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            x.astype(self.dtype), ks,
+            tuple(self.strides) if self.strides else (1,) * rank,
+            self.padding,
+            dimension_numbers=_conv_dimension_numbers(rank),
         )
-        # patches feature dim is ordered (cin, kh, kw); fold the kernel the
+        # patches feature dim is ordered (cin, *kernel); fold the kernel the
         # same way so parameters stay interchangeable with nn.Conv.
-        w = jnp.transpose(kernel, (2, 0, 1, 3)).reshape(
-            cin * kh * kw, self.features
+        w = jnp.transpose(kernel, (rank, *range(rank), rank + 1)).reshape(
+            cin * int(np.prod(ks)), self.features
         )
         y = patches @ w.astype(self.dtype)
         return y + bias.astype(self.dtype)
+
+
+def make_conv(
+    impl: str,
+    features: int,
+    kernel_size: tuple[int, ...],
+    *,
+    strides: tuple[int, ...] | None = None,
+    padding: str = "SAME",
+    dtype: jnp.dtype = jnp.float32,
+    name: str | None = None,
+) -> nn.Module:
+    """The ONE conv-impl switch ("lax" = nn.Conv, "mxu" = MxuConv) shared by
+    every model that offers the knob (CifarNet, the U-Net blocks/heads).
+
+    Callers must pass ``name`` matching nn.Conv's auto-name for that call
+    site ("Conv_0", "Conv_1", ...): both impls then produce identical param
+    paths, hence identical RNG-keyed initial values, so checkpoints and
+    exchanger path filters are impl-agnostic.
+    """
+    if impl == "mxu":
+        return MxuConv(features, tuple(kernel_size), strides=strides,
+                       padding=padding, dtype=dtype, name=name)
+    if impl != "lax":
+        raise ValueError(f"conv impl must be 'lax' or 'mxu', got {impl!r}")
+    return nn.Conv(features, tuple(kernel_size), strides=strides,
+                   padding=padding, dtype=dtype, use_bias=True, name=name)
 
 
 class CifarNet(nn.Module):
@@ -100,23 +147,16 @@ class CifarNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
     conv_impl: str = "lax"
 
-    def _conv(self, features, kernel_size, name):
-        # Explicit names pin BOTH impls to the same param paths ("Conv_0",
-        # "Conv_1" — nn.Conv's auto-names), so the tree structure, the
-        # RNG-keyed initial values, and any checkpoint/exchange path filters
-        # are identical regardless of conv_impl.
-        if self.conv_impl == "mxu":
-            return MxuConv(features, kernel_size, dtype=self.dtype, name=name)
-        return nn.Conv(features, kernel_size, dtype=self.dtype, name=name)
-
     @nn.compact
     def __call__(self, x, train: bool = True):
         # x: [B, 32, 32, 3]
         x = x.astype(self.dtype)
-        x = self._conv(32, (5, 5), "Conv_0")(x)
+        x = make_conv(self.conv_impl, 32, (5, 5), dtype=self.dtype,
+                      name="Conv_0")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = self._conv(64, (5, 5), "Conv_1")(x)
+        x = make_conv(self.conv_impl, 64, (5, 5), dtype=self.dtype,
+                      name="Conv_1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))

@@ -25,20 +25,30 @@ from flax import linen as nn
 
 
 class ConvBlock(nn.Module):
-    """Conv -> InstanceNorm -> LeakyReLU (the nnU-Net basic block)."""
+    """Conv -> InstanceNorm -> LeakyReLU (the nnU-Net basic block).
+
+    ``conv_impl``: "lax" = nn.Conv; "mxu" = the im2col batched-matmul conv
+    (models/cnn.py MxuConv — required when the clients axis is SHARDED:
+    the grouped-conv lowering of per-client-weights vmapped nn.Conv is
+    rejected by XLA's partitioner; pinned in
+    tests/parallel/test_sharded_mesh.py). Param paths are identical either
+    way ("Conv_0"), so checkpoints and exchangers are impl-agnostic."""
 
     features: int
     kernel_size: Sequence[int]
     strides: Sequence[int] | None = None
+    conv_impl: str = "lax"
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(
+        from fl4health_tpu.models.cnn import make_conv
+
+        x = make_conv(
+            self.conv_impl,
             self.features,
             tuple(self.kernel_size),
             strides=tuple(self.strides) if self.strides else None,
-            padding="SAME",
-            use_bias=True,
+            name="Conv_0",
         )(x)
         x = nn.InstanceNorm(epsilon=1e-5)(x)
         return nn.leaky_relu(x, negative_slope=0.01)
@@ -49,6 +59,7 @@ class StackedConvs(nn.Module):
     kernel_size: Sequence[int]
     n_convs: int
     first_stride: Sequence[int] | None = None
+    conv_impl: str = "lax"
 
     @nn.compact
     def __call__(self, x):
@@ -57,6 +68,7 @@ class StackedConvs(nn.Module):
                 self.features,
                 self.kernel_size,
                 strides=self.first_stride if i == 0 else None,
+                conv_impl=self.conv_impl,
             )(x)
         return x
 
@@ -76,6 +88,7 @@ class PlainConvUNet(nn.Module):
     n_classes: int
     n_conv_per_stage: int = 2
     deep_supervision: bool = True
+    conv_impl: str = "lax"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -93,6 +106,7 @@ class PlainConvUNet(nn.Module):
                 self.kernel_sizes[s],
                 self.n_conv_per_stage,
                 first_stride=self.strides[s] if s > 0 else None,
+                conv_impl=self.conv_impl,
             )(x)
             skips.append(x)
 
@@ -112,9 +126,17 @@ class PlainConvUNet(nn.Module):
                 self.features_per_stage[s],
                 self.kernel_sizes[s],
                 self.n_conv_per_stage,
+                conv_impl=self.conv_impl,
             )(x)
             if self.deep_supervision or s == 0:
-                head = nn.Conv(self.n_classes, (1,) * ndim, use_bias=True)(x)
+                from fl4health_tpu.models.cnn import make_conv
+
+                # explicit name matches nn.Conv's auto-name for the i-th
+                # head so the param tree is impl-agnostic
+                head = make_conv(
+                    self.conv_impl, self.n_classes, (1,) * ndim,
+                    name=f"Conv_{len(ds_logits)}",
+                )(x)
                 ds_logits.append(head)
 
         # Highest resolution is the final decoder stage's head.
@@ -131,6 +153,7 @@ def unet_from_plans(
     num_classes: int,
     configuration: str | None = None,
     deep_supervision: bool = True,
+    conv_impl: str = "lax",
 ) -> PlainConvUNet:
     """Instantiate the network a plans dict describes (the
     ``build_network_architecture`` equivalent, nnunet_server.py:145-152).
@@ -150,6 +173,7 @@ def unet_from_plans(
         n_classes=num_classes,
         n_conv_per_stage=int(cfg.get("n_conv_per_stage", 2)),
         deep_supervision=deep_supervision,
+        conv_impl=conv_impl,
     )
 
 

@@ -87,3 +87,50 @@ class TestMxuConvParity:
             np.asarray(mxu.apply(params, x)), np.asarray(ref.apply(params, x)),
             rtol=1e-5, atol=1e-5,
         )
+
+
+class TestUnetConvImpl:
+    def test_unet_impls_share_tree_and_agree(self):
+        """conv_impl="mxu" on the real U-Net: identical param structure AND
+        initial values (same paths -> same RNG folds), forward agreement —
+        the property that makes the impl switchable per deployment (sharded
+        cohorts need mxu; see test_sharded_mesh.py)."""
+        from fl4health_tpu.models.unet import PlainConvUNet
+
+        kwargs = dict(
+            features_per_stage=(8, 16),
+            strides=((1, 1, 1), (2, 2, 2)),
+            kernel_sizes=((3, 3, 3), (3, 3, 3)),
+            n_classes=2,
+            n_conv_per_stage=2,
+            deep_supervision=True,
+        )
+        lax_net = PlainConvUNet(**kwargs)
+        mxu_net = PlainConvUNet(conv_impl="mxu", **kwargs)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 8, 1))
+        v_lax = lax_net.init(jax.random.PRNGKey(1), x, train=False)
+        v_mxu = mxu_net.init(jax.random.PRNGKey(1), x, train=False)
+        assert (jax.tree_util.tree_structure(v_lax)
+                == jax.tree_util.tree_structure(v_mxu))
+        for a, b in zip(jax.tree_util.tree_leaves(v_lax),
+                        jax.tree_util.tree_leaves(v_mxu)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        p_lax, _ = lax_net.apply(v_lax, x, train=False)
+        p_mxu, _ = mxu_net.apply(v_lax, x, train=False)
+        for k in p_lax:
+            np.testing.assert_allclose(
+                np.asarray(p_mxu[k]), np.asarray(p_lax[k]),
+                rtol=5e-4, atol=5e-4,
+            )
+
+    def test_strided_mxu_conv_matches_lax(self):
+        from flax import linen as nn
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 8, 2))
+        ref = nn.Conv(4, (3, 3), strides=(2, 2))
+        mxu = MxuConv(4, (3, 3), strides=(2, 2))
+        params = ref.init(jax.random.PRNGKey(3), x)
+        np.testing.assert_allclose(
+            np.asarray(mxu.apply(params, x)),
+            np.asarray(ref.apply(params, x)), rtol=1e-5, atol=1e-5,
+        )
