@@ -591,14 +591,24 @@ def epoch_batches(
     If n_steps exceeds one epoch, batches wrap around (reference
     train_by_steps cycles its loader); if it's shorter, the epoch is truncated.
     Padding rows get example_mask 0; padding steps get step_mask 0.
+    ``x``/``y`` may be pytrees of arrays sharing axis 0 (dict inputs).
     """
+    ns = {leaf.shape[0] for leaf in jax.tree_util.tree_leaves(x)}
+    if len(ns) > 1:
+        # without this, the gather below would CLAMP out-of-range indices on
+        # the short leaves — silently repeating rows instead of erroring
+        raise ValueError(
+            f"epoch_batches: x leaves disagree on example count: {sorted(ns)}"
+        )
     idx, example_mask, step_mask = epoch_index_plan(
-        _entropy_from_key(rng), x.shape[0], batch_size, n_steps, shuffle, drop_last
+        _entropy_from_key(rng), data_rows(x), batch_size, n_steps, shuffle,
+        drop_last,
     )
     idx_arr = jnp.asarray(idx)
+    take = lambda a: a[idx_arr]  # noqa: E731
     return Batch(
-        x=x[idx_arr],
-        y=y[idx_arr],
+        x=jax.tree_util.tree_map(take, x),
+        y=jax.tree_util.tree_map(take, y),
         example_mask=jnp.asarray(example_mask),
         step_mask=jnp.asarray(step_mask),
     )
@@ -644,14 +654,56 @@ def multi_client_index_plans(
     return idx_all, em_all, sm_all
 
 
-def pad_and_stack_data(arrays: list[jax.Array], name: str = "data") -> jax.Array:
-    """Zero-pad along axis 0 to the max length and stack -> [C, max_n, ...].
+def data_rows(tree) -> int:
+    """Example count of a data pytree (axis-0 length of its first leaf) —
+    the one place "how many rows" is defined for array and dict data alike."""
+    return int(jax.tree_util.tree_leaves(tree)[0].shape[0])
+
+
+def pad_and_stack_data(arrays: list, name: str = "data"):
+    """Zero-pad along axis 0 to the max length and stack -> [C, max_n, ...],
+    leafwise over a data PYTREE (a plain array, or a dict of arrays — the
+    reference's DictionaryDataset role, utils/dataset.py:DictionaryDataset:
+    multi-input models take {"input_ids": ..., "attention_mask": ...}-style
+    batches; here any pytree x flows through the same stacked-gather path).
 
     Setup-time only; padding rows are never selected by a valid index plan.
-    Assembly happens on HOST (numpy) with a single device transfer at the end,
-    so device memory holds only the stacked copy — not stack + originals.
-    Pass numpy arrays in ClientDataset to avoid any device round-trip.
+    Assembly happens on HOST (numpy) with a single device transfer at the
+    end. Pass numpy arrays in ClientDataset to avoid any device round-trip.
     """
+    treedef = jax.tree_util.tree_structure(arrays[0])
+    for i, a in enumerate(arrays):
+        if jax.tree_util.tree_structure(a) != treedef:
+            raise ValueError(
+                f"client {i}'s {name} pytree structure "
+                f"{jax.tree_util.tree_structure(a)} differs from client 0's "
+                f"{treedef}; every client must provide the same input keys."
+            )
+    flat = [jax.tree_util.tree_flatten_with_path(a)[0] for a in arrays]
+    # within each client, every leaf must carry the same number of examples
+    for i, leaves in enumerate(flat):
+        ns = {path_str(path): leaf.shape[0] for path, leaf in leaves}
+        if len(set(ns.values())) > 1:
+            raise ValueError(
+                f"client {i}'s {name} leaves disagree on example count: {ns}"
+            )
+    out_leaves = [
+        _pad_and_stack_leaf(
+            [leaves[j][1] for leaves in flat],
+            name + path_str(flat[0][j][0]),
+        )
+        for j in range(len(flat[0]))
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def path_str(path) -> str:
+    """Readable suffix for a tree path in error messages ("" for the root,
+    i.e. plain-array data). Delegates to jax's canonical renderer."""
+    return jax.tree_util.keystr(path) if path else ""
+
+
+def _pad_and_stack_leaf(arrays: list[jax.Array], name: str) -> jax.Array:
     host = [np.asarray(a) for a in arrays]
     # The cohort shares one compiled program: every client's example shape
     # and dtype must agree. Name the offending client and array instead of
@@ -681,18 +733,21 @@ def pad_and_stack_data(arrays: list[jax.Array], name: str = "data") -> jax.Array
 
 
 def gather_batches(
-    x_stack: jax.Array,
-    y_stack: jax.Array,
+    x_stack,
+    y_stack,
     idx: np.ndarray,
     example_mask: np.ndarray,
     step_mask: np.ndarray,
 ) -> Batch:
-    """One device-side gather from pre-stacked data -> [C,S,B,...] Batch."""
+    """One device-side gather from pre-stacked data -> [C,S,B,...] Batch.
+    ``x_stack``/``y_stack`` may be pytrees (dict inputs); the same index
+    plan gathers every leaf."""
     idx_arr = jnp.asarray(idx)
     c = jnp.arange(idx_arr.shape[0])[:, None, None]
+    gather = lambda s: s[c, idx_arr]  # noqa: E731
     return Batch(
-        x=x_stack[c, idx_arr],
-        y=y_stack[c, idx_arr],
+        x=jax.tree_util.tree_map(gather, x_stack),
+        y=jax.tree_util.tree_map(gather, y_stack),
         example_mask=jnp.asarray(example_mask),
         step_mask=jnp.asarray(step_mask),
     )
@@ -703,13 +758,17 @@ def pad_batch_stacks(stacks: list[Batch]) -> Batch:
     new leading clients axis -> [clients, steps, B, ...]."""
     max_steps = max(b.step_mask.shape[0] for b in stacks)
 
+    def pad_leaf(a, pad):
+        return jnp.concatenate([a, jnp.zeros((pad, *a.shape[1:]), a.dtype)])
+
     def pad_one(b: Batch) -> Batch:
         pad = max_steps - b.step_mask.shape[0]
         if pad == 0:
             return b
+        # x/y may be pytrees (dict inputs) — pad every leaf
         return Batch(
-            x=jnp.concatenate([b.x, jnp.zeros((pad, *b.x.shape[1:]), b.x.dtype)]),
-            y=jnp.concatenate([b.y, jnp.zeros((pad, *b.y.shape[1:]), b.y.dtype)]),
+            x=jax.tree_util.tree_map(lambda a: pad_leaf(a, pad), b.x),
+            y=jax.tree_util.tree_map(lambda a: pad_leaf(a, pad), b.y),
             example_mask=jnp.concatenate(
                 [b.example_mask, jnp.zeros((pad, *b.example_mask.shape[1:]), jnp.float32)]
             ),

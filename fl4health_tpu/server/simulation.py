@@ -42,18 +42,23 @@ from fl4health_tpu.strategies.base import FitResults, Strategy
 
 @dataclasses.dataclass
 class ClientDataset:
-    """Host-side per-client data (the DataLoader boundary)."""
+    """Host-side per-client data (the DataLoader boundary).
 
-    x_train: jax.Array
-    y_train: jax.Array
-    x_val: jax.Array
-    y_val: jax.Array
-    x_test: jax.Array | None = None
-    y_test: jax.Array | None = None
+    ``x_*`` may be a plain array or a PYTREE of arrays sharing axis 0 (dict
+    inputs — the reference's DictionaryDataset role); the engine's stacked
+    gather handles either, and the model's ``__call__`` receives whatever
+    structure was provided."""
+
+    x_train: Any
+    y_train: Any
+    x_val: Any
+    y_val: Any
+    x_test: Any = None
+    y_test: Any = None
 
     @property
     def n_train(self) -> int:
-        return int(self.x_train.shape[0])
+        return engine.data_rows(self.x_train)
 
 
 class ClientFailuresError(RuntimeError):
@@ -206,8 +211,7 @@ class FederatedSimulation:
                     raise ValueError(f"client {i}: x_test set but y_test is None")
                 splits.append((d.x_test, d.y_test, "test"))
             for xs, ys, split in splits:
-                # .shape is metadata — no device->host copy of the data
-                nx, ny = xs.shape[0], ys.shape[0]
+                nx, ny = engine.data_rows(xs), engine.data_rows(ys)
                 if nx != ny:
                     raise ValueError(
                         f"client {i}: x_{split} has {nx} rows but y_{split} "
@@ -227,7 +231,9 @@ class FederatedSimulation:
 
         # --- init client + server state -----------------------------------
         init_rng = jax.random.fold_in(self.rng, 0)
-        sample_x = self.datasets[0].x_train[:1]
+        sample_x = jax.tree_util.tree_map(
+            lambda a: a[:1], self.datasets[0].x_train
+        )
         proto = engine.create_train_state(logic, tx, init_rng, sample_x)
         per_client = []
         for i in range(self.n_clients):
@@ -248,16 +254,37 @@ class FederatedSimulation:
         per-round data refresh (e.g. fresh nnU-Net patch banks). Shapes and
         dtypes must match the originals: the compiled round program is traced
         against the stacked layout and must not be invalidated."""
-        new_x = engine.pad_and_stack_data([jnp.asarray(x) for x in xs], "x_train")
-        new_y = engine.pad_and_stack_data([jnp.asarray(y) for y in ys], "y_train")
+        def coerce(d):
+            # Preserve pre-pytree behavior for array-likes (lists of rows
+            # coerce to ONE array); only Mapping inputs are treated as
+            # multi-input pytrees.
+            from collections.abc import Mapping
+
+            if isinstance(d, Mapping):
+                return jax.tree_util.tree_map(jnp.asarray, d)
+            return jnp.asarray(d)
+
+        new_x = engine.pad_and_stack_data([coerce(x) for x in xs], "x_train")
+        new_y = engine.pad_and_stack_data([coerce(y) for y in ys], "y_train")
         for name, new, old in (("x_train", new_x, self._x_train_stack),
                                ("y_train", new_y, self._y_train_stack)):
-            if new.shape != old.shape or new.dtype != old.dtype:
+            if (jax.tree_util.tree_structure(new)
+                    != jax.tree_util.tree_structure(old)):
                 raise ValueError(
-                    f"set_train_data: {name} stack {new.shape}/{new.dtype} "
-                    f"must match the original {old.shape}/{old.dtype} "
+                    f"set_train_data: {name} pytree structure changed "
                     "(per-round refresh may not change the data layout)"
                 )
+            for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(new)[0],
+                jax.tree_util.tree_flatten_with_path(old)[0],
+            ):
+                if a.shape != b.shape or a.dtype != b.dtype:
+                    raise ValueError(
+                        f"set_train_data: {name}{engine.path_str(pa)} stack "
+                        f"{a.shape}/{a.dtype} must match the original "
+                        f"{b.shape}/{b.dtype} (per-round refresh may not "
+                        "change the data layout)"
+                    )
         self._x_train_stack, self._y_train_stack = new_x, new_y
 
     # ------------------------------------------------------------------
@@ -522,7 +549,7 @@ class FederatedSimulation:
         if self._val_cache is None:
             self._val_cache = self._eval_split_batches(
                 self._x_val_stack, self._y_val_stack,
-                [d.x_val.shape[0] for d in self.datasets],
+                [engine.data_rows(d.x_val) for d in self.datasets],
             )
         return self._val_cache
 
@@ -541,7 +568,7 @@ class FederatedSimulation:
                 [d.y_test for d in self.datasets], "y_test"
             )
             self._test_cache = self._eval_split_batches(
-                x_stack, y_stack, [d.x_test.shape[0] for d in self.datasets]
+                x_stack, y_stack, [engine.data_rows(d.x_test) for d in self.datasets]
             )
         return self._test_cache
 
