@@ -104,15 +104,17 @@ def _provenance() -> tuple[str, str]:
 
 
 def _bench_dtype():
-    """bf16 on TPU (the MXU-native path), fp32 on CPU (bf16 is emulated
-    there); FL4HEALTH_BENCH_DTYPE=float32|bfloat16 overrides."""
+    """bf16 on any accelerator (the MXU-native path), fp32 on CPU (bf16 is
+    emulated there); FL4HEALTH_BENCH_DTYPE=float32|bfloat16 overrides. Gate
+    is platform != cpu, not == tpu: the axon plugin's exact platform string
+    is unconfirmed and an f32 no-MFU "TPU" artifact would be incomparable."""
     import jax.numpy as jnp
 
     forced = os.environ.get("FL4HEALTH_BENCH_DTYPE")
     if forced:
         return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[forced]
     platform, _ = _provenance()
-    return jnp.bfloat16 if platform == "tpu" else jnp.float32
+    return jnp.float32 if platform == "cpu" else jnp.bfloat16
 
 
 def make_sim(model_kind: str = "cifar_cnn"):
@@ -533,21 +535,18 @@ def main() -> None:
         spending the TPU slice of the budget on a doomed child. The probe
         budget scales with the total so a slow-but-alive tunnel (cold init
         can take minutes) isn't misread as dead."""
+        from fl4health_tpu.utils.tpu_probe import is_accelerator, probe_platform
+
         if timeout_s is None:
             timeout_s = max(120, int(CHILD_TIMEOUT_S * 0.15))
-        try:
-            res = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True, text=True, timeout=timeout_s,
-            )
-        except subprocess.TimeoutExpired:
+        platform = probe_platform(timeout_s)
+        if platform == "down":
             print("bench: TPU probe timed out (tunnel down?) — skipping the "
                   "TPU attempt", file=sys.stderr)
             return False
-        ok = res.returncode == 0 and "tpu" in res.stdout
+        ok = is_accelerator(platform)
         if not ok:
-            print(f"bench: TPU probe found no TPU ({res.stdout.strip()!r}) — "
+            print(f"bench: TPU probe found no TPU ({platform!r}) — "
                   "skipping the TPU attempt", file=sys.stderr)
         return ok
 

@@ -40,7 +40,10 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 
 
 def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
+    # interpret only where Mosaic cannot compile (XLA:CPU); any non-cpu
+    # backend (incl. the axon plugin, whatever platform string it reports)
+    # gets the real kernels
+    return jax.default_backend() == "cpu"
 
 
 # ---------------------------------------------------------------------------

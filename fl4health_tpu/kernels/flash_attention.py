@@ -35,7 +35,10 @@ NEG_INF = -1e30
 
 
 def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
+    # interpret only where Mosaic cannot compile (XLA:CPU); any non-cpu
+    # backend (incl. the axon plugin, whatever platform string it reports)
+    # gets the real kernels
+    return jax.default_backend() == "cpu"
 
 
 def _pad_axis(x: jax.Array, axis: int, multiple: int, value=0.0) -> jax.Array:

@@ -1,0 +1,63 @@
+"""Shared tunnel-probe helpers for bench.py and tools/tpu_watch.py.
+
+The axon TPU tunnel hangs at backend init when down, so liveness is decided
+by a subprocess probe under a timeout. Both the bench parent and the watcher
+need the identical policy for "which platform strings count as the chip" —
+keeping it here prevents the two from drifting (r5 review finding).
+
+The probe child prints a sentinel-prefixed line so trailing plugin banners
+or info messages on stdout can never be misread as a platform string.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+_SENTINEL = "FL4HEALTH_PLATFORM="
+
+_PROBE_SRC = (
+    "import jax; "
+    f"print('{_SENTINEL}' + jax.devices()[0].platform)"
+)
+
+
+def probe_platform(timeout_s: int, cwd: str | None = None) -> str:
+    """Return the live backend's platform string, 'down' on timeout (a dead
+    tunnel hangs at backend init), or 'error: <stderr tail>' when the probe
+    child crashed outright — a broken environment (missing plugin, bad
+    PYTHONPATH) must stay distinguishable from a dead tunnel in the logs."""
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s, cwd=cwd,
+        )
+    except subprocess.TimeoutExpired:
+        return "down"
+    if res.returncode != 0:
+        tail = res.stderr.strip().splitlines()
+        return f"error: {tail[-1][:200] if tail else f'rc={res.returncode}'}"
+    for line in reversed(res.stdout.splitlines()):
+        if line.startswith(_SENTINEL):
+            return line[len(_SENTINEL):].strip()
+    return ""
+
+
+def is_accelerator(platform: str) -> bool:
+    """Any live backend that isn't XLA:CPU is the tunneled chip (the axon
+    plugin's exact platform string can't be confirmed while the tunnel is
+    down, so don't gate on the literal 'tpu')."""
+    return platform not in ("", "cpu", "down") and not platform.startswith("error")
+
+
+def last_json_line(text: str) -> dict | None:
+    """Parse the LAST valid JSON object line from child stdout (later lines
+    supersede earlier partial/progress output)."""
+    for line in reversed(text.splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None

@@ -1,0 +1,167 @@
+"""On-TPU self-test for the Pallas kernels (flash attention + DP clip).
+
+Both kernels are interpret-mode validated by the CPU suite
+(tests/kernels/), but a Mosaic compile can fail or miscompute where
+interpret mode passes (VERDICT r4 missing #2). This script runs the REAL
+compiled kernels on the attached accelerator against dense XLA references
+on the same device and prints ONE JSON line:
+
+  {"ok": bool, "platform": ..., "device_kind": ..., "checks": [...]}
+
+Run by tools/tpu_watch.py the moment the tunnel opens; also runnable by
+hand. Exit code 0 iff every check passed.
+
+Reference contract being validated (no reference-repo counterpart — the
+reference delegates attention to torch SDPA and DP clipping to Opacus;
+SURVEY.md §2.0): numerical agreement of the fused kernels with the naive
+formulation, forward AND backward.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# FL4HEALTH_SELFTEST_INTERPRET=1 runs the same checks through Pallas
+# interpret mode — used on CPU to validate the selftest's own reference
+# math and tolerances, so a failure on real TPU can only mean Mosaic.
+INTERPRET = os.environ.get("FL4HEALTH_SELFTEST_INTERPRET") == "1"
+
+
+def _check(name: str, fn) -> dict:
+    try:
+        err = fn()
+        return {"name": name, "ok": bool(err is None or err[0]), "detail": None if err is None else err[1]}
+    except Exception as e:  # noqa: BLE001 — a Mosaic compile error IS the finding
+        return {"name": name, "ok": False, "detail": f"{type(e).__name__}: {e}"}
+
+
+def flash_checks() -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from fl4health_tpu.kernels.flash_attention import flash_attention
+
+    def dense_ref(q, k, v, mask):
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        # [B,T,H,D] -> scores [B,H,Tq,Tk]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    checks = []
+
+    def make_inputs(b, t, h, d, dtype, frac_pad=0.25):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (b, t, h, d), dtype)
+        k = jax.random.normal(ks[1], (b, t, h, d), dtype)
+        v = jax.random.normal(ks[2], (b, t, h, d), dtype)
+        n_real = int(t * (1 - frac_pad))
+        mask = (jnp.arange(t)[None, :] < n_real).astype(jnp.float32)
+        mask = jnp.broadcast_to(mask, (b, t))
+        return q, k, v, mask
+
+    def fwd_case(t, d, dtype, tol, name):
+        def run():
+            q, k, v, mask = make_inputs(2, t, 4, d, dtype)
+            out = jax.jit(
+                lambda *a: flash_attention(*a, interpret=INTERPRET)
+            )(q, k, v, mask)
+            ref = jax.jit(dense_ref)(q, k, v, mask)
+            # padded query rows attend to garbage by design; compare real rows
+            n_real = int(jnp.sum(mask[0]))
+            err = float(
+                jnp.max(jnp.abs(out[:, :n_real].astype(jnp.float32)
+                                - ref[:, :n_real].astype(jnp.float32)))
+            )
+            return (err < tol, f"max_abs_err={err:.2e} tol={tol}")
+        checks.append(_check(name, run))
+
+    fwd_case(512, 64, jnp.float32, 2e-4, "flash_fwd_f32_t512")
+    fwd_case(2048, 64, jnp.bfloat16, 3e-2, "flash_fwd_bf16_t2048")
+    # T=600 does NOT divide lcm(block_q, block_k)=128 -> real zero-padding
+    # to 640 plus key-block tail masking, exercised on real Mosaic
+    fwd_case(600, 64, jnp.float32, 2e-4, "flash_fwd_f32_t600_ragged")
+
+    def bwd_case():
+        q, k, v, mask = make_inputs(2, 512, 4, 64, jnp.float32)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, mask, interpret=INTERPRET)
+            return jnp.sum(o * o * mask[:, :, None, None])
+
+        def loss_ref(q, k, v):
+            o = dense_ref(q, k, v, mask)
+            return jnp.sum(o * o * mask[:, :, None, None])
+
+        g_f = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        g_r = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        errs = [
+            float(jnp.max(jnp.abs(a - b))) for a, b in zip(g_f, g_r)
+        ]
+        tol = 5e-3  # grads accumulate blockwise in f32; scale ~O(100) here
+        return (max(errs) < tol, f"max grad errs dq/dk/dv={errs} tol={tol}")
+
+    checks.append(_check("flash_bwd_f32_t512", bwd_case))
+    return checks
+
+
+def dp_clip_checks() -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from fl4health_tpu.kernels.dp_clip import fused_clipped_masked_sum
+
+    def run():
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        b = 64
+        grads = {
+            "w": jax.random.normal(ks[0], (b, 256, 130)),  # ragged width
+            "b": jax.random.normal(ks[1], (b, 130)),
+        }
+        mask = (jax.random.uniform(ks[2], (b,)) > 0.3).astype(jnp.float32)
+        c = 1.0
+        out = jax.jit(
+            lambda g, m: fused_clipped_masked_sum(g, m, c, interpret=INTERPRET)
+        )(grads, mask)
+
+        # naive reference on-device
+        flat = jnp.concatenate(
+            [grads["w"].reshape(b, -1), grads["b"].reshape(b, -1)], axis=1
+        )
+        norms = jnp.linalg.norm(flat, axis=1)
+        factor = jnp.minimum(1.0, c / jnp.maximum(norms, 1e-12)) * mask
+        ref_w = jnp.einsum("b,bij->ij", factor, grads["w"])
+        ref_b = jnp.einsum("b,bi->i", factor, grads["b"])
+        err = max(
+            float(jnp.max(jnp.abs(out["w"] - ref_w))),
+            float(jnp.max(jnp.abs(out["b"] - ref_b))),
+        )
+        tol = 1e-4
+        return (err < tol, f"max_abs_err={err:.2e} tol={tol}")
+
+    return [_check("dp_clip_fused_b64", run)]
+
+
+def main() -> int:
+    import jax
+
+    d = jax.devices()[0]
+    record = {
+        "platform": d.platform,
+        "device_kind": getattr(d, "device_kind", "unknown"),
+        "checks": [],
+    }
+    record["checks"] += flash_checks()
+    record["checks"] += dp_clip_checks()
+    record["ok"] = all(c["ok"] for c in record["checks"])
+    print(json.dumps(record))
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
