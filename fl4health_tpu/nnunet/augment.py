@@ -11,18 +11,26 @@ TPU-native design: instead of CPU worker processes mutating numpy batches, the
 transforms are pure jax ops applied *inside* the compiled training scan, keyed
 per step and per example. That makes augmentation free of host round-trips,
 reproducible from the PRNG stream, and fused by XLA into the forward pass.
-Arbitrary-angle rotation/elastic deformation (interpolating resamplers) are
-replaced by their grid-exact counterparts (axis mirrors + 90-degree rotations
-on isotropic axis pairs) — the standard lossless subset; everything intensity-
-side (noise/brightness/contrast/gamma) matches the nnU-Net family directly.
+The spatial family has two tiers: grid-exact transforms (axis mirrors +
+90-degree rotations on isotropic axis pairs) and the interpolating family
+below; everything intensity-side (noise/brightness/contrast/gamma) matches
+the nnU-Net family directly.
 
 Default probabilities follow nnunetv2's defaults: noise p=0.1 (variance-
 uniform), brightness p=0.15, contrast p=0.15, gamma p=0.3 (retain_stats)
-+ invert-image gamma p=0.1, mirror p=0.5 per axis. Known deviations from
-the nnunetv2 pipeline, by design: free-angle rotation, elastic deformation,
-random scaling/zoom, and low-resolution simulation are omitted (all require
-interpolating resamplers — hostile to static-shape compiled code); mirrors
-+ rot90 carry the spatial role.
++ invert-image gamma p=0.1, mirror p=0.5 per axis, free-angle rotation
+(±30°) p=0.2, random scaling (0.7–1.4) p=0.2. The interpolating transforms
+(rotation/scaling, optional elastic) are resamples of the FIXED patch grid —
+``jax.scipy.ndimage.map_coordinates`` with order-1 gathers for image
+channels and order-0 (nearest) for labels — so shapes stay static and the
+whole family compiles into the training scan. Out-of-bounds voxels use edge
+replication (mode="nearest") for both image and label rather than
+nnunetv2's constant-fill with a -1 ignore label: this keeps every label
+valid and avoids threading new ignore-index semantics through the loss
+stack (documented deviation). Remaining deviations, by design: elastic
+deformation defaults OFF (matching nnunetv2, whose default pipeline sets
+do_elastic=False) but is available via p_elastic; low-resolution simulation
+is omitted.
 """
 
 from __future__ import annotations
@@ -135,6 +143,113 @@ def _gamma_one(x, key, p, lo, hi, invert):
     return jnp.where(do, out, x)
 
 
+def _rotation_matrix(angles: jax.Array, nd: int) -> jax.Array:
+    """[nd, nd] rotation from ``angles``: one angle for 2-D, three per-axis
+    angles composed Rz @ Ry @ Rx for 3-D (the batchgenerators convention —
+    each axis rotation drawn independently)."""
+    c, s = jnp.cos(angles), jnp.sin(angles)
+    if nd == 2:
+        return jnp.array([[c[0], -s[0]], [s[0], c[0]]])
+    rx = jnp.array([
+        [1.0, 0.0, 0.0],
+        [0.0, c[0], -s[0]],
+        [0.0, s[0], c[0]],
+    ])
+    ry = jnp.array([
+        [c[1], 0.0, s[1]],
+        [0.0, 1.0, 0.0],
+        [-s[1], 0.0, c[1]],
+    ])
+    rz = jnp.array([
+        [c[2], -s[2], 0.0],
+        [s[2], c[2], 0.0],
+        [0.0, 0.0, 1.0],
+    ])
+    return rz @ ry @ rx
+
+
+def _spatial_resample_one(
+    x: jax.Array,
+    y: jax.Array,
+    key: jax.Array,
+    p_rotation: float,
+    p_scaling: float,
+    rot_max_rad: float,
+    scale_lo: float,
+    scale_hi: float,
+    p_elastic: float,
+    elastic_alpha: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Free-angle rotation + isotropic scaling (+ optional elastic) of one
+    example via a single resampling gather on the fixed patch grid.
+
+    x [*spatial, C] float, y [*spatial] int. Output voxel p samples input at
+    ``center + s·R·(p − center) (+ elastic displacement)``: image channels
+    bilinear (order=1), labels nearest (order=0) so no new label values can
+    appear. When neither transform fires the coordinates are exact integers
+    and both interpolators return the input bit-exactly; a final ``where``
+    guards against float round-off anyway.
+    """
+    from jax.scipy.ndimage import map_coordinates
+
+    spatial = y.shape
+    nd = len(spatial)
+    do_rot = _bernoulli(jax.random.fold_in(key, 0), p_rotation)
+    do_scale = _bernoulli(jax.random.fold_in(key, 1), p_scaling)
+    n_angles = 1 if nd == 2 else 3
+    angles = jax.random.uniform(
+        jax.random.fold_in(key, 2), (n_angles,),
+        minval=-rot_max_rad, maxval=rot_max_rad,
+    ) * do_rot
+    scale = jnp.where(
+        do_scale,
+        jax.random.uniform(jax.random.fold_in(key, 3), (),
+                           minval=scale_lo, maxval=scale_hi),
+        1.0,
+    )
+    rot = _rotation_matrix(angles, nd)
+
+    center = jnp.array([(s - 1) / 2.0 for s in spatial])
+    grid = jnp.stack(
+        jnp.meshgrid(*[jnp.arange(s, dtype=jnp.float32) for s in spatial],
+                     indexing="ij")
+    )  # [nd, *spatial]
+    rel = grid - center.reshape((nd,) + (1,) * nd)
+    mapped = scale * jnp.tensordot(rot, rel, axes=1) \
+        + center.reshape((nd,) + (1,) * nd)
+
+    do_elastic = _bernoulli(jax.random.fold_in(key, 4), p_elastic)
+    if p_elastic > 0.0:
+        # Coarse per-axis displacement noise upsampled to the patch — the
+        # smooth random field of batchgenerators' elastic_deform, built from
+        # a 4^nd grid instead of a gaussian-filtered dense field (cheaper,
+        # same low-frequency character). Amplitude ~ U(0, elastic_alpha)
+        # voxels.
+        coarse = jax.random.normal(
+            jax.random.fold_in(key, 5), (nd,) + (4,) * nd, jnp.float32
+        )
+        alpha = jax.random.uniform(
+            jax.random.fold_in(key, 6), (), minval=0.0, maxval=elastic_alpha
+        )
+        disp = jax.image.resize(coarse, (nd, *spatial), method="linear")
+        mapped = mapped + do_elastic * alpha * disp
+
+    coords = [mapped[i] for i in range(nd)]
+    x_out = jnp.stack(
+        [
+            map_coordinates(x[..., c], coords, order=1, mode="nearest")
+            for c in range(x.shape[-1])
+        ],
+        axis=-1,
+    ).astype(x.dtype)
+    y_out = map_coordinates(y, coords, order=0, mode="nearest").astype(y.dtype)
+    fired = do_rot | do_scale | (do_elastic if p_elastic > 0.0 else False)
+    return (
+        jnp.where(fired, x_out, x),
+        jnp.where(fired, y_out, y),
+    )
+
+
 def _isotropic_pairs(spatial_shape: Sequence[int]) -> tuple:
     """Spatial axis pairs (as x-array axes, i.e. offset by 0 for the leading
     per-example layout [*spatial, C]) with equal sizes."""
@@ -150,7 +265,9 @@ def _isotropic_pairs(spatial_shape: Sequence[int]) -> tuple:
 @functools.partial(
     jax.jit,
     static_argnames=("p_mirror", "p_rot90", "p_noise", "p_brightness",
-                     "p_contrast", "p_gamma", "p_gamma_invert"),
+                     "p_contrast", "p_gamma", "p_gamma_invert",
+                     "p_rotation", "p_scaling", "rot_max_deg",
+                     "scale_lo", "scale_hi", "p_elastic", "elastic_alpha"),
 )
 def augment_patch_batch(
     x: jax.Array,
@@ -163,23 +280,41 @@ def augment_patch_batch(
     p_contrast: float = 0.15,
     p_gamma: float = 0.3,
     p_gamma_invert: float = 0.1,
+    p_rotation: float = 0.2,
+    p_scaling: float = 0.2,
+    rot_max_deg: float = 30.0,
+    scale_lo: float = 0.7,
+    scale_hi: float = 1.4,
+    p_elastic: float = 0.0,
+    elastic_alpha: float = 8.0,
 ) -> tuple[jax.Array, jax.Array]:
     """Augment one batch: x [B, *spatial, C] float, y [B, *spatial] int.
 
-    Spatial transforms (mirror, rot90 on equal-size axis pairs) apply to x
-    and y together; intensity transforms (noise, brightness, contrast, two
-    gamma variants) to x only. Every decision is drawn per example from
-    ``rng``. Matches nnunetv2's default intensity family: noise VARIANCE ~
-    U(0, 0.1) at p=0.1, brightness/contrast (0.75, 1.25) at p=0.15,
-    gamma (0.7, 1.5) with retain_stats at p=0.3 plus the separate
-    invert-image gamma at p=0.1.
+    Spatial transforms (free-angle rotation ±rot_max_deg at p_rotation,
+    isotropic scaling scale_lo–scale_hi at p_scaling, optional elastic,
+    mirror, rot90 on equal-size axis pairs) apply to x and y together;
+    intensity transforms (noise, brightness, contrast, two gamma variants)
+    to x only. Every decision is drawn per example from ``rng``. Matches
+    nnunetv2's defaults: rotation ±30° p=0.2, scaling (0.7, 1.4) p=0.2
+    (interpolating transforms lead the pipeline, as in nnunetv2's
+    SpatialTransform), noise VARIANCE ~ U(0, 0.1) at p=0.1,
+    brightness/contrast (0.75, 1.25) at p=0.15, gamma (0.7, 1.5) with
+    retain_stats at p=0.3 plus the separate invert-image gamma at p=0.1;
+    elastic defaults off as in nnunetv2.
     """
     spatial = x.shape[1:-1]
     pairs = _isotropic_pairs(spatial)
     spatial_axes = tuple(range(len(spatial)))  # per-example x axes, pre-C
+    interp_on = p_rotation > 0.0 or p_scaling > 0.0 or p_elastic > 0.0
 
     def one(xe, ye, key):
-        keys = jax.random.split(key, 7)
+        keys = jax.random.split(key, 8)
+        if interp_on:  # static gate: skip the gather entirely when disabled
+            xe, ye = _spatial_resample_one(
+                xe, ye, keys[7], p_rotation, p_scaling,
+                rot_max_deg * jnp.pi / 180.0, scale_lo, scale_hi,
+                p_elastic, elastic_alpha,
+            )
         xe, ye = _mirror_one(
             xe, ye, keys[0], tuple(a for a in spatial_axes), p_mirror
         )

@@ -1,0 +1,306 @@
+"""Shared method grid for the FLamby research harnesses.
+
+Reference role: /root/reference/research/flamby/ — the FENDA-FL paper's
+experimental grid (arXiv 2309.16825). Each FLamby dataset dir there holds
+one subdir per method (fed_heart_disease: apfl/central/ditto/fedadam/
+fedavg/fedper/fedprox/fenda/local/moon/perfcl/scaffold, plus mkmmd/deep-mmd
+arms on fed_isic2019), each with Slurm HP sweeps selected by
+research/flamby/find_best_hp.py. This module is the TPU-native counterpart:
+``build_method`` wires any of those method arms into a
+``FederatedSimulation`` from a per-dataset model zoo, and the three sweeps
+(fed_heart_disease/, fed_isic2019/, fed_ixi/) run the grid in-process.
+
+Data: FLamby's clinical corpora cannot exist on a zero-egress box. Each
+sweep ships a synthetic stand-in shaped like its dataset (center counts,
+feature shapes, per-center heterogeneity) and accepts the real thing via
+``FL4HEALTH_FLAMBY_DIR/<name>.npz`` with arrays x, y, center — the same
+env-var drop-in contract as the rxrx1 harness.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.apfl import ApflClientLogic
+from fl4health_tpu.clients.ditto import (
+    DittoClientLogic,
+    KeepLocalExchanger,
+    MrMtlClientLogic,
+)
+from fl4health_tpu.clients.fenda import (
+    ConstrainedFendaClientLogic,
+    PerFclClientLogic,
+)
+from fl4health_tpu.clients.fedprox import FedProxClientLogic
+from fl4health_tpu.clients.moon import MoonClientLogic
+from fl4health_tpu.clients.scaffold import ScaffoldClientLogic
+from fl4health_tpu.exchange.exchanger import FixedLayerExchanger
+from fl4health_tpu.models import bases
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.fedopt import FedOpt
+from fl4health_tpu.strategies.fedprox import FedAvgWithAdaptiveConstraint
+from fl4health_tpu.strategies.scaffold import Scaffold
+
+# The reference's per-dataset method lists (dir listings above); mmd arms
+# are added by fed_isic2019 itself.
+METHODS = (
+    "central", "local", "fedavg", "fedadam", "fedprox", "scaffold",
+    "ditto", "mr_mtl", "apfl", "fenda", "moon", "fedper", "perfcl",
+)
+
+
+def real_npz(name: str):
+    """FL4HEALTH_FLAMBY_DIR/<name>.npz -> (x, y, center) or None."""
+    root = os.environ.get("FL4HEALTH_FLAMBY_DIR")
+    if not root:
+        return None
+    path = Path(root) / f"{name}.npz"
+    if not path.exists():
+        return None
+    with np.load(path) as z:
+        return z["x"], z["y"], z["center"]
+
+
+def center_datasets(x, y, center, val_frac=0.25, seed=0):
+    """Split arrays into per-center ClientDatasets (FLamby's natural-split
+    role — flamby_data_utils.py construct_*_train_val_datasets)."""
+    out = []
+    rng = np.random.default_rng(seed)
+    for c in sorted(np.unique(np.asarray(center))):
+        idx = np.flatnonzero(np.asarray(center) == c)
+        rng.shuffle(idx)
+        cut = max(int(len(idx) * (1 - val_frac)), 1)
+        out.append(ClientDataset(
+            x_train=x[idx[:cut]], y_train=y[idx[:cut]],
+            x_val=x[idx[cut:]], y_val=y[idx[cut:]],
+        ))
+    return out
+
+
+def pooled_dataset(datasets):
+    """All centers concatenated into one client (the 'central' baseline)."""
+    cat = lambda parts: np.concatenate([np.asarray(p) for p in parts])  # noqa: E731
+    return [ClientDataset(
+        x_train=cat([d.x_train for d in datasets]),
+        y_train=cat([d.y_train for d in datasets]),
+        x_val=cat([d.x_val for d in datasets]),
+        y_val=cat([d.y_val for d in datasets]),
+    )]
+
+
+def masked_seg_cross_entropy(logits, targets, mask):
+    """Dense-map criterion with the engine's (logits, targets, example_mask)
+    signature, delegating to the seg-loss helpers (losses/segmentation.py)
+    so label clipping / voxel weighting stay single-sourced."""
+    from fl4health_tpu.losses.segmentation import (
+        _voxel_weights,
+        masked_voxel_cross_entropy,
+    )
+
+    return masked_voxel_cross_entropy(
+        logits, targets, _voxel_weights(targets, mask, None)
+    )
+
+
+def _flat(features: dict) -> dict:
+    return {k: v.reshape(v.shape[0], -1) for k, v in features.items()}
+
+
+class SegMoonClientLogic(MoonClientLogic):
+    """MOON over dense feature MAPS (fed_ixi): the contrastive term needs
+    [B, D] vectors, so feature maps are flattened for the cosine terms while
+    the prediction head still sees the map."""
+
+    def _features_of(self, params, model_state, x, rng):
+        f = super()._features_of(params, model_state, x, rng)
+        return f.reshape(f.shape[0], -1)
+
+    def training_loss(self, preds, features, batch, params, state, ctx):
+        return super().training_loss(
+            preds, _flat(features), batch, params, state, ctx
+        )
+
+
+class SegConstrainedFendaClientLogic(ConstrainedFendaClientLogic):
+    """FENDA over dense feature maps (fed_ixi): the cosine term reduces over
+    the last axis, so maps are flattened to [B, D] for it. The contrastive
+    arm is refused outright: the parent recomputes old-model features via a
+    raw model.apply inside training_loss, which this override cannot
+    flatten — mixing flat and map features there would crash or silently
+    broadcast wrong."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.con_w > 0.0:
+            raise ValueError(
+                "SegConstrainedFendaClientLogic supports the cosine term "
+                "only; contrastive_loss_weight must be 0 on feature maps"
+            )
+
+    def training_loss(self, preds, features, batch, params, state, ctx):
+        return super().training_loss(
+            preds, _flat(features), batch, params, state, ctx
+        )
+
+
+class SegPerFclClientLogic(PerFclClientLogic):
+    """PerFCL over dense feature maps (fed_ixi) — same flattening as
+    SegMoonClientLogic, applied to both contrastive feature streams."""
+
+    def _features(self, params, model_state, x, rng):
+        return _flat(super()._features(params, model_state, x, rng))
+
+    def training_loss(self, preds, features, batch, params, state, ctx):
+        return super().training_loss(
+            preds, _flat(features), batch, params, state, ctx
+        )
+
+
+def build_method(
+    method: str,
+    zoo: dict,
+    criterion,
+    datasets: list[ClientDataset],
+    lr: float,
+    lam: float,
+    batch_size: int,
+    local_steps: int,
+    metrics,
+    seed: int,
+    server_lr: float = 0.01,
+    seg: bool = False,
+) -> FederatedSimulation:
+    """One FLamby method arm as a FederatedSimulation.
+
+    zoo: {"plain": () -> flax module, "features": () -> extractor module,
+    "head": () -> head module}. ``seg=True`` selects the feature-map-safe
+    contrastive logics for moon/perfcl.
+    """
+    tx = optax.adam(lr)
+    strategy = FedAvg()
+    exchanger = None
+    sim_datasets = datasets
+
+    if method == "central":
+        logic = engine.ClientLogic(engine.from_flax(zoo["plain"]()), criterion)
+        sim_datasets = pooled_dataset(datasets)
+    elif method == "local":
+        logic = engine.ClientLogic(engine.from_flax(zoo["plain"]()), criterion)
+        exchanger = KeepLocalExchanger()
+    elif method == "fedavg":
+        logic = engine.ClientLogic(engine.from_flax(zoo["plain"]()), criterion)
+    elif method == "fedadam":
+        logic = engine.ClientLogic(engine.from_flax(zoo["plain"]()), criterion)
+        strategy = FedOpt(optax.adam(server_lr))
+    elif method == "fedprox":
+        logic = FedProxClientLogic(
+            engine.from_flax(zoo["plain"]()), criterion
+        )
+        strategy = FedAvgWithAdaptiveConstraint(
+            initial_drift_penalty_weight=lam, adapt_loss_weight=False
+        )
+    elif method == "scaffold":
+        logic = ScaffoldClientLogic(
+            engine.from_flax(zoo["plain"]()), criterion, learning_rate=lr
+        )
+        tx = optax.sgd(lr)  # SCAFFOLD's variate algebra assumes vanilla SGD
+        strategy = Scaffold(learning_rate=1.0)
+    elif method == "ditto":
+        model = bases.TwinModel(
+            global_model=zoo["plain"](), personal_model=zoo["plain"]()
+        )
+        logic = DittoClientLogic(engine.from_flax(model), criterion, lam=lam)
+        exchanger = FixedLayerExchanger(bases.TwinModel.exchange_global_model)
+    elif method == "mr_mtl":
+        logic = MrMtlClientLogic(
+            engine.from_flax(zoo["plain"]()), criterion, lam=lam
+        )
+        exchanger = KeepLocalExchanger()
+    elif method == "apfl":
+        module = bases.ApflModule(
+            local_model=zoo["plain"](), global_model=zoo["plain"]()
+        )
+        logic = ApflClientLogic(engine.from_flax(module), criterion)
+        exchanger = FixedLayerExchanger(bases.ApflModule.exchange_global_model)
+    elif method == "fenda":
+        model = bases.FendaModel(
+            first_feature_extractor=zoo["features"](),
+            second_feature_extractor=zoo["features"](),
+            head_module=bases.HeadModule(head=zoo["head"]()),
+        )
+        cls = SegConstrainedFendaClientLogic if seg else ConstrainedFendaClientLogic
+        logic = cls(engine.from_flax(model), criterion)
+        exchanger = FixedLayerExchanger(
+            bases.ParallelSplitModel.exchange_global_extractor
+        )
+    elif method == "moon":
+        model = bases.MoonModel(
+            base_module=zoo["features"](), head_module=zoo["head"]()
+        )
+        cls = SegMoonClientLogic if seg else MoonClientLogic
+        logic = cls(engine.from_flax(model), criterion,
+                    contrastive_weight=lam)
+    elif method == "fedper":
+        model = bases.SequentiallySplitModel(
+            features_module=zoo["features"](), head_module=zoo["head"]()
+        )
+        logic = engine.ClientLogic(engine.from_flax(model), criterion)
+        exchanger = FixedLayerExchanger(
+            bases.SequentiallySplitModel.exchange_features_only
+        )
+    elif method == "perfcl":
+        model = bases.PerFclModel(
+            first_feature_extractor=zoo["features"](),
+            second_feature_extractor=zoo["features"](),
+            head_module=bases.HeadModule(head=zoo["head"]()),
+        )
+        cls = SegPerFclClientLogic if seg else PerFclClientLogic
+        logic = cls(engine.from_flax(model), criterion,
+                    global_feature_loss_weight=lam,
+                    local_feature_loss_weight=lam)
+        exchanger = FixedLayerExchanger(
+            bases.ParallelSplitModel.exchange_global_extractor
+        )
+    else:
+        raise ValueError(f"unknown flamby method {method!r}")
+
+    return FederatedSimulation(
+        logic=logic,
+        tx=tx,
+        strategy=strategy,
+        datasets=sim_datasets,
+        batch_size=batch_size,
+        metrics=metrics,
+        local_steps=local_steps,
+        seed=seed,
+        exchanger=exchanger,
+        extra_loss_keys=tuple(getattr(logic, "extra_loss_keys", ()) or ()),
+    )
+
+
+def write_hp_dir_and_select(out_dir: Path, results, metric_key: str):
+    """Materialize sweep results as the reference's hp-folder layout and
+    re-select the winner via find_best_hp_dir (find_best_hp.py:36 flow) —
+    pinning that the file-based selection agrees with the in-memory sweep."""
+    import json
+
+    from fl4health_tpu.utils.hp_search import find_best_hp_dir
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    for r in results:
+        label = "_".join(f"{k}-{v}" for k, v in sorted(r.params.items()))
+        run_dir = out_dir / label / "Run0"
+        run_dir.mkdir(parents=True, exist_ok=True)
+        (run_dir / "metrics.json").write_text(json.dumps(
+            {"rounds": {"1": {metric_key: r.mean_score}}}
+        ))
+    best_dir, best_score = find_best_hp_dir(
+        out_dir, metric=metric_key, minimize=False
+    )
+    return best_dir, best_score

@@ -38,7 +38,7 @@ class TestAugmentPatchBatch:
         ax, ay = augment_patch_batch(
             x, y, jax.random.PRNGKey(0), p_mirror=0.0, p_rot90=0.0,
             p_noise=0.0, p_brightness=0.0, p_contrast=0.0, p_gamma=0.0,
-            p_gamma_invert=0.0,
+            p_gamma_invert=0.0, p_rotation=0.0, p_scaling=0.0,
         )
         np.testing.assert_array_equal(np.asarray(ax), np.asarray(x))
         np.testing.assert_array_equal(np.asarray(ay), np.asarray(y))
@@ -65,6 +65,7 @@ class TestAugmentPatchBatch:
             jnp.asarray(x), jnp.asarray(y), jax.random.PRNGKey(1),
             p_mirror=1.0, p_rot90=1.0, p_noise=0.0, p_brightness=0.0,
             p_contrast=0.0, p_gamma=0.0, p_gamma_invert=0.0,
+            p_rotation=0.0, p_scaling=0.0,  # lossless family only here
         )
         residual = np.asarray(ax)[..., 0] - 10.0 * np.asarray(ay)
         # consistent spatial transform => residual is a permutation of noise
@@ -81,6 +82,7 @@ class TestAugmentPatchBatch:
         ax, ay = augment_patch_batch(
             x, y, jax.random.PRNGKey(2), p_mirror=0.0, p_rot90=0.0,
             p_noise=1.0, p_brightness=1.0, p_contrast=1.0, p_gamma=1.0,
+            p_rotation=0.0, p_scaling=0.0,
         )
         np.testing.assert_array_equal(np.asarray(ay), np.asarray(y))
         assert not np.array_equal(np.asarray(ax), np.asarray(x))
@@ -110,7 +112,7 @@ class TestAugmentPatchBatch:
         ax, _ = augment_patch_batch(
             x, y, jax.random.PRNGKey(3), p_mirror=0.0, p_rot90=0.0,
             p_noise=0.0, p_brightness=0.0, p_contrast=0.0, p_gamma=1.0,
-            p_gamma_invert=0.0,
+            p_gamma_invert=0.0, p_rotation=0.0, p_scaling=0.0,
         )
         assert not np.array_equal(np.asarray(ax), np.asarray(x))
         for b in range(x.shape[0]):
@@ -118,6 +120,115 @@ class TestAugmentPatchBatch:
                                        float(x[b].mean()), atol=1e-3)
             np.testing.assert_allclose(float(ax[b].std()),
                                        float(x[b].std()), rtol=1e-3)
+
+
+def _disk(shape, radius, center=None):
+    """Binary disk/ball label on ``shape`` (2-D or 3-D)."""
+    grids = np.meshgrid(*[np.arange(s) for s in shape], indexing="ij")
+    if center is None:
+        center = [(s - 1) / 2.0 for s in shape]
+    d2 = sum((g - c) ** 2 for g, c in zip(grids, center))
+    return (d2 <= radius ** 2).astype(np.int32)
+
+
+class TestSpatialResample:
+    """The interpolating family (free-angle rotation, scaling, elastic) —
+    resamples of the fixed patch grid, nnunetv2's leading transforms
+    (ref fl4health/utils/nnunet_utils.py:307 wraps them)."""
+
+    def _interp_only(self, x, y, key, **kw):
+        base = dict(p_mirror=0.0, p_rot90=0.0, p_noise=0.0, p_brightness=0.0,
+                    p_contrast=0.0, p_gamma=0.0, p_gamma_invert=0.0,
+                    p_rotation=0.0, p_scaling=0.0)
+        base.update(kw)
+        return augment_patch_batch(x, y, key, **base)
+
+    def test_rotation_moves_x_and_y_together(self):
+        """Mirror of the lossless-family joint test: x channel 0 IS the
+        label as float, so thresholding the bilinear-resampled image must
+        reproduce the nearest-resampled label except in a thin interpolation
+        boundary shell."""
+        y = np.stack([_disk((16, 16, 16), 5, center=(7.5, 7.5, 10.0))] * 4)
+        x = y[..., None].astype(np.float32)
+        ax, ay = self._interp_only(
+            jnp.asarray(x), jnp.asarray(y), jax.random.PRNGKey(0),
+            p_rotation=1.0,
+        )
+        ax, ay = np.asarray(ax), np.asarray(ay)
+        assert not np.array_equal(ay, y)  # something rotated
+        mismatch = np.mean((ax[..., 0] > 0.5) != (ay > 0))
+        assert mismatch < 0.05, f"x/y rotated apart: {mismatch:.3f}"
+
+    def test_rotation_keeps_center_and_label_set(self):
+        y = np.stack([_disk((16, 16, 16), 4)] * 3)
+        x = np.random.default_rng(0).normal(
+            size=(3, 16, 16, 16, 1)).astype(np.float32)
+        _, ay = self._interp_only(
+            jnp.asarray(x), jnp.asarray(y), jax.random.PRNGKey(1),
+            p_rotation=1.0,
+        )
+        ay = np.asarray(ay)
+        # a centered ball contains the center under any rotation
+        assert (ay[:, 8, 8, 8] == 1).all()
+        assert set(np.unique(ay)) <= {0, 1}
+
+    def test_scaling_zoom_out_shrinks_and_zoom_in_grows(self):
+        y = np.stack([_disk((24, 24), 6)] * 4)
+        x = y[..., None].astype(np.float32)
+        n0 = y.sum()
+        _, ay_out = self._interp_only(
+            jnp.asarray(x), jnp.asarray(y), jax.random.PRNGKey(2),
+            p_scaling=1.0, scale_lo=1.35, scale_hi=1.4,
+        )
+        _, ay_in = self._interp_only(
+            jnp.asarray(x), jnp.asarray(y), jax.random.PRNGKey(3),
+            p_scaling=1.0, scale_lo=0.7, scale_hi=0.72,
+        )
+        # coords scaled by s>1 sample a wider input region -> object shrinks
+        assert np.asarray(ay_out).sum() < 0.75 * n0
+        assert np.asarray(ay_in).sum() > 1.3 * n0
+
+    def test_2d_patches_supported(self):
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(size=(2, 12, 12, 3)).astype(np.float32))
+        y = jnp.asarray((rng.random((2, 12, 12)) < 0.4).astype(np.int32))
+        ax, ay = self._interp_only(x, y, jax.random.PRNGKey(5),
+                                   p_rotation=1.0, p_scaling=1.0)
+        assert ax.shape == x.shape and ay.shape == y.shape
+        assert set(np.unique(np.asarray(ay))) <= {0, 1}
+
+    def test_elastic_deforms_when_enabled(self):
+        y = np.stack([_disk((16, 16, 16), 5)] * 2)
+        x = y[..., None].astype(np.float32)
+        ax, ay = self._interp_only(
+            jnp.asarray(x), jnp.asarray(y), jax.random.PRNGKey(6),
+            p_elastic=1.0, elastic_alpha=6.0,
+        )
+        assert not np.array_equal(np.asarray(ay), y)
+        assert set(np.unique(np.asarray(ay))) <= {0, 1}
+        # x and y deform together (same field): thresholded image ~ label
+        mismatch = np.mean((np.asarray(ax)[..., 0] > 0.5)
+                           != (np.asarray(ay) > 0))
+        assert mismatch < 0.05
+
+    def test_no_fire_is_bit_exact_even_with_interp_enabled(self):
+        """p>0 but the per-example bernoulli says no: the where-guard must
+        return the ORIGINAL bits, not a resample-of-identity."""
+        x, y = _batch(b=64, shape=(6, 6, 6))
+        ax, ay = self._interp_only(x, y, jax.random.PRNGKey(7),
+                                   p_rotation=0.35, p_scaling=0.35)
+        # with 64 examples some fire and some don't; the non-fired must be
+        # bit-identical
+        same = [
+            np.array_equal(np.asarray(ax[i]), np.asarray(x[i]))
+            for i in range(x.shape[0])
+        ]
+        changed = [not s for s in same]
+        assert any(same) and any(changed)
+        for i, s in enumerate(same):
+            if s:
+                np.testing.assert_array_equal(np.asarray(ay[i]),
+                                              np.asarray(y[i]))
 
 
 class TestEngineAugmentHook:

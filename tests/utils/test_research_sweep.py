@@ -103,3 +103,47 @@ def test_picai_sweep_tiny(monkeypatch, capsys):
         sys.path[:] = old_path
     out = capsys.readouterr().out
     assert '"best"' in out and '"dice"' in out
+
+
+def _run_sweep(monkeypatch, rel_path):
+    monkeypatch.setenv("FL4HEALTH_SWEEP_TINY", "1")
+    old_path = list(sys.path)
+    try:
+        runpy.run_path(str(REPO / rel_path), run_name="__main__")
+    finally:
+        sys.path[:] = old_path
+
+
+def test_flamby_heart_disease_sweep_tiny(monkeypatch, capsys, tmp_path):
+    """FLamby fed_heart_disease method grid (reference
+    research/flamby/fed_heart_disease/ — the FENDA-FL paper arms) on the
+    4-center tabular stand-in, with find_best_hp_dir file-based selection
+    agreeing with the in-memory sweep (asserted inside the script)."""
+    monkeypatch.setenv("FL4HEALTH_SWEEP_OUT", str(tmp_path / "out"))
+    _run_sweep(monkeypatch, "research/flamby/fed_heart_disease/sweep.py")
+    out = capsys.readouterr().out
+    assert '"best"' in out and '"best_hp_dir"' in out
+    for method in ("fedavg", "scaffold", "ditto", "apfl", "fenda", "moon",
+                   "perfcl", "central", "local"):
+        assert f'"{method}"' in out
+
+
+def test_flamby_isic2019_sweep_tiny(monkeypatch, capsys, tmp_path):
+    """FLamby fed_isic2019 grid incl. the MMD arms the reference adds only
+    for this dataset (ditto_mkmmd / mr_mtl_mkmmd / mr_mtl_deep_mmd)."""
+    monkeypatch.setenv("FL4HEALTH_SWEEP_OUT", str(tmp_path / "out"))
+    _run_sweep(monkeypatch, "research/flamby/fed_isic2019/sweep.py")
+    out = capsys.readouterr().out
+    assert '"best"' in out
+    assert '"ditto_mkmmd"' in out and '"mr_mtl_deep_mmd"' in out
+    assert '"balanced_accuracy"' in out  # FLamby's ISIC scoring metric
+
+
+def test_flamby_ixi_sweep_tiny(monkeypatch, capsys, tmp_path):
+    """FLamby fed_ixi grid: the personalization arms composed with dense
+    3-D segmentation (feature-map-safe contrastive logics)."""
+    monkeypatch.setenv("FL4HEALTH_SWEEP_OUT", str(tmp_path / "out"))
+    _run_sweep(monkeypatch, "research/flamby/fed_ixi/sweep.py")
+    out = capsys.readouterr().out
+    assert '"best"' in out and '"dice"' in out
+    assert '"fenda"' in out and '"moon"' in out and '"perfcl"' in out
