@@ -55,20 +55,20 @@ PEAK_BF16_FLOPS = {
 # FLOP-based bridge to the north star (BASELINE.json: >=10x vs single-A100
 # Flower simulation). The A100 run cannot exist in this environment, so the
 # bridge MODELS it: the per-round FLOPs are identical (same model/config),
-# so speedup = (measured TPU TFLOP/s) / (A100 peak x assumed Flower
-# utilization). The utilization band is an ASSUMPTION, stated in the
-# artifact: Flower's simulation dispatches clients sequentially through
-# eager torch with gRPC/NumPy round-trips per round; small-CNN eager
-# training on big accelerators typically lands at a few percent of peak,
-# and the band's upper end (10%) is deliberately generous to the baseline
-# so the modeled speedup under-claims rather than over-claims.
+# so speedup = (measured TPU TFLOP/s) / (A100 peak x Flower utilization).
+# The utilization band is DERIVED from a measured chain (tools/
+# a100_band_anchor.py -> A100_BAND_ANCHOR.json; derivation in BASELINE.md):
+# the measured ~1.1 ms/step eager dispatch overhead against A100 spec peaks
+# bounds eager small-CNN utilization to 0.9-5.0%; the low end is rounded UP
+# to 1% so the modeled speedup band's high end under-claims.
 A100_PEAK_BF16_FLOPS = 312e12
-FLOWER_A100_UTIL_BAND = (0.01, 0.10)
+FLOWER_A100_UTIL_BAND = (0.01, 0.05)
 
 
 def modeled_vs_a100_flower(achieved_flops: float) -> dict | None:
-    """Assumption-based bridge, not a measurement — returns the modeled
-    speedup band with its assumptions embedded in the record."""
+    """Model-based bridge, not a measurement — returns the modeled speedup
+    band; the utilization band is derived from the measured chain in
+    A100_BAND_ANCHOR.json (see BASELINE.md)."""
     if not achieved_flops:
         return None
     lo_util, hi_util = FLOWER_A100_UTIL_BAND
@@ -77,9 +77,10 @@ def modeled_vs_a100_flower(achieved_flops: float) -> dict | None:
         "low": round(achieved_flops / (hi_util * A100_PEAK_BF16_FLOPS), 2),
         "high": round(achieved_flops / (lo_util * A100_PEAK_BF16_FLOPS), 2),
         "model": (
-            "measured TFLOP/s / (A100 312 TFLOP/s bf16 x assumed Flower "
-            f"utilization {lo_util:.0%}-{hi_util:.0%}); FLOP-parity bridge "
-            "(same model+config), NOT an A100 measurement"
+            "measured TFLOP/s / (A100 312 TFLOP/s bf16 x Flower "
+            f"utilization {lo_util:.0%}-{hi_util:.0%}, band derived from "
+            "the measured chain in A100_BAND_ANCHOR.json); FLOP-parity "
+            "bridge (same model+config), NOT an A100 measurement"
         ),
     }
 

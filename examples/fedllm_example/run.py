@@ -139,5 +139,34 @@ if n_model_shards > 1 and len(jax.devices()) >= n_model_shards:
           f"{z2_tx.grad_bytes_per_device()} summed-grad bytes per device, "
           f"update norm="
           f"{float(jnp.linalg.norm(jax.flatten_util.ravel_pytree(updates)[0])):.4f}")
+
+    # --- ZeRO-2 through the federated engine -----------------------------
+    # Same FederatedSimulation API as everywhere else: pass the ZeRO-2
+    # optimizer as ``tx`` and the engine splits every batch into n_shards
+    # microbatches whose unreduced grads reduce via psum_scatter
+    # (clients/engine.py _microbatched_value_and_grads; parity with the
+    # unsharded round pinned by tests/parallel/test_tp_zero.py::
+    # TestZero2EngineIntegration). Full-parameter exchange here: the
+    # pytree-masked LoRA optimizer operates on the param TREE while the
+    # ZeRO wrapper works on the flat shard vector, so the two don't compose
+    # yet — this sim trains the full model.
+    if cfg["batch_size"] % n_model_shards == 0:
+        z2_sim = FederatedSimulation(
+            logic=engine.ClientLogic(model, engine.masked_cross_entropy),
+            tx=z2_tx,
+            strategy=FedAvg(),
+            datasets=datasets,
+            batch_size=cfg["batch_size"],
+            metrics=lib.accuracy_metrics(),
+            local_steps=cfg["local_steps"],
+            seed=11,
+        )
+        z2_hist = z2_sim.fit(2)
+        print(f"# zero-2 federated sim: 2 rounds through the engine "
+              f"microbatch path, final eval acc="
+              f"{float(z2_hist[-1].eval_metrics['accuracy']):.4f}")
+    else:
+        print(f"# zero-2 federated sim skipped: batch_size "
+              f"{cfg['batch_size']} not divisible by {n_model_shards} shards")
 else:
     print("# zero-1/2 demo skipped (single device or zero_shards=1)")

@@ -298,8 +298,77 @@ def _mask_tree(new: PyTree, old: PyTree, keep_new: jax.Array) -> PyTree:
     return jax.tree_util.tree_map(lambda n, o: jnp.where(keep_new > 0, n, o), new, old)
 
 
+def _microbatched_value_and_grads(logic, tx, state, ctx, batch, step_rng):
+    """ZeRO-2 gradient path: split the batch into ``tx.n_shards``
+    microbatches, compute per-microbatch grads, and hand the UNREDUCED
+    [n_shards]-leading stack to ``tx.update`` — its psum_scatter does the
+    reduction without ever materializing the summed gradient
+    (parallel/zero.py Zero2ShardedOptimizer; the DeepSpeed-zero2 role of the
+    reference's fedllm example).
+
+    Exactness contract: each microbatch grad is pre-scaled by
+    ``n * M_k / M_total`` (M_k = valid examples in microbatch k) so the
+    optimizer's uniform mean reproduces the full-batch masked-mean gradient
+    bit-for-math. This is exact for losses that are masked example-means
+    plus state-only penalty terms (CE/MSE, FedProx/Ditto/MR-MTL penalties —
+    weights sum to 1) and for affine transform_gradients hooks (SCAFFOLD's
+    variate correction). Batch-coupled losses (contrastive normalizers over
+    the whole batch) and non-affine gradient transforms (DP clipping) change
+    semantics under microbatching — same caveat as any grad-accumulation
+    scheme — and mutable model_state (batch stats) takes the LAST
+    microbatch's update.
+    """
+    n = tx.n_shards
+    b = batch.example_mask.shape[0]
+    if b % n != 0:
+        raise ValueError(
+            f"ZeRO-2 engine path needs batch size divisible by n_shards: "
+            f"batch={b}, n_shards={n}"
+        )
+    m = b // n
+
+    def split(leaf):
+        return leaf.reshape((n, m) + leaf.shape[1:])
+
+    micro = Batch(
+        x=jax.tree_util.tree_map(split, batch.x),
+        y=jax.tree_util.tree_map(split, batch.y),
+        example_mask=split(batch.example_mask),
+        step_mask=jnp.broadcast_to(batch.step_mask, (n,)),
+    )
+
+    def one(mb, rng_k):
+        (bw, aux), g = logic.value_and_grads(state, ctx, mb, rng_k)
+        g = logic.transform_gradients(g, state, ctx)
+        return (bw, aux), g
+
+    # independent rng per microbatch: a shared key would draw IDENTICAL
+    # dropout masks in every microbatch (correlated noise); per-fold keys
+    # match grad-accumulation convention (still a different stream than the
+    # full-batch draw — stochastic layers are approximate here, like the
+    # other microbatching caveats above)
+    rngs = jax.vmap(lambda i: jax.random.fold_in(step_rng, i))(jnp.arange(n))
+    (bw_k, (preds_k, add_k, mstate_k)), grads_k = jax.vmap(one)(micro, rngs)
+
+    m_k = jnp.sum(micro.example_mask.astype(jnp.float32), axis=1)  # [n]
+    m_tot = jnp.maximum(jnp.sum(m_k), 1.0)
+    w = n * m_k / m_tot  # uniform mean of w_k·g_k == masked-mean grad
+    grads_scaled = jax.tree_util.tree_map(
+        lambda g: g * w.reshape((n,) + (1,) * (g.ndim - 1)), grads_k
+    )
+    recombine = lambda v: jnp.sum((w / n) * v)  # noqa: E731 — Σ (M_k/M_tot)·v_k
+    backward = recombine(bw_k)
+    additional = {k: recombine(v) for k, v in add_k.items()}
+    preds = jax.tree_util.tree_map(
+        lambda p: p.reshape((b,) + p.shape[2:]), preds_k
+    )
+    new_model_state = jax.tree_util.tree_map(lambda s: s[-1], mstate_k)
+    return backward, preds, additional, new_model_state, grads_scaled
+
+
 def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
     """Returns step(state, ctx, batch) -> (state, StepOutput) — jit/scan-safe."""
+    unreduced = getattr(tx, "expects_unreduced_grads", False)
 
     def step(state: TrainState, ctx: Any, batch: Batch):
         state = _mask_tree(
@@ -307,10 +376,17 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
         )
         rng, step_rng = jax.random.split(state.rng)
         batch = logic.augment(batch, jax.random.fold_in(step_rng, 0xA6), ctx)
-        (backward, (preds, additional, new_model_state)), grads = logic.value_and_grads(
-            state, ctx, batch, step_rng
-        )
-        grads = logic.transform_gradients(grads, state, ctx)
+        if unreduced:
+            backward, preds, additional, new_model_state, grads = (
+                _microbatched_value_and_grads(
+                    logic, tx, state, ctx, batch, step_rng
+                )
+            )
+        else:
+            (backward, (preds, additional, new_model_state)), grads = (
+                logic.value_and_grads(state, ctx, batch, step_rng)
+            )
+            grads = logic.transform_gradients(grads, state, ctx)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
@@ -593,12 +669,18 @@ def epoch_batches(
     Padding rows get example_mask 0; padding steps get step_mask 0.
     ``x``/``y`` may be pytrees of arrays sharing axis 0 (dict inputs).
     """
-    ns = {leaf.shape[0] for leaf in jax.tree_util.tree_leaves(x)}
+    # x AND y leaves must agree on axis-0 size: without this, the gather
+    # below would CLAMP out-of-range indices on short leaves — silently
+    # repeating rows instead of erroring (direct callers like the
+    # fedprox_cluster silo handler bypass FederatedSimulation's nx==ny check)
+    ns = {
+        leaf.shape[0]
+        for tree in (x, y)
+        for leaf in jax.tree_util.tree_leaves(tree)
+    }
     if len(ns) > 1:
-        # without this, the gather below would CLAMP out-of-range indices on
-        # the short leaves — silently repeating rows instead of erroring
         raise ValueError(
-            f"epoch_batches: x leaves disagree on example count: {sorted(ns)}"
+            f"epoch_batches: x/y leaves disagree on example count: {sorted(ns)}"
         )
     idx, example_mask, step_mask = epoch_index_plan(
         _entropy_from_key(rng), data_rows(x), batch_size, n_steps, shuffle,

@@ -78,7 +78,9 @@ class MxuConv(nn.Module):
     features: int
     kernel_size: tuple[int, ...] = (3, 3)
     padding: str = "SAME"
-    dtype: jnp.dtype = jnp.float32
+    # None = infer from the input (nn.Conv's dtype=None semantics): a bf16
+    # input stays bf16 instead of being silently promoted to f32
+    dtype: jnp.dtype | None = None
     strides: tuple[int, ...] | None = None
 
     @nn.compact
@@ -91,8 +93,9 @@ class MxuConv(nn.Module):
             (*ks, cin, self.features),
         )
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        dtype = self.dtype if self.dtype is not None else x.dtype
         patches = jax.lax.conv_general_dilated_patches(
-            x.astype(self.dtype), ks,
+            x.astype(dtype), ks,
             tuple(self.strides) if self.strides else (1,) * rank,
             self.padding,
             dimension_numbers=_conv_dimension_numbers(rank),
@@ -102,8 +105,8 @@ class MxuConv(nn.Module):
         w = jnp.transpose(kernel, (rank, *range(rank), rank + 1)).reshape(
             cin * int(np.prod(ks)), self.features
         )
-        y = patches @ w.astype(self.dtype)
-        return y + bias.astype(self.dtype)
+        y = patches @ w.astype(dtype)
+        return y + bias.astype(dtype)
 
 
 def make_conv(
@@ -113,7 +116,7 @@ def make_conv(
     *,
     strides: tuple[int, ...] | None = None,
     padding: str = "SAME",
-    dtype: jnp.dtype = jnp.float32,
+    dtype: jnp.dtype | None = None,
     name: str | None = None,
 ) -> nn.Module:
     """The ONE conv-impl switch ("lax" = nn.Conv, "mxu" = MxuConv) shared by

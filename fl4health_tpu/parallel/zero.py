@@ -144,6 +144,16 @@ class Zero2ShardedOptimizer:
     params_template: Params | None = None
     reduce: str = "mean"
 
+    # Engine handshake (clients/engine.py make_train_step): optimizers that
+    # set this receive a [n_shards]-leading stack of UNREDUCED gradient
+    # trees instead of one reduced tree — the engine computes per-microbatch
+    # grads and lets the psum_scatter below do the reduction.
+    expects_unreduced_grads = True
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[self.axis_name]
+
     def _flat_size(self) -> tuple[int, int]:
         flat, _ = ptu.ravel(self.params_template)
         n_shards = self.mesh.shape[self.axis_name]

@@ -710,6 +710,7 @@ class FederatedSimulation:
                 "model's params (run the checkpoint through WarmedUpModule/"
                 "warm_up_from_file against this model's init first)"
             )
+        any_dtype_mismatch = False
         for (pa, a), (_, b) in zip(
             jax.tree_util.tree_flatten_with_path(params)[0],
             jax.tree_util.tree_flatten_with_path(ref)[0],
@@ -719,6 +720,15 @@ class FederatedSimulation:
                     f"set_global_params: leaf {pa} has shape {a.shape}, "
                     f"model expects {b.shape}"
                 )
+            any_dtype_mismatch |= a.dtype != b.dtype
+        if any_dtype_mismatch:
+            # a float64/float16 checkpoint leaf would silently change the
+            # compiled program's input signature (recompile) or its
+            # precision; cast to the model's dtype instead (AFTER the full
+            # shape loop — a later bad-shape leaf must still raise above)
+            params = jax.tree_util.tree_map(
+                lambda x, y: x.astype(y.dtype), params, ref
+            )
         self.server_state = self.server_state.replace(params=params)
         if broadcast_to_clients:
             n = self.n_clients

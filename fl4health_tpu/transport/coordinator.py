@@ -43,7 +43,14 @@ def weighted_merge(
 ) -> tuple[Any, np.ndarray]:
     """n-weighted FedAvg over reply param trees -> (merged, weights)."""
     weights = np.asarray([float(r[weight_key]) for r in replies])
-    weights = weights / weights.sum()
+    total = weights.sum()
+    if total <= 0:
+        raise ValueError(
+            f"weighted_merge: total weight is {total} (every silo reported "
+            f"{weight_key}=0 — empty shards or failed fits); refusing to "
+            "produce NaN global params"
+        )
+    weights = weights / total
     merged = jax.tree_util.tree_map(
         lambda *leaves: sum(w * leaf for w, leaf in zip(weights, leaves)),
         *[r[params_key] for r in replies],

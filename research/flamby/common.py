@@ -284,6 +284,38 @@ def build_method(
     )
 
 
+# Methods whose ``lam`` knob is live (penalty weight / contrastive weight);
+# for every other method lam is inert and duplicate grid points are dropped.
+LAM_METHODS = frozenset({"fedprox", "ditto", "mr_mtl", "moon", "perfcl"})
+
+
+def dedup_inert_lam(grid: list[dict], extra_lam_methods=()) -> list[dict]:
+    """Drop grid points that differ only in an inert ``lam``."""
+    live = LAM_METHODS | set(extra_lam_methods)
+    return [hp for hp in grid
+            if hp["method"] in live or hp["lam"] == grid[0]["lam"]]
+
+
+def finish(results, out_prefix: str, metric_key: str, score_name: str):
+    """Shared sweep tail: print the ranked arms, materialize the hp-dir
+    layout, re-select via find_best_hp_dir, assert agreement, print best."""
+    import json as _json
+    import os as _os
+    import tempfile as _tempfile
+
+    for r in results:
+        print(_json.dumps({"params": r.params,
+                           f"mean_{score_name}": round(r.mean_score, 4)}))
+    out_dir = Path(_os.environ.get("FL4HEALTH_SWEEP_OUT")
+                   or _tempfile.mkdtemp(prefix=out_prefix))
+    best_dir, best_score = write_hp_dir_and_select(out_dir, results, metric_key)
+    best = results[0]
+    assert best_dir is not None and abs(best_score - best.mean_score) < 1e-9
+    print(_json.dumps({"best": best.params,
+                       score_name: round(best.mean_score, 4),
+                       "best_hp_dir": best_dir.name}))
+
+
 def write_hp_dir_and_select(out_dir: Path, results, metric_key: str):
     """Materialize sweep results as the reference's hp-folder layout and
     re-select the winner via find_best_hp_dir (find_best_hp.py:36 flow) —

@@ -15,10 +15,8 @@ Run:  python research/flamby/fed_heart_disease/sweep.py
 Tiny: FL4HEALTH_SWEEP_TINY=1 python research/flamby/fed_heart_disease/sweep.py
 """
 
-import json
 import os
 import sys
-import tempfile
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent.parent.parent
@@ -93,32 +91,15 @@ def build(seed, method, lr, lam):
     )
 
 
-grid = hp_grid(
+grid = common.dedup_inert_lam(hp_grid(
     method=list(common.METHODS),
     lr=[0.01] if TINY else [0.003, 0.01, 0.03],
     lam=[0.1] if TINY else [0.01, 0.1, 1.0],
-)
-# lam is inert outside the penalty/contrastive arms — drop duplicates
-LAM_METHODS = {"fedprox", "ditto", "mr_mtl", "moon", "perfcl"}
-grid = [hp for hp in grid
-        if hp["method"] in LAM_METHODS or hp["lam"] == grid[0]["lam"]]
+))
 
 results = sweep(
     build, grid, n_rounds=ROUNDS, n_seeds=1 if TINY else 3,
     score=lambda history: float(history[-1].eval_metrics["accuracy"]),
     minimize=False,
 )
-for r in results:
-    print(json.dumps({"params": r.params,
-                      "mean_accuracy": round(r.mean_score, 4)}))
-
-out_dir = Path(os.environ.get("FL4HEALTH_SWEEP_OUT")
-               or tempfile.mkdtemp(prefix="flamby_heart_"))
-best_dir, best_score = common.write_hp_dir_and_select(
-    out_dir, results, "eval_accuracy"
-)
-best = results[0]
-assert best_dir is not None and abs(best_score - best.mean_score) < 1e-9
-print(json.dumps({"best": best.params,
-                  "accuracy": round(best.mean_score, 4),
-                  "best_hp_dir": best_dir.name}))
+common.finish(results, "flamby_heart_", "eval_accuracy", "accuracy")

@@ -8,6 +8,8 @@ the reference's smoke-test role for its gRPC fan-out
 in place of process boundaries.
 """
 
+import re
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -288,5 +290,23 @@ def test_grouped_conv_sharding_limitation_pinned(eight_devices):
         extra_loss_keys=("dice", "ce"),
     )
     mesh = meshlib.client_mesh(8, devices=eight_devices)
-    with pytest.raises(Exception, match="feature_group_count|divisible"):
+    try:
         _run_round(sim, shard_mesh=mesh)
+    except Exception as e:  # noqa: BLE001 — partitioner rejection expected
+        if re.search("feature_group_count|divisible", str(e)):
+            return  # the pinned rejection, verbatim
+        if re.search("shard|partition|spmd|group", str(e), re.IGNORECASE):
+            # An XLA upgrade that REWORDS the rejection should not fail the
+            # suite — the pin is about the behavior, not the message.
+            pytest.xfail(
+                f"grouped-conv sharding still rejected, but with a reworded "
+                f"error: {type(e).__name__}: {str(e)[:200]}"
+            )
+        raise  # unrelated crash (API change, OOM, ...) must FAIL the suite
+    # No exception: the partitioner learned to shard grouped convs — the
+    # MxuConv workaround note in models/cnn.py can be revisited. Surface as
+    # xpass-style skip rather than a suite failure.
+    pytest.xfail(
+        "XLA now shards the grouped-conv lowering — product behavior "
+        "improved; revisit models/cnn.py's MxuConv default"
+    )

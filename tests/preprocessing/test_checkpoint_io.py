@@ -189,6 +189,23 @@ class TestSimulationInjection:
         with pytest.raises(ValueError, match="shape"):
             sim.set_global_params(bad)
 
+    def test_dtype_mismatch_is_cast_to_model_dtype(self):
+        """Round-4 advisor finding: a float64/float16 checkpoint leaf must
+        not silently change the compiled program's input signature — it is
+        cast to the model leaf's dtype instead."""
+        sim, params = self._sim()
+        ref = jax.device_get(sim.global_params)
+        half = jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, 0.5).astype(jnp.float16), params
+        )
+        sim.set_global_params(half)
+        for leaf_ref, leaf_new in zip(
+            jax.tree_util.tree_leaves(ref),
+            jax.tree_util.tree_leaves(sim.global_params),
+        ):
+            assert leaf_new.dtype == leaf_ref.dtype
+            np.testing.assert_allclose(np.asarray(leaf_new), 0.5)
+
     def test_training_proceeds_from_injected_weights(self, tmp_path):
         sim, params = self._sim()
         pretrained = jax.tree_util.tree_map(

@@ -112,6 +112,19 @@ class TestDictInputs:
         h_dict = sim_dict.fit(1)
         assert np.isfinite(h_dict[-1].fit_losses["backward"])
 
+    def test_y_leaf_row_disagreement_raises_in_epoch_batches(self):
+        """Round-4 advisor finding: direct epoch_batches callers (e.g. the
+        fedprox_cluster silo handler) bypass FederatedSimulation's nx==ny
+        check, so a short y leaf must be caught by epoch_batches itself —
+        the silent index-clamping row-repetition hazard."""
+        import jax as _jax
+
+        x = jnp.zeros((10, 3))
+        y_short = jnp.zeros((8,), jnp.int32)
+        with pytest.raises(ValueError, match="disagree on example count"):
+            engine.epoch_batches(_jax.random.PRNGKey(0), x, y_short,
+                                 batch_size=4)
+
     def test_leaf_row_disagreement_raises(self):
         a, b, y = _client_data(0)
         with pytest.raises(ValueError, match="disagree on example count"):

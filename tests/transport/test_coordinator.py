@@ -5,6 +5,7 @@ deployment; reference role: basic_fedavg.py aggregate_fit over gRPC)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fl4health_tpu.transport import (
     LoopbackServer,
@@ -34,6 +35,16 @@ class TestWeightedMerge:
         ]
         merged, _ = weighted_merge(replies)
         np.testing.assert_allclose(float(merged["w"]), 1.5)
+
+    def test_all_zero_weights_raise_instead_of_nan(self):
+        """Round-4 advisor finding: every silo replying n=0 (empty shard or
+        failed fit) must raise, not silently propagate NaN global params."""
+        replies = [
+            {"params": {"w": jnp.asarray([1.0, 2.0])}, "n": jnp.asarray(0.0)}
+            for _ in range(3)
+        ]
+        with pytest.raises(ValueError, match="total weight"):
+            weighted_merge(replies)
 
 
 class TestBroadcastRound:
