@@ -1,13 +1,15 @@
-"""Federated VAE training (reference: examples/ae_examples).
+"""FedProx + federated VAE training (reference:
+examples/ae_examples/fedprox_vae_example — VAE clients under the adaptive
+proximal constraint).
 
-Run:  python examples/ae_examples/run.py
-Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/ae_examples/run.py
+Run:  python examples/ae_examples/fedprox_vae_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/ae_examples/fedprox_vae_example/run.py
 """
 
 import sys
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
 import optax  # noqa: E402
 
 import _lib as lib  # noqa: E402
@@ -20,8 +22,9 @@ import jax.numpy as jnp
 from flax import linen as nn
 from fl4health_tpu.models.autoencoders import VariationalAe, make_vae_loss
 from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.clients.fedprox import FedProxClientLogic
 from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
-from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.fedprox import FedAvgWithAdaptiveConstraint
 
 latent = cfg["latent_dim"]
 base = lib.mnist_client_datasets(cfg)
@@ -52,16 +55,19 @@ def mse(preds, targets, mask):
     return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 sim = FederatedSimulation(
-    logic=engine.ClientLogic(
+    logic=FedProxClientLogic(
         engine.from_flax(VariationalAe(encoder=Enc(), decoder=Dec())),
         make_vae_loss(latent, mse),
     ),
     tx=optax.adam(cfg["learning_rate"]),
-    strategy=FedAvg(),
+    strategy=FedAvgWithAdaptiveConstraint(
+        initial_drift_penalty_weight=cfg["initial_mu"]
+    ),
     datasets=datasets,
     batch_size=cfg["batch_size"],
     metrics=MetricManager(()),
     local_epochs=cfg["local_epochs"],
     seed=11,
+    extra_loss_keys=("vanilla", "penalty"),
 )
 lib.run_and_report(sim, cfg)

@@ -369,6 +369,26 @@ def _microbatched_value_and_grads(logic, tx, state, ctx, batch, step_rng):
 def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
     """Returns step(state, ctx, batch) -> (state, StepOutput) — jit/scan-safe."""
     unreduced = getattr(tx, "expects_unreduced_grads", False)
+    if unreduced:
+        # The microbatch pre-scaling assumes the optimizer's uniform MEAN
+        # reduction; a reduce="sum" ZeRO-2 would silently apply n_shards x
+        # the true gradient (an effective-LR inflation).
+        if getattr(tx, "reduce", "mean") != "mean":
+            raise ValueError(
+                "expects_unreduced_grads optimizers must use reduce='mean' "
+                f"through the engine (got {tx.reduce!r}) — the microbatch "
+                "weighting is calibrated for a uniform mean"
+            )
+        # A logic that overrides the gradient computation itself (DP
+        # per-example clip+noise) would run it once PER MICROBATCH — noise
+        # drawn n times and recombined no longer matches the (eps, delta)
+        # accounting. Same loud-error policy as personalized.py.
+        if type(logic).value_and_grads is not ClientLogic.value_and_grads:
+            raise TypeError(
+                f"ZeRO-2 microbatching cannot wrap {type(logic).__name__}: "
+                "it overrides value_and_grads (e.g. DP per-example "
+                "gradients), whose semantics change under microbatching"
+            )
 
     def step(state: TrainState, ctx: Any, batch: Batch):
         state = _mask_tree(

@@ -78,8 +78,9 @@ class MxuConv(nn.Module):
     features: int
     kernel_size: tuple[int, ...] = (3, 3)
     padding: str = "SAME"
-    # None = infer from the input (nn.Conv's dtype=None semantics): a bf16
-    # input stays bf16 instead of being silently promoted to f32
+    # None = nn.Conv's dtype=None semantics: promote input AND params via
+    # result_type (f32 params + bf16 input -> f32 compute), keeping the
+    # lax/mxu impls numerically interchangeable
     dtype: jnp.dtype | None = None
     strides: tuple[int, ...] | None = None
 
@@ -93,7 +94,8 @@ class MxuConv(nn.Module):
             (*ks, cin, self.features),
         )
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
-        dtype = self.dtype if self.dtype is not None else x.dtype
+        dtype = (self.dtype if self.dtype is not None
+                 else jnp.result_type(x.dtype, kernel.dtype))
         patches = jax.lax.conv_general_dilated_patches(
             x.astype(dtype), ks,
             tuple(self.strides) if self.strides else (1,) * rank,

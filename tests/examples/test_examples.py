@@ -41,7 +41,9 @@ def test_corpus_is_complete():
         "fedrep_example", "gpfl_example", "ensemble_example",
         "fedsimclr_example", "dynamic_layer_exchange_example",
         "sparse_tensor_partial_exchange_example", "warm_up_example",
-        "fedpca_example", "ae_examples", "mkmmd_example", "cross_silo_example",
+        "fedpca_example", "ae_examples/fedprox_vae_example",
+        "ae_examples/cvae_example", "ae_examples/cvae_dim_example",
+        "mkmmd_example", "cross_silo_example",
         "fl_plus_local_ft_example", "dp_fed_examples/dp_scaffold",
         "fenda_ditto_example", "fedllm_example", "nnunet_pfl_example",
         "docker_basic_example",
