@@ -1,0 +1,34 @@
+"""Shared CVAE pieces for the ae_examples flows (role of the reference's
+shared example models; both CVAE examples wire these through
+AutoEncoderDatasetConverter's packing contract)."""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class CondEnc(nn.Module):
+    latent: int
+
+    @nn.compact
+    def __call__(self, x, condition, train=True):
+        x = x.reshape(x.shape[0], -1)
+        h = nn.relu(nn.Dense(32)(jnp.concatenate([x, condition], axis=1)))
+        return nn.Dense(self.latent)(h), nn.Dense(self.latent)(h)
+
+
+class CondDec(nn.Module):
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, z, condition, train=True):
+        h = nn.relu(nn.Dense(32)(jnp.concatenate([z, condition], axis=1)))
+        return nn.Dense(self.out_dim)(h)
+
+
+def mse(preds, targets, mask):
+    # make_vae_loss reshapes recon to the target's (image) shape; compare
+    # flat either way
+    preds = preds.reshape(preds.shape[0], -1)
+    targets = targets.reshape(targets.shape[0], -1)
+    per = jnp.mean((preds - targets) ** 2, axis=-1)
+    return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -6,8 +6,10 @@ latents).
 
 Two stages in one script (the reference ships the trained CVAE as a
 checkpoint; here stage 1 trains it in-process so the flow is end-to-end):
-  1. federated CVAE training, condition = client one-hot;
-  2. CvaeFixedConditionProcessor(preprocessing/autoencoders.py) encodes
+  1. federated CVAE training via AutoEncoderDatasetConverter with a FIXED
+     condition per client (client one-hot — the converter's fixed-array
+     path, utils/dataset_converter.py:169);
+  2. CvaeFixedConditionProcessor (preprocessing/autoencoders.py) encodes
      every client's images to latent mu's; FedAvg MLP classifies latents.
 
 Run:  python examples/ae_examples/cvae_dim_example/run.py
@@ -18,21 +20,24 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 
 import _lib as lib  # noqa: E402
+from _cvae_lib import CondDec, CondEnc, mse  # noqa: E402
 from fl4health_tpu.clients import engine  # noqa: E402
 
 cfg = lib.example_config(Path(__file__).parent)
 
-import jax
-import jax.numpy as jnp
-from flax import linen as nn
-
 from fl4health_tpu.metrics.base import MetricManager
 from fl4health_tpu.models.autoencoders import ConditionalVae, make_vae_loss
 from fl4health_tpu.models.cnn import Mlp
-from fl4health_tpu.preprocessing.autoencoders import CvaeFixedConditionProcessor
+from fl4health_tpu.preprocessing.autoencoders import (
+    AutoEncoderDatasetConverter,
+    CvaeFixedConditionProcessor,
+)
 from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
 from fl4health_tpu.strategies.fedavg import FedAvg
 
@@ -41,51 +46,25 @@ base = lib.mnist_client_datasets(cfg)
 n_clients = len(base)
 flat_dim = int(jnp.prod(jnp.asarray(base[0].x_train.shape[1:])))
 
-
-def pack(x, client_idx):
-    flat = jnp.asarray(x).reshape(len(x), -1)
-    cond = jnp.broadcast_to(
-        jax.nn.one_hot(client_idx, n_clients)[None, :], (len(flat), n_clients)
-    )
-    return jnp.concatenate([flat, cond], axis=1)
-
-
-cvae_datasets = [
-    ClientDataset(
-        x_train=pack(d.x_train, i),
-        y_train=jnp.asarray(d.x_train).reshape(len(d.x_train), -1),
-        x_val=pack(d.x_val, i),
-        y_val=jnp.asarray(d.x_val).reshape(len(d.x_val), -1),
-    )
-    for i, d in enumerate(base)
+# One converter per client: the FIXED condition is the client's one-hot id
+# (the reference conditions its CVAE on client membership for dim-reduction).
+converters = [
+    AutoEncoderDatasetConverter(condition=jax.nn.one_hot(i, n_clients))
+    for i in range(n_clients)
 ]
+cvae_datasets = []
+for conv, d in zip(converters, base):
+    x_tr, t_tr = conv.convert_dataset(jnp.asarray(d.x_train),
+                                      jnp.asarray(d.y_train))
+    x_va, t_va = conv.convert_dataset(jnp.asarray(d.x_val),
+                                      jnp.asarray(d.y_val))
+    cvae_datasets.append(ClientDataset(x_train=x_tr, y_train=t_tr,
+                                       x_val=x_va, y_val=t_va))
 
-
-def unpack_input_condition(packed):
-    return packed[:, :flat_dim], packed[:, flat_dim:]
-
-
-class CondEnc(nn.Module):
-    @nn.compact
-    def __call__(self, x, condition, train=True):
-        h = nn.relu(nn.Dense(32)(jnp.concatenate([x, condition], axis=1)))
-        return nn.Dense(latent)(h), nn.Dense(latent)(h)
-
-
-class CondDec(nn.Module):
-    @nn.compact
-    def __call__(self, z, condition, train=True):
-        h = nn.relu(nn.Dense(32)(jnp.concatenate([z, condition], axis=1)))
-        return nn.Dense(flat_dim)(h)
-
-
-def mse(preds, targets, mask):
-    per = jnp.mean((preds - targets) ** 2, axis=-1)
-    return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-
-
-cvae = ConditionalVae(encoder=CondEnc(), decoder=CondDec(),
-                      unpack_input_condition=unpack_input_condition)
+cvae = ConditionalVae(
+    encoder=CondEnc(latent), decoder=CondDec(flat_dim),
+    unpack_input_condition=converters[0].get_unpacking_function(),
+)
 stage1 = FederatedSimulation(
     logic=engine.ClientLogic(engine.from_flax(cvae), make_vae_loss(latent, mse)),
     tx=optax.adam(cfg["learning_rate"]),

@@ -1,11 +1,13 @@
 """Federated Conditional-VAE training (reference:
 examples/ae_examples/cvae_examples/mlp_cvae_example — CVAE conditioned on a
-per-sample one-hot, trained federally).
+per-sample one-hot label, trained federally).
 
-The condition (here the digit label, one-hot) is PACKED into the model
-input and split back out by ``ConditionalVae.unpack_input_condition`` —
-the reference's AutoEncoderDatasetConverter condition-packing contract
-(utils/dataset_converter.py:68).
+The condition is packed into the model input by
+``AutoEncoderDatasetConverter`` and split back out by the converter's own
+unpacking function wired into ``ConditionalVae.unpack_input_condition`` —
+the reference's converter contract (utils/dataset_converter.py:68). A
+custom converter pins the one-hot width to 10 so non-IID clients missing
+some digits still agree on the condition size.
 
 Run:  python examples/ae_examples/cvae_example/run.py
 Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 FL4HEALTH_EXAMPLE_CLIENTS=2 python examples/ae_examples/cvae_example/run.py
@@ -15,18 +17,20 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 
 import _lib as lib  # noqa: E402
+from _cvae_lib import CondDec, CondEnc, mse  # noqa: E402
 from fl4health_tpu.clients import engine  # noqa: E402
 
 cfg = lib.example_config(Path(__file__).parent)
 
-import jax.numpy as jnp
-from flax import linen as nn
-
 from fl4health_tpu.metrics.base import MetricManager
 from fl4health_tpu.models.autoencoders import ConditionalVae, make_vae_loss
+from fl4health_tpu.preprocessing.autoencoders import AutoEncoderDatasetConverter
 from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
 from fl4health_tpu.strategies.fedavg import FedAvg
 
@@ -35,55 +39,30 @@ N_CLASSES = 10
 base = lib.mnist_client_datasets(cfg)
 flat_dim = int(jnp.prod(jnp.asarray(base[0].x_train.shape[1:])))
 
+converter = AutoEncoderDatasetConverter(
+    custom_converter=lambda x, y: (
+        jnp.concatenate(
+            [x.reshape(x.shape[0], -1), jax.nn.one_hot(y, N_CLASSES)], axis=1
+        ),
+        x,
+    ),
+    condition_vector_size=N_CLASSES,
+)
 
-def pack(x, y):
-    """[flat image | one-hot condition] — the converter's packed layout."""
-    flat = jnp.asarray(x).reshape(len(x), -1)
-    cond = jax.nn.one_hot(jnp.asarray(y), N_CLASSES)
-    return jnp.concatenate([flat, cond], axis=1)
-
-
-import jax  # noqa: E402
-
-datasets = [
-    ClientDataset(
-        x_train=pack(d.x_train, d.y_train),
-        y_train=jnp.asarray(d.x_train).reshape(len(d.x_train), -1),
-        x_val=pack(d.x_val, d.y_val),
-        y_val=jnp.asarray(d.x_val).reshape(len(d.x_val), -1),
-    )
-    for d in base
-]
-
-
-def unpack_input_condition(packed):
-    return packed[:, :flat_dim], packed[:, flat_dim:]
-
-
-class CondEnc(nn.Module):
-    @nn.compact
-    def __call__(self, x, condition, train=True):
-        h = nn.relu(nn.Dense(32)(jnp.concatenate([x, condition], axis=1)))
-        return nn.Dense(latent)(h), nn.Dense(latent)(h)
-
-
-class CondDec(nn.Module):
-    @nn.compact
-    def __call__(self, z, condition, train=True):
-        h = nn.relu(nn.Dense(32)(jnp.concatenate([z, condition], axis=1)))
-        return nn.Dense(flat_dim)(h)
-
-
-def mse(preds, targets, mask):
-    per = jnp.mean((preds - targets) ** 2, axis=-1)
-    return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-
+datasets = []
+for d in base:
+    x_tr, t_tr = converter.convert_dataset(jnp.asarray(d.x_train),
+                                           jnp.asarray(d.y_train))
+    x_va, t_va = converter.convert_dataset(jnp.asarray(d.x_val),
+                                           jnp.asarray(d.y_val))
+    datasets.append(ClientDataset(x_train=x_tr, y_train=t_tr,
+                                  x_val=x_va, y_val=t_va))
 
 sim = FederatedSimulation(
     logic=engine.ClientLogic(
         engine.from_flax(ConditionalVae(
-            encoder=CondEnc(), decoder=CondDec(),
-            unpack_input_condition=unpack_input_condition,
+            encoder=CondEnc(latent), decoder=CondDec(flat_dim),
+            unpack_input_condition=converter.get_unpacking_function(),
         )),
         make_vae_loss(latent, mse),
     ),

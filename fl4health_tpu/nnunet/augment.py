@@ -27,10 +27,13 @@ whole family compiles into the training scan. Out-of-bounds voxels use edge
 replication (mode="nearest") for both image and label rather than
 nnunetv2's constant-fill with a -1 ignore label: this keeps every label
 valid and avoids threading new ignore-index semantics through the loss
-stack (documented deviation). Remaining deviations, by design: elastic
-deformation defaults OFF (matching nnunetv2, whose default pipeline sets
-do_elastic=False) but is available via p_elastic; low-resolution simulation
-is omitted.
+stack (documented deviation). Low-resolution simulation (nearest-downsample by a random zoom, cubic
+upsample back — batchgenerators' SimulateLowResolutionTransform with
+order_down=0/order_up=3, p=0.25) keeps static shapes by drawing the zoom
+from a small static set via ``lax.switch``; Gaussian blur is a separable
+fixed-tap kernel. Remaining deviation, by design: elastic deformation
+defaults OFF (matching nnunetv2, whose default pipeline sets
+do_elastic=False) but is available via p_elastic.
 """
 
 from __future__ import annotations
@@ -93,6 +96,30 @@ def _noise_one(x, key, p, variance_max):
         jax.random.fold_in(key, 2), x.shape, x.dtype
     )
     return jnp.where(do, x + noise, x)
+
+
+def _blur_one(x, key, p, sigma_lo=0.5, sigma_hi=1.0, radius=2):
+    """Separable Gaussian blur, sigma ~ U(sigma_lo, sigma_hi) — nnU-Net's
+    GaussianBlurTransform (p=0.2). Fixed 2*radius+1 tap kernel (radius 2
+    covers 2 sigma at the range's top), edge padding."""
+    do = _bernoulli(jax.random.fold_in(key, 0), p)
+    sigma = jax.random.uniform(jax.random.fold_in(key, 1), (),
+                               minval=sigma_lo, maxval=sigma_hi)
+    offs = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    w = jnp.exp(-0.5 * jnp.square(offs / sigma))
+    w = w / jnp.sum(w)
+    out = x
+    for ax in range(x.ndim - 1):  # spatial axes ([*spatial, C] layout)
+        widths = [(0, 0)] * x.ndim
+        widths[ax] = (radius, radius)
+        xp = jnp.pad(out, widths, mode="edge")
+        acc = jnp.zeros_like(out, dtype=jnp.float32)
+        for i in range(2 * radius + 1):
+            sl = [slice(None)] * x.ndim
+            sl[ax] = slice(i, i + x.shape[ax])
+            acc = acc + w[i] * xp[tuple(sl)].astype(jnp.float32)
+        out = acc
+    return jnp.where(do, out.astype(x.dtype), x)
 
 
 def _brightness_one(x, key, p, lo, hi):
@@ -250,6 +277,31 @@ def _spatial_resample_one(
     )
 
 
+# Static zoom choices for low-res simulation: lax.switch needs static
+# intermediate shapes, so the continuous U(0.5, 1) draw becomes a uniform
+# choice over this set (covering batchgenerators' U(0.5, 1) range incl. the
+# mild top end).
+_LOWRES_ZOOMS = (0.5, 0.65, 0.8, 0.95)
+
+
+def _lowres_one(x, key, p):
+    """SimulateLowResolutionTransform: nearest-downsample by a random zoom,
+    cubic-upsample back to the patch grid (order_down=0 / order_up=3).
+    x-only (labels keep full resolution, as in batchgenerators)."""
+    do = _bernoulli(jax.random.fold_in(key, 0), p)
+    zi = jax.random.randint(jax.random.fold_in(key, 1), (), 0,
+                            len(_LOWRES_ZOOMS))
+    spatial = x.shape[:-1]
+
+    def branch(z):
+        small = tuple(max(int(round(s * z)), 1) for s in spatial)
+        down = jax.image.resize(x, small + (x.shape[-1],), method="nearest")
+        return jax.image.resize(down, x.shape, method="cubic").astype(x.dtype)
+
+    out = jax.lax.switch(zi, [lambda z=z: branch(z) for z in _LOWRES_ZOOMS])
+    return jnp.where(do, out, x)
+
+
 def _isotropic_pairs(spatial_shape: Sequence[int]) -> tuple:
     """Spatial axis pairs (as x-array axes, i.e. offset by 0 for the leading
     per-example layout [*spatial, C]) with equal sizes."""
@@ -267,7 +319,8 @@ def _isotropic_pairs(spatial_shape: Sequence[int]) -> tuple:
     static_argnames=("p_mirror", "p_rot90", "p_noise", "p_brightness",
                      "p_contrast", "p_gamma", "p_gamma_invert",
                      "p_rotation", "p_scaling", "rot_max_deg",
-                     "scale_lo", "scale_hi", "p_elastic", "elastic_alpha"),
+                     "scale_lo", "scale_hi", "p_elastic", "elastic_alpha",
+                     "p_lowres", "p_blur"),
 )
 def augment_patch_batch(
     x: jax.Array,
@@ -287,6 +340,8 @@ def augment_patch_batch(
     scale_hi: float = 1.4,
     p_elastic: float = 0.0,
     elastic_alpha: float = 8.0,
+    p_lowres: float = 0.25,
+    p_blur: float = 0.2,
 ) -> tuple[jax.Array, jax.Array]:
     """Augment one batch: x [B, *spatial, C] float, y [B, *spatial] int.
 
@@ -298,9 +353,9 @@ def augment_patch_batch(
     nnunetv2's defaults: rotation ±30° p=0.2, scaling (0.7, 1.4) p=0.2
     (interpolating transforms lead the pipeline, as in nnunetv2's
     SpatialTransform), noise VARIANCE ~ U(0, 0.1) at p=0.1,
-    brightness/contrast (0.75, 1.25) at p=0.15, gamma (0.7, 1.5) with
-    retain_stats at p=0.3 plus the separate invert-image gamma at p=0.1;
-    elastic defaults off as in nnunetv2.
+    brightness/contrast (0.75, 1.25) at p=0.15, low-res simulation at
+    p=0.25, gamma (0.7, 1.5) with retain_stats at p=0.3 plus the separate
+    invert-image gamma at p=0.1; elastic defaults off as in nnunetv2.
     """
     spatial = x.shape[1:-1]
     pairs = _isotropic_pairs(spatial)
@@ -308,7 +363,7 @@ def augment_patch_batch(
     interp_on = p_rotation > 0.0 or p_scaling > 0.0 or p_elastic > 0.0
 
     def one(xe, ye, key):
-        keys = jax.random.split(key, 8)
+        keys = jax.random.split(key, 10)
         if interp_on:  # static gate: skip the gather entirely when disabled
             xe, ye = _spatial_resample_one(
                 xe, ye, keys[7], p_rotation, p_scaling,
@@ -320,8 +375,11 @@ def augment_patch_batch(
         )
         xe, ye = _rot90_one(xe, ye, keys[1], pairs, p_rot90)
         xe = _noise_one(xe, keys[2], p_noise, 0.1)
+        xe = _blur_one(xe, keys[9], p_blur)  # nnunetv2 order: noise -> blur
         xe = _brightness_one(xe, keys[3], p_brightness, 0.75, 1.25)
         xe = _contrast_one(xe, keys[4], p_contrast, 0.75, 1.25)
+        if p_lowres > 0.0:  # static gate: three resize branches aren't free
+            xe = _lowres_one(xe, keys[8], p_lowres)
         xe = _gamma_one(xe, keys[5], p_gamma_invert, 0.7, 1.5, invert=True)
         xe = _gamma_one(xe, keys[6], p_gamma, 0.7, 1.5, invert=False)
         return xe, ye

@@ -38,7 +38,8 @@ class TestAugmentPatchBatch:
         ax, ay = augment_patch_batch(
             x, y, jax.random.PRNGKey(0), p_mirror=0.0, p_rot90=0.0,
             p_noise=0.0, p_brightness=0.0, p_contrast=0.0, p_gamma=0.0,
-            p_gamma_invert=0.0, p_rotation=0.0, p_scaling=0.0,
+            p_gamma_invert=0.0, p_rotation=0.0, p_scaling=0.0, p_lowres=0.0,
+            p_blur=0.0,
         )
         np.testing.assert_array_equal(np.asarray(ax), np.asarray(x))
         np.testing.assert_array_equal(np.asarray(ay), np.asarray(y))
@@ -65,8 +66,8 @@ class TestAugmentPatchBatch:
             jnp.asarray(x), jnp.asarray(y), jax.random.PRNGKey(1),
             p_mirror=1.0, p_rot90=1.0, p_noise=0.0, p_brightness=0.0,
             p_contrast=0.0, p_gamma=0.0, p_gamma_invert=0.0,
-            p_rotation=0.0, p_scaling=0.0,  # lossless family only here
-        )
+            p_rotation=0.0, p_scaling=0.0, p_lowres=0.0, p_blur=0.0,
+        )  # lossless family only
         residual = np.asarray(ax)[..., 0] - 10.0 * np.asarray(ay)
         # consistent spatial transform => residual is a permutation of noise
         np.testing.assert_allclose(
@@ -112,7 +113,8 @@ class TestAugmentPatchBatch:
         ax, _ = augment_patch_batch(
             x, y, jax.random.PRNGKey(3), p_mirror=0.0, p_rot90=0.0,
             p_noise=0.0, p_brightness=0.0, p_contrast=0.0, p_gamma=1.0,
-            p_gamma_invert=0.0, p_rotation=0.0, p_scaling=0.0,
+            p_gamma_invert=0.0, p_rotation=0.0, p_scaling=0.0, p_lowres=0.0,
+            p_blur=0.0,
         )
         assert not np.array_equal(np.asarray(ax), np.asarray(x))
         for b in range(x.shape[0]):
@@ -139,7 +141,7 @@ class TestSpatialResample:
     def _interp_only(self, x, y, key, **kw):
         base = dict(p_mirror=0.0, p_rot90=0.0, p_noise=0.0, p_brightness=0.0,
                     p_contrast=0.0, p_gamma=0.0, p_gamma_invert=0.0,
-                    p_rotation=0.0, p_scaling=0.0)
+                    p_rotation=0.0, p_scaling=0.0, p_lowres=0.0, p_blur=0.0)
         base.update(kw)
         return augment_patch_batch(x, y, key, **base)
 
@@ -210,6 +212,33 @@ class TestSpatialResample:
         mismatch = np.mean((np.asarray(ax)[..., 0] > 0.5)
                            != (np.asarray(ay) > 0))
         assert mismatch < 0.05
+
+    def test_blur_smooths_x_only(self):
+        """Gaussian blur must reduce high-frequency content of x, leave y
+        untouched, and roughly preserve the mean (kernel sums to 1)."""
+        rng = np.random.default_rng(12)
+        x = jnp.asarray(rng.normal(size=(3, 10, 10, 10, 1)).astype(np.float32))
+        y = jnp.asarray((rng.random((3, 10, 10, 10)) < 0.3).astype(np.int32))
+        ax, ay = self._interp_only(x, y, jax.random.PRNGKey(11), p_blur=1.0)
+        np.testing.assert_array_equal(np.asarray(ay), np.asarray(y))
+        def hf(a):
+            return float(np.mean(np.square(np.diff(np.asarray(a), axis=1))))
+        assert hf(ax) < 0.7 * hf(x)
+        np.testing.assert_allclose(float(jnp.mean(ax)), float(jnp.mean(x)),
+                                   atol=0.02)
+
+    def test_lowres_smooths_x_only(self):
+        """Low-res sim (nearest down, cubic up) must reduce high-frequency
+        content of x, leave y untouched, and preserve shapes."""
+        rng = np.random.default_rng(8)
+        x = jnp.asarray(rng.normal(size=(3, 12, 12, 12, 1)).astype(np.float32))
+        y = jnp.asarray((rng.random((3, 12, 12, 12)) < 0.3).astype(np.int32))
+        ax, ay = self._interp_only(x, y, jax.random.PRNGKey(9), p_lowres=1.0)
+        np.testing.assert_array_equal(np.asarray(ay), np.asarray(y))
+        assert ax.shape == x.shape
+        def hf(a):  # mean squared adjacent-voxel difference
+            return float(np.mean(np.square(np.diff(np.asarray(a), axis=1))))
+        assert hf(ax) < 0.7 * hf(x)
 
     def test_no_fire_is_bit_exact_even_with_interp_enabled(self):
         """p>0 but the per-example bernoulli says no: the where-guard must
