@@ -25,7 +25,7 @@ TINY_ENV = {
 }
 
 
-# Completeness stays in the fast lane (cheap, pure-Python); the 39 e2e runs
+# Completeness stays in the fast lane (cheap, pure-Python); the 41 e2e runs
 # are the slow lane's biggest line item.
 def test_corpus_is_complete():
     """The corpus must keep covering the major reference families."""
