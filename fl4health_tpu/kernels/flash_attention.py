@@ -184,9 +184,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, mask, o, lse, do, block_q, block_k, scale, interpret):
+def _bwd_call(q, k, v, mask, o, lse, do, block_q, block_k, scale, interpret,
+              dlse=None):
     bh, tp, dp = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        # lse as a differentiable OUTPUT (ring-flash merge): its cotangent
+        # enters the score gradient as dS = p*(dP - delta + dlse), i.e. the
+        # existing delta slot carries (delta - dlse) — kernels unchanged.
+        delta = delta - dlse.astype(jnp.float32)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale)
     dq = pl.pallas_call(
@@ -257,6 +263,29 @@ def _flash_padded_bwd(block_q, block_k, scale, interpret, res, do):
 _flash_padded.defvjp(_flash_padded_fwd, _flash_padded_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_padded_lse(q, k, v, mask, block_q, block_k, scale, interpret):
+    """(out, lse) variant — lse is a first-class differentiable output so
+    partial-attention results can be merged exactly (ring-flash)."""
+    return _fwd_call(q, k, v, mask, block_q, block_k, scale, interpret)
+
+
+def _flash_padded_lse_fwd(q, k, v, mask, block_q, block_k, scale, interpret):
+    out, lse = _fwd_call(q, k, v, mask, block_q, block_k, scale, interpret)
+    return (out, lse), (q, k, v, mask, out, lse)
+
+
+def _flash_padded_lse_bwd(block_q, block_k, scale, interpret, res, cts):
+    do, dlse = cts
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, mask, out, lse, do, block_q, block_k,
+                           scale, interpret, dlse=dlse)
+    return dq, dk, dv, None
+
+
+_flash_padded_lse.defvjp(_flash_padded_lse_fwd, _flash_padded_lse_bwd)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -301,3 +330,47 @@ def flash_attention(
     out = _flash_padded(qp, kp, vp, maskp, block_q, block_k, scale, interpret)
     out = out[:, :t, :d].reshape(b, h, t, d)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    pad_mask: jax.Array | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """flash_attention returning (out [B,T,H,D], lse [B,H,T]) with lse a
+    DIFFERENTIABLE output — the partial-softmax statistic that lets two
+    attention results over disjoint key sets merge exactly:
+    ``L = logsumexp_j(lse_j); out = sum_j exp(lse_j - L) * out_j``. This is
+    the local block of ring-flash attention (parallel/ring_attention.py
+    ``ring_flash_attention``). Query rows with no valid key anywhere get
+    lse ~ NEG_INF + log(1e-20) — a large FINITE negative, deliberately not
+    -inf: the ring merge computes exp(lse - M) and a true -inf would turn
+    all-padded rows into inf-inf = NaN. Their merge weight underflows to 0
+    either way; fully-padded rows' out is garbage, exactly like
+    flash_attention."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, h, d = q.shape
+    if pad_mask is None:
+        pad_mask = jnp.ones((b, t), jnp.float32)
+    scale = 1.0 / (d ** 0.5)
+    t_multiple = math.lcm(block_q, block_k)
+
+    def to_bh(x):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+        return _pad_axis(_pad_axis(x, 2, _LANE), 1, t_multiple)
+
+    qp, kp, vp = to_bh(q), to_bh(k), to_bh(v)
+    pad_mask = jax.lax.stop_gradient(pad_mask)
+    maskp = _pad_axis(pad_mask.astype(jnp.float32), 1, t_multiple)
+    maskp = jnp.repeat(maskp, h, axis=0)
+
+    out, lse = _flash_padded_lse(qp, kp, vp, maskp, block_q, block_k, scale,
+                                 interpret)
+    out = out[:, :t, :d].reshape(b, h, t, d)
+    lse = lse[:, :t].reshape(b, h, t)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype), lse
