@@ -243,30 +243,12 @@ def _bwd_call(q, k, v, mask, o, lse, do, block_q, block_k, scale, interpret,
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_padded(q, k, v, mask, block_q, block_k, scale, interpret):
-    out, _ = _fwd_call(q, k, v, mask, block_q, block_k, scale, interpret)
-    return out
-
-
-def _flash_padded_fwd(q, k, v, mask, block_q, block_k, scale, interpret):
-    out, lse = _fwd_call(q, k, v, mask, block_q, block_k, scale, interpret)
-    return out, (q, k, v, mask, out, lse)
-
-
-def _flash_padded_bwd(block_q, block_k, scale, interpret, res, do):
-    q, k, v, mask, out, lse = res
-    dq, dk, dv = _bwd_call(q, k, v, mask, out, lse, do, block_q, block_k,
-                           scale, interpret)
-    return dq, dk, dv, None
-
-
-_flash_padded.defvjp(_flash_padded_fwd, _flash_padded_bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash_padded_lse(q, k, v, mask, block_q, block_k, scale, interpret):
-    """(out, lse) variant — lse is a first-class differentiable output so
-    partial-attention results can be merged exactly (ring-flash)."""
+    """(out, lse) pair with lse a first-class differentiable output so
+    partial-attention results can be merged exactly (ring-flash). The
+    plain-``out`` path (flash_attention) wraps this and drops lse — its
+    zero cotangent makes _bwd_call's dlse term vanish, so ONE custom_vjp
+    serves both APIs."""
     return _fwd_call(q, k, v, mask, block_q, block_k, scale, interpret)
 
 
@@ -301,35 +283,11 @@ def flash_attention(
 
     pad_mask is NON-differentiable: it is a binary padding indicator, and the
     custom VJP returns a zero cotangent for it (a soft/learned mask would get
-    silent zero grads here — use the dense path for that; stop_gradient below
-    makes the contract explicit)."""
-    if interpret is None:
-        interpret = _interpret_default()
-    b, t, h, d = q.shape
-    if pad_mask is None:
-        pad_mask = jnp.ones((b, t), jnp.float32)
-    scale = 1.0 / (d ** 0.5)
-
-    # [B,T,H,D] -> [B*H, T, D]; pad T to the block grid, D to the lane width.
-    # T must divide by BOTH block sizes (the q grid tiles by block_q while
-    # each kernel loops T/block_k key blocks) — lcm, not max: padding only to
-    # max(block_q, block_k) would silently drop trailing key blocks for
-    # non-dividing pairs like 48/32.
-    t_multiple = math.lcm(block_q, block_k)
-
-    def to_bh(x):
-        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
-        x = _pad_axis(_pad_axis(x, 2, _LANE), 1, t_multiple)
-        return x
-
-    qp, kp, vp = to_bh(q), to_bh(k), to_bh(v)
-    pad_mask = jax.lax.stop_gradient(pad_mask)
-    maskp = _pad_axis(pad_mask.astype(jnp.float32), 1, t_multiple)
-    maskp = jnp.repeat(maskp, h, axis=0)  # [B*H, Tp] (B-major like to_bh)
-
-    out = _flash_padded(qp, kp, vp, maskp, block_q, block_k, scale, interpret)
-    out = out[:, :t, :d].reshape(b, h, t, d)
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    silent zero grads here — use the dense path for that; stop_gradient in
+    the shared prep makes the contract explicit)."""
+    out, _ = flash_attention_lse(q, k, v, pad_mask, block_q, block_k,
+                                 interpret)
+    return out
 
 
 def flash_attention_lse(
@@ -358,6 +316,11 @@ def flash_attention_lse(
     if pad_mask is None:
         pad_mask = jnp.ones((b, t), jnp.float32)
     scale = 1.0 / (d ** 0.5)
+    # [B,T,H,D] -> [B*H, T, D]; pad T to the block grid, D to the lane width.
+    # T must divide by BOTH block sizes (the q grid tiles by block_q while
+    # each kernel loops T/block_k key blocks) — lcm, not max: padding only to
+    # max(block_q, block_k) would silently drop trailing key blocks for
+    # non-dividing pairs like 48/32.
     t_multiple = math.lcm(block_q, block_k)
 
     def to_bh(x):

@@ -58,7 +58,10 @@ def _dense_local_lse(q_blk, k_blk, v_blk, mask_blk):
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
     denom = jnp.maximum(l, 1e-20)
     lse = m + jnp.log(denom)
-    return (o / denom[..., None].transpose(0, 2, 1, 3)).astype(q_blk.dtype), lse
+    # stay fp32: the ring driver accumulates in fp32 and casts ONCE at the
+    # end — a per-hop downcast would add bf16 quantization per hop that the
+    # single-accumulator formulation never had
+    return o / denom[..., None].transpose(0, 2, 1, 3), lse
 
 
 def _ring_body(q_blk, k_blk, v_blk, mask_blk, local_fn, axis_name: str):
@@ -155,9 +158,10 @@ def ring_flash_attention(
     one level up. Differentiable end-to-end (lse carries a first-class
     cotangent through the kernel's custom VJP).
 
-    Same contract as ring_self_attention; additionally T/N must divide the
-    lcm of the block sizes (the flash kernel would otherwise pad ring
-    blocks internally and attend to phantom keys rotated around the ring).
+    Same contract as ring_self_attention; additionally the local length T/N
+    must be divisible by usable block sizes: each block shrinks to
+    gcd(T/N, block) and a degenerate shrink (below 8 on a real-sized
+    shard) raises rather than compiling a pathological Mosaic tile.
     """
     import math as _math
 

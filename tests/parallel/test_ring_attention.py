@@ -254,3 +254,31 @@ class TestFlashAttentionLse:
         merged = (w1 * o1 + w2 * o2) / (w1 + w2)
         np.testing.assert_allclose(np.asarray(merged), np.asarray(o_full),
                                    atol=1e-5)
+
+
+@pytest.mark.slow
+def test_transformer_with_ring_flash_matches_dense(eight_devices):
+    """The full long-context model path through the Pallas local block:
+    TransformerClassifier(attention_fn=ring_flash) on a (seq,) mesh
+    reproduces the dense-attention model's logits."""
+    import functools
+
+    from fl4health_tpu.models.transformer import TransformerClassifier
+    from fl4health_tpu.parallel.ring_attention import ring_flash_attention
+
+    mesh = _mesh(eight_devices, 8)
+    kw = dict(vocab_size=64, n_classes=3, d_model=16, n_heads=2, n_layers=2,
+              d_ff=32, max_len=32)
+    dense_model = TransformerClassifier(**kw)
+    rf_model = TransformerClassifier(
+        **kw,
+        attention_fn=functools.partial(ring_flash_attention, mesh=mesh),
+    )
+    x = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 1, 64)
+    variables = dense_model.init(jax.random.PRNGKey(1), x, train=False)
+    out_dense, _ = dense_model.apply(variables, x, train=False)
+    out_rf, _ = rf_model.apply(variables, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_dense["prediction"]), np.asarray(out_rf["prediction"]),
+        atol=2e-5,
+    )
