@@ -185,14 +185,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_call(q, k, v, mask, o, lse, do, block_q, block_k, scale, interpret,
-              dlse=None):
+              dlse):
     bh, tp, dp = q.shape
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    if dlse is not None:
-        # lse as a differentiable OUTPUT (ring-flash merge): its cotangent
-        # enters the score gradient as dS = p*(dP - delta + dlse), i.e. the
-        # existing delta slot carries (delta - dlse) — kernels unchanged.
-        delta = delta - dlse.astype(jnp.float32)
+    # lse is a differentiable OUTPUT (ring-flash merge): its cotangent
+    # enters the score gradient as dS = p*(dP - delta + dlse), i.e. the
+    # delta slot carries (delta - dlse) — kernels unchanged. Plain
+    # flash_attention reaches here with dlse = zeros (custom_vjp
+    # instantiates the dropped output's cotangent).
+    delta = (jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+             - dlse.astype(jnp.float32))
 
     dq_kernel = functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale)
     dq = pl.pallas_call(

@@ -59,8 +59,10 @@ def _dense_local_lse(q_blk, k_blk, v_blk, mask_blk):
     denom = jnp.maximum(l, 1e-20)
     lse = m + jnp.log(denom)
     # stay fp32: the ring driver accumulates in fp32 and casts ONCE at the
-    # end — a per-hop downcast would add bf16 quantization per hop that the
-    # single-accumulator formulation never had
+    # end, so the DENSE ring adds no per-hop quantization. (The flash local
+    # block is different: its kernel writes each hop's output in the io
+    # dtype — inherent to its memory layout — so bf16 ring-flash carries
+    # one io-dtype rounding per hop into the fp32 merge.)
     return o / denom[..., None].transpose(0, 2, 1, 3), lse
 
 

@@ -1,0 +1,79 @@
+"""The shared tunnel-probe policy (utils/tpu_probe.py) — the one place that
+decides 'is the chip there', used by both bench.py and tools/tpu_watch.py.
+A misclassification here either wastes the round's only capture window or
+publishes a CPU number under the TPU headline, so the parse/classify rules
+get their own unit pins."""
+
+import pytest
+
+from fl4health_tpu.utils import tpu_probe
+
+
+class TestLastJsonLine:
+    def test_picks_last_valid_json(self):
+        text = '{"a": 1}\nnoise\n{"b": 2}'
+        assert tpu_probe.last_json_line(text) == {"b": 2}
+
+    def test_skips_trailing_invalid_json(self):
+        text = '{"a": 1}\n{broken'
+        assert tpu_probe.last_json_line(text) == {"a": 1}
+
+    def test_none_when_no_json(self):
+        assert tpu_probe.last_json_line("no json here\nstill none") is None
+
+
+class TestIsAccelerator:
+    @pytest.mark.parametrize("platform,expected", [
+        ("tpu", True),
+        ("axon", True),       # unknown plugin string still counts as a chip
+        ("gpu", True),
+        ("cpu", False),
+        ("down", False),
+        ("", False),
+        ("error: ModuleNotFoundError: no module named jax", False),
+    ])
+    def test_classification(self, platform, expected):
+        assert tpu_probe.is_accelerator(platform) is expected
+
+
+class TestProbePlatform:
+    def test_sentinel_line_parsed_from_child(self, monkeypatch):
+        # NOT a real jax child: on this box the axon sitecustomize overrides
+        # JAX_PLATFORMS in subprocesses and a dark tunnel hangs the import —
+        # the exact behavior probe_platform exists to time out on. The parse
+        # contract is pinned against a deterministic fake child instead.
+        monkeypatch.setattr(
+            tpu_probe, "_PROBE_SRC",
+            f"print('{tpu_probe._SENTINEL}tpu')",
+        )
+        assert tpu_probe.probe_platform(60) == "tpu"
+
+    def test_crashing_child_reports_error_not_down(self, monkeypatch):
+        """A broken environment (import crash) must stay distinguishable
+        from a dead tunnel in the watch log (r5 review finding)."""
+        monkeypatch.setattr(
+            tpu_probe, "_PROBE_SRC",
+            "import nonexistent_module_xyz_12345",
+        )
+        out = tpu_probe.probe_platform(60)
+        assert out.startswith("error")
+        assert "nonexistent_module_xyz_12345" in out
+
+    def test_hanging_child_reports_down(self, monkeypatch):
+        monkeypatch.setattr(
+            tpu_probe, "_PROBE_SRC", "import time; time.sleep(60)"
+        )
+        assert tpu_probe.probe_platform(1) == "down"
+
+    def test_sentinel_required_even_with_noisy_stdout(self, monkeypatch):
+        """Trailing banner lines after the platform print must not be
+        misread as the platform (the pre-refactor out[-1] bug)."""
+        monkeypatch.setattr(
+            tpu_probe, "_PROBE_SRC",
+            f"print('{tpu_probe._SENTINEL}cpu'); print('INFO: plugin idle')",
+        )
+        assert tpu_probe.probe_platform(60) == "cpu"
+
+    def test_no_sentinel_reports_empty(self, monkeypatch):
+        monkeypatch.setattr(tpu_probe, "_PROBE_SRC", "print('cpu')")
+        assert tpu_probe.probe_platform(60) == ""
