@@ -1,0 +1,148 @@
+"""Unit pins for the schema-driven column transforms
+(feature_alignment/preprocessor.py — reference
+tab_features_preprocessor.py:18 + string_columns_transformer.py). The
+orchestration e2e test proves the negotiation; these pin the TRANSFORM
+semantics the aligned arrays depend on: fit-then-transform scaling,
+unknown-category handling, missing-column synthesis, and sklearn-default
+TF-IDF math."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fl4health_tpu.feature_alignment.preprocessor import (
+    TabularFeaturesPreprocessor,
+    _categorical_transform,
+    _NumericTransform,
+    _TfidfTransform,
+)
+from fl4health_tpu.feature_alignment.schema import (
+    TabularFeature,
+    TabularFeaturesInfoEncoder,
+    TabularType,
+)
+
+
+def _num(name="age", fill=0.0):
+    return TabularFeature(name, TabularType.NUMERIC, fill_value=fill)
+
+
+class TestNumericTransform:
+    def test_fit_then_transform_scales_consistently(self):
+        """Validation data must use the TRAINING min/max (sklearn pipeline
+        semantics) — values outside the fitted range land outside [0, 1]."""
+        t = _NumericTransform(_num())
+        t.fit(np.asarray([0.0, 10.0], dtype=object))
+        out = t(np.asarray([0.0, 5.0, 10.0, 20.0], dtype=object))
+        np.testing.assert_allclose(out[:, 0], [0.0, 0.5, 1.0, 2.0])
+
+    def test_constant_column_does_not_divide_by_zero(self):
+        t = _NumericTransform(_num())
+        out = t(np.asarray([3.0, 3.0, 3.0], dtype=object))
+        assert np.all(np.isfinite(out))
+        np.testing.assert_allclose(out[:, 0], 0.0)
+
+    def test_missing_values_imputed_with_fill(self):
+        t = _NumericTransform(_num(fill=5.0))
+        t.fit(np.asarray([0.0, 10.0], dtype=object))
+        out = t(np.asarray([None, float("nan"), 10.0], dtype=object))
+        np.testing.assert_allclose(out[:, 0], [0.5, 0.5, 1.0])
+
+
+class TestCategoricalTransform:
+    def _feat(self):
+        return TabularFeature("color", TabularType.ORDINAL, fill_value="red",
+                              metadata=["blue", "green", "red"])
+
+    def test_one_hot_known_and_unknown(self):
+        t = _categorical_transform(self._feat(), one_hot=True)
+        out = t(np.asarray(["blue", "red", "PURPLE"], dtype=object))
+        np.testing.assert_array_equal(out[0], [1, 0, 0])
+        np.testing.assert_array_equal(out[1], [0, 0, 1])
+        # unknown category -> all-zero row (handle_unknown='ignore')
+        np.testing.assert_array_equal(out[2], [0, 0, 0])
+
+    def test_ordinal_targets_get_dedicated_unknown_code(self):
+        t = _categorical_transform(self._feat(), one_hot=False)
+        out = t(np.asarray(["green", "PURPLE"], dtype=object))
+        assert out[0, 0] == 1.0
+        assert out[1, 0] == len(self._feat().metadata) + 1  # unknown_value
+
+    def test_missing_imputed_before_encoding(self):
+        t = _categorical_transform(self._feat(), one_hot=True)
+        out = t(np.asarray([None], dtype=object))
+        np.testing.assert_array_equal(out[0], [0, 0, 1])  # fill 'red'
+
+
+class TestTfidfTransform:
+    def _feat(self):
+        return TabularFeature("notes", TabularType.STRING, fill_value="",
+                              metadata=["cough", "fever", "mild"])
+
+    def test_matches_sklearn_default_formula(self):
+        """smooth-idf + l2 rows: idf = log((1+n)/(1+df)) + 1."""
+        t = _TfidfTransform(self._feat())
+        corpus = np.asarray(
+            ["mild cough", "fever", "mild fever"], dtype=object
+        )
+        out = t.fit(corpus)(corpus)
+        n = 3
+        df = np.asarray([1, 2, 2])  # cough, fever, mild
+        idf = np.log((1 + n) / (1 + df)) + 1
+        row0 = np.asarray([idf[0], 0.0, idf[2]])
+        row0 = row0 / np.linalg.norm(row0)
+        np.testing.assert_allclose(out[0], row0, rtol=1e-12)
+        # every non-empty row is l2-normalized
+        np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0)
+
+    def test_out_of_vocabulary_tokens_ignored(self):
+        t = _TfidfTransform(self._feat())
+        t.fit(np.asarray(["cough fever mild"], dtype=object))
+        out = t(np.asarray(["zebra quantum"], dtype=object))
+        np.testing.assert_allclose(out[0], 0.0)
+
+
+class TestPreprocessorAlignment:
+    def _encoder(self):
+        return TabularFeaturesInfoEncoder(
+            tabular_features=[
+                _num("age"),
+                TabularFeature("color", TabularType.ORDINAL,
+                               fill_value="red",
+                               metadata=["blue", "green", "red"]),
+            ],
+            tabular_targets=[
+                TabularFeature("label", TabularType.ORDINAL, fill_value="no",
+                               metadata=["no", "yes"]),
+            ],
+        )
+
+    def test_missing_column_synthesized_from_fill_value(self):
+        """A client lacking a negotiated column still produces the aligned
+        width — the core cross-client alignment contract."""
+        pre = TabularFeaturesPreprocessor(self._encoder())
+        df_full = pd.DataFrame({"age": [0.0, 10.0], "color": ["blue", "red"],
+                                "label": ["no", "yes"]})
+        pre.fit(df_full)
+        x_full, y_full = pre.preprocess_features(df_full)
+        df_missing = pd.DataFrame({"age": [5.0], "label": ["yes"]})
+        x_miss, y_miss = pre.preprocess_features(df_missing)
+        assert x_miss.shape[1] == x_full.shape[1]
+        # synthesized 'color' column one-hots the fill value 'red'
+        np.testing.assert_array_equal(x_miss[0, 1:], [0, 0, 1])
+        assert y_miss[0] == 1.0
+
+    def test_column_order_is_sorted_feature_names(self):
+        pre = TabularFeaturesPreprocessor(self._encoder())
+        df = pd.DataFrame({"color": ["blue"], "age": [1.0], "label": ["no"]})
+        x, _ = pre.preprocess_features(df)
+        # 'age' (numeric, 1 col) before 'color' (one-hot, 3 cols)
+        assert x.shape == (1, 4)
+        np.testing.assert_allclose(x[0, 0], 0.0)  # lazily-fit single value
+
+    def test_set_feature_pipeline_hook(self):
+        pre = TabularFeaturesPreprocessor(self._encoder())
+        pre.set_feature_pipeline("age", lambda col: np.full((len(col), 1), 7.0))
+        df = pd.DataFrame({"age": [1.0], "color": ["blue"], "label": ["no"]})
+        x, _ = pre.preprocess_features(df)
+        assert x[0, 0] == pytest.approx(7.0)
