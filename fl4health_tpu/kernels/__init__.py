@@ -5,11 +5,15 @@ from fl4health_tpu.kernels.dp_clip import (
     per_example_sq_norms,
     scaled_masked_sum,
 )
-from fl4health_tpu.kernels.flash_attention import flash_attention
+from fl4health_tpu.kernels.flash_attention import (
+    flash_attention,
+    flash_attention_lse,
+)
 
 __all__ = [
     "fused_clipped_masked_sum",
     "per_example_sq_norms",
     "scaled_masked_sum",
     "flash_attention",
+    "flash_attention_lse",
 ]
