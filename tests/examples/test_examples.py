@@ -25,7 +25,7 @@ TINY_ENV = {
 }
 
 
-# Completeness stays in the fast lane (cheap, pure-Python); the 41 e2e runs
+# Completeness stays in the fast lane (cheap, pure-Python); the 42 e2e runs
 # are the slow lane's biggest line item.
 def test_corpus_is_complete():
     """The corpus must keep covering the major reference families."""
@@ -46,6 +46,7 @@ def test_corpus_is_complete():
         "mkmmd_example", "cross_silo_example",
         "fl_plus_local_ft_example", "dp_fed_examples/dp_scaffold",
         "fenda_ditto_example", "fedllm_example", "nnunet_pfl_example",
+        "long_context_example",
         "docker_basic_example",
     ]:
         assert required in names, f"examples/{required} missing from corpus"
