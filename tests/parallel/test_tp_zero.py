@@ -416,11 +416,11 @@ class TestZero2EngineIntegration:
                              step_mask=jnp.asarray(1.0))
         return logic, batch
 
-    @pytest.mark.parametrize("n_shards", [2, 4])
-    def test_engine_step_matches_plain_adam(self, eight_devices, n_shards):
-        logic, batch = self._logic_and_batch()
+    def _assert_zero2_matches_plain(self, logic, batch, sample, n_shards):
+        """The ONE copy of the plain-Adam-vs-ZeRO-2 step comparison (state
+        init, mesh/optimizer construction, tolerance policy)."""
         state0 = engine.create_train_state(
-            logic, optax.adam(1e-2), jax.random.PRNGKey(0), batch.x[:1]
+            logic, optax.adam(1e-2), jax.random.PRNGKey(0), sample
         )
         plain_step = engine.make_train_step(logic, optax.adam(1e-2))
         s_plain, out_plain = plain_step(state0, None, batch)
@@ -444,7 +444,43 @@ class TestZero2EngineIntegration:
             float(out_z.losses["backward"]), rtol=1e-5,
         )
         # predictions reshape back to the full batch for metrics
-        assert out_z.preds.shape == out_plain.preds.shape
+        assert jax.tree_util.tree_map(
+            lambda a: a.shape, out_z.preds
+        ) == jax.tree_util.tree_map(lambda a: a.shape, out_plain.preds)
+
+    @pytest.mark.parametrize("n_shards", [2, 4])
+    def test_engine_step_matches_plain_adam(self, eight_devices, n_shards):
+        logic, batch = self._logic_and_batch()
+        self._assert_zero2_matches_plain(logic, batch, batch.x[:1], n_shards)
+
+    def test_engine_step_with_dict_inputs(self, eight_devices):
+        """The microbatch split tree_maps over pytree x — dict-input models
+        (multi-modal batches) must reduce to the same step as plain Adam."""
+        import flax.linen as nn
+
+        class TwoInput(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=True):
+                h = jnp.concatenate([x["a"], x["b"]], axis=-1)
+                h = nn.relu(nn.Dense(8)(h))
+                return {"prediction": nn.Dense(4)(h)}, {"features": h}
+
+        logic = engine.ClientLogic(
+            engine.from_flax(TwoInput()), engine.masked_cross_entropy
+        )
+        rng = np.random.default_rng(3)
+        x = {
+            "a": jnp.asarray(rng.normal(size=(8, 5)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(8, 3)).astype(np.float32)),
+        }
+        y = jnp.asarray(rng.integers(0, 4, size=8))
+        batch = engine.Batch(
+            x=x, y=y,
+            example_mask=jnp.asarray([1, 1, 0, 1, 1, 1, 0, 1], jnp.float32),
+            step_mask=jnp.asarray(1.0),
+        )
+        sample = jax.tree_util.tree_map(lambda a: a[:1], x)
+        self._assert_zero2_matches_plain(logic, batch, sample, n_shards=2)
 
     def test_engine_step_rejects_indivisible_batch(self, eight_devices):
         logic, batch = self._logic_and_batch(b=6)
