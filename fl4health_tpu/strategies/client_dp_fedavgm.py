@@ -11,6 +11,25 @@ fl4health_tpu.clients.clipping). Server:
     x        += v
     b_bar     = (sum_i b_i + N(0, z_b^2)) / |S|                    [noised]
     C        *= exp(-lr_C * (b_bar - target_quantile))             [geometric]
+
+Weighted aggregation (reference noisy_aggregate.py:70
+``gaussian_noisy_weighted_aggregate``; McMahan et al. arXiv 1710.06963):
+
+    w_k       = min(n_k / example_cap, 1)       (cap defaults to sum_k n_k)
+    coef_k    = w_k / (q * W),  W = sum_k w_k,  q = fraction_fit
+    delta_bar = (sum_{i in S} coef_i delta_i
+                 + N(0, (z * C * max_{i in S} w_i / q)^2)) / |S|
+
+matching the reference exactly, including its final 1/|S| normalization
+(noisy_aggregate.py:41 applies ``1/n_clients`` to the already
+coefficient-scaled sum).
+
+Adaptive clipping additionally *modifies the update-noise multiplier*
+(reference client_dp_fedavgm.py:181 ``modify_noise_multiplier``, Algorithm 1
+of arXiv 1905.03871): z_delta = (z^-2 - (2 z_b)^-2)^(-1/2), so the privacy
+accountant's z covers both the noised update and the noised clipping bit.
+Applied only when both z and z_b are positive (z=0 configs stay
+deterministic for tests; the reference crashes on those inputs).
 """
 
 from __future__ import annotations
@@ -50,6 +69,8 @@ class ClientLevelDPFedAvgM(Strategy):
         clipping_learning_rate: float = 0.2,
         clipping_quantile: float = 0.5,
         weighted_aggregation: bool = False,
+        fraction_fit: float = 1.0,
+        per_client_example_cap: float | None = None,
         seed: int = 0,
     ):
         self.z = noise_multiplier
@@ -60,7 +81,36 @@ class ClientLevelDPFedAvgM(Strategy):
         self.lr_c = clipping_learning_rate
         self.quantile = clipping_quantile
         self.weighted_aggregation = weighted_aggregation
+        self.fraction_fit = fraction_fit
+        self.example_cap = per_client_example_cap
         self.seed = seed
+        # fail at construction, not mid-round (ref client_dp_fedavgm.py:195)
+        self.effective_noise_multiplier()
+        if weighted_aggregation and not fraction_fit > 0.0:
+            raise ValueError(
+                f"fraction_fit must be positive, got {fraction_fit}: the "
+                "weighted coefficients divide by it"
+            )
+
+    def effective_noise_multiplier(self) -> float:
+        """The update-noise multiplier actually applied to delta_bar.
+
+        Under adaptive clipping some privacy budget is spent on the noised
+        clipping bit, so the update noise must be raised to keep the
+        accountant's z honest: z_delta = (z^-2 - (2 z_b)^-2)^(-1/2)
+        (ref client_dp_fedavgm.py:181, arXiv 1905.03871 Alg. 1). Identity
+        when adaptive clipping is off or either multiplier is zero.
+        """
+        if not (self.adaptive and self.z > 0.0 and self.z_bit > 0.0):
+            return self.z
+        sqrt_arg = self.z ** -2.0 - (2.0 * self.z_bit) ** -2.0
+        if sqrt_arg <= 0.0:
+            raise ValueError(
+                "noise_multiplier and bit_noise_multiplier are ill-related "
+                f"for adaptive clipping: z^-2 - (2 z_b)^-2 = {sqrt_arg:.4g} "
+                "<= 0; raise bit_noise_multiplier or lower noise_multiplier"
+            )
+        return sqrt_arg ** -0.5
 
     def init(self, params: Params) -> ClientDpFedAvgMState:
         return ClientDpFedAvgMState(
@@ -80,15 +130,41 @@ class ClientLevelDPFedAvgM(Strategy):
         packets: ClippingBitPacket = results.packets
         n_sampled = jnp.maximum(jnp.sum(results.mask), 1.0)
         rng, k_delta, k_bit = jax.random.split(server_state.rng, 3)
+        z_eff = self.effective_noise_multiplier()
 
-        # unweighted masked mean of clipped deltas
-        def mean_delta(stacked):
-            mm = results.mask.reshape((-1,) + (1,) * (stacked.ndim - 1))
-            return jnp.sum(stacked * mm, axis=0) / n_sampled
+        if self.weighted_aggregation:
+            # McMahan weighted path (ref noisy_aggregate.py:70): coefficient
+            # per client from capped sample counts, noise scaled by the
+            # largest participating coefficient; cap/W over the full cohort
+            # (the reference computes them from the startup sample-count poll
+            # of every registered client, client_dp_fedavgm.py:332).
+            counts = results.sample_counts.astype(jnp.float32)
+            cap = (jnp.sum(counts) if self.example_cap is None
+                   else jnp.asarray(self.example_cap, jnp.float32))
+            w = jnp.minimum(counts / jnp.maximum(cap, 1.0), 1.0)
+            total_w = jnp.maximum(jnp.sum(w), 1e-12)
+            coef = w / (self.fraction_fit * total_w)
 
-        delta_bar = jax.tree_util.tree_map(mean_delta, packets.params)
-        # Gaussian mechanism: sensitivity C/|S| per coordinate-vector
-        sigma = self.z * server_state.clipping_bound / n_sampled
+            def weighted_sum(stacked):
+                cc = (coef * results.mask).reshape(
+                    (-1,) + (1,) * (stacked.ndim - 1))
+                return jnp.sum(stacked * cc, axis=0) / n_sampled
+
+            delta_bar = jax.tree_util.tree_map(weighted_sum, packets.params)
+            max_w = jnp.max(jnp.where(results.mask > 0, w, 0.0))
+            # sensitivity of the coefficient-scaled sum is C*max(w)/q; the
+            # reference's final 1/n normalization applies to noise too
+            sigma = (z_eff * server_state.clipping_bound * max_w
+                     / self.fraction_fit / n_sampled)
+        else:
+            # unweighted masked mean of clipped deltas
+            def mean_delta(stacked):
+                mm = results.mask.reshape((-1,) + (1,) * (stacked.ndim - 1))
+                return jnp.sum(stacked * mm, axis=0) / n_sampled
+
+            delta_bar = jax.tree_util.tree_map(mean_delta, packets.params)
+            # Gaussian mechanism: sensitivity C/|S| per coordinate-vector
+            sigma = z_eff * server_state.clipping_bound / n_sampled
         leaves, treedef = jax.tree_util.tree_flatten(delta_bar)
         keys = jax.random.split(k_delta, len(leaves))
         noised = [
@@ -100,13 +176,18 @@ class ClientLevelDPFedAvgM(Strategy):
         new_momentum = ptu.tree_axpy(self.beta, server_state.momentum, delta_bar)
         new_params = ptu.tree_add(server_state.params, new_momentum)
 
+        any_client = jnp.sum(results.mask) > 0
         bound = server_state.clipping_bound
         if self.adaptive:
             bit_sum = jnp.sum(packets.clipping_bit * results.mask)
             b_bar = (bit_sum + self.z_bit * jax.random.normal(k_bit, ())) / n_sampled
-            bound = bound * jnp.exp(-self.lr_c * (b_bar - self.quantile))
-
-        any_client = jnp.sum(results.mask) > 0
+            # empty cohort: b_bar would be pure bit-noise — hold the bound
+            # (the reference returns early with no results, base_server)
+            bound = jnp.where(
+                any_client,
+                bound * jnp.exp(-self.lr_c * (b_bar - self.quantile)),
+                bound,
+            )
         new_params, new_momentum = jax.tree_util.tree_map(
             lambda n, o: jnp.where(any_client, n, o),
             (new_params, new_momentum),

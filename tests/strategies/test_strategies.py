@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fl4health_tpu.core import pytree as ptu
 from fl4health_tpu.exchange.packer import (
@@ -219,6 +220,65 @@ def test_client_dp_adaptive_bound_shrinks_when_all_below():
     )
     new2 = strat.aggregate(state, _results(packets2), 1)
     assert float(new2.clipping_bound) > 1.0
+
+
+def test_client_dp_weighted_zero_noise_matches_hand_computation():
+    # McMahan weighted path (ref noisy_aggregate.py:70): w_k = min(n_k/cap,1)
+    # with cap = sum n_k, coef_k = w_k/(q*W), then the coefficient-scaled sum
+    # gets the reference's extra 1/n_clients normalization.
+    strat = ClientLevelDPFedAvgM(
+        noise_multiplier=0.0, server_momentum=0.0, weighted_aggregation=True,
+    )
+    state = strat.init({"w": jnp.zeros((1,))})
+    packets = ClippingBitPacket(
+        params={"w": jnp.asarray([[0.2], [0.4]])},
+        clipping_bit=jnp.asarray([0.0, 0.0]),
+    )
+    counts = jnp.asarray([10.0, 30.0])
+    new = strat.aggregate(state, _results(packets, counts=counts), 1)
+    # cap=40 -> w=[0.25,0.75], W=1, coef=w; (0.25*0.2 + 0.75*0.4)/2 = 0.175
+    np.testing.assert_allclose(float(new.params["w"][0]), 0.175, atol=1e-6)
+
+
+def test_client_dp_weighted_respects_example_cap_and_mask():
+    strat = ClientLevelDPFedAvgM(
+        noise_multiplier=0.0, server_momentum=0.0, weighted_aggregation=True,
+        per_client_example_cap=20.0,
+    )
+    state = strat.init({"w": jnp.zeros((1,))})
+    packets = ClippingBitPacket(
+        params={"w": jnp.asarray([[0.2], [0.4], [100.0]])},
+        clipping_bit=jnp.asarray([0.0, 0.0, 0.0]),
+    )
+    counts = jnp.asarray([10.0, 30.0, 30.0])
+    mask = jnp.asarray([1.0, 1.0, 0.0])  # third client did not participate
+    new = strat.aggregate(state, _results(packets, counts=counts, mask=mask), 1)
+    # cap=20 -> w=[0.5,1,1] (count 30 capped), W=2.5, coef=[0.2,0.4,0.4];
+    # masked sum = 0.2*0.2 + 0.4*0.4 = 0.2, /|S|=2 -> 0.1
+    np.testing.assert_allclose(float(new.params["w"][0]), 0.1, atol=1e-6)
+
+
+def test_client_dp_adaptive_noise_modification():
+    # Alg. 1 of arXiv 1905.03871 (ref client_dp_fedavgm.py:181): z_delta =
+    # (z^-2 - (2 z_b)^-2)^(-1/2); ill-related multipliers fail at init.
+    strat = ClientLevelDPFedAvgM(
+        noise_multiplier=0.1, adaptive_clipping=True, bit_noise_multiplier=0.1,
+    )
+    np.testing.assert_allclose(
+        strat.effective_noise_multiplier(), (0.1 ** -2 - 0.2 ** -2) ** -0.5,
+        rtol=1e-12,
+    )
+    # adaptive off, or z=0, leaves z untouched (deterministic test configs)
+    assert ClientLevelDPFedAvgM(
+        noise_multiplier=0.1).effective_noise_multiplier() == 0.1
+    assert ClientLevelDPFedAvgM(
+        noise_multiplier=0.0, adaptive_clipping=True,
+        bit_noise_multiplier=0.0).effective_noise_multiplier() == 0.0
+    with pytest.raises(ValueError, match="ill-related"):
+        ClientLevelDPFedAvgM(
+            noise_multiplier=1.0, adaptive_clipping=True,
+            bit_noise_multiplier=0.1,
+        )
 
 
 def test_model_merge_uniform():

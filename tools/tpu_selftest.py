@@ -47,11 +47,15 @@ def flash_checks() -> list[dict]:
 
     def dense_ref(q, k, v, mask):
         scale = 1.0 / (q.shape[-1] ** 0.5)
+        # HIGHEST: on TPU the default lowers f32 matmuls to one bf16 MXU
+        # pass (~1e-3 abs err) — the reference must be faithful f32 or the
+        # f32 tolerance below just measures the reference's own sloppiness
+        prec = jax.lax.Precision.HIGHEST
         # [B,T,H,D] -> scores [B,H,Tq,Tk]
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=prec) * scale
         s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v, precision=prec)
 
     checks = []
 
