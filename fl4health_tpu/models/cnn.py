@@ -67,12 +67,16 @@ class MxuConv(nn.Module):
     MXU is built for, and one that shards over the clients axis without
     constraint.
 
-    Measured caveat (2026-07, 8-client vmapped CifarNet train step): on
-    XLA:CPU this path is ~3.4x SLOWER than the grouped-conv lowering — the
-    patches BACKWARD is a col2im scatter-add, which XLA:CPU runs poorly.
-    The TPU comparison must be measured there (``FL4HEALTH_BENCH_CONV=mxu``,
-    the bench's conv A/B child); for sharded-clients meshes it is not an
-    optimization but the path that compiles at all.
+    Measured (2026-07): on XLA:CPU ~3.4x slower than grouped conv (the
+    patches backward lowers to a col2im scatter-add). The TPU A/B answered
+    the open BENCH_r03 question: on a real v5e
+    (`BENCH_tpu_20260731_034629.json` ``conv_mxu_alt``) im2col reaches only
+    606 steps/s vs grouped conv's 3186 — XLA:TPU lowers the vmapped grouped
+    conv onto the MXU just fine, so ``lax`` stays the default everywhere the
+    partitioner accepts it. MxuConv's role is therefore NOT speed: for
+    sharded-clients meshes it is the path that compiles at all (the
+    partitioner rejection above), and it is what makes segmentation rounds
+    shardable over the clients axis.
     """
 
     features: int
