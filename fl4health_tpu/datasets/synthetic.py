@@ -134,7 +134,24 @@ def synthetic_text_classification(
     k_logits, k_y, k_tok, k_len = jax.random.split(rng, 4)
     class_logits = jax.random.normal(k_logits, (n_classes, vocab_size - 1)) * class_sep
     y = jax.random.randint(k_y, (n,), 0, n_classes)
-    toks = jax.random.categorical(k_tok, class_logits[y], axis=-1, shape=(seq_len, n)).T
+    if n * seq_len * vocab_size <= 1 << 28:
+        toks = jax.random.categorical(
+            k_tok, class_logits[y], axis=-1, shape=(seq_len, n)
+        ).T
+    else:
+        # categorical broadcasts logits to [seq, n, vocab] — ~12 GB for the
+        # long-context bench config (n=176, seq=2048, vocab=8192), which
+        # RESOURCE_EXHAUSTs a 16 GB v5e before training even starts. Same
+        # distribution via inverse-CDF: O(n*vocab + n*seq) memory. Different
+        # draws for the same key, so the small-config branch above keeps the
+        # recorded goldens' exact data.
+        cdf = jnp.cumsum(jax.nn.softmax(class_logits, axis=-1), axis=-1)
+        u = jax.random.uniform(k_tok, (n, seq_len))
+        # f32 cumsum can end slightly below 1.0; a u above cdf[-1] would
+        # index one past the support — clamp to the last real token
+        toks = jnp.minimum(
+            jax.vmap(jnp.searchsorted)(cdf[y], u), vocab_size - 2
+        )
     toks = toks + 1  # reserve 0 for PAD
     lengths = jax.random.randint(k_len, (n,), seq_len // 2, seq_len + 1)
     mask = jnp.arange(seq_len)[None, :] < lengths[:, None]

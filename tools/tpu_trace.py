@@ -33,7 +33,7 @@ def main() -> int:
     import bench
 
     platform = jax.devices()[0].platform
-    sim = bench.make_sim("cifar_cnn")
+    _, sim = bench.make_sim("cifar_cnn")
     compiled, _ = bench.compile_fit_round(sim)
     mask = sim.client_manager.sample_all()
     val_batches, _ = sim._val_batches()
