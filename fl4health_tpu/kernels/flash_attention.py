@@ -350,16 +350,25 @@ def flash_attention_lse(
     if pad_mask is None:
         pad_mask = jnp.ones((b, t), jnp.float32)
     scale = 1.0 / (d ** 0.5)
-    # [B,T,H,D] -> [B*H, T, D]; pad T to the block grid, D to the lane width.
+    # [B,T,H,D] -> [B*H, T, D]; pad T to the block grid, D per d_multiple
+    # below (64 for head_dim<=64, else the 128 lane width — dp is NOT
+    # guaranteed to be a multiple of 128).
     # T must divide by BOTH block sizes (the q grid tiles by block_q while
     # each kernel loops T/block_k key blocks) — lcm, not max: padding only to
     # max(block_q, block_k) would silently drop trailing key blocks for
     # non-dividing pairs like 48/32.
     t_multiple = math.lcm(block_q, block_k)
 
+    # D padding: blocks always span the full head dim, and a block dim equal
+    # to the array dim is legal on Mosaic whatever its size — so pad only to
+    # the sublane-packable 64 for the ubiquitous head_dim<=64 case instead
+    # of burning 2x FLOPs/VMEM traffic on 128-lane zero padding (the r5
+    # long-context config is exactly head_dim=64).
+    d_multiple = 64 if d <= 64 else _LANE
+
     def to_bh(x):
         x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
-        return _pad_axis(_pad_axis(x, 2, _LANE), 1, t_multiple)
+        return _pad_axis(_pad_axis(x, 2, d_multiple), 1, t_multiple)
 
     qp, kp, vp = to_bh(q), to_bh(k), to_bh(v)
     pad_mask = jax.lax.stop_gradient(pad_mask)
