@@ -151,6 +151,23 @@ def test_client_level_dp_sharded_matches_single_device(eight_devices):
     )
 
 
+def test_client_level_dp_weighted_sharded_matches_single_device(eight_devices):
+    # The McMahan weighted path reduces capped sample-count coefficients
+    # ACROSS the sharded clients axis (sum/max over w) — exactly the kind of
+    # cross-client math that could silently change under sharding.
+    _check_algorithm(
+        lambda: ClippingClientLogic(
+            _model(), engine.masked_cross_entropy, adaptive_clipping=True
+        ),
+        lambda: ClientLevelDPFedAvgM(
+            noise_multiplier=0.2, server_momentum=0.9,
+            initial_clipping_bound=0.5, weighted_aggregation=True,
+            adaptive_clipping=True, bit_noise_multiplier=0.5,
+        ),
+        eight_devices,
+    )
+
+
 def test_partial_participation_sharded(eight_devices):
     """A masked cohort (half the clients participating) must also agree."""
     mesh = meshlib.client_mesh(8, devices=eight_devices)
