@@ -160,12 +160,37 @@ def client_dp_mnist():
     )
 
 
+def client_dp_weighted_mnist():
+    # The weighted (McMahan 1710.06963) + adaptive-clipping variant of
+    # client_dp_mnist: capped sample-count coefficients over the Dirichlet
+    # partition's unequal client sizes, the noised clipping bit driving the
+    # bound, and the Alg.-1 modified update-noise multiplier — the whole
+    # examples/dp_fed_examples/client_level_dp_weighted surface pinned by a
+    # convergent seeded trajectory.
+    from fl4health_tpu.clients.clipping import ClippingClientLogic
+    from fl4health_tpu.models.cnn import Mlp
+    from fl4health_tpu.strategies.client_dp_fedavgm import ClientLevelDPFedAvgM
+
+    return _base(
+        ClippingClientLogic(engine.from_flax(Mlp(features=(16,), n_outputs=10)),
+                            engine.masked_cross_entropy,
+                            adaptive_clipping=True),
+        ClientLevelDPFedAvgM(
+            noise_multiplier=0.1, server_momentum=0.5,
+            initial_clipping_bound=0.5, weighted_aggregation=True,
+            adaptive_clipping=True, bit_noise_multiplier=1.0, seed=7,
+        ),
+        optax.sgd(0.05),
+    )
+
+
 CONFIGS = {
     "fedavg_mnist": fedavg_mnist,
     "scaffold_mnist": scaffold_mnist,
     "fedprox_mnist": fedprox_mnist,
     "moon_mnist": moon_mnist,
     "client_dp_mnist": client_dp_mnist,
+    "client_dp_weighted_mnist": client_dp_weighted_mnist,
 }
 
 # ---------------------------------------------------------------------------
