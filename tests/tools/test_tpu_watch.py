@@ -1,0 +1,83 @@
+"""Unit tests for the watcher's capture chain (tools/tpu_watch.py).
+
+The watcher is the round's only guarantee that a tunnel window is never
+missed (VERDICT r4 missing #1), so its success semantics are pinned here
+with run_child mocked: a capture only counts (consumes the one-shot) when
+the BENCH record is from a non-cpu platform with a real value — selftest
+or trace failures, timeouts, and cpu fallbacks must leave the watcher
+re-arming on the next up-event.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_watch(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch_under_test", os.path.join(REPO, "tools", "tpu_watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # sandbox every file the capture writes
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    monkeypatch.setattr(mod, "LOG", str(tmp_path / "TPU_WATCH.log"))
+    monkeypatch.setattr(mod, "STATE", str(tmp_path / "state.json"))
+    return mod
+
+
+def fake_result(stdout):
+    return types.SimpleNamespace(stdout=stdout, stderr="", returncode=0)
+
+
+def test_capture_success_requires_tpu_platform_and_value(tmp_path, monkeypatch):
+    mod = load_watch(tmp_path, monkeypatch)
+
+    def run_child(cmd, timeout_s, extra_env=None):
+        if "tpu_selftest.py" in cmd[1]:
+            return fake_result(json.dumps({"ok": True, "platform": "tpu"}))
+        if cmd[1].endswith("bench.py"):
+            return fake_result(json.dumps(
+                {"metric": "m", "value": 123.0, "platform": "tpu"}))
+        return fake_result(json.dumps({"ok": True, "trace_dir": None}))
+
+    monkeypatch.setattr(mod, "run_child", run_child)
+    written, success = mod.capture("20990101_000000")
+    assert success
+    names = [w for w in written]
+    assert any(n.startswith("KERNELS_tpu_") for n in names)
+    assert any(n.startswith("BENCH_tpu_") for n in names)
+    assert any(n.startswith("TRACE_tpu_") for n in names)
+    bench_rec = json.load(open(tmp_path / names[1]))
+    assert bench_rec["platform"] == "tpu"
+
+
+def test_cpu_fallback_bench_does_not_consume_capture(tmp_path, monkeypatch):
+    mod = load_watch(tmp_path, monkeypatch)
+
+    def run_child(cmd, timeout_s, extra_env=None):
+        if cmd[1].endswith("bench.py"):
+            return fake_result(json.dumps(
+                {"metric": "m_cpu_fallback", "value": 1.0, "platform": "cpu"}))
+        return fake_result(json.dumps({"ok": False}))
+
+    monkeypatch.setattr(mod, "run_child", run_child)
+    written, success = mod.capture("20990101_000001")
+    assert not success  # tunnel flapped mid-capture: retry on next up-event
+    # the failed attempt is still recorded for the audit trail
+    assert any(n.startswith("BENCH_tpu_") for n in written)
+
+
+def test_timed_out_children_recorded_as_errors(tmp_path, monkeypatch):
+    mod = load_watch(tmp_path, monkeypatch)
+    monkeypatch.setattr(mod, "run_child",
+                        lambda cmd, timeout_s, extra_env=None: None)
+    written, success = mod.capture("20990101_000002")
+    assert not success
+    for name in written:
+        rec = json.load(open(tmp_path / name))
+        assert "error" in rec and "timed out" in rec["error"]
