@@ -24,6 +24,8 @@ from typing import Any, Mapping
 
 from flax import serialization
 
+from fl4health_tpu.core.io import atomic_write
+
 
 class Snapshotter(ABC):
     """Typed converter to/from a JSON-safe header value
@@ -97,12 +99,10 @@ class StateCheckpointer:
             header[k] = snap.save(v)
         header_bytes = json.dumps(header).encode("utf-8")
         blob = serialization.to_bytes(dict(trees))
-        tmp = self._path + ".tmp"
-        with open(tmp, "wb") as f:
+        with atomic_write(self._path, "wb") as f:  # single atomic publish
             f.write(len(header_bytes).to_bytes(8, "big"))
             f.write(header_bytes)
             f.write(blob)
-        os.replace(tmp, self._path)  # single atomic publish
 
     def _read(self) -> tuple[dict, bytes]:
         with open(self._path, "rb") as f:

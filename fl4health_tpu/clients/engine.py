@@ -28,9 +28,12 @@ import numpy as np
 import optax
 from flax import struct
 
+from fl4health_tpu.core.pytree import tree_nbytes
 from fl4health_tpu.core.types import Params, PRNGKey, PyTree
 from fl4health_tpu.losses.containers import LossMeter
 from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.observability.registry import get_registry
+from fl4health_tpu.observability.spans import get_tracer
 
 
 # ---------------------------------------------------------------------------
@@ -789,13 +792,26 @@ def pad_and_stack_data(arrays: list, name: str = "data"):
             raise ValueError(
                 f"client {i}'s {name} leaves disagree on example count: {ns}"
             )
-    out_leaves = [
-        _pad_and_stack_leaf(
-            [leaves[j][1] for leaves in flat],
-            name + path_str(flat[0][j][0]),
-        )
-        for j in range(len(flat[0]))
-    ]
+    # data-staging observability: this is the DataLoader-boundary cost (host
+    # assembly + one device transfer), paid at setup / per-round refresh —
+    # the span is a shared no-op while the process tracer is disabled
+    with get_tracer().span(
+        "pad_and_stack", cat="data", dataset=name, clients=len(arrays)
+    ) as sp:
+        out_leaves = [
+            _pad_and_stack_leaf(
+                [leaves[j][1] for leaves in flat],
+                name + path_str(flat[0][j][0]),
+            )
+            for j in range(len(flat[0]))
+        ]
+        staged = tree_nbytes(out_leaves)
+        sp.set(staged_bytes=staged)
+    get_registry().counter(
+        "engine_staged_bytes_total",
+        help="bytes staged into client-stacked device arrays "
+             "(setup + per-round data refresh)",
+    ).inc(staged)
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
 

@@ -1,0 +1,35 @@
+"""Host filesystem helpers — dependency-light (no JAX imports) so the
+observability primitives and reporters can use them freely.
+
+One definition of the atomic-publish pattern (write temp file, then
+``os.replace``): metrics/trace exports, reporter dumps, and state
+checkpoints all publish artifacts that a concurrent reader (smoke-test
+scraper, Prometheus scrape, resume-from-checkpoint) may open mid-run — a
+crash mid-write must never leave a truncated file at the published path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "w"):
+    """Context manager yielding a file handle to a temp sibling of ``path``;
+    on clean exit the temp file is atomically renamed over ``path``
+    (parent directories are created), on exception it is removed and the
+    previously-published file is left untouched."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            yield f
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -141,3 +141,22 @@ def tree_astype(tree: PyTree, dtype) -> PyTree:
     return jax.tree_util.tree_map(
         lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree
     )
+
+
+# ---------------------------------------------------------------------------
+# Size accounting
+# ---------------------------------------------------------------------------
+
+def tree_nbytes(tree: PyTree) -> int:
+    """Total byte footprint of a pytree's array leaves, from shape/dtype
+    metadata only (works on concrete arrays AND ``jax.eval_shape`` structs;
+    no device transfer). The ONE definition the observability byte
+    accounting uses — payload wire-cost (server/simulation.py) and staged
+    data stacks (clients/engine.py) must agree on what a byte is."""
+    import numpy as np
+
+    return int(sum(
+        int(np.prod(leaf.shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+    ))

@@ -1,0 +1,200 @@
+"""Observability — round-level tracing, metrics, and XLA profiling hooks.
+
+The reference's visibility story is wall-clock deltas in reporter dicts
+(base_server.py fit/eval timing). Because the TPU build compiles a whole FL
+round into two XLA programs, "where did the time go" needs three different
+instruments, bundled here:
+
+- :mod:`~fl4health_tpu.observability.spans` — nested context-manager spans
+  on monotonic clocks, exported as Chrome trace-event JSON (open in
+  Perfetto: one smoke run yields a visual per-round timeline of
+  configure_fit -> fit_round -> aggregate -> eval_round -> checkpoint);
+- :mod:`~fl4health_tpu.observability.registry` — process-wide
+  counters/gauges/histograms with Prometheus text exposition and a JSONL
+  event log (``tools/perf_report.py`` renders it);
+- :mod:`~fl4health_tpu.observability.jaxmon` — JAX hooks: compile/cache
+  event counting via ``jax.monitoring``, honest device-time fencing
+  (``block_until_ready`` only when enabled), opt-in per-round
+  ``jax.profiler.trace`` capture.
+
+:class:`Observability` is the facade ``FederatedSimulation`` accepts: it
+wires all three to the process-wide defaults (so transport byte counters
+land in the same snapshot) and owns export. Disabled, every hook is a
+shared no-op — zero device syncs, zero allocations on the round hot path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from fl4health_tpu.observability.jaxmon import (
+    CompileMonitor,
+    profile_round,
+    synced,
+)
+from fl4health_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from fl4health_tpu.observability.spans import (
+    _NULL_SPAN,
+    Span,
+    Tracer,
+    get_tracer,
+    set_tracer,
+)
+
+__all__ = [
+    "Observability",
+    "Tracer",
+    "Span",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "CompileMonitor",
+    "get_tracer",
+    "set_tracer",
+    "get_registry",
+    "set_registry",
+    "profile_round",
+    "synced",
+]
+
+
+class Observability:
+    """One handle bundling tracer + registry + JAX hooks for a run.
+
+    Defaults bind to the process-wide tracer/registry so free-function call
+    sites (transport codec, coordinator) and the simulation share one
+    snapshot; pass private instances for isolation (tests do).
+
+    ``profile_round_idx`` selects ONE round for a ``jax.profiler.trace``
+    capture under ``output_dir/xprof`` — device-level detail without paying
+    profiler overhead on every round.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        output_dir: str | None = None,
+        tracer: Tracer | None = None,
+        registry: MetricsRegistry | None = None,
+        profile_round_idx: int | None = None,
+        sync_device: bool = True,
+    ):
+        self.enabled = enabled
+        self.output_dir = output_dir
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.registry = registry if registry is not None else get_registry()
+        self.profile_round_idx = profile_round_idx
+        self.sync_device = sync_device
+        self.compile_monitor = CompileMonitor(self.registry)
+        # Ownership of the tracer's enabled flag: only the handle that
+        # actually flipped it on may flip it off (and clear its events) at
+        # shutdown — a disabled Observability, or one handed an
+        # already-enabled tracer, must not reset state it doesn't own.
+        self._owns_tracer_enable = False
+        if enabled:
+            self.start()
+
+    def start(self) -> "Observability":
+        """(Re-)arm the hooks: enable the tracer, install the compile
+        monitor. Called by ``__init__`` and again by ``FederatedSimulation``
+        at each ``fit()`` so a handle survives multiple runs (``shutdown``
+        disarms it between them). Idempotent; no-op when disabled."""
+        if self.enabled:
+            if not self.tracer.enabled:
+                # flipping the (possibly process-global) tracer on is what
+                # makes transport/engine spans visible
+                self.tracer.enabled = True
+                self._owns_tracer_enable = True
+            self.compile_monitor.install()
+        return self
+
+    # -- tracing ---------------------------------------------------------
+    def span(self, name: str, cat: str = "round", **args: Any):
+        if not self.enabled:
+            return _NULL_SPAN
+        return self.tracer.span(name, cat=cat, **args)
+
+    def instant(self, name: str, **args: Any) -> None:
+        if self.enabled:
+            self.tracer.instant(name, **args)
+
+    # -- metrics ---------------------------------------------------------
+    def counter(self, name: str, help: str = "", labels=None) -> Counter:
+        return self.registry.counter(name, help=help, labels=labels)
+
+    def gauge(self, name: str, help: str = "", labels=None) -> Gauge:
+        return self.registry.gauge(name, help=help, labels=labels)
+
+    def histogram(self, name: str, help: str = "", labels=None, **kw) -> Histogram:
+        return self.registry.histogram(name, help=help, labels=labels, **kw)
+
+    def log_event(self, event: str, **fields: Any) -> dict | None:
+        if not self.enabled:
+            return None
+        return self.registry.log_event(event, **fields)
+
+    def snapshot(self) -> dict:
+        return self.registry.snapshot()
+
+    # -- JAX hooks -------------------------------------------------------
+    def fence(self, tree: Any) -> tuple[Any, float]:
+        """``block_until_ready`` fence returning (tree, wait_seconds); a pure
+        pass-through when disabled — no new syncs on the disabled path."""
+        return synced(tree, enabled=self.enabled and self.sync_device)
+
+    def maybe_profile(self, round_idx: int):
+        """``jax.profiler.trace`` context for the chosen round, else no-op."""
+        if (
+            self.enabled
+            and self.profile_round_idx is not None
+            and round_idx == self.profile_round_idx
+            and self.output_dir is not None
+        ):
+            return profile_round(os.path.join(self.output_dir, "xprof"))
+        return profile_round(None)
+
+    # -- export ----------------------------------------------------------
+    def export(self) -> dict[str, str]:
+        """Write trace.json (Chrome trace events), metrics.prom (Prometheus
+        text), metrics.jsonl (event log) under ``output_dir``. Returns
+        {artifact: path}; empty when disabled or no output_dir."""
+        if not self.enabled or self.output_dir is None:
+            return {}
+        os.makedirs(self.output_dir, exist_ok=True)
+        return {
+            "trace": self.tracer.export(os.path.join(self.output_dir, "trace.json")),
+            "prometheus": self.registry.export_prometheus(
+                os.path.join(self.output_dir, "metrics.prom")
+            ),
+            "events": self.registry.dump_jsonl(
+                os.path.join(self.output_dir, "metrics.jsonl")
+            ),
+        }
+
+    def shutdown(self) -> dict[str, str]:
+        """Export artifacts and disarm every hook: detach the compile
+        monitor (so a later run's monitor doesn't double-count compile
+        events through the global fan-out), and — if this handle is the one
+        that enabled the tracer — disable it and drop its exported events
+        (a long-lived process must not accumulate spans forever, nor re-export
+        run 1's events into run 2's trace). ``start()`` re-arms."""
+        paths = self.export()
+        self.compile_monitor.uninstall()
+        if self._owns_tracer_enable:
+            self.tracer.enabled = False
+            self.tracer.clear()
+            self._owns_tracer_enable = False
+        if "events" in paths:
+            # only after a successful JSONL dump — with no output_dir the
+            # events stay readable programmatically (registry.events)
+            self.registry.clear_events()
+        return paths

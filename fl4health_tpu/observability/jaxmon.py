@@ -1,0 +1,169 @@
+"""JAX-specific observability hooks.
+
+Three concerns the generic tracer/registry can't cover:
+
+1. **Compile accounting** — ``jax.monitoring`` emits named events for every
+   trace/lower/backend-compile (``/jax/core/compile/*_duration``) and for
+   persistent-cache traffic (``/jax/compilation_cache/*``). ``CompileMonitor``
+   forwards them into a ``MetricsRegistry`` so a run can answer "did round N
+   recompile?" — the single most common TPU perf bug (shape drift silently
+   re-paying a multi-second XLA compile every round).
+
+   ``jax.monitoring`` has no per-listener unregister, so this module
+   registers ONE forwarding listener pair lazily and fans out to whatever
+   monitors are currently installed; ``uninstall()`` detaches a monitor
+   without touching global JAX state.
+
+2. **Honest device time** — an XLA dispatch returns before the device
+   finishes; timing the Python call measures enqueue latency, not execute
+   time. ``synced()`` fences with ``jax.block_until_ready`` *only when
+   observability is enabled*, so the disabled path introduces zero extra
+   device syncs on the round hot loop (the acceptance bar for this
+   subsystem).
+
+3. **Round profiling** — ``profile_round(dir)`` wraps one chosen round in
+   ``jax.profiler.trace`` (TensorBoard/XProf-viewable device trace) without
+   paying profiler overhead on every round the way a whole-``fit`` capture
+   does.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any
+
+from fl4health_tpu.observability.registry import MetricsRegistry
+
+# Map jax.monitoring event names -> registry counter names. Durations also
+# accumulate a *_seconds_total counter so compile time (not just count) is
+# visible per round.
+_DURATION_EVENTS = {
+    "/jax/core/compile/backend_compile_duration": "jax_backend_compiles",
+    "/jax/core/compile/jaxpr_trace_duration": "jax_jaxpr_traces",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "jax_mlir_lowerings",
+}
+_COUNT_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "jax_persistent_cache_hits_total",
+    "/jax/compilation_cache/cache_misses": "jax_persistent_cache_misses_total",
+    "/jax/compilation_cache/compile_requests_use_cache":
+        "jax_cache_compile_requests_total",
+}
+
+_monitors_lock = threading.Lock()
+_monitors: list["CompileMonitor"] = []
+_listeners_registered = False
+
+
+def _fanout_event(event: str, **kwargs: Any) -> None:
+    with _monitors_lock:
+        targets = list(_monitors)
+    for mon in targets:
+        mon._on_event(event)
+
+
+def _fanout_duration(event: str, duration: float, **kwargs: Any) -> None:
+    with _monitors_lock:
+        targets = list(_monitors)
+    for mon in targets:
+        mon._on_duration(event, duration)
+
+
+def _ensure_listeners() -> None:
+    global _listeners_registered
+    with _monitors_lock:
+        if _listeners_registered:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_listener(_fanout_event)
+        jax.monitoring.register_event_duration_secs_listener(_fanout_duration)
+        _listeners_registered = True
+
+
+class CompileMonitor:
+    """Forwards jax.monitoring compile/cache events into a registry.
+
+    Counters written (all monotonic):
+    - ``jax_backend_compiles_total`` / ``jax_backend_compiles_seconds_total``
+    - ``jax_jaxpr_traces_total`` / ``jax_jaxpr_traces_seconds_total``
+    - ``jax_mlir_lowerings_total`` / ``jax_mlir_lowerings_seconds_total``
+    - ``jax_persistent_cache_hits_total`` / ``..._misses_total``
+    """
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self._installed = False
+
+    def install(self) -> "CompileMonitor":
+        _ensure_listeners()
+        with _monitors_lock:
+            if not self._installed:
+                _monitors.append(self)
+                self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        with _monitors_lock:
+            if self._installed:
+                _monitors.remove(self)
+                self._installed = False
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
+    # fan-out targets ----------------------------------------------------
+    def _on_event(self, event: str) -> None:
+        name = _COUNT_EVENTS.get(event)
+        if name is not None:
+            self.registry.counter(name, help=f"jax.monitoring {event}").inc()
+
+    def _on_duration(self, event: str, duration: float) -> None:
+        base = _DURATION_EVENTS.get(event)
+        if base is None:
+            return
+        self.registry.counter(
+            f"{base}_total", help=f"count of jax.monitoring {event}"
+        ).inc()
+        self.registry.counter(
+            f"{base}_seconds_total", help=f"seconds in jax.monitoring {event}"
+        ).inc(max(0.0, float(duration)))
+
+    def compile_count(self) -> float:
+        return self.registry.counter("jax_backend_compiles_total").value
+
+    def __enter__(self) -> "CompileMonitor":
+        return self.install()
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False
+
+
+def synced(tree: Any, enabled: bool = True) -> tuple[Any, float]:
+    """Fence ``tree`` with ``block_until_ready`` and return
+    ``(tree, wait_seconds)``. With ``enabled=False`` this is a pure
+    pass-through (``(tree, 0.0)``) — no sync, no clock read — so call sites
+    can fence unconditionally and let the flag decide."""
+    if not enabled:
+        return tree, 0.0
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(tree)
+    return tree, time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def profile_round(profile_dir: str | None):
+    """Opt-in ``jax.profiler.trace`` capture of one block (one round).
+    ``profile_dir=None`` is a no-op, so the call site stays unconditional."""
+    if profile_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(profile_dir):
+        yield

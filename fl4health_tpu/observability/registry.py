@@ -1,0 +1,329 @@
+"""Metrics registry — process-wide counters/gauges/histograms.
+
+Role: the per-round byte/time accounting that communication-efficiency work
+treats as a first-class experimental output (arXiv:1610.05492 reports
+per-round upload bytes; FedJAX logs simulation timing). Two exposition
+surfaces:
+
+- ``to_prometheus()`` — the Prometheus text format (``# HELP``/``# TYPE`` +
+  samples), scrapable or diffable in tests;
+- ``log_event()`` + ``dump_jsonl()`` — an append-only JSONL event log (one
+  JSON object per line) that ``tools/perf_report.py`` renders into a
+  per-round summary table.
+
+All instruments are host-side Python on plain floats: no device syncs, no
+JAX imports — safe to call from transport code and the round loop alike.
+Thread-safe via one registry lock (instrument mutation is a dict update;
+contention is negligible next to an XLA dispatch).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Any, Iterable, Mapping
+
+from fl4health_tpu.core.io import atomic_write
+
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, math.inf,
+)
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_label(v: Any) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(labels: Mapping[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter. ``inc`` with a negative amount raises — a counter
+    that can decrease silently corrupts rate() math downstream."""
+
+    __slots__ = ("name", "help", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = "", labels: Mapping[str, str] | None = None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels or {})
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative increment {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> list[str]:
+        return [f"{self.name}{_label_str(self.labels)} {_fmt_value(self._value)}"]
+
+    def snapshot(self) -> float:
+        return self._value
+
+    prom_type = "counter"
+
+
+class Gauge:
+    """Last-write-wins instantaneous value; supports inc/dec for level
+    tracking (in-flight RPCs)."""
+
+    __slots__ = ("name", "help", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = "", labels: Mapping[str, str] | None = None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels or {})
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> list[str]:
+        return [f"{self.name}{_label_str(self.labels)} {_fmt_value(self._value)}"]
+
+    def snapshot(self) -> float:
+        return self._value
+
+    prom_type = "gauge"
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each ``le`` bucket
+    counts observations <= bound; ``+Inf`` equals ``_count``)."""
+
+    __slots__ = ("name", "help", "labels", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        labels: Mapping[str, str] | None = None,
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels or {})
+        bs = sorted(set(float(b) for b in buckets) | {math.inf})
+        self.buckets = tuple(bs)
+        self._counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def expose(self) -> list[str]:
+        lines = []
+        for b, c in zip(self.buckets, self._counts):
+            lbl = _label_str({**self.labels, "le": _fmt_value(b)})
+            lines.append(f"{self.name}_bucket{lbl} {c}")
+        lines.append(f"{self.name}_sum{_label_str(self.labels)} {_fmt_value(self._sum)}")
+        lines.append(f"{self.name}_count{_label_str(self.labels)} {self._count}")
+        return lines
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self._count,
+            "sum": self._sum,
+            "buckets": {_fmt_value(b): c for b, c in zip(self.buckets, self._counts)},
+        }
+
+    prom_type = "histogram"
+
+
+class MetricsRegistry:
+    """Names + label sets -> instruments. Getter-or-create semantics: the
+    same (name, labels) always returns the same instrument, so call sites
+    never coordinate registration. Re-requesting a name as a different
+    instrument kind raises (a counter silently shadowed by a gauge is the
+    classic metrics-soup bug)."""
+
+    def __init__(self):
+        self._metrics: dict[tuple[str, tuple], Any] = {}
+        self._helps: dict[str, str] = {}
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+
+    # -- instruments -----------------------------------------------------
+    def _get(self, cls, name, help, labels, **kwargs):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            existing = self._metrics.get(key)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}, requested {cls.__name__}"
+                    )
+                if help:
+                    # a metric first touched help-lessly (e.g. a baseline
+                    # read) still earns its # HELP line from a later caller
+                    self._helps.setdefault(name, help)
+                return existing
+            m = cls(name, help=help, labels=labels, **kwargs)
+            self._metrics[key] = m
+            if help:
+                self._helps.setdefault(name, help)
+            return m
+
+    def counter(self, name: str, help: str = "", labels: Mapping[str, str] | None = None) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", labels: Mapping[str, str] | None = None) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labels: Mapping[str, str] | None = None,
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get(Histogram, name, help, labels, buckets=buckets)
+
+    # -- event log -------------------------------------------------------
+    def log_event(self, event: str, **fields: Any) -> dict:
+        """Append one structured event (stamped with wall time) to the JSONL
+        log. Returns the record for immediate reuse (reporter bridging)."""
+        rec = {"ts": time.time(), "event": event, **fields}
+        with self._lock:
+            self._events.append(rec)
+        return rec
+
+    @property
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def dump_jsonl(self, path: str) -> str:
+        """Atomic JSONL dump of the event log."""
+        with atomic_write(path) as f:
+            for rec in self.events:
+                f.write(json.dumps(rec) + "\n")
+        return path
+
+    # -- exposition ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """{name: value | {labels...} | histogram-dict} — the programmatic
+        view tests and the reporter bridge consume."""
+        out: dict[str, Any] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for (name, labels), m in items:
+            val = m.snapshot()
+            if labels:
+                slot = out.setdefault(name, {})
+                slot[_label_str(dict(labels))] = val
+            else:
+                out[name] = val
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            items = list(self._metrics.items())
+        by_name: dict[str, list] = {}
+        for (name, _), m in items:
+            by_name.setdefault(name, []).append(m)
+        lines: list[str] = []
+        for name in sorted(by_name):
+            ms = by_name[name]
+            help_text = self._helps.get(name, "")
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {ms[0].prom_type}")
+            for m in ms:
+                lines.extend(m.expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def export_prometheus(self, path: str) -> str:
+        with atomic_write(path) as f:
+            f.write(self.to_prometheus())
+        return path
+
+    def clear_events(self) -> None:
+        """Drop the event log only (instruments keep their process-lifetime
+        counter semantics) — called after a run's JSONL dump so a second run
+        in the same process doesn't re-dump round records it didn't own."""
+        with self._lock:
+            self._events.clear()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._helps.clear()
+            self._events.clear()
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default registry: transport counters and the simulation's
+# round accounting land in ONE snapshot unless a caller wires a private one.
+# ---------------------------------------------------------------------------
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Install ``registry`` as the process default; returns the previous one
+    (tests swap in a private registry and restore)."""
+    global _default_registry
+    prev = _default_registry
+    _default_registry = registry
+    return prev

@@ -1,0 +1,202 @@
+"""Span tracer — Chrome trace-event JSON on monotonic clocks.
+
+The reference instruments rounds with coarse ``time.time()`` deltas fed to
+reporters (base_server.py:288-300 wall-clock accounting). On the TPU build a
+round is two XLA dispatches, so the interesting structure is *inside* a
+round: configure_fit vs. device execute vs. host aggregation vs. checkpoint.
+This tracer records nested context-manager spans on ``perf_counter_ns`` and
+exports the Chrome trace-event format (``{"traceEvents": [...]}``) that
+Perfetto / ``chrome://tracing`` render as a per-round flame timeline — the
+FedJAX-style built-in simulation timing (arXiv:2108.02117 §4) without any
+external dependency.
+
+Disabled-path contract: a disabled tracer's ``span()`` returns a shared
+no-op context manager — no allocation, no locking, no clock reads — so the
+round hot loop pays nothing when observability is off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from fl4health_tpu.core.io import atomic_write
+
+
+class _NullSpan:
+    """Shared no-op span: reentrant, stateless, free."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One live span; records a complete ("ph": "X") trace event on exit."""
+
+    __slots__ = ("tracer", "name", "cat", "args", "_start_ns", "_depth")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._start_ns = 0
+        self._depth = 0
+
+    def set(self, **args: Any) -> None:
+        """Attach/override args mid-span (e.g. measured byte counts)."""
+        self.args.update(args)
+
+    def __enter__(self) -> "Span":
+        self._depth = self.tracer._enter_depth()
+        self._start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        end_ns = time.perf_counter_ns()
+        self.tracer._exit_depth()
+        if exc_type is not None:
+            self.args.setdefault("error", exc_type.__name__)
+        self.tracer._record(
+            self.name, self.cat, self._start_ns, end_ns, self._depth, self.args
+        )
+        return False
+
+
+class Tracer:
+    """Collects spans; thread-safe; exports Chrome trace-event JSON.
+
+    Timestamps are microseconds since tracer construction (monotonic clock),
+    so traces from one process align across threads. ``depth`` is recorded in
+    each event's args for programmatic nesting assertions; the viewer derives
+    visual nesting from ts/dur containment on its own.
+    """
+
+    def __init__(self, enabled: bool = True, process_name: str = "fl4health_tpu"):
+        self.enabled = enabled
+        self.process_name = process_name
+        self._t0_ns = time.perf_counter_ns()
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    # -- depth bookkeeping (thread-local; tests assert nesting) ----------
+    def _enter_depth(self) -> int:
+        d = getattr(self._local, "depth", 0)
+        self._local.depth = d + 1
+        return d
+
+    def _exit_depth(self) -> None:
+        self._local.depth = max(0, getattr(self._local, "depth", 1) - 1)
+
+    # -- recording -------------------------------------------------------
+    def span(self, name: str, cat: str = "round", **args: Any):
+        """Context manager timing a block. No-op (shared instance) when
+        disabled — zero overhead on the hot path."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return Span(self, name, cat, dict(args))
+
+    def instant(self, name: str, cat: str = "event", **args: Any) -> None:
+        """A zero-duration marker ("ph": "i")."""
+        if not self.enabled:
+            return
+        ts = (time.perf_counter_ns() - self._t0_ns) / 1000.0
+        evt = {
+            "name": name, "cat": cat, "ph": "i", "s": "t",
+            "ts": ts, "pid": os.getpid(), "tid": threading.get_ident(),
+            "args": dict(args),
+        }
+        with self._lock:
+            self._events.append(evt)
+
+    def counter(self, name: str, **series: float) -> None:
+        """A Chrome counter track sample ("ph": "C")."""
+        if not self.enabled:
+            return
+        ts = (time.perf_counter_ns() - self._t0_ns) / 1000.0
+        evt = {
+            "name": name, "cat": "counter", "ph": "C",
+            "ts": ts, "pid": os.getpid(), "tid": threading.get_ident(),
+            "args": {k: float(v) for k, v in series.items()},
+        }
+        with self._lock:
+            self._events.append(evt)
+
+    def _record(self, name, cat, start_ns, end_ns, depth, args) -> None:
+        evt = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": (start_ns - self._t0_ns) / 1000.0,
+            "dur": (end_ns - start_ns) / 1000.0,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": {**args, "depth": depth},
+        }
+        with self._lock:
+            self._events.append(evt)
+
+    # -- introspection / export -----------------------------------------
+    @property
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def spans_named(self, name: str) -> list[dict]:
+        return [e for e in self.events if e["ph"] == "X" and e["name"] == name]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def to_chrome_trace(self) -> dict:
+        """The Chrome trace-event envelope Perfetto expects."""
+        meta = {
+            "name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
+            "args": {"name": self.process_name},
+        }
+        return {"traceEvents": [meta, *self.events], "displayTimeUnit": "ms"}
+
+    def export(self, path: str) -> str:
+        """Atomically write the trace JSON (a crash mid-dump never leaves a
+        truncated, unloadable trace at the published path)."""
+        with atomic_write(path) as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default tracer: free functions (transport/codec.py,
+# transport/coordinator.py) trace through this without threading a handle.
+# Starts disabled; Observability(enabled=True) flips it on.
+# ---------------------------------------------------------------------------
+
+_default_tracer = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    return _default_tracer
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Install ``tracer`` as the process default; returns the previous one
+    (tests swap in a private tracer and restore)."""
+    global _default_tracer
+    prev = _default_tracer
+    _default_tracer = tracer
+    return prev

@@ -11,9 +11,20 @@ from __future__ import annotations
 
 import datetime
 import json
+import logging
 import os
 import uuid
 from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from fl4health_tpu.core.io import atomic_write
+
+logger = logging.getLogger(__name__)
+
+# Arrays up to this many elements serialize as JSON lists; larger ones are
+# summarized (a reporter dict is a log line, not a checkpoint format).
+_MAX_ARRAY_ELEMENTS = 64
 
 
 class BaseReporter:
@@ -73,9 +84,11 @@ class JsonReporter(BaseReporter):
             rd.update(_jsonify(data))
 
     def dump(self) -> str:
-        os.makedirs(self.output_folder, exist_ok=True)
+        # Atomic publish: dump() runs per shutdown and on per-round state
+        # checkpoints; a crash mid-write must never leave a truncated JSON
+        # that poisons the smoke-test reader.
         path = os.path.join(self.output_folder, f"{self.run_id}.json")
-        with open(path, "w") as f:
+        with atomic_write(path) as f:
             json.dump(self.data, f, indent=2)
         return path
 
@@ -92,6 +105,25 @@ def _jsonify(data: Mapping[str, Any]) -> dict:
             out[k] = v
         elif isinstance(v, datetime.datetime):
             out[k] = v.isoformat()
+        elif hasattr(v, "shape") and hasattr(v, "dtype"):
+            # numpy / JAX arrays: 0-d -> Python scalar, small -> nested
+            # lists, big -> a shape/dtype summary string (previously
+            # non-scalar arrays fell through to str(v), mangling them into
+            # unparseable reprs). Size-gate on shape METADATA before
+            # np.asarray: a big on-device array must not pay a blocking
+            # device->host transfer just to be summarized away.
+            shape = tuple(v.shape)
+            size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            if shape and size > _MAX_ARRAY_ELEMENTS:
+                out[k] = f"array(shape={shape}, dtype={v.dtype})"
+            else:
+                arr = np.asarray(v)
+                out[k] = arr.item() if arr.ndim == 0 else arr.tolist()
+        elif isinstance(v, (list, tuple)):
+            out[k] = [
+                _jsonify({"_": item})["_"]
+                for item in v
+            ]
         else:
             try:
                 out[k] = float(v)
@@ -114,7 +146,13 @@ class WandBReporter(BaseReporter):
             import wandb  # type: ignore
 
             self._run = wandb.init(project=self.project, **self.init_kwargs)
-        except Exception:
+        except Exception as e:
+            # the docstring's promised degradation is "no-op WITH a warning";
+            # swallowing silently hid misconfigured runs for entire jobs
+            logger.warning(
+                "WandBReporter disabled (wandb init failed: %s: %s); "
+                "reports will be dropped.", type(e).__name__, e,
+            )
             self._run = None
 
     def report(self, data, round=None, epoch=None, step=None):

@@ -31,6 +31,7 @@ import optax
 
 from fl4health_tpu.checkpointing.checkpointer import CheckpointMode
 from fl4health_tpu.clients import engine
+from fl4health_tpu.observability import Observability
 from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
 from fl4health_tpu.core import pytree as ptu
 from fl4health_tpu.exchange.exchanger import FullExchanger
@@ -132,6 +133,7 @@ class FederatedSimulation:
         failure_policy: FailurePolicy | None = None,
         profile_dir: str | None = None,
         train_data_provider: Any = None,
+        observability: Observability | None = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -175,6 +177,13 @@ class FederatedSimulation:
         # When set, fit() wraps the round loop in jax.profiler.trace and the
         # trace directory can be opened in TensorBoard/XProf.
         self.profile_dir = profile_dir
+        # Round-level observability (observability/__init__.py): spans per
+        # round phase, compile/byte counters, opt-in per-round XProf capture.
+        # Defaults to a disabled handle whose every hook is a shared no-op,
+        # so the un-instrumented hot loop stays exactly as fast (and adds no
+        # device syncs — the fence is a pass-through when disabled).
+        self.observability = observability or Observability(enabled=False)
+        self._payload_bytes_cache: tuple[int, int] | None = None
         # Optional per-round host data refresh: callable(round_idx) ->
         # (x_list, y_list) | None. Called at the top of each fit() round;
         # shapes must match the originals so the compiled round program
@@ -580,83 +589,143 @@ class FederatedSimulation:
         return self._fit_loop(n_rounds)
 
     def _fit_loop(self, n_rounds: int) -> list[RoundRecord]:
+        obs = self.observability
+        obs.start()  # re-arm after a previous fit()'s shutdown (idempotent)
         for r in self.reporters:
             r.report({"host_type": "server", "fit_start": time.time(),
                       "num_rounds": n_rounds})
-        val_batches, val_counts = self._val_batches()
-        start_round = 1
-        if self.state_checkpointer is not None and self.state_checkpointer.exists():
-            # fit_with_per_round_checkpointing resume (base_server.py:143-229)
-            start_round = self.state_checkpointer.load_simulation(self)
-        for rnd in range(start_round, n_rounds + 1):
-            t0 = time.time()
-            if self.train_data_provider is not None:
-                fresh = self.train_data_provider(rnd)
-                if fresh is not None:
-                    self.set_train_data(*fresh)
-            mask = self.client_manager.sample(
-                jax.random.fold_in(self.rng, 2000 + rnd), rnd
-            )
-            batches = self._round_batches(rnd)
-            (
-                self.server_state,
-                self.client_states,
-                fit_losses,
-                fit_metrics,
-                per_client_fit_losses,
-            ) = self._fit_round(
-                self.server_state, self.client_states, batches, mask,
-                jnp.asarray(rnd, jnp.int32), val_batches,
-            )
-            # Failure policy screen (base_server.py:316-318): terminate before
-            # checkpointing a poisoned aggregate when accept_failures=False.
-            self.failure_policy.check(jax.device_get(per_client_fit_losses), mask)
-            fit_losses = {k: float(v) for k, v in jax.device_get(fit_losses).items()}
-            fit_metrics = {k: float(v) for k, v in jax.device_get(fit_metrics).items()}
-            for mode, ckpt in self.model_checkpointers:
-                if mode == CheckpointMode.PRE_AGGREGATION:
-                    ckpt.maybe_checkpoint(
-                        self.client_states.params,
-                        fit_losses.get("backward", float("nan")),
-                        fit_metrics,
-                    )
-            t1 = time.time()
-            (
-                self.client_states,
-                eval_losses,
-                eval_metrics,
-                per_client_eval_losses,
-                per_client_eval_metrics,
-            ) = self._eval_round(
-                self.server_state, self.client_states, val_batches, val_counts
-            )
-            self.server_state = self.strategy.update_after_eval(
-                self.server_state, per_client_eval_losses, per_client_eval_metrics, mask
-            )
-            eval_losses = {k: float(v) for k, v in jax.device_get(eval_losses).items()}
-            eval_metrics = {k: float(v) for k, v in jax.device_get(eval_metrics).items()}
-            test = self._test_batches()
-            if test is not None:
-                # Separate test loader: same aggregated model, "test - "
-                # prefixed keys alongside the val metrics (base_server.py:545).
-                _, test_losses, test_metrics, _, _ = self._eval_round(
-                    self.server_state, self.client_states, test[0], test[1]
+        with obs.span("setup", cat="fit"):
+            val_batches, val_counts = self._val_batches()
+            start_round = 1
+            if self.state_checkpointer is not None and self.state_checkpointer.exists():
+                # fit_with_per_round_checkpointing resume (base_server.py:143-229)
+                start_round = self.state_checkpointer.load_simulation(self)
+        try:
+            for rnd in range(start_round, n_rounds + 1):
+                # opt-in XProf capture of ONE chosen round (profile_round_idx)
+                with obs.maybe_profile(rnd):
+                    self._run_round(rnd, val_batches, val_counts)
+        finally:
+            # shutdown (not just export) ALWAYS runs — even when a round
+            # raises (ClientFailuresError): it detaches the compile monitor
+            # and releases/clears the tracer this run enabled, so a retry in
+            # the same process doesn't double-count compiles, and the failed
+            # run's trace/metrics (the run you most want to inspect) still
+            # land on disk.
+            artifacts = obs.shutdown()
+        for rep in self.reporters:
+            if artifacts:
+                rep.report({"observability_artifacts": dict(artifacts)})
+            rep.report({"fit_end": time.time()})
+            rep.shutdown()
+        return self.history
+
+    def _run_round(self, rnd: int, val_batches, val_counts) -> RoundRecord:
+        """One federated round: configure_fit -> fit_round -> aggregate ->
+        checkpoint -> eval_round -> checkpoint -> report, each phase under an
+        observability span (no-ops when disabled)."""
+        obs = self.observability
+        # compile accounting baseline: delta over the round = recompiles
+        # (shape drift re-paying XLA compiles is THE classic round-loop bug)
+        if obs.enabled:
+            compiles_before = obs.registry.counter("jax_backend_compiles_total").value
+            compile_s_before = obs.registry.counter(
+                "jax_backend_compiles_seconds_total"
+            ).value
+        device_wait_s = 0.0
+        t0 = time.time()
+        with obs.span("round", round=rnd):
+            with obs.span("configure_fit", round=rnd):
+                if self.train_data_provider is not None:
+                    fresh = self.train_data_provider(rnd)
+                    if fresh is not None:
+                        self.set_train_data(*fresh)
+                mask = self.client_manager.sample(
+                    jax.random.fold_in(self.rng, 2000 + rnd), rnd
                 )
-                eval_losses.update({
-                    f"test - {k}": float(v)
-                    for k, v in jax.device_get(test_losses).items()
-                })
-                eval_metrics.update({
-                    f"test - {k}": float(v)
-                    for k, v in jax.device_get(test_metrics).items()
-                })
-            for mode, ckpt in self.model_checkpointers:
-                if mode == CheckpointMode.POST_AGGREGATION:
-                    ckpt.maybe_checkpoint(
-                        self.global_params,
-                        eval_losses.get("checkpoint", float("nan")),
-                        eval_metrics,
+                batches = self._round_batches(rnd)
+            with obs.span("fit_round", round=rnd) as fit_span:
+                (
+                    self.server_state,
+                    self.client_states,
+                    fit_losses,
+                    fit_metrics,
+                    per_client_fit_losses,
+                ) = self._fit_round(
+                    self.server_state, self.client_states, batches, mask,
+                    jnp.asarray(rnd, jnp.int32), val_batches,
+                )
+                # Honest device time: the dispatch above returns at enqueue;
+                # fence (enabled path ONLY — disabled adds zero syncs) so the
+                # span covers actual device execution, not enqueue latency.
+                _, wait = obs.fence(
+                    (fit_losses, fit_metrics, per_client_fit_losses)
+                )
+                device_wait_s += wait
+                fit_span.set(device_wait_s=wait)
+            with obs.span("aggregate", round=rnd):
+                # Failure policy screen (base_server.py:316-318): terminate
+                # before checkpointing a poisoned aggregate when
+                # accept_failures=False.
+                host_fit_losses = jax.device_get(per_client_fit_losses)
+                failed = self.failure_policy.check(host_fit_losses, mask)
+                fit_losses = {k: float(v) for k, v in jax.device_get(fit_losses).items()}
+                fit_metrics = {k: float(v) for k, v in jax.device_get(fit_metrics).items()}
+            with obs.span("checkpoint", round=rnd, mode="pre_aggregation"):
+                for mode, ckpt in self.model_checkpointers:
+                    if mode == CheckpointMode.PRE_AGGREGATION:
+                        ckpt.maybe_checkpoint(
+                            self.client_states.params,
+                            fit_losses.get("backward", float("nan")),
+                            fit_metrics,
+                        )
+            t1 = time.time()
+            with obs.span("eval_round", round=rnd) as eval_span:
+                (
+                    self.client_states,
+                    eval_losses,
+                    eval_metrics,
+                    per_client_eval_losses,
+                    per_client_eval_metrics,
+                ) = self._eval_round(
+                    self.server_state, self.client_states, val_batches, val_counts
+                )
+                self.server_state = self.strategy.update_after_eval(
+                    self.server_state, per_client_eval_losses,
+                    per_client_eval_metrics, mask
+                )
+                _, eval_wait = obs.fence((eval_losses, eval_metrics))
+                eval_losses = {k: float(v) for k, v in jax.device_get(eval_losses).items()}
+                eval_metrics = {k: float(v) for k, v in jax.device_get(eval_metrics).items()}
+                test = self._test_batches()
+                if test is not None:
+                    # Separate test loader: same aggregated model, "test - "
+                    # prefixed keys alongside the val metrics (base_server.py:545).
+                    _, test_losses, test_metrics, _, _ = self._eval_round(
+                        self.server_state, self.client_states, test[0], test[1]
                     )
+                    # fence the test dispatch too — its device time belongs
+                    # in device_wait_s, not misattributed to host_s
+                    _, test_wait = obs.fence((test_losses, test_metrics))
+                    eval_wait += test_wait
+                    eval_losses.update({
+                        f"test - {k}": float(v)
+                        for k, v in jax.device_get(test_losses).items()
+                    })
+                    eval_metrics.update({
+                        f"test - {k}": float(v)
+                        for k, v in jax.device_get(test_metrics).items()
+                    })
+                device_wait_s += eval_wait
+                eval_span.set(device_wait_s=eval_wait)
+            with obs.span("checkpoint", round=rnd, mode="post_aggregation"):
+                for mode, ckpt in self.model_checkpointers:
+                    if mode == CheckpointMode.POST_AGGREGATION:
+                        ckpt.maybe_checkpoint(
+                            self.global_params,
+                            eval_losses.get("checkpoint", float("nan")),
+                            eval_metrics,
+                        )
             t2 = time.time()
             rec = RoundRecord(
                 round=rnd,
@@ -670,23 +739,121 @@ class FederatedSimulation:
             self.history.append(rec)
             if self.state_checkpointer is not None:
                 # per-round durable state (_save_server_state, base_server.py:420)
-                self.state_checkpointer.save_simulation(self, rnd)
-            for rep in self.reporters:
-                rep.report(
-                    {
+                with obs.span("checkpoint", round=rnd, mode="state"):
+                    self.state_checkpointer.save_simulation(self, rnd)
+            obs_summary = None
+            if obs.enabled:
+                obs_summary = self._record_round_metrics(
+                    rnd, rec, mask, host_fit_losses, failed,
+                    compiles_before, compile_s_before, device_wait_s,
+                )
+            with obs.span("report", round=rnd):
+                for rep in self.reporters:
+                    payload = {
                         "fit_losses": rec.fit_losses,
                         "fit_metrics": rec.fit_metrics,
                         "eval_losses": rec.eval_losses,
                         "eval_metrics": rec.eval_metrics,
                         "fit_elapsed_s": rec.fit_elapsed_s,
                         "eval_elapsed_s": rec.eval_elapsed_s,
-                    },
-                    round=rnd,
-                )
-        for rep in self.reporters:
-            rep.report({"fit_end": time.time()})
-            rep.shutdown()
-        return self.history
+                    }
+                    if obs_summary is not None:
+                        # same data the registry/trace hold, bridged through
+                        # ReportsManager so JsonReporter/WandBReporter see it
+                        payload["observability"] = dict(obs_summary)
+                    rep.report(payload, round=rnd)
+        return rec
+
+    def _payload_nbytes(self) -> tuple[int, int]:
+        """(broadcast, gather) logical payload bytes per participating client
+        — what a wire deployment would serialize each round (the arXiv:
+        1610.05492 communication-cost accounting). Computed abstractly via
+        ``jax.eval_shape`` (no device work) and cached: payload shapes are
+        fixed for the life of the compiled round program."""
+        if self._payload_bytes_cache is not None:
+            return self._payload_bytes_cache
+        tree_bytes = ptu.tree_nbytes
+        gp = self.strategy.global_params(self.server_state)
+        try:
+            payload = jax.eval_shape(
+                lambda s: self.strategy.client_payload(s, jnp.zeros((), jnp.int32)),
+                self.server_state,
+            )
+            down_tree = payload.params if hasattr(payload, "params") else payload
+        except Exception:  # exotic strategy payloads fall back to the globals
+            down_tree = gp
+        try:
+            up_tree = jax.eval_shape(lambda p: self.exchanger.push(p, p), gp)
+        except Exception:
+            up_tree = gp
+        self._payload_bytes_cache = (tree_bytes(down_tree), tree_bytes(up_tree))
+        return self._payload_bytes_cache
+
+    def _record_round_metrics(
+        self, rnd: int, rec: RoundRecord, mask, host_fit_losses, failed,
+        compiles_before: float, compile_s_before: float, device_wait_s: float,
+    ) -> dict:
+        """Per-round gauges/counters + one JSONL ``round`` event; returns the
+        summary dict bridged into every reporter."""
+        reg = self.observability.registry
+        mask_np = np.asarray(mask)
+        participants = int((mask_np > 0).sum())
+        down, up = self._payload_nbytes()
+        bcast, gather = down * participants, up * participants
+        reg.counter("fl_rounds_total", help="completed federated rounds").inc()
+        reg.counter(
+            "fl_client_failures_total",
+            help="clients excluded by the failure policy (non-finite loss)",
+        ).inc(len(failed))
+        reg.gauge(
+            "fl_participating_clients",
+            help="clients sampled into the current round",
+        ).set(participants)
+        row = np.asarray(host_fit_losses.get("backward", np.zeros_like(mask_np)))
+        sel = row[(mask_np > 0) & np.isfinite(row)]
+        loss_std = float(sel.std()) if sel.size else 0.0
+        loss_spread = float(sel.max() - sel.min()) if sel.size else 0.0
+        reg.gauge(
+            "fl_fit_loss_std",
+            help="dispersion of participating clients' training loss",
+        ).set(loss_std)
+        reg.gauge(
+            "fl_fit_loss_spread",
+            help="straggler proxy: max-min participating client training loss",
+        ).set(loss_spread)
+        reg.counter(
+            "fl_broadcast_bytes_total",
+            help="logical server->client payload bytes (what a wire "
+                 "deployment would serialize per round)",
+        ).inc(bcast)
+        reg.counter(
+            "fl_gather_bytes_total",
+            help="logical client->server payload bytes",
+        ).inc(gather)
+        summary = {
+            "round": rnd,
+            "compiles": reg.counter("jax_backend_compiles_total").value
+            - compiles_before,
+            "compile_s": reg.counter("jax_backend_compiles_seconds_total").value
+            - compile_s_before,
+            "device_wait_s": device_wait_s,
+            "fit_s": rec.fit_elapsed_s,
+            "eval_s": rec.eval_elapsed_s,
+            "host_s": max(
+                0.0, rec.fit_elapsed_s + rec.eval_elapsed_s - device_wait_s
+            ),
+            "broadcast_bytes": bcast,
+            "gather_bytes": gather,
+            "participants": participants,
+            "failures": len(failed),
+            "fit_loss_std": loss_std,
+            "fit_loss_spread": loss_spread,
+        }
+        reg.log_event("round", **summary)
+        self.observability.tracer.counter(
+            "fl_round_time_s", fit=rec.fit_elapsed_s, eval=rec.eval_elapsed_s
+        )
+        return summary
 
     @property
     def global_params(self):

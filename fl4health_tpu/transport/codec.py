@@ -31,9 +31,26 @@ import numpy as np
 
 from fl4health_tpu.core.types import PyTree
 from fl4health_tpu.exchange.packer import SparseMaskPacket
+from fl4health_tpu.observability.registry import get_registry
 from fl4health_tpu.transport.native import get_framing
 
 FLAG_COO = 1
+
+
+def _account(direction: str, nbytes: int, kind: str) -> None:
+    """Wire byte accounting (arXiv:1610.05492-style per-round cost) into the
+    process-wide registry. Host-side counter bumps only — no device work, so
+    the codec hot path cost is unchanged to first order."""
+    reg = get_registry()
+    reg.counter(
+        f"transport_bytes_{direction}_total",
+        help=f"total wire bytes {direction} by the codec",
+    ).inc(nbytes)
+    reg.counter(
+        f"transport_frames_{direction}_total",
+        help=f"wire frames {direction} by the codec",
+        labels={"kind": kind},
+    ).inc()
 
 
 def _paths_and_leaves(tree: PyTree) -> list[tuple[str, np.ndarray]]:
@@ -58,7 +75,9 @@ def encode(tree: PyTree) -> bytes:
         meta.append({"path": path, "shape": list(arr.shape), "dtype": str(data.dtype)})
         chunks.append(data.tobytes())
     header = json.dumps({"leaves": meta}).encode("utf-8")
-    return get_framing().frame(header, b"".join(chunks), flags=0)
+    frame = get_framing().frame(header, b"".join(chunks), flags=0)
+    _account("encoded", len(frame), "dense")
+    return frame
 
 
 def _rebuild_nested(items: list[tuple[str, np.ndarray]]) -> dict:
@@ -79,6 +98,7 @@ def decode(data: bytes, like: PyTree | None = None) -> PyTree:
     meta = json.loads(header.decode("utf-8"))
     if flags & FLAG_COO:
         raise ValueError("COO frame: use decode_sparse()")
+    _account("decoded", len(data), "dense")
     items: list[tuple[str, np.ndarray]] = []
     off = 0
     for entry in meta["leaves"]:
@@ -126,7 +146,9 @@ def encode_sparse(packet: SparseMaskPacket) -> bytes:
         chunks.append(flat_idx.tobytes())
         chunks.append(values.tobytes())
     header = json.dumps({"coo": meta}).encode("utf-8")
-    return get_framing().frame(header, b"".join(chunks), flags=FLAG_COO)
+    frame = get_framing().frame(header, b"".join(chunks), flags=FLAG_COO)
+    _account("encoded", len(frame), "coo")
+    return frame
 
 
 def decode_sparse(data: bytes, like: SparseMaskPacket | None = None) -> SparseMaskPacket:
@@ -134,6 +156,7 @@ def decode_sparse(data: bytes, like: SparseMaskPacket | None = None) -> SparseMa
     header, payload, flags = get_framing().unframe(data)
     if not flags & FLAG_COO:
         raise ValueError("dense frame: use decode()")
+    _account("decoded", len(data), "coo")
     meta = json.loads(header.decode("utf-8"))
     items, mask_items = [], []
     off = 0

@@ -11,13 +11,20 @@ serialization per round, n-weighted FedAvg over reply trees) has one home.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Mapping, Sequence
 
 import jax
 import numpy as np
 
+from fl4health_tpu.observability.registry import get_registry
+from fl4health_tpu.observability.spans import get_tracer
 from fl4health_tpu.transport.codec import decode, encode
 from fl4health_tpu.transport.loopback import call
+
+# RPC latency buckets tuned for LAN/WAN silo links (1ms .. 60s)
+_RPC_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 30.0, 60.0)
 
 
 def broadcast_round(
@@ -27,13 +34,46 @@ def broadcast_round(
     timeout: float | None = None,
 ) -> list[dict[str, Any]]:
     """Send the global params to every silo (ONE serialization — the frame
-    is identical) and decode each reply against ``reply_template``."""
+    is identical) and decode each reply against ``reply_template``.
+
+    Observability: each silo's request/decode round trip lands in a
+    per-silo ``transport_rpc_latency_seconds`` histogram and a ``rpc`` span
+    (no-ops while the process tracer is disabled); failures bump
+    ``transport_rpc_failures_total`` before re-raising so partial rounds
+    stay visible in the metrics even when the exception unwinds the round.
+    """
+    reg, tracer = get_registry(), get_tracer()
     frame = encode(global_params)
     kwargs = {} if timeout is None else {"timeout": timeout}
-    return [
-        decode(call(host, port, frame, **kwargs), like=reply_template)
-        for host, port in silos
-    ]
+    replies = []
+    for host, port in silos:
+        silo = f"{host}:{port}"
+        hist = reg.histogram(
+            "transport_rpc_latency_seconds",
+            help="per-silo round-trip latency (request + decode)",
+            labels={"silo": silo},
+            buckets=_RPC_BUCKETS,
+        )
+        t0 = time.perf_counter()
+        with tracer.span("rpc", cat="transport", silo=silo,
+                         request_bytes=len(frame)) as sp:
+            try:
+                raw = call(host, port, frame, **kwargs)
+                reply = decode(raw, like=reply_template)
+            except Exception:
+                reg.counter(
+                    "transport_rpc_failures_total",
+                    help="silo round trips that raised",
+                    labels={"silo": silo},
+                ).inc()
+                raise
+            # successes only: a timed-out silo's 60s ceiling in the latency
+            # histogram would swamp the percentiles of working round trips
+            # (dead-silo visibility lives in the failure counter above)
+            hist.observe(time.perf_counter() - t0)
+            sp.set(reply_bytes=len(raw))
+        replies.append(reply)
+    return replies
 
 
 def weighted_merge(

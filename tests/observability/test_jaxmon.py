@@ -1,0 +1,75 @@
+"""JAX hook tests: compile-event counting, device-time fencing semantics,
+opt-in profiler capture."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.observability.jaxmon import (
+    CompileMonitor,
+    profile_round,
+    synced,
+)
+from fl4health_tpu.observability.registry import MetricsRegistry
+
+
+def test_compile_monitor_counts_fresh_compiles():
+    reg = MetricsRegistry()
+    with CompileMonitor(reg) as mon:
+        # a never-seen jaxpr forces a fresh trace + backend compile
+        f = jax.jit(lambda x: x * 3.0 + jnp.tanh(x))
+        f(jnp.ones(7)).block_until_ready()
+        after_first = mon.compile_count()
+        f(jnp.ones(7)).block_until_ready()  # tracing-cache hit: no recompile
+        after_second = mon.compile_count()
+    assert after_first >= 1
+    assert after_second == after_first
+    snap = reg.snapshot()
+    assert snap["jax_backend_compiles_seconds_total"] > 0
+    assert snap["jax_jaxpr_traces_total"] >= 1
+
+
+def test_uninstalled_monitor_stops_counting():
+    reg = MetricsRegistry()
+    mon = CompileMonitor(reg).install()
+    mon.uninstall()
+    assert not mon.installed
+    jax.jit(lambda x: x - 11.0)(jnp.ones(3)).block_until_ready()
+    assert reg.snapshot().get("jax_backend_compiles_total", 0) == 0
+
+
+def test_two_monitors_fan_out_independently():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    with CompileMonitor(r1) as m1, CompileMonitor(r2) as m2:
+        jax.jit(lambda x: jnp.sin(x) * 5)(jnp.ones(5)).block_until_ready()
+        assert m1.compile_count() == m2.compile_count() >= 1
+
+
+def test_synced_disabled_is_pure_passthrough():
+    x = jnp.ones(4)
+    out, wait = synced(x, enabled=False)
+    assert out is x
+    assert wait == 0.0
+
+
+def test_synced_enabled_fences_and_times():
+    tree = {"a": jnp.ones(4) * 2, "b": [jnp.zeros(3)]}
+    out, wait = synced(tree, enabled=True)
+    assert out is tree
+    assert wait >= 0.0
+
+
+def test_profile_round_none_is_noop():
+    with profile_round(None):
+        jnp.ones(2).block_until_ready()
+
+
+def test_profile_round_writes_artifacts(tmp_path):
+    d = str(tmp_path / "xprof")
+    with profile_round(d):
+        jax.jit(lambda x: x + 2.0)(jnp.ones(3)).block_until_ready()
+    produced = [
+        os.path.join(root, f) for root, _, files in os.walk(d) for f in files
+    ]
+    assert produced, "jax.profiler.trace produced no artifacts"

@@ -1,0 +1,133 @@
+"""Registry tests: counter/gauge/histogram semantics, Prometheus text
+exposition, JSONL event log."""
+
+import json
+import math
+
+import pytest
+
+from fl4health_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+class TestCounter:
+    def test_monotonic(self):
+        c = Counter("x_total")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_registry_returns_same_instance(self):
+        reg = MetricsRegistry()
+        assert reg.counter("a") is reg.counter("a")
+        # distinct label sets are distinct children
+        assert reg.counter("a", labels={"k": "1"}) is not reg.counter("a")
+
+    def test_kind_conflict_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        g = Gauge("g")
+        g.set(10)
+        g.inc(5)
+        g.dec(2)
+        assert g.value == 13.0
+
+
+class TestHistogram:
+    def test_cumulative_buckets(self):
+        h = Histogram("lat", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        snap = h.snapshot()
+        # Prometheus semantics: each le-bucket counts observations <= bound,
+        # +Inf equals _count
+        assert snap["buckets"] == {"0.1": 1, "1": 3, "10": 4, "+Inf": 5}
+        assert snap["count"] == 5
+        assert snap["sum"] == pytest.approx(56.05)
+
+    def test_inf_bucket_always_present(self):
+        h = Histogram("h", buckets=(1.0,))
+        assert h.buckets[-1] == math.inf
+
+
+class TestPrometheusExposition:
+    def test_format(self):
+        reg = MetricsRegistry()
+        reg.counter("fl_rounds_total", help="completed rounds").inc(2)
+        reg.gauge("fl_participating_clients").set(4)
+        reg.histogram("rpc_seconds", labels={"silo": "h:1"},
+                      buckets=(0.5,)).observe(0.1)
+        text = reg.to_prometheus()
+        lines = text.splitlines()
+        assert "# HELP fl_rounds_total completed rounds" in lines
+        assert "# TYPE fl_rounds_total counter" in lines
+        assert "fl_rounds_total 2" in lines
+        assert "# TYPE fl_participating_clients gauge" in lines
+        assert "fl_participating_clients 4" in lines
+        assert "# TYPE rpc_seconds histogram" in lines
+        assert 'rpc_seconds_bucket{le="0.5",silo="h:1"} 1' in lines
+        assert 'rpc_seconds_bucket{le="+Inf",silo="h:1"} 1' in lines
+        assert 'rpc_seconds_count{silo="h:1"} 1' in lines
+        assert text.endswith("\n")
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("c", labels={"p": 'a"b\\c'}).inc()
+        assert 'p="a\\"b\\\\c"' in reg.to_prometheus()
+
+    def test_help_backfilled_on_later_lookup(self):
+        """A metric first touched help-lessly (a baseline read) still earns
+        its # HELP line when a later caller supplies one."""
+        reg = MetricsRegistry()
+        reg.counter("jax_backend_compiles_total")  # baseline read, no help
+        reg.counter("jax_backend_compiles_total", help="XLA backend compiles")
+        assert ("# HELP jax_backend_compiles_total XLA backend compiles"
+                in reg.to_prometheus())
+
+    def test_type_line_emitted_once_per_name(self):
+        reg = MetricsRegistry()
+        reg.counter("c", labels={"s": "1"}).inc()
+        reg.counter("c", labels={"s": "2"}).inc(3)
+        text = reg.to_prometheus()
+        assert text.count("# TYPE c counter") == 1
+        assert 'c{s="1"} 1' in text
+        assert 'c{s="2"} 3' in text
+
+
+class TestEventLog:
+    def test_log_and_dump_jsonl(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.log_event("round", round=1, compiles=3)
+        reg.log_event("round", round=2, compiles=0)
+        path = reg.dump_jsonl(str(tmp_path / "m.jsonl"))
+        recs = [json.loads(line) for line in open(path)]
+        assert [r["round"] for r in recs] == [1, 2]
+        assert all(r["event"] == "round" and "ts" in r for r in recs)
+
+    def test_snapshot_shapes(self):
+        reg = MetricsRegistry()
+        reg.counter("a").inc(2)
+        reg.gauge("b", labels={"k": "v"}).set(1)
+        snap = reg.snapshot()
+        assert snap["a"] == 2.0
+        assert snap["b"] == {'{k="v"}': 1.0}
+
+    def test_clear(self):
+        reg = MetricsRegistry()
+        reg.counter("a").inc()
+        reg.log_event("e")
+        reg.clear()
+        assert reg.snapshot() == {}
+        assert reg.events == []

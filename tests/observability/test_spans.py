@@ -1,0 +1,131 @@
+"""Tracer tests: span nesting, Chrome trace-event export round-trip,
+disabled-path zero-cost contract."""
+
+import json
+import threading
+
+from fl4health_tpu.observability.spans import (
+    _NULL_SPAN,
+    Tracer,
+    get_tracer,
+    set_tracer,
+)
+
+
+def test_span_nesting_depths_recorded():
+    tr = Tracer()
+    with tr.span("round", round=1):
+        with tr.span("fit_round", round=1):
+            with tr.span("device_execute"):
+                pass
+        with tr.span("eval_round", round=1):
+            pass
+    by_name = {e["name"]: e for e in tr.events if e["ph"] == "X"}
+    assert by_name["round"]["args"]["depth"] == 0
+    assert by_name["fit_round"]["args"]["depth"] == 1
+    assert by_name["device_execute"]["args"]["depth"] == 2
+    assert by_name["eval_round"]["args"]["depth"] == 1
+
+
+def test_span_timing_containment():
+    """Visual nesting in Perfetto is derived from ts/dur containment: a
+    child's [ts, ts+dur] interval must sit inside its parent's."""
+    tr = Tracer()
+    with tr.span("parent"):
+        with tr.span("child"):
+            pass
+    parent = tr.spans_named("parent")[0]
+    child = tr.spans_named("child")[0]
+    assert parent["ts"] <= child["ts"]
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1e-6
+    assert parent["dur"] >= child["dur"]
+
+
+def test_export_round_trip(tmp_path):
+    tr = Tracer(process_name="test-proc")
+    with tr.span("round", round=3, cat="round"):
+        pass
+    tr.instant("marker", note="hi")
+    tr.counter("bytes", up=10, down=20)
+    path = tr.export(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    # Chrome trace-event envelope Perfetto accepts
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    events = doc["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta and meta[0]["args"]["name"] == "test-proc"
+    complete = [e for e in events if e["ph"] == "X"]
+    assert complete[0]["name"] == "round"
+    for field in ("ts", "dur", "pid", "tid"):
+        assert field in complete[0]
+    assert complete[0]["args"]["round"] == 3
+    assert [e for e in events if e["ph"] == "i"][0]["name"] == "marker"
+    assert [e for e in events if e["ph"] == "C"][0]["args"] == {
+        "up": 10.0, "down": 20.0,
+    }
+
+
+def test_export_is_atomic_no_partial_file(tmp_path):
+    tr = Tracer()
+    with tr.span("x"):
+        pass
+    path = str(tmp_path / "sub" / "trace.json")
+    tr.export(path)
+    leftovers = [p for p in (tmp_path / "sub").iterdir() if "tmp" in p.name]
+    assert not leftovers
+
+
+def test_disabled_tracer_records_nothing_and_shares_null_span():
+    tr = Tracer(enabled=False)
+    s1 = tr.span("a")
+    s2 = tr.span("b", round=2)
+    assert s1 is s2 is _NULL_SPAN  # no allocation on the disabled path
+    with s1:
+        s1.set(anything=1)
+    tr.instant("x")
+    tr.counter("y", v=1)
+    assert tr.events == []
+
+
+def test_span_records_exception_and_propagates():
+    tr = Tracer()
+    try:
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    evt = tr.spans_named("boom")[0]
+    assert evt["args"]["error"] == "RuntimeError"
+
+
+def test_threaded_spans_use_distinct_tids():
+    tr = Tracer()
+    # hold all workers alive simultaneously: the OS reuses thread idents of
+    # exited threads, which would collapse the tid set
+    barrier = threading.Barrier(3)
+
+    def work():
+        with tr.span("worker"):
+            barrier.wait(timeout=10)
+
+    threads = [threading.Thread(target=work) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with tr.span("main"):
+        pass
+    tids = {e["tid"] for e in tr.events}
+    assert len(tids) == 4
+
+
+def test_default_tracer_swap_restores():
+    prev = get_tracer()
+    mine = Tracer()
+    try:
+        assert set_tracer(mine) is prev
+        assert get_tracer() is mine
+    finally:
+        set_tracer(prev)
+    assert get_tracer() is prev

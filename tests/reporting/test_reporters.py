@@ -39,3 +39,62 @@ def test_jsonify_coerces_arrays(tmp_path):
     rep = JsonReporter(output_folder=str(tmp_path), run_id="c")
     rep.report({"loss": jnp.asarray(2.5)}, round=1)
     assert rep.data["rounds"]["1"]["loss"] == 2.5
+
+
+def test_jsonify_nonscalar_arrays_become_lists_not_reprs(tmp_path):
+    """Satellite fix: non-scalar numpy/JAX arrays used to fall through to
+    str(v) (an unparseable repr); now 0-d -> scalar, small -> list, big ->
+    a summary string — and the result must survive json round-trip."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rep = JsonReporter(output_folder=str(tmp_path), run_id="arr")
+    rep.report(
+        {
+            "zero_d_np": np.float32(1.5),
+            "zero_d_jnp": jnp.asarray(3),
+            "small_np": np.arange(4.0),
+            "small_jnp": jnp.ones((2, 2)),
+            "big": np.zeros(10_000),
+        },
+        round=1,
+    )
+    path = rep.dump()
+    with open(path) as f:
+        rd = json.load(f)["rounds"]["1"]
+    assert rd["zero_d_np"] == 1.5
+    assert rd["zero_d_jnp"] == 3
+    assert rd["small_np"] == [0.0, 1.0, 2.0, 3.0]
+    assert rd["small_jnp"] == [[1.0, 1.0], [1.0, 1.0]]
+    # big arrays summarize instead of bloating the log
+    assert "shape=(10000,)" in rd["big"]
+
+
+def test_json_dump_is_atomic(tmp_path):
+    """Satellite fix: dump writes a temp file then os.replace — no partial
+    JSON and no temp leftovers."""
+    rep = JsonReporter(output_folder=str(tmp_path), run_id="atomic")
+    rep.report({"x": 1}, round=1)
+    rep.dump()
+    rep.report({"x": 2}, round=2)
+    rep.dump()
+    leftovers = [p.name for p in tmp_path.iterdir() if ".tmp" in p.name]
+    assert leftovers == []
+    with open(tmp_path / "atomic.json") as f:
+        assert json.load(f)["rounds"]["2"]["x"] == 2
+
+
+def test_wandb_reporter_warns_instead_of_silently_swallowing(caplog):
+    """Satellite fix: a failing wandb.init must degrade to a no-op WITH a
+    logged warning (the docstring's promise), not silently."""
+    import logging
+
+    from fl4health_tpu.reporting.base import WandBReporter
+
+    rep = WandBReporter(project="p", nonexistent_kwarg_to_force_failure=object())
+    with caplog.at_level(logging.WARNING, logger="fl4health_tpu.reporting.base"):
+        rep.initialize()
+    assert rep._run is None
+    assert any("WandBReporter disabled" in r.message for r in caplog.records)
+    # and report() after failed init is a harmless no-op
+    rep.report({"x": 1}, round=1)

@@ -1,0 +1,95 @@
+"""tools/perf_report.py: JSONL round log -> per-round summary table."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import perf_report  # noqa: E402
+
+
+def _log(tmp_path, rounds):
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"ts": 0, "event": "other"}) + "\n")
+        for r in rounds:
+            f.write(json.dumps({"ts": 0, "event": "round", **r}) + "\n")
+    return str(path)
+
+
+def _round(n, **kw):
+    base = dict(round=n, compiles=0, compile_s=0.0, device_wait_s=0.01,
+                host_s=0.02, fit_s=0.02, eval_s=0.01,
+                broadcast_bytes=1000, gather_bytes=1000,
+                participants=4, failures=0)
+    base.update(kw)
+    return base
+
+
+def test_load_filters_and_sorts(tmp_path):
+    path = _log(tmp_path, [_round(2), _round(1, compiles=12)])
+    rounds = perf_report.load_round_events(path)
+    assert [r["round"] for r in rounds] == [1, 2]
+
+
+def test_malformed_lines_skipped(tmp_path):
+    path = _log(tmp_path, [_round(1)])
+    with open(path, "a") as f:
+        f.write("{not json\n")
+    assert len(perf_report.load_round_events(path)) == 1
+
+
+def test_render_table_aligned(tmp_path):
+    rounds = [_round(1, compiles=12, broadcast_bytes=4096),
+              _round(2)]
+    table = perf_report.render_table(rounds)
+    lines = table.splitlines()
+    assert lines[0].split()[:4] == ["round", "compiles", "compile_ms",
+                                   "device_ms"]
+    assert len(lines) == 4  # header + rule + 2 rounds
+    assert all(len(line) == len(lines[0]) for line in lines)
+    assert "4096" in lines[2]
+
+
+def test_render_missing_fields_dash():
+    table = perf_report.render_table([{"round": 1}])
+    assert "-" in table.splitlines()[2].split()
+
+
+def test_summarize_steady_state():
+    rounds = [_round(1, compiles=12, compile_s=2.0), _round(2), _round(3)]
+    s = perf_report.summarize(rounds)
+    assert s["rounds"] == 3
+    assert s["total_compiles"] == 12
+    assert s["steady_state_recompiles"] == 0
+    assert s["broadcast_bytes"] == 3000
+
+
+def test_cli_table_and_json(tmp_path):
+    path = _log(tmp_path, [_round(1, compiles=3), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    )
+    assert "compiles" in out.stdout and "steady_state_recompiles" in out.stdout
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    )
+    doc = json.loads(out.stdout)
+    assert doc["summary"]["total_compiles"] == 3
+    assert len(doc["rounds"]) == 2
+
+
+def test_cli_empty_log_fails(tmp_path):
+    path = tmp_path / "empty.jsonl"
+    path.write_text("")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 1

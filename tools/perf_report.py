@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Render an observability JSONL metrics log into a per-round summary table.
+
+The observability subsystem (fl4health_tpu/observability/) logs one
+``round`` event per federated round into ``metrics.jsonl`` (written by
+``Observability.export()``). This tool turns that log into the table a perf
+investigation starts from — compile count, device/host split, wire bytes —
+without opening the Perfetto trace:
+
+    python tools/perf_report.py artifacts/obs/metrics.jsonl
+    python tools/perf_report.py artifacts/obs/metrics.jsonl --json
+
+No third-party deps (zero-egress box): plain-text alignment, stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Iterable
+
+COLUMNS = (
+    # (header, event field, formatter)
+    ("round", "round", lambda v: str(int(v))),
+    ("compiles", "compiles", lambda v: str(int(v))),
+    ("compile_ms", "compile_s", lambda v: f"{v * 1000:.1f}"),
+    ("device_ms", "device_wait_s", lambda v: f"{v * 1000:.1f}"),
+    ("host_ms", "host_s", lambda v: f"{v * 1000:.1f}"),
+    ("fit_ms", "fit_s", lambda v: f"{v * 1000:.1f}"),
+    ("eval_ms", "eval_s", lambda v: f"{v * 1000:.1f}"),
+    ("bytes_out", "broadcast_bytes", lambda v: str(int(v))),
+    ("bytes_in", "gather_bytes", lambda v: str(int(v))),
+    ("clients", "participants", lambda v: str(int(v))),
+    ("failures", "failures", lambda v: str(int(v))),
+)
+
+
+def load_round_events(path: str) -> list[dict]:
+    """Parse the JSONL log, keeping only ``round`` events (other event kinds
+    share the file). Malformed lines are skipped with a note on stderr — a
+    crash mid-append must not make the whole log unreadable."""
+    rounds = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"{path}:{lineno}: skipping malformed line",
+                      file=sys.stderr)
+                continue
+            if rec.get("event") == "round":
+                rounds.append(rec)
+    return sorted(rounds, key=lambda r: r.get("round", 0))
+
+
+def render_table(rounds: Iterable[dict]) -> str:
+    """Aligned plain-text table; missing fields render as '-'."""
+    rows = [[h for h, _, _ in COLUMNS]]
+    for rec in rounds:
+        row = []
+        for _, field, fmt in COLUMNS:
+            v = rec.get(field)
+            row.append("-" if v is None else fmt(float(v)))
+        rows.append(row)
+    widths = [max(len(r[i]) for r in rows) for i in range(len(COLUMNS))]
+    lines = []
+    for n, row in enumerate(rows):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if n == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def summarize(rounds: list[dict]) -> dict[str, Any]:
+    """Aggregate totals — the one-glance numbers a PR comment quotes."""
+    if not rounds:
+        return {"rounds": 0}
+    tot = lambda k: sum(float(r.get(k, 0.0)) for r in rounds)  # noqa: E731
+    steady = [r for r in rounds[1:]] or rounds  # round 1 pays the compiles
+    return {
+        "rounds": len(rounds),
+        "total_compiles": int(tot("compiles")),
+        "compile_s": round(tot("compile_s"), 4),
+        "device_s": round(tot("device_wait_s"), 4),
+        "host_s": round(tot("host_s"), 4),
+        "broadcast_bytes": int(tot("broadcast_bytes")),
+        "gather_bytes": int(tot("gather_bytes")),
+        "steady_state_round_s": round(
+            sum(float(r.get("fit_s", 0)) + float(r.get("eval_s", 0))
+                for r in steady) / len(steady), 4,
+        ),
+        "steady_state_recompiles": int(
+            sum(float(r.get("compiles", 0)) for r in rounds[1:])
+        ),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("log", help="path to metrics.jsonl")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    rounds = load_round_events(args.log)
+    if not rounds:
+        print(f"no 'round' events in {args.log}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({"summary": summarize(rounds), "rounds": rounds},
+                         indent=2))
+        return 0
+    print(render_table(rounds))
+    print()
+    for k, v in summarize(rounds).items():
+        print(f"{k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
