@@ -240,9 +240,13 @@ def make_sim(model_kind: str = "cifar_cnn"):
                 module.vocab_size, seq, 4,
             )
             datasets.append(split_train_val(x, y))
-        if attention_fn is not None:
-            # FLASH=1 forced on this config: cost_analysis would drop the
-            # Pallas attention FLOPs here exactly as in transformer_long
+        # FLASH=1: cost_analysis would drop the Pallas attention FLOPs here
+        # exactly as in transformer_long. FL4HEALTH_BENCH_ANALYTIC_FLOPS=1
+        # (tools/flash_crossover.py sets it for BOTH arms) forces the same
+        # analytic numerator on the dense arm too, so per-cell mfu_pct is
+        # apples-to-apples across dense and flash.
+        if (attention_fn is not None
+                or os.environ.get("FL4HEALTH_BENCH_ANALYTIC_FLOPS") == "1"):
             analytic_flops = analytic_transformer_round_flops(
                 d=module.d_model, d_ff=module.d_ff, n_layers=module.n_layers,
                 seq=seq, n_clients=n_clients,
@@ -307,7 +311,12 @@ def timed_chunked_rounds(sim) -> float:
 
 
 def timed_compiled_rounds(sim, compiled) -> float:
-    """Wall time per round of the compiled fit path (excludes compile)."""
+    """Wall time per round of the compiled fit path (excludes compile).
+
+    The executable donates its state arguments (simulation.py mirrors
+    fit_chunk's donate_argnums), so the warmup outputs — not the consumed
+    sim fields — seed the timed loop, and the final states are written back
+    so later measurements (chunked, eager) see live buffers."""
     import jax
     import jax.numpy as jnp
 
@@ -315,13 +324,12 @@ def timed_compiled_rounds(sim, compiled) -> float:
     val_batches, _ = sim._val_batches()
     r = jnp.asarray(1, jnp.int32)
     # warmup (executable already compiled; first call pages it in)
-    out = compiled(
+    server_state, client_states, *_ = compiled(
         sim.server_state, sim.client_states, sim._round_batches(0), mask, r,
         val_batches,
     )
-    jax.block_until_ready(out[0])
+    jax.block_until_ready(jax.tree_util.tree_leaves(server_state)[0])
     t0 = time.perf_counter()
-    server_state, client_states = sim.server_state, sim.client_states
     for i in range(TIMED_ROUNDS):
         # Honest full-round cost: per-round batch construction included
         # (host index plan + one device gather), exactly as fit() pays it.
@@ -330,7 +338,64 @@ def timed_compiled_rounds(sim, compiled) -> float:
             server_state, client_states, round_batches, mask, r, val_batches
         )
     jax.block_until_ready(jax.tree_util.tree_leaves(server_state)[0])
-    return (time.perf_counter() - t0) / TIMED_ROUNDS
+    per_round = (time.perf_counter() - t0) / TIMED_ROUNDS
+    sim.server_state, sim.client_states = server_state, client_states
+    return per_round
+
+
+def timed_fit_overhead(sim) -> dict:
+    """Host-overhead decomposition of the REAL fit() driver loop, tracked in
+    BENCH_* from the async-pipeline PR onward.
+
+    device_busy_s: fit+eval dispatches for TIMED_ROUNDS rounds with a single
+    terminal block — what the devices are actually busy (plus per-round
+    batch construction, exactly as fit() pays it).
+    host_busy_s: fit() wall per round minus device_busy_s — the driver
+    loop's own per-round cost (pipelined path: consumer/prefetch overlap).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    mask = sim.client_manager.sample_all()
+    val_batches, val_counts = sim._val_batches()
+    r = jnp.asarray(1, jnp.int32)
+    # device-only loop. Warm BOTH jits first: earlier measurements used the
+    # AOT-compiled executable, so sim._fit_round's own jit (what fit()
+    # dispatches) still needs its trace+compile paid outside the timing.
+    ss, cs = sim.server_state, sim.client_states
+    ss, cs, *_ = sim._fit_round(ss, cs, sim._round_batches(0), mask, r,
+                                val_batches)
+    ev = sim._eval_round(ss, cs, val_batches, val_counts)
+    jax.block_until_ready(ev[1])
+    cs = ev[0]
+    t0 = time.perf_counter()
+    for i in range(TIMED_ROUNDS):
+        b = sim._round_batches(i + 1)
+        ss, cs, *_ = sim._fit_round(ss, cs, b, mask, r, val_batches)
+        ev = sim._eval_round(ss, cs, val_batches, val_counts)
+        cs = ev[0]
+    jax.block_until_ready((jax.tree_util.tree_leaves(ss)[0], ev[1]))
+    device_busy = (time.perf_counter() - t0) / TIMED_ROUNDS
+    sim.server_state, sim.client_states = ss, cs
+
+    # the real driver loop on the pipelined path (the mode whose host
+    # overhead this PR targets; chunked would hide it by construction)
+    sim.execution_mode = "pipelined"
+    sim.fit(1)  # warmup: everything fit() touches is compiled after this
+    t0 = time.perf_counter()
+    sim.fit(TIMED_ROUNDS)
+    wall = (time.perf_counter() - t0) / TIMED_ROUNDS
+    host_busy = max(0.0, wall - device_busy)
+    return {
+        "fit_wall_s": round(wall, 4),
+        "device_busy_s": round(device_busy, 4),
+        "host_busy_s": round(host_busy, 4),
+        "host_device_ratio": (
+            round(host_busy / device_busy, 4) if device_busy else None
+        ),
+        "fit_execution_mode": "pipelined_per_round",
+        "rounds": TIMED_ROUNDS,
+    }
 
 
 def timed_eager_round(sim) -> tuple[float, int]:
@@ -385,13 +450,15 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
     flops_source = "xla_cost_analysis"
     if analytic_flops is not None:
         # Pallas custom-call FLOPs are invisible to the cost model; the
-        # analytic count is the honest MFU numerator for those configs.
-        # Keep the cost-model figure in the artifact for transparency.
+        # analytic count is the honest MFU numerator for those configs —
+        # and, under FL4HEALTH_BENCH_ANALYTIC_FLOPS=1, for the dense arm of
+        # an A/B too, so both arms share one numerator. Keep the cost-model
+        # figure in the artifact for transparency.
         xla_flops, round_flops = round_flops, analytic_flops
         flops_source = (
-            "analytic_3x_fwd (XLA cost_analysis cannot see Pallas "
-            f"custom-call FLOPs; cost model said {xla_flops / 1e12:.3f} "
-            "TFLOP/round)"
+            "analytic_3x_fwd (one numerator for all attention arms; XLA "
+            "cost_analysis cannot see Pallas custom-call FLOPs — cost model "
+            f"said {xla_flops / 1e12:.3f} TFLOP/round)"
         )
     per_round_dispatch = timed_compiled_rounds(sim, compiled)
     # Two supported execution modes: per-round dispatch and the on-device
@@ -442,6 +509,17 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
         # docstring): the eager baseline times this many clients and scales
         # linearly to the full cohort.
         out["eager_clients_measured"] = eager_measured
+    # Host-overhead decomposition of the real fit() loop (async-pipeline PR
+    # acceptance metric). "auto" runs it on the headline (eager-comparison)
+    # config only and skips the CPU fallback, whose tight budget the extra
+    # fit rounds would blow; FL4HEALTH_BENCH_HOST_OVERHEAD=1 forces it for
+    # ANY config, =0 disables it.
+    want_ho = os.environ.get("FL4HEALTH_BENCH_HOST_OVERHEAD", "auto")
+    if want_ho == "1" or (
+        want_ho == "auto" and with_eager
+        and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+    ):
+        out["host_overhead"] = timed_fit_overhead(sim)
     return out
 
 
@@ -520,6 +598,10 @@ def run_measurement() -> None:
         "rounds_per_dispatch": cifar["rounds_per_dispatch"],
         "steps_per_sec_single_dispatch": cifar["steps_per_sec_single_dispatch"],
         "steps_per_sec_chunked": cifar["steps_per_sec_chunked"],
+        # per-round host/device busy split of the real fit() driver loop
+        # (host_busy_s, device_busy_s, host_device_ratio) — the async-round-
+        # pipeline win, tracked per BENCH_* artifact from that PR onward.
+        "host_overhead": cifar.get("host_overhead"),
     }
     if fallback_note:
         record["note"] = fallback_note

@@ -34,14 +34,20 @@ from fl4health_tpu.strategies.client_dp_fedavgm import ClientLevelDPFedAvgM  # n
 cfg = lib.example_config(Path(__file__).parent)
 n_clients = int(cfg["n_clients"])
 
-# Uneven "hospitals": sizes drawn 64..256 so the capped-count weighting is
-# exercised (equal shards would collapse it to the unweighted mean).
+# Uneven "hospitals": a 64..256 linspace profile NORMALIZED to the
+# 1024-sample pool, so the capped-count weighting is exercised (equal shards
+# would collapse it to the unweighted mean) and every client gets a
+# non-empty shard at any FL4HEALTH_EXAMPLE_CLIENTS. (The previous raw
+# linspace summed past 1024 at >=7 clients, silently truncating trailing
+# clients to empty shards.)
 x, y = synthetic_classification(
     jax.random.PRNGKey(0), 1024, (31,), 2, class_sep=1.5
 )
 x, y = np.asarray(x), np.asarray(y)
-sizes = np.linspace(64, 256, n_clients).astype(int)
-sizes[-1] += 1024 - sizes.sum() if sizes.sum() < 1024 else 0
+profile = np.linspace(64, 256, n_clients)
+sizes = np.floor(profile * 1024 / profile.sum()).astype(int)
+sizes[: 1024 - sizes.sum()] += 1  # distribute the flooring remainder
+assert sizes.sum() == 1024 and (sizes > 0).all()
 offsets = np.concatenate([[0], np.cumsum(sizes)])
 datasets = []
 for i in range(n_clients):

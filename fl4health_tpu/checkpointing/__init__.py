@@ -4,8 +4,12 @@ Two concerns, as in the reference (SURVEY.md §2.9): (1) model-artifact
 checkpointing with latest/best policies and pre/post-aggregation modes
 (checkpointing.checkpointer); (2) preemption-resilient state checkpointing
 with typed snapshotters and per-round resume (checkpointing.state).
+A third, TPU-native concern rides along: (3) the async writer
+(checkpointing.async_writer) that the pipelined round loop uses to move
+msgpack serialization and file I/O off the round-critical path.
 """
 
+from fl4health_tpu.checkpointing.async_writer import AsyncCheckpointWriter
 from fl4health_tpu.checkpointing.checkpointer import (
     BestLossCheckpointer,
     BestMetricCheckpointer,
@@ -23,6 +27,7 @@ from fl4health_tpu.checkpointing.state import (
 )
 
 __all__ = [
+    "AsyncCheckpointWriter",
     "BestLossCheckpointer",
     "BestMetricCheckpointer",
     "CheckpointMode",

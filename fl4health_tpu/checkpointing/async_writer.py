@@ -1,0 +1,43 @@
+"""Async checkpoint writer — serialize + write artifacts off the round loop.
+
+The reference checkpoints synchronously inside the round loop
+(``torch.save`` in ``TorchModuleCheckpointer.maybe_checkpoint``); on the TPU
+build the msgpack serialization and file write are pure host work that the
+async round pipeline (``server/pipeline.py``) moves off the critical path.
+The checkpoint *decision* (best-loss/best-metric comparisons) stays ordered
+in the round consumer; only the persist lands here.
+
+Jobs receive HOST data (numpy pytrees snapshotted before the next round's
+donation invalidates the device buffers) — a submitted job must never touch
+live simulation state. The single worker keeps writes ordered, so "latest"
+policies end with the last round's artifact on disk. Queue, flush-barrier
+and first-exception propagation contracts come from
+:class:`~fl4health_tpu.core.workqueue.SingleWorkerQueue`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+from fl4health_tpu.checkpointing.checkpointer import save_params
+from fl4health_tpu.core.workqueue import SingleWorkerQueue
+
+
+class AsyncCheckpointWriter(SingleWorkerQueue):
+    """Bounded single-worker queue for checkpoint persists."""
+
+    def __init__(self, maxsize: int = 4, name: str = "fl-ckpt-writer"):
+        super().__init__(maxsize=maxsize, name=name)
+
+    def submit(self, fn: Callable, *args: Any, **kwargs: Any) -> None:
+        """Enqueue a persist job; blocks when ``maxsize`` writes are pending
+        (disk slower than rounds must throttle the pipeline, not accumulate
+        unbounded host copies). Re-raises a stored failure first."""
+        super().submit(functools.partial(fn, *args, **kwargs) if (args or kwargs)
+                       else fn)
+
+    def submit_save(self, path: str, params: Any) -> None:
+        """Persist a params pytree (flax msgpack bytes) asynchronously.
+        ``params`` must already be host data (numpy leaves)."""
+        self.submit(save_params, path, params)

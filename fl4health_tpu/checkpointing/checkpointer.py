@@ -43,16 +43,34 @@ def load_params(path: str, template: Params) -> Params:
 
 
 class ParamsCheckpointer(ABC):
-    """Decides per call whether the given params are worth persisting."""
+    """Decides per call whether the given params are worth persisting.
+
+    ``async_writer`` (an ``AsyncCheckpointWriter`` or None) routes the
+    persist off-thread: the *decision* stays wherever ``maybe_checkpoint``
+    runs (ordered, in the round consumer under the pipelined loop), only the
+    serialize+write moves. The pipelined ``fit()`` attaches its writer for
+    the duration of the run; standalone use stays synchronous.
+    """
 
     def __init__(self, path: str):
         self.path = path
+        self.async_writer = None
 
     @abstractmethod
     def maybe_checkpoint(
         self, params: Params, loss: float | None, metrics: Mapping[str, Any]
     ) -> bool:
         ...
+
+    def _persist(self, params: Params) -> None:
+        """Write now, or hand off to the attached async writer. ``params``
+        handed to a writer must already be host data (the pipelined loop
+        snapshots before submitting — device buffers may be donated away by
+        the time the write runs)."""
+        if self.async_writer is not None:
+            self.async_writer.submit_save(self.path, params)
+        else:
+            save_params(self.path, params)
 
     def load(self, template: Params) -> Params:
         return load_params(self.path, template)
@@ -85,7 +103,7 @@ class FunctionCheckpointer(ParamsCheckpointer):
         )
         if improved:
             self.best_score = score
-            save_params(self.path, params)
+            self._persist(params)
         return improved
 
 
@@ -93,7 +111,7 @@ class LatestCheckpointer(ParamsCheckpointer):
     """Unconditional overwrite (LatestTorchModuleCheckpointer :162)."""
 
     def maybe_checkpoint(self, params, loss, metrics) -> bool:
-        save_params(self.path, params)
+        self._persist(params)
         return True
 
 

@@ -139,18 +139,39 @@ class SimulationStateCheckpointer(StateCheckpointer):
     TREES = ("server_state", "client_states")
 
     def save_simulation(self, sim, current_round: int) -> None:
-        self.save(
+        self.save_simulation_snapshot(
             trees={
                 "server_state": sim.server_state,
                 "client_states": sim.client_states,
             },
+            current_round=current_round,
+            n_clients=sim.n_clients,
+            history=list(sim.history),
+        )
+
+    def save_simulation_snapshot(
+        self, trees, current_round: int, n_clients: int, history,
+        writer=None,
+    ) -> None:
+        """Persist an explicit state snapshot — the pipelined round loop's
+        entry point. ``trees`` must be caller-owned copies (host numpy under
+        the async pipeline: the live device buffers may be donated into the
+        next round before the write runs). With ``writer`` (an
+        ``AsyncCheckpointWriter``) the serialize+write happens off-thread;
+        saves stay ordered because the writer is single-worker."""
+        kwargs = dict(
+            trees=dict(trees),
             host={
                 "current_round": current_round,
-                "n_clients": sim.n_clients,
-                "history": sim.history,
+                "n_clients": n_clients,
+                "history": list(history),
             },
             snapshotters={"history": DataclassListSnapshotter()},
         )
+        if writer is not None:
+            writer.submit(self.save, **kwargs)
+        else:
+            self.save(**kwargs)
 
     def load_simulation(self, sim) -> int:
         """Restore in place; returns the next round to run (1-based)."""

@@ -23,6 +23,11 @@ from fl4health_tpu.core.types import PRNGKey
 
 
 class ClientManager:
+    """Subclasses expose ``fraction`` — the configured per-round sampling
+    fraction q — when the scheme has one; DP consumers (accountants, the
+    DP-FedAvgM coefficient scaling) read it at setup so the q they account
+    for is the q actually sampled."""
+
     def __init__(self, n_clients: int):
         self.n_clients = n_clients
 
@@ -36,6 +41,8 @@ class ClientManager:
 class FullParticipationManager(ClientManager):
     """sample_all semantics — every client every round."""
 
+    fraction = 1.0
+
     def sample(self, rng, round_idx):
         return self.sample_all()
 
@@ -46,6 +53,9 @@ class FixedFractionManager(ClientManager):
 
     def __init__(self, n_clients: int, fraction: float, min_clients: int = 1):
         super().__init__(n_clients)
+        # the CONFIGURED q (what a DP accountant composes with); the realized
+        # count k may round/floor away from q*n
+        self.fraction = fraction
         self.k = max(min_clients, int(fraction * n_clients))
 
     def sample(self, rng, round_idx):
@@ -76,6 +86,7 @@ class FixedSamplingManager(ClientManager):
 
     def __init__(self, n_clients: int, fraction: float = 1.0):
         super().__init__(n_clients)
+        self.fraction = fraction
         self.k = max(1, int(fraction * n_clients))
         self._cached: jax.Array | None = None
 

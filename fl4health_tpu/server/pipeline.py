@@ -1,0 +1,104 @@
+"""Async round pipeline — overlap host work with device execution.
+
+Round-5 VERDICT measured ~1.5 s of host Python per round against ~0.1 s of
+device busy time: the TPU sat idle while the driver loop did failure
+screening, checkpointing, record construction and reporter I/O between
+dispatches. FedJAX (arXiv:2108.02117) wins FL-simulation throughput by
+keeping the accelerator saturated across the round loop; these two helpers
+are the host half of that design for ``FederatedSimulation.fit``:
+
+- :class:`RoundConsumer` — a bounded single-worker queue that executes each
+  round's host-side epilogue (failure policy, checkpoint decisions,
+  ``RoundRecord`` construction, reporter fan-out) in a background thread
+  while the device already runs the next round. FIFO ordering is guaranteed
+  (one worker), ``flush()`` is a completion barrier, and the first exception
+  raised by round *r*'s epilogue (e.g. ``ClientFailuresError``) is re-raised
+  into the producer at the next ``submit``/``flush``.
+
+- :class:`RoundPrefetcher` — builds round *r+1*'s host-side index plan
+  (pure numpy) and stages its gathered batches on device while round *r*
+  executes. If ``set_train_data`` swapped the data stacks after staging
+  (a ``train_data_provider`` refresh), the staged gather is discarded and
+  re-issued against the fresh stacks — the *plan* (index math) is still
+  reused, so only the cheap device gather is re-paid.
+
+Neither helper touches device buffers that donation could invalidate: the
+consumer receives *result* arrays (fresh outputs, never donated back into a
+later round) or device-side snapshot copies; the prefetcher reads only the
+immutable per-round plan inputs and the data stacks it re-validates by
+identity.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any
+
+from fl4health_tpu.core.workqueue import SingleWorkerQueue
+
+
+class RoundConsumer(SingleWorkerQueue):
+    """Single-worker FIFO executor for per-round host epilogues.
+
+    ``maxsize`` bounds how many rounds of host work may be pending — the
+    producer blocks on ``submit`` once the device is that far ahead, so host
+    memory (result trees, checkpoint snapshots) stays bounded. Queue,
+    ordering, flush-barrier and exception contracts come from
+    :class:`~fl4health_tpu.core.workqueue.SingleWorkerQueue`.
+    """
+
+    def __init__(self, maxsize: int = 2, name: str = "fl-round-consumer"):
+        super().__init__(maxsize=maxsize, name=name)
+
+
+class RoundPrefetcher:
+    """Stage round *r+1*'s batches while round *r* executes.
+
+    ``schedule(r)`` computes the host index plan (numpy) and dispatches the
+    device gather in a worker thread; ``take(r)`` returns the staged batches,
+    falling back to synchronous construction on a miss. Staleness rule: if
+    the simulation's train stacks were swapped (``set_train_data``) between
+    staging and ``take``, the plan is re-gathered against the fresh stacks —
+    correctness over reuse.
+    """
+
+    def __init__(self, sim: Any):
+        self._sim = sim
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="fl-round-prefetch"
+        )
+        self._pending: tuple[int, Future] | None = None
+
+    def schedule(self, round_idx: int) -> None:
+        sim = self._sim
+        # capture the stacks NOW: take() compares by identity to detect a
+        # mid-flight set_train_data swap
+        x_stack, y_stack = sim._x_train_stack, sim._y_train_stack
+
+        def build():
+            from fl4health_tpu.clients import engine
+
+            plan = sim._round_plan(round_idx)
+            batches = engine.gather_batches(x_stack, y_stack, *plan)
+            return (x_stack, y_stack), plan, batches
+
+        self._pending = (round_idx, self._pool.submit(build))
+
+    def take(self, round_idx: int):
+        sim = self._sim
+        pending, self._pending = self._pending, None
+        if pending is None or pending[0] != round_idx:
+            return sim._round_batches(round_idx)
+        (x_stack, y_stack), plan, batches = pending[1].result()
+        if x_stack is sim._x_train_stack and y_stack is sim._y_train_stack:
+            return batches
+        # data refreshed after staging: same plan, fresh gather
+        from fl4health_tpu.clients import engine
+
+        return engine.gather_batches(
+            sim._x_train_stack, sim._y_train_stack, *plan
+        )
+
+    def close(self) -> None:
+        self._pending = None
+        self._pool.shutdown(wait=False, cancel_futures=True)

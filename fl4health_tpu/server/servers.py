@@ -95,7 +95,13 @@ def scaffold_warm_start(sim: FederatedSimulation) -> None:
     mask = jnp.ones((sim.n_clients,), jnp.float32)
     batches = sim._round_batches(0)
     val_batches, _ = sim._val_batches()
-    server_state, client_states, _, _, _ = sim._fit_round(
+    # A fresh NON-donating jit of the round program: warm start needs BOTH
+    # the pre-round states (rolled back below) and the warmed outputs, so
+    # sim._fit_round — which donates its state arguments and invalidates
+    # the passed-in buffers — cannot be used here. One extra compile,
+    # one-time cost at warm start.
+    fit_once = jax.jit(sim._fit_round_fn)
+    server_state, client_states, _, _, _ = fit_once(
         sim.server_state, sim.client_states, batches, mask,
         jnp.asarray(0, jnp.int32), val_batches,
     )
@@ -273,11 +279,16 @@ class EvaluateServer:
             # (evaluate_server.py loads from model checkpoint path).
             sim.server_state = sim.server_state.replace(params=self.params)
         val_batches, val_counts = sim._val_batches()
-        _, losses, metrics, per_losses, per_metrics = sim._eval_round(
+        # _eval_round donates the client stack — re-assign the returned one
+        # (value-identical modulo the pulled params) so the sim stays usable.
+        (
+            sim.client_states, losses, metrics, per_losses, per_metrics,
+        ) = sim._eval_round(
             sim.server_state, sim.client_states, val_batches, val_counts
         )
-        out_losses = {k: float(v) for k, v in jax.device_get(losses).items()}
-        out_metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        host = jax.device_get((losses, metrics))  # one fused transfer
+        out_losses = {k: float(v) for k, v in host[0].items()}
+        out_metrics = {k: float(v) for k, v in host[1].items()}
         return out_losses, out_metrics
 
 

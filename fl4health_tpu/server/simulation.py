@@ -20,6 +20,7 @@ responsibilities without any per-round serialize/deserialize.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import time
 from typing import Any, Callable, Sequence
@@ -29,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from fl4health_tpu.checkpointing.async_writer import AsyncCheckpointWriter
 from fl4health_tpu.checkpointing.checkpointer import CheckpointMode
 from fl4health_tpu.clients import engine
 from fl4health_tpu.observability import Observability
@@ -38,7 +40,61 @@ from fl4health_tpu.exchange.exchanger import FullExchanger
 from fl4health_tpu.metrics.aggregation import aggregate_metrics
 from fl4health_tpu.metrics.base import MetricManager
 from fl4health_tpu.server.client_manager import ClientManager, FullParticipationManager
+from fl4health_tpu.server.pipeline import RoundConsumer, RoundPrefetcher
 from fl4health_tpu.strategies.base import FitResults, Strategy
+
+# Execution modes fit() can run in (reported through observability and every
+# reporter's fit_start payload):
+# - "pipelined_per_round": one fit + one eval dispatch per round, with the
+#   host epilogue (failure policy, checkpointing, records, reporting) running
+#   in a background RoundConsumer and the next round's batches prefetched —
+#   host work overlaps device execution.
+# - "chunked_scan": ALL rounds compile into one on-device lax.scan dispatch
+#   (fit + eval per round inside the scan); per-round host work collapses to
+#   a single fused device->host pull at the end.
+EXEC_PIPELINED = "pipelined_per_round"
+EXEC_CHUNKED = "chunked_scan"
+
+
+def _donate_argnums(*argnums: int) -> tuple[int, ...]:
+    """Buffer donation, gated OFF the CPU backend.
+
+    Verified in this environment (jax 0.4.37, XLA:CPU, persistent
+    compilation cache enabled by tests/conftest.py): an executable compiled
+    WITH input-output aliasing computes correct results on the compile run
+    but WRONG numerics after being reloaded from the persistent cache
+    (A/B: the same program without donate_argnums round-trips exactly).
+    Donation on CPU saves nothing we need — the in-place client-stack
+    update is a device-memory lever — so CPU runs plain and TPU/GPU get
+    the donation. Re-evaluate when the jaxlib cache serializes aliasing
+    correctly."""
+    return argnums if jax.default_backend() != "cpu" else ()
+
+
+def _dedupe_donated(*trees):
+    """Break buffer aliasing inside trees about to be DONATED.
+
+    XLA rejects donating the same buffer twice (``f(donate(a), donate(a))``)
+    and Python-level state construction can legitimately alias — e.g. a
+    strategy ``init`` storing the initial params in two fields. Compiled
+    round OUTPUTS never alias (each output gets its own buffer), so one
+    dedupe at fit entry keeps every subsequent donated dispatch safe.
+    Returns the trees with later duplicates replaced by copies."""
+    seen: set = set()
+
+    def fix(x):
+        if not isinstance(x, jax.Array):
+            return x
+        try:
+            key = x.unsafe_buffer_pointer()
+        except Exception:  # sharded/committed arrays: object identity
+            key = id(x)
+        if key in seen:
+            return jnp.copy(x)
+        seen.add(key)
+        return x
+
+    return jax.tree_util.tree_map(fix, trees)
 
 
 @dataclasses.dataclass
@@ -81,9 +137,11 @@ class FailurePolicy:
         key = "backward" if "backward" in per_client_losses else None
         if key is None:
             return []
-        row = jnp.asarray(per_client_losses[key])
-        bad = jnp.logical_and(~jnp.isfinite(row), jnp.asarray(mask) > 0)
-        failed = [int(i) for i in jnp.nonzero(bad)[0]]
+        # pure numpy: the pipelined loop runs this on already-host data in a
+        # background thread — the screen must not dispatch device work
+        row = np.asarray(per_client_losses[key])
+        bad = np.logical_and(~np.isfinite(row), np.asarray(mask) > 0)
+        failed = [int(i) for i in np.nonzero(bad)[0]]
         for cid in failed:
             logging.getLogger(__name__).error(
                 "Client %d failed (non-finite training loss).", cid
@@ -105,6 +163,26 @@ class RoundRecord:
     eval_metrics: dict
     fit_elapsed_s: float
     eval_elapsed_s: float
+
+
+@dataclasses.dataclass
+class _RoundWork:
+    """Everything the RoundConsumer needs to finish one round on the host.
+
+    ``device_results`` holds fresh (never-donated) device arrays — round
+    results plus any ``_pre_agg_params``/``_post_agg_params``/
+    ``_state_trees`` device-side snapshot copies — and the consumer performs
+    the round's single fused device->host transfer of all of it."""
+
+    round: int
+    device_results: dict
+    fit_elapsed_s: float
+    eval_elapsed_s: float
+    device_wait_s: float
+    compiles_before: float
+    compile_s_before: float
+    compiles_after: float | None
+    compile_s_after: float | None
 
 
 class FederatedSimulation:
@@ -134,10 +212,17 @@ class FederatedSimulation:
         profile_dir: str | None = None,
         train_data_provider: Any = None,
         observability: Observability | None = None,
+        execution_mode: str = "auto",
+        pipeline_depth: int = 2,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
                              "(reference: utils/config.py epochs-xor-steps check)")
+        if execution_mode not in ("auto", "pipelined", "chunked"):
+            raise ValueError(
+                f"execution_mode must be 'auto', 'pipelined' or 'chunked'; "
+                f"got {execution_mode!r}"
+            )
         self.logic = logic
         self.tx = tx
         self.strategy = strategy
@@ -151,6 +236,12 @@ class FederatedSimulation:
         self.local_steps = local_steps
         self.exchanger = exchanger or FullExchanger()
         self.client_manager = client_manager or FullParticipationManager(self.n_clients)
+        # setup-time strategy <-> sampling-scheme validation (e.g. the DP
+        # strategies derive/check fraction_fit against the manager's sampling
+        # fraction — a mismatch silently mis-scales the DP noise).
+        bind = getattr(strategy, "bind_client_manager", None)
+        if bind is not None:
+            bind(self.client_manager)
         self.reporters = list(reporters)
         # (CheckpointMode, ParamsCheckpointer) pairs — PRE_AGGREGATION fires on
         # the client-stacked post-fit params, POST_AGGREGATION on the
@@ -191,6 +282,20 @@ class FederatedSimulation:
         # fresh patch extraction per round (nnunet.data.make_patch_resampler);
         # fit_chunk bakes its data at dispatch time and bypasses it.
         self.train_data_provider = train_data_provider
+        # fit() dispatch strategy: "auto" routes through the on-device
+        # multi-round chunked scan whenever the configuration permits (see
+        # _chunk_ineligibility) and falls back to the pipelined per-round
+        # path otherwise; "pipelined"/"chunked" force one path (forcing
+        # "chunked" on an ineligible config raises at fit()).
+        self.execution_mode = execution_mode
+        # How many rounds of host epilogue work may be in flight behind the
+        # device on the pipelined path (bounded RoundConsumer queue).
+        self.pipeline_depth = pipeline_depth
+        self._active_execution_mode = EXEC_PIPELINED
+        self._consumer: RoundConsumer | None = None
+        self._prefetcher: RoundPrefetcher | None = None
+        self._ckpt_writer: AsyncCheckpointWriter | None = None
+        self._fit_n_rounds = 0
         self.rng = jax.random.PRNGKey(seed)
         self.sample_counts = jnp.asarray(
             [d.n_train for d in self.datasets], jnp.float32
@@ -402,9 +507,22 @@ class FederatedSimulation:
             return new_states, agg_losses, agg_metrics, losses, metrics
 
         self._fit_round_fn = fit_round  # raw (un-jitted) for the chunked scan
-        self._fit_round = jax.jit(fit_round)
-        self._eval_round = jax.jit(eval_round)
+        self._eval_round_fn = eval_round
+        # Donation (mirroring fit_chunk's donate_argnums=(0,1), per
+        # arXiv:2004.13336's reuse-the-replica-buffers rule): the full
+        # client-weight stack and server state are updated IN PLACE each
+        # round instead of copied — halves the steady-state footprint of the
+        # big-cohort configs and removes an alloc+copy from the hot path.
+        # CONTRACT for every caller: treat the passed-in states as INVALID
+        # after the call — always replace them with the returned ones.
+        # (Donation is gated off the CPU backend — see _donate_argnums —
+        # but call sites must stay donation-safe for the TPU path.) eval
+        # donates only the client stack: its server_state flows on to
+        # update_after_eval/test-eval on the caller side.
+        self._fit_round = jax.jit(fit_round, donate_argnums=_donate_argnums(0, 1))
+        self._eval_round = jax.jit(eval_round, donate_argnums=_donate_argnums(1))
         self._chunked_fit = None  # compiled lazily by make_chunked_fit
+        self._chunked_fit_eval = None  # compiled lazily (fit()'s chunked route)
 
     def _extra_keys(self):
         # explicit constructor keys win; else the logic's declared keys
@@ -491,7 +609,7 @@ class FederatedSimulation:
         # buffers in place instead of allocating a second copy — on a 16GB
         # chip that halves the peak footprint of the big-cohort configs.
         # (No-op on CPU; data stacks are NOT donated.)
-        self._chunked_fit = jax.jit(chunk, donate_argnums=(0, 1))
+        self._chunked_fit = jax.jit(chunk, donate_argnums=_donate_argnums(0, 1))
         return self._chunked_fit
 
     def fit_chunk(self, start_round: int, k: int, mask=None):
@@ -538,12 +656,66 @@ class FederatedSimulation:
                 mask, (k,) + mask.shape
             )
         val_batches, _ = self._val_batches()
+        self.server_state, self.client_states = _dedupe_donated(
+            self.server_state, self.client_states
+        )
         self.server_state, self.client_states, losses, metrics = chunked(
             self.server_state, self.client_states,
             self._x_train_stack, self._y_train_stack, idx, em, sm, masks,
             jnp.asarray(start_round, jnp.int32), val_batches,
         )
         return losses, metrics
+
+    def _make_chunked_fit_with_eval(self):
+        """Compile fit()'s chunked route: a multi-round scan whose body runs
+        the SAME fit_round + eval_round (+ optional test eval) sequence as
+        one pipelined round — so a chunked fit() produces the same
+        RoundRecord trajectory as the per-round path, in ONE dispatch for
+        the whole run. Donates the carried states like make_chunked_fit."""
+        if self._chunked_fit_eval is not None:
+            return self._chunked_fit_eval
+        fit_round = self._fit_round_fn
+        eval_round = self._eval_round_fn
+
+        def chunk(server_state, client_states, x_stack, y_stack, idx, em, sm,
+                  masks, start_round, val_batches, val_counts,
+                  test_batches=None, test_counts=None):
+            def body(carry, per_round):
+                server_state, client_states, r = carry
+                idx_r, em_r, sm_r, mask_r = per_round
+                batches = engine.gather_batches(x_stack, y_stack, idx_r, em_r, sm_r)
+                server_state, client_states, fit_losses, fit_metrics, per_fit = (
+                    fit_round(server_state, client_states, batches, mask_r, r,
+                              val_batches)
+                )
+                # mirror _run_round: post-aggregation eval refreshes the
+                # client stack with the pulled global params
+                client_states, ev_losses, ev_metrics, _pl, _pm = eval_round(
+                    server_state, client_states, val_batches, val_counts
+                )
+                out = {
+                    "fit_losses": fit_losses,
+                    "fit_metrics": fit_metrics,
+                    "per_client_fit_losses": per_fit,
+                    "eval_losses": ev_losses,
+                    "eval_metrics": ev_metrics,
+                }
+                if test_batches is not None:
+                    _, t_losses, t_metrics, _, _ = eval_round(
+                        server_state, client_states, test_batches, test_counts
+                    )
+                    out["test_losses"] = t_losses
+                    out["test_metrics"] = t_metrics
+                return (server_state, client_states, r + 1), out
+
+            (server_state, client_states, _), outs = jax.lax.scan(
+                body, (server_state, client_states, start_round),
+                (idx, em, sm, masks),
+            )
+            return server_state, client_states, outs
+
+        self._chunked_fit_eval = jax.jit(chunk, donate_argnums=_donate_argnums(0, 1))
+        return self._chunked_fit_eval
 
     def _eval_split_batches(self, x_stack, y_stack, ns) -> tuple[Batch, jax.Array]:
         """Shared val/test eval batching: fixed-order full pass + counts —
@@ -582,6 +754,45 @@ class FederatedSimulation:
         return self._test_cache
 
     # ------------------------------------------------------------------
+    def _chunk_ineligibility(self) -> str | None:
+        """Why fit() may NOT route through the on-device chunked scan
+        (None = eligible). Anything that needs the host between rounds
+        forces the pipelined per-round path."""
+        if self.train_data_provider is not None:
+            return "train_data_provider needs a host data refresh every round"
+        if self.model_checkpointers:
+            return "per-round model checkpointing needs per-round host access"
+        if self.state_checkpointer is not None:
+            return "per-round durable state checkpointing (and resume)"
+        if not self.failure_policy.accept_failures:
+            return "accept_failures=False must be able to terminate mid-run"
+        if self.observability.enabled:
+            return ("observability needs per-round spans/fences "
+                    "(per-round dispatch keeps them meaningful)")
+        if type(self.strategy).update_after_eval is not Strategy.update_after_eval:
+            return ("strategy overrides update_after_eval (host-side "
+                    "per-round eval consumption)")
+        return None
+
+    def _select_execution_mode(self, n_rounds: int) -> tuple[str, str]:
+        """(mode, reason) for this fit() call. 'auto' prefers the chunked
+        scan (fastest: zero per-round host work) and falls back to the
+        pipelined path with the blocking reason attached."""
+        if n_rounds < 1:
+            # graceful no-op for every mode (the pipelined loop simply runs
+            # zero rounds) — fit(0) must not raise even when chunked is forced
+            return EXEC_PIPELINED, "n_rounds < 1 (no rounds to run)"
+        if self.execution_mode == "pipelined":
+            return EXEC_PIPELINED, "forced by execution_mode='pipelined'"
+        why = self._chunk_ineligibility()
+        if self.execution_mode == "chunked":
+            if why:
+                raise ValueError(f"execution_mode='chunked' but {why}")
+            return EXEC_CHUNKED, "forced by execution_mode='chunked'"
+        if why:
+            return EXEC_PIPELINED, why
+        return EXEC_CHUNKED, "auto: no per-round host dependencies"
+
     def fit(self, n_rounds: int) -> list[RoundRecord]:
         if self.profile_dir is not None:
             with jax.profiler.trace(self.profile_dir):
@@ -591,20 +802,22 @@ class FederatedSimulation:
     def _fit_loop(self, n_rounds: int) -> list[RoundRecord]:
         obs = self.observability
         obs.start()  # re-arm after a previous fit()'s shutdown (idempotent)
+        mode, mode_reason = self._select_execution_mode(n_rounds)
+        self._active_execution_mode = mode
+        logging.getLogger(__name__).info(
+            "fit: execution_mode=%s (%s)", mode, mode_reason
+        )
+        if obs.enabled:
+            obs.log_event("execution_mode", mode=mode, reason=mode_reason)
         for r in self.reporters:
             r.report({"host_type": "server", "fit_start": time.time(),
-                      "num_rounds": n_rounds})
-        with obs.span("setup", cat="fit"):
-            val_batches, val_counts = self._val_batches()
-            start_round = 1
-            if self.state_checkpointer is not None and self.state_checkpointer.exists():
-                # fit_with_per_round_checkpointing resume (base_server.py:143-229)
-                start_round = self.state_checkpointer.load_simulation(self)
+                      "num_rounds": n_rounds, "execution_mode": mode,
+                      "execution_mode_reason": mode_reason})
         try:
-            for rnd in range(start_round, n_rounds + 1):
-                # opt-in XProf capture of ONE chosen round (profile_round_idx)
-                with obs.maybe_profile(rnd):
-                    self._run_round(rnd, val_batches, val_counts)
+            if mode == EXEC_CHUNKED:
+                self._fit_chunked(n_rounds)
+            else:
+                self._fit_pipelined(n_rounds)
         finally:
             # shutdown (not just export) ALWAYS runs — even when a round
             # raises (ClientFailuresError): it detaches the compile monitor
@@ -620,14 +833,73 @@ class FederatedSimulation:
             rep.shutdown()
         return self.history
 
-    def _run_round(self, rnd: int, val_batches, val_counts) -> RoundRecord:
-        """One federated round: configure_fit -> fit_round -> aggregate ->
-        checkpoint -> eval_round -> checkpoint -> report, each phase under an
-        observability span (no-ops when disabled)."""
+    # -- pipelined per-round path --------------------------------------
+    def _fit_pipelined(self, n_rounds: int) -> None:
+        """The per-round path, pipelined: each round the producer (this
+        thread) dispatches fit+eval and hands the round's results — one
+        fused device tree plus any host snapshots donation would otherwise
+        invalidate — to a background RoundConsumer that runs the host
+        epilogue for round r while the device executes round r+1. The next
+        round's batches are prefetched concurrently."""
         obs = self.observability
-        # compile accounting baseline: delta over the round = recompiles
-        # (shape drift re-paying XLA compiles is THE classic round-loop bug)
+        with obs.span("setup", cat="fit"):
+            val_batches, val_counts = self._val_batches()
+            start_round = 1
+            if self.state_checkpointer is not None and self.state_checkpointer.exists():
+                # fit_with_per_round_checkpointing resume (base_server.py:143-229)
+                start_round = self.state_checkpointer.load_simulation(self)
+        self._fit_n_rounds = n_rounds
+        # the round program donates the states — break any Python-level
+        # buffer aliasing once; round outputs stay alias-free thereafter
+        self.server_state, self.client_states = _dedupe_donated(
+            self.server_state, self.client_states
+        )
+        consumer = self._consumer = RoundConsumer(maxsize=self.pipeline_depth)
+        prefetcher = self._prefetcher = RoundPrefetcher(self)
+        writer = None
+        if self.model_checkpointers or self.state_checkpointer is not None:
+            writer = self._ckpt_writer = AsyncCheckpointWriter()
+            for _mode, ckpt in self.model_checkpointers:
+                if hasattr(ckpt, "async_writer"):
+                    ckpt.async_writer = writer
+        try:
+            if start_round <= n_rounds:
+                prefetcher.schedule(start_round)
+            for rnd in range(start_round, n_rounds + 1):
+                consumer.raise_pending()
+                # opt-in XProf capture of ONE chosen round (profile_round_idx)
+                with obs.maybe_profile(rnd):
+                    self._run_round(rnd, val_batches, val_counts)
+            consumer.flush()  # barrier: every round's epilogue has run
+            if writer is not None:
+                writer.flush()  # ...and every checkpoint write is durable
+        finally:
+            consumer.close()
+            prefetcher.close()
+            if writer is not None:
+                writer.close()
+                for _mode, ckpt in self.model_checkpointers:
+                    if getattr(ckpt, "async_writer", None) is writer:
+                        ckpt.async_writer = None
+            self._consumer = None
+            self._prefetcher = None
+            self._ckpt_writer = None
+
+    def _run_round(self, rnd: int, val_batches, val_counts) -> None:
+        """Producer half of one federated round: configure_fit -> fit
+        dispatch -> eval dispatch, then submit the host epilogue
+        (_finish_round) to the RoundConsumer. All device_get of results
+        happens in the consumer (results are fresh outputs, never donated
+        into a later round, so they stay valid); only checkpoint/state
+        snapshots — whose buffers round r+1's donation WILL invalidate —
+        are pulled here."""
+        obs = self.observability
+        consumer = self._consumer
+        prefetcher = self._prefetcher
+        compiles_before = compile_s_before = 0.0
         if obs.enabled:
+            # compile accounting baseline: delta over the round = recompiles
+            # (shape drift re-paying XLA compiles is THE classic round-loop bug)
             compiles_before = obs.registry.counter("jax_backend_compiles_total").value
             compile_s_before = obs.registry.counter(
                 "jax_backend_compiles_seconds_total"
@@ -643,7 +915,11 @@ class FederatedSimulation:
                 mask = self.client_manager.sample(
                     jax.random.fold_in(self.rng, 2000 + rnd), rnd
                 )
-                batches = self._round_batches(rnd)
+                batches = (prefetcher.take(rnd) if prefetcher is not None
+                           else self._round_batches(rnd))
+            if prefetcher is not None and rnd < self._fit_n_rounds:
+                # stage round r+1's plan+gather while round r executes
+                prefetcher.schedule(rnd + 1)
             with obs.span("fit_round", round=rnd) as fit_span:
                 (
                     self.server_state,
@@ -663,22 +939,25 @@ class FederatedSimulation:
                 )
                 device_wait_s += wait
                 fit_span.set(device_wait_s=wait)
-            with obs.span("aggregate", round=rnd):
-                # Failure policy screen (base_server.py:316-318): terminate
-                # before checkpointing a poisoned aggregate when
-                # accept_failures=False.
-                host_fit_losses = jax.device_get(per_client_fit_losses)
-                failed = self.failure_policy.check(host_fit_losses, mask)
-                fit_losses = {k: float(v) for k, v in jax.device_get(fit_losses).items()}
-                fit_metrics = {k: float(v) for k, v in jax.device_get(fit_metrics).items()}
-            with obs.span("checkpoint", round=rnd, mode="pre_aggregation"):
-                for mode, ckpt in self.model_checkpointers:
-                    if mode == CheckpointMode.PRE_AGGREGATION:
-                        ckpt.maybe_checkpoint(
-                            self.client_states.params,
-                            fit_losses.get("backward", float("nan")),
-                            fit_metrics,
-                        )
+            need_pre = any(m == CheckpointMode.PRE_AGGREGATION
+                           for m, _ in self.model_checkpointers)
+            need_post = any(m == CheckpointMode.POST_AGGREGATION
+                            for m, _ in self.model_checkpointers)
+            snapshot_state = (
+                self.state_checkpointer is not None
+                and hasattr(self.state_checkpointer, "save_simulation_snapshot")
+            )
+            pre_agg_params = None
+            if need_pre:
+                # post-fit client-stacked params (client_module.py:23-28
+                # PRE_AGGREGATION semantics) — DEVICE-side copy (async, no
+                # host sync) taken BEFORE eval overwrites the stack with the
+                # pulled globals; the copy's fresh buffers are never donated,
+                # so the consumer's fused transfer can pull them later
+                with obs.span("state_snapshot", round=rnd, what="pre_agg"):
+                    pre_agg_params = jax.tree_util.tree_map(
+                        jnp.copy, self.client_states.params
+                    )
             t1 = time.time()
             with obs.span("eval_round", round=rnd) as eval_span:
                 (
@@ -695,74 +974,287 @@ class FederatedSimulation:
                     per_client_eval_metrics, mask
                 )
                 _, eval_wait = obs.fence((eval_losses, eval_metrics))
-                eval_losses = {k: float(v) for k, v in jax.device_get(eval_losses).items()}
-                eval_metrics = {k: float(v) for k, v in jax.device_get(eval_metrics).items()}
                 test = self._test_batches()
+                test_losses = test_metrics = None
                 if test is not None:
                     # Separate test loader: same aggregated model, "test - "
-                    # prefixed keys alongside the val metrics (base_server.py:545).
-                    _, test_losses, test_metrics, _, _ = self._eval_round(
+                    # prefixed keys alongside the val metrics
+                    # (base_server.py:545). The returned stack is
+                    # value-identical to the val-eval one (pull is
+                    # idempotent) but must be re-assigned: the input stack
+                    # was donated.
+                    (
+                        self.client_states, test_losses, test_metrics, _, _,
+                    ) = self._eval_round(
                         self.server_state, self.client_states, test[0], test[1]
                     )
                     # fence the test dispatch too — its device time belongs
                     # in device_wait_s, not misattributed to host_s
                     _, test_wait = obs.fence((test_losses, test_metrics))
                     eval_wait += test_wait
-                    eval_losses.update({
-                        f"test - {k}": float(v)
-                        for k, v in jax.device_get(test_losses).items()
-                    })
-                    eval_metrics.update({
-                        f"test - {k}": float(v)
-                        for k, v in jax.device_get(test_metrics).items()
-                    })
                 device_wait_s += eval_wait
                 eval_span.set(device_wait_s=eval_wait)
-            with obs.span("checkpoint", round=rnd, mode="post_aggregation"):
-                for mode, ckpt in self.model_checkpointers:
-                    if mode == CheckpointMode.POST_AGGREGATION:
-                        ckpt.maybe_checkpoint(
-                            self.global_params,
-                            eval_losses.get("checkpoint", float("nan")),
-                            eval_metrics,
+            post_agg_params = None
+            state_trees = None
+            if need_post or snapshot_state:
+                # device-side copies only (async): the producer never blocks
+                # on a transfer — the consumer's fused device_get pulls these
+                # fresh (never-donated) buffers off-thread
+                with obs.span("state_snapshot", round=rnd, what="post_agg"):
+                    if need_post:
+                        post_agg_params = jax.tree_util.tree_map(
+                            jnp.copy, self.global_params
+                        )
+                    if snapshot_state:
+                        state_trees = jax.tree_util.tree_map(
+                            jnp.copy,
+                            {"server_state": self.server_state,
+                             "client_states": self.client_states},
                         )
             t2 = time.time()
-            rec = RoundRecord(
+            compiles_after = compile_s_after = None
+            if obs.enabled:
+                # all of round r's compiles happened at dispatch, above; read
+                # the counters HERE so a pipelined consumer can't misattribute
+                # round r+1's (hypothetical) recompile to round r
+                compiles_after = obs.registry.counter(
+                    "jax_backend_compiles_total").value
+                compile_s_after = obs.registry.counter(
+                    "jax_backend_compiles_seconds_total").value
+            device_results = {
+                "mask": mask,
+                "fit_losses": fit_losses,
+                "fit_metrics": fit_metrics,
+                "per_client_fit_losses": per_client_fit_losses,
+                "eval_losses": eval_losses,
+                "eval_metrics": eval_metrics,
+            }
+            if test_losses is not None:
+                device_results["test_losses"] = test_losses
+                device_results["test_metrics"] = test_metrics
+            # snapshots ride the SAME fused transfer (keys the consumer pops
+            # before the results are read)
+            if pre_agg_params is not None:
+                device_results["_pre_agg_params"] = pre_agg_params
+            if post_agg_params is not None:
+                device_results["_post_agg_params"] = post_agg_params
+            if state_trees is not None:
+                device_results["_state_trees"] = state_trees
+            work = _RoundWork(
                 round=rnd,
-                fit_losses={k: float(v) for k, v in fit_losses.items()},
-                fit_metrics={k: float(v) for k, v in fit_metrics.items()},
-                eval_losses={k: float(v) for k, v in eval_losses.items()},
-                eval_metrics={k: float(v) for k, v in eval_metrics.items()},
+                device_results=device_results,
                 fit_elapsed_s=t1 - t0,
                 eval_elapsed_s=t2 - t1,
+                device_wait_s=device_wait_s,
+                compiles_before=compiles_before,
+                compile_s_before=compile_s_before,
+                compiles_after=compiles_after,
+                compile_s_after=compile_s_after,
+            )
+            if consumer is not None:
+                consumer.submit(functools.partial(self._finish_round, work))
+                legacy_state_save = (
+                    self.state_checkpointer is not None and not snapshot_state
+                )
+                if legacy_state_save or not self.failure_policy.accept_failures:
+                    # Correctness over overlap, two cases:
+                    # - legacy sim-based checkpointer API (save_simulation
+                    #   only): it reads LIVE sim state + history, so the
+                    #   producer must not run ahead of the save;
+                    # - accept_failures=False: the failure screen runs in the
+                    #   epilogue and must be able to terminate BEFORE the
+                    #   next round dispatches/mutates state, exactly like the
+                    #   old inline loop.
+                    consumer.flush()
+            else:
+                # no pipeline (direct calls in tests) — run inline
+                self._finish_round(work)
+
+    def _finish_round(self, work: "_RoundWork") -> None:
+        """Consumer half of one round: ONE fused device->host transfer of
+        the results tree, then failure-policy screen, checkpoint decisions,
+        RoundRecord construction and reporter I/O — all while the device
+        executes later rounds. Runs on the RoundConsumer thread in
+        submission (= round) order."""
+        obs = self.observability
+        rnd = work.round
+        # the single fused pull this round pays (replaces ~8 scattered
+        # device_get/float() syncs in the old loop)
+        host = jax.device_get(work.device_results)
+        mask = np.asarray(host["mask"])
+        pre_agg_params = host.pop("_pre_agg_params", None)
+        post_agg_params = host.pop("_post_agg_params", None)
+        state_trees = host.pop("_state_trees", None)
+        with obs.span("aggregate", round=rnd):
+            # Failure policy screen (base_server.py:316-318): terminate
+            # before checkpointing a poisoned aggregate when
+            # accept_failures=False.
+            host_fit_losses = host["per_client_fit_losses"]
+            failed = self.failure_policy.check(host_fit_losses, mask)
+            fit_losses = {k: float(v) for k, v in host["fit_losses"].items()}
+            fit_metrics = {k: float(v) for k, v in host["fit_metrics"].items()}
+            eval_losses = {k: float(v) for k, v in host["eval_losses"].items()}
+            eval_metrics = {k: float(v) for k, v in host["eval_metrics"].items()}
+            if "test_losses" in host:
+                eval_losses.update({
+                    f"test - {k}": float(v)
+                    for k, v in host["test_losses"].items()
+                })
+                eval_metrics.update({
+                    f"test - {k}": float(v)
+                    for k, v in host["test_metrics"].items()
+                })
+        with obs.span("checkpoint", round=rnd, mode="pre_aggregation"):
+            for mode, ckpt in self.model_checkpointers:
+                if mode == CheckpointMode.PRE_AGGREGATION:
+                    ckpt.maybe_checkpoint(
+                        pre_agg_params,
+                        fit_losses.get("backward", float("nan")),
+                        fit_metrics,
+                    )
+        with obs.span("checkpoint", round=rnd, mode="post_aggregation"):
+            for mode, ckpt in self.model_checkpointers:
+                if mode == CheckpointMode.POST_AGGREGATION:
+                    ckpt.maybe_checkpoint(
+                        post_agg_params,
+                        eval_losses.get("checkpoint", float("nan")),
+                        eval_metrics,
+                    )
+        rec = RoundRecord(
+            round=rnd,
+            fit_losses=fit_losses,
+            fit_metrics=fit_metrics,
+            eval_losses=eval_losses,
+            eval_metrics=eval_metrics,
+            fit_elapsed_s=work.fit_elapsed_s,
+            eval_elapsed_s=work.eval_elapsed_s,
+        )
+        self.history.append(rec)
+        if self.state_checkpointer is not None:
+            # per-round durable state (_save_server_state, base_server.py:420)
+            with obs.span("checkpoint", round=rnd, mode="state"):
+                if state_trees is not None:
+                    self.state_checkpointer.save_simulation_snapshot(
+                        state_trees, rnd, self.n_clients,
+                        list(self.history), writer=self._ckpt_writer,
+                    )
+                else:
+                    # legacy sim-based API: reads live sim state — safe ONLY
+                    # because the producer flushes this round's epilogue
+                    # before dispatching the next round (see _run_round)
+                    self.state_checkpointer.save_simulation(self, rnd)
+        obs_summary = None
+        if obs.enabled:
+            obs_summary = self._record_round_metrics(
+                rnd, rec, mask, host_fit_losses, failed,
+                work.compiles_before, work.compile_s_before,
+                work.device_wait_s,
+                compiles_after=work.compiles_after,
+                compile_s_after=work.compile_s_after,
+            )
+        with obs.span("report", round=rnd):
+            for rep in self.reporters:
+                payload = {
+                    "fit_losses": rec.fit_losses,
+                    "fit_metrics": rec.fit_metrics,
+                    "eval_losses": rec.eval_losses,
+                    "eval_metrics": rec.eval_metrics,
+                    "fit_elapsed_s": rec.fit_elapsed_s,
+                    "eval_elapsed_s": rec.eval_elapsed_s,
+                    "execution_mode": self._active_execution_mode,
+                }
+                if obs_summary is not None:
+                    # same data the registry/trace hold, bridged through
+                    # ReportsManager so JsonReporter/WandBReporter see it
+                    payload["observability"] = dict(obs_summary)
+                rep.report(payload, round=rnd)
+
+    # -- chunked on-device path ----------------------------------------
+    def _fit_chunked(self, n_rounds: int) -> None:
+        """fit()'s chunked route: ALL rounds execute in one compiled
+        lax.scan dispatch (fit + eval per round on device), then ONE fused
+        device->host pull materializes every RoundRecord. Per-round host
+        overhead collapses to the record/report loop at the end. Per-round
+        participation masks come from the same PRNG stream as the pipelined
+        path, so the trajectories match."""
+        obs = self.observability
+        t_start = time.time()
+        val_batches, val_counts = self._val_batches()
+        test = self._test_batches()
+        chunked = self._make_chunked_fit_with_eval()
+        self.server_state, self.client_states = _dedupe_donated(
+            self.server_state, self.client_states
+        )
+        plans = [self._round_plan(r) for r in range(1, n_rounds + 1)]
+        idx = jnp.asarray(np.stack([p[0] for p in plans]))
+        em = jnp.asarray(np.stack([p[1] for p in plans]))
+        sm = jnp.asarray(np.stack([p[2] for p in plans]))
+        mask_stack = jnp.stack([
+            self.client_manager.sample(
+                jax.random.fold_in(self.rng, 2000 + r), r
+            )
+            for r in range(1, n_rounds + 1)
+        ])
+        masks_np = np.asarray(mask_stack)
+        args = [self.server_state, self.client_states,
+                self._x_train_stack, self._y_train_stack, idx, em, sm,
+                mask_stack, jnp.asarray(1, jnp.int32), val_batches, val_counts]
+        if test is not None:
+            args.extend(test)
+        with obs.span("fit_chunk", cat="fit", rounds=n_rounds):
+            self.server_state, self.client_states, outs = chunked(*args)
+            stacked = jax.device_get(outs)  # the run's ONE fused host pull
+        per_round_s = (time.time() - t_start) / max(n_rounds, 1)
+        for i in range(n_rounds):
+            rnd = i + 1
+            per_fit_i = {
+                k: v[i] for k, v in stacked["per_client_fit_losses"].items()
+            }
+            # logs per-round failures; cannot terminate (eligibility
+            # guarantees accept_failures=True on this path)
+            self.failure_policy.check(per_fit_i, masks_np[i])
+            eval_losses = {
+                k: float(v[i]) for k, v in stacked["eval_losses"].items()
+            }
+            eval_metrics = {
+                k: float(v[i]) for k, v in stacked["eval_metrics"].items()
+            }
+            if "test_losses" in stacked:
+                eval_losses.update({
+                    f"test - {k}": float(v[i])
+                    for k, v in stacked["test_losses"].items()
+                })
+                eval_metrics.update({
+                    f"test - {k}": float(v[i])
+                    for k, v in stacked["test_metrics"].items()
+                })
+            rec = RoundRecord(
+                round=rnd,
+                fit_losses={
+                    k: float(v[i]) for k, v in stacked["fit_losses"].items()
+                },
+                fit_metrics={
+                    k: float(v[i]) for k, v in stacked["fit_metrics"].items()
+                },
+                eval_losses=eval_losses,
+                eval_metrics=eval_metrics,
+                # one dispatch covers the whole run: report the amortized
+                # per-round wall; there is no separable eval wall on-device
+                fit_elapsed_s=per_round_s,
+                eval_elapsed_s=0.0,
             )
             self.history.append(rec)
-            if self.state_checkpointer is not None:
-                # per-round durable state (_save_server_state, base_server.py:420)
-                with obs.span("checkpoint", round=rnd, mode="state"):
-                    self.state_checkpointer.save_simulation(self, rnd)
-            obs_summary = None
-            if obs.enabled:
-                obs_summary = self._record_round_metrics(
-                    rnd, rec, mask, host_fit_losses, failed,
-                    compiles_before, compile_s_before, device_wait_s,
-                )
-            with obs.span("report", round=rnd):
-                for rep in self.reporters:
-                    payload = {
-                        "fit_losses": rec.fit_losses,
-                        "fit_metrics": rec.fit_metrics,
-                        "eval_losses": rec.eval_losses,
-                        "eval_metrics": rec.eval_metrics,
-                        "fit_elapsed_s": rec.fit_elapsed_s,
-                        "eval_elapsed_s": rec.eval_elapsed_s,
-                    }
-                    if obs_summary is not None:
-                        # same data the registry/trace hold, bridged through
-                        # ReportsManager so JsonReporter/WandBReporter see it
-                        payload["observability"] = dict(obs_summary)
-                    rep.report(payload, round=rnd)
-        return rec
+            for rep in self.reporters:
+                rep.report({
+                    "fit_losses": rec.fit_losses,
+                    "fit_metrics": rec.fit_metrics,
+                    "eval_losses": rec.eval_losses,
+                    "eval_metrics": rec.eval_metrics,
+                    "fit_elapsed_s": rec.fit_elapsed_s,
+                    "eval_elapsed_s": rec.eval_elapsed_s,
+                    "execution_mode": EXEC_CHUNKED,
+                }, round=rnd)
+
 
     def _payload_nbytes(self) -> tuple[int, int]:
         """(broadcast, gather) logical payload bytes per participating client
@@ -792,9 +1284,17 @@ class FederatedSimulation:
     def _record_round_metrics(
         self, rnd: int, rec: RoundRecord, mask, host_fit_losses, failed,
         compiles_before: float, compile_s_before: float, device_wait_s: float,
+        *, compiles_after: float | None = None,
+        compile_s_after: float | None = None,
     ) -> dict:
         """Per-round gauges/counters + one JSONL ``round`` event; returns the
-        summary dict bridged into every reporter."""
+        summary dict bridged into every reporter.
+
+        ``compiles_after``/``compile_s_after``: counter readings taken by the
+        PRODUCER right after the round's dispatches. Under the pipelined loop
+        this method runs on the consumer thread while later rounds dispatch;
+        reading the live counters here would misattribute their compiles to
+        this round, so the producer-captured values win when provided."""
         reg = self.observability.registry
         mask_np = np.asarray(mask)
         participants = int((mask_np > 0).sum())
@@ -830,12 +1330,16 @@ class FederatedSimulation:
             "fl_gather_bytes_total",
             help="logical client->server payload bytes",
         ).inc(gather)
+        if compiles_after is None:
+            compiles_after = reg.counter("jax_backend_compiles_total").value
+        if compile_s_after is None:
+            compile_s_after = reg.counter(
+                "jax_backend_compiles_seconds_total").value
         summary = {
             "round": rnd,
-            "compiles": reg.counter("jax_backend_compiles_total").value
-            - compiles_before,
-            "compile_s": reg.counter("jax_backend_compiles_seconds_total").value
-            - compile_s_before,
+            "execution_mode": self._active_execution_mode,
+            "compiles": compiles_after - compiles_before,
+            "compile_s": compile_s_after - compile_s_before,
             "device_wait_s": device_wait_s,
             "fit_s": rec.fit_elapsed_s,
             "eval_s": rec.eval_elapsed_s,

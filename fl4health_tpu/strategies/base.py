@@ -50,6 +50,12 @@ class Strategy:
     weighted_aggregation: bool = True
     weighted_eval_aggregation: bool = True
 
+    def bind_client_manager(self, client_manager: Any) -> None:
+        """Setup-time hook: FederatedSimulation calls this with its client
+        manager before training so a strategy can derive/validate sampling
+        assumptions (e.g. DP-FedAvgM's ``fraction_fit`` against the
+        manager's sampling fraction). Runs host-side once; default no-op."""
+
     def init(self, params: Params) -> Any:
         """Build initial server state from initial model params."""
         raise NotImplementedError

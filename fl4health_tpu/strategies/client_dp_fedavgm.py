@@ -30,9 +30,35 @@ of arXiv 1905.03871): z_delta = (z^-2 - (2 z_b)^-2)^(-1/2), so the privacy
 accountant's z covers both the noised update and the noised clipping bit.
 Applied only when both z and z_b are positive (z=0 configs stay
 deterministic for tests; the reference crashes on those inputs).
+
+**Deliberate divergence — adaptive-clipping bound-update ordering.** This
+implementation computes the round's noise scale sigma from the PRE-round
+clipping bound C_t and only then applies the geometric bound update to
+produce C_{t+1} (both inside one compiled ``aggregate``). The reference
+interleaves differently: it updates the bound from the incoming clipping
+bits *before* building the next broadcast, so the sigma its server applies
+in round t can reflect a partially-updated bound depending on call order.
+The pre-round-bound convention here is the standard reading of
+arXiv 1905.03871 Alg. 1 (noise calibrated to the bound the clients actually
+clipped with) and is self-consistent: clients clip round t's update with
+C_t, and sigma_t = z * C_t * (...) matches that sensitivity exactly. Do not
+expect bitwise parity with the reference on adaptive-clipping runs; the
+accounting (epsilon) is unaffected because z, not C, drives it.
+
+**Sampling-fraction coupling.** With ``weighted_aggregation=True`` the
+per-client coefficients divide by the sampling fraction q
+(``fraction_fit``). If the configured q does not equal the client manager's
+actual sampling fraction, sigma is mis-scaled by their ratio versus the
+logged epsilon — e.g. leaving the old default q=1 while a manager samples
+q=0.25 under-scales the noise 4x. ``fraction_fit`` therefore defaults to
+None = "derive from the client manager at setup"
+(``bind_client_manager``), and an explicitly configured value is asserted
+equal to the manager's fraction when weighted aggregation is on.
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -69,7 +95,7 @@ class ClientLevelDPFedAvgM(Strategy):
         clipping_learning_rate: float = 0.2,
         clipping_quantile: float = 0.5,
         weighted_aggregation: bool = False,
-        fraction_fit: float = 1.0,
+        fraction_fit: float | None = None,
         per_client_example_cap: float | None = None,
         seed: int = 0,
     ):
@@ -81,16 +107,57 @@ class ClientLevelDPFedAvgM(Strategy):
         self.lr_c = clipping_learning_rate
         self.quantile = clipping_quantile
         self.weighted_aggregation = weighted_aggregation
+        # None = derive from the client manager at bind_client_manager (the
+        # FederatedSimulation setup hook); standalone use falls back to 1.0.
         self.fraction_fit = fraction_fit
         self.example_cap = per_client_example_cap
         self.seed = seed
         # fail at construction, not mid-round (ref client_dp_fedavgm.py:195)
         self.effective_noise_multiplier()
-        if weighted_aggregation and not fraction_fit > 0.0:
+        if (weighted_aggregation and fraction_fit is not None
+                and not fraction_fit > 0.0):
             raise ValueError(
                 f"fraction_fit must be positive, got {fraction_fit}: the "
                 "weighted coefficients divide by it"
             )
+
+    def bind_client_manager(self, client_manager) -> None:
+        """Derive (or validate) the sampling fraction q from the client
+        manager actually used (ADVICE round 5): with q<1 sampling, the old
+        default q=1 under-scales sigma by 1/q versus the logged epsilon."""
+        fraction = getattr(client_manager, "fraction", None)
+        if self.fraction_fit is None:
+            if self.weighted_aggregation and fraction is None:
+                raise ValueError(
+                    f"{type(client_manager).__name__} exposes no sampling "
+                    "fraction; pass fraction_fit explicitly so the weighted "
+                    "DP coefficients (and sigma) are scaled by the true q"
+                )
+            if (self.weighted_aggregation and fraction is not None
+                    and not float(fraction) > 0.0):
+                # same rejection the constructor applies to an explicit
+                # value: the weighted coefficients divide by q
+                raise ValueError(
+                    f"client manager sampling fraction {float(fraction)} is "
+                    "not positive; the weighted DP coefficients divide by it"
+                )
+            self.fraction_fit = float(fraction) if fraction is not None else 1.0
+        elif (self.weighted_aggregation and fraction is not None
+              and not math.isclose(self.fraction_fit, float(fraction),
+                                   rel_tol=1e-9, abs_tol=1e-12)):
+            raise ValueError(
+                f"fraction_fit={self.fraction_fit} does not match the client "
+                f"manager's sampling fraction {float(fraction)}; with "
+                "weighted_aggregation the coefficients divide by q, so a "
+                "mismatch mis-scales sigma by their ratio vs the logged "
+                "epsilon (omit fraction_fit to derive it from the manager)"
+            )
+
+    @property
+    def _q(self) -> float:
+        """The sampling fraction used in the weighted coefficients; 1.0 when
+        never bound to a manager (standalone full-participation use)."""
+        return 1.0 if self.fraction_fit is None else self.fraction_fit
 
     def effective_noise_multiplier(self) -> float:
         """The update-noise multiplier actually applied to delta_bar.
@@ -143,7 +210,7 @@ class ClientLevelDPFedAvgM(Strategy):
                    else jnp.asarray(self.example_cap, jnp.float32))
             w = jnp.minimum(counts / jnp.maximum(cap, 1.0), 1.0)
             total_w = jnp.maximum(jnp.sum(w), 1e-12)
-            coef = w / (self.fraction_fit * total_w)
+            coef = w / (self._q * total_w)
 
             def weighted_sum(stacked):
                 cc = (coef * results.mask).reshape(
@@ -155,7 +222,7 @@ class ClientLevelDPFedAvgM(Strategy):
             # sensitivity of the coefficient-scaled sum is C*max(w)/q; the
             # reference's final 1/n normalization applies to noise too
             sigma = (z_eff * server_state.clipping_bound * max_w
-                     / self.fraction_fit / n_sampled)
+                     / self._q / n_sampled)
         else:
             # unweighted masked mean of clipped deltas
             def mean_delta(stacked):

@@ -1,0 +1,93 @@
+"""AsyncCheckpointWriter: ordered off-thread persists, flush durability,
+exception propagation, and the ParamsCheckpointer._persist routing."""
+
+import numpy as np
+import pytest
+
+from fl4health_tpu.checkpointing.async_writer import AsyncCheckpointWriter
+from fl4health_tpu.checkpointing.checkpointer import (
+    BestLossCheckpointer,
+    LatestCheckpointer,
+    load_params,
+)
+
+
+def _params(v: float):
+    return {"w": np.full((3,), v, np.float32)}
+
+
+def test_submit_save_is_durable_after_flush(tmp_path):
+    w = AsyncCheckpointWriter()
+    path = str(tmp_path / "p.msgpack")
+    w.submit_save(path, _params(1.5))
+    w.flush()
+    loaded = load_params(path, _params(0.0))
+    np.testing.assert_allclose(loaded["w"], 1.5)
+    w.close()
+
+
+def test_writes_stay_ordered_latest_wins(tmp_path):
+    # single worker => FIFO: the last submitted round's artifact is on disk
+    w = AsyncCheckpointWriter(maxsize=2)
+    path = str(tmp_path / "latest.msgpack")
+    for v in range(8):
+        w.submit_save(path, _params(float(v)))
+    w.flush()
+    w.close()
+    np.testing.assert_allclose(load_params(path, _params(0.0))["w"], 7.0)
+
+
+def test_exception_propagates_once_and_skips_later_jobs(tmp_path):
+    w = AsyncCheckpointWriter()
+    ran = []
+
+    def boom():
+        raise OSError("disk full")
+
+    w.submit(boom)
+    w._queue.join()
+    with pytest.raises(OSError, match="disk full"):
+        w.submit(lambda: ran.append(1))
+    w.flush()  # exception already consumed; flush is clean
+    assert ran == []
+    w.close()
+
+
+def test_close_is_idempotent_and_rejects_after(tmp_path):
+    w = AsyncCheckpointWriter()
+    w.close()
+    w.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit_save(str(tmp_path / "x"), _params(0.0))
+
+
+def test_checkpointer_routes_persist_through_attached_writer(tmp_path):
+    w = AsyncCheckpointWriter()
+    ck = LatestCheckpointer(str(tmp_path / "m.msgpack"))
+    ck.async_writer = w
+    assert ck.maybe_checkpoint(_params(3.0), 0.5, {})
+    w.flush()
+    np.testing.assert_allclose(
+        load_params(ck.path, _params(0.0))["w"], 3.0
+    )
+    # detach -> synchronous persist again
+    ck.async_writer = None
+    ck.maybe_checkpoint(_params(4.0), 0.4, {})
+    np.testing.assert_allclose(
+        load_params(ck.path, _params(0.0))["w"], 4.0
+    )
+    w.close()
+
+
+def test_best_loss_decision_unaffected_by_async_routing(tmp_path):
+    w = AsyncCheckpointWriter()
+    ck = BestLossCheckpointer(str(tmp_path / "best.msgpack"))
+    ck.async_writer = w
+    assert ck.maybe_checkpoint(_params(1.0), 1.0, {})
+    assert not ck.maybe_checkpoint(_params(2.0), 2.0, {})  # worse: no write
+    assert ck.maybe_checkpoint(_params(3.0), 0.5, {})
+    w.flush()
+    w.close()
+    np.testing.assert_allclose(
+        load_params(ck.path, _params(0.0))["w"], 3.0
+    )

@@ -87,7 +87,9 @@ def test_scaffold_variate_math_single_client_single_step():
         logic=logic, tx=optax.sgd(lr), strategy=Scaffold(),
         datasets=ds, batch_size=8, metrics=_metrics(), local_steps=1, seed=0,
     )
-    params_before = sim.global_params
+    # host snapshot: fit() donates the server state, so a live reference to
+    # the pre-fit params would be invalidated by the first round
+    params_before = jax.device_get(sim.global_params)
     sim.fit(1)
     y_after = sim.global_params
     cv = sim.server_state.control_variates

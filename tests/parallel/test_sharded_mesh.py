@@ -58,12 +58,15 @@ def _sim(logic, strategy, tx=None, exchanger=None):
 
 
 def _run_round(sim, shard_mesh=None):
-    """One _fit_round; optionally with all client-axis inputs sharded."""
+    """One _fit_round; optionally with all client-axis inputs sharded.
+
+    _fit_round DONATES its state arguments — hand it copies so the sim's own
+    buffers survive for the second (sharded) arm of each comparison."""
     mask = sim.client_manager.sample_all()
     batches = sim._round_batches(1)
     val_batches, _ = sim._val_batches()
-    client_states = sim.client_states
-    server_state = sim.server_state
+    client_states = jax.tree_util.tree_map(jnp.copy, sim.client_states)
+    server_state = jax.tree_util.tree_map(jnp.copy, sim.server_state)
     if shard_mesh is not None:
         client_states = meshlib.shard_over_clients(client_states, shard_mesh)
         server_state = meshlib.replicate(server_state, shard_mesh)
@@ -176,8 +179,12 @@ def test_partial_participation_sharded(eight_devices):
     batches = sim._round_batches(1)
     val_batches, _ = sim._val_batches()
 
+    # copies: _fit_round donates its state args and the 8d arm below still
+    # needs the sim's buffers
     out_1d = sim._fit_round(
-        sim.server_state, sim.client_states, batches, mask,
+        jax.tree_util.tree_map(jnp.copy, sim.server_state),
+        jax.tree_util.tree_map(jnp.copy, sim.client_states),
+        batches, mask,
         jnp.asarray(1, jnp.int32), val_batches,
     )
     out_8d = sim._fit_round(

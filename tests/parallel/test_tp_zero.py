@@ -57,7 +57,10 @@ def _run_round(sim, place=None):
     mask = sim.client_manager.sample_all()
     batches = sim._round_batches(1)
     val_batches, _ = sim._val_batches()
-    client_states, server_state = sim.client_states, sim.server_state
+    # copies: _fit_round donates its state args on accelerator backends and
+    # each test calls _run_round twice on the same sim
+    client_states = jax.tree_util.tree_map(jnp.copy, sim.client_states)
+    server_state = jax.tree_util.tree_map(jnp.copy, sim.server_state)
     if place is not None:
         client_states, server_state, batches, val_batches, mask = place(
             client_states, server_state, batches, val_batches, mask

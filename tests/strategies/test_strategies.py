@@ -306,3 +306,95 @@ def test_fed_yogi_and_adagrad_aggregate_finitely_and_learn_direction():
         w = np.asarray(strat.global_params(state)["w"])
         assert np.all(np.isfinite(w))
         assert w[0] > 0 and w[1] < 0, f"{make.__name__} moved wrong way: {w}"
+
+
+# ---------------------------------------------------------------------------
+# DP-FedAvgM sampling-fraction coupling (ADVICE round 5): fraction_fit is
+# derived from the client manager at setup, and an explicit mismatch under
+# weighted aggregation is rejected — q<1 sampling with the old q=1 default
+# under-scaled sigma by 1/q vs the logged epsilon.
+# ---------------------------------------------------------------------------
+
+def test_client_dp_fraction_fit_derived_from_manager():
+    from fl4health_tpu.server.client_manager import (
+        FixedFractionManager,
+        FullParticipationManager,
+        PoissonSamplingManager,
+    )
+
+    strat = ClientLevelDPFedAvgM(weighted_aggregation=True)
+    assert strat.fraction_fit is None  # not yet bound
+    strat.bind_client_manager(FixedFractionManager(8, 0.25))
+    assert strat.fraction_fit == 0.25
+
+    strat2 = ClientLevelDPFedAvgM(weighted_aggregation=True)
+    strat2.bind_client_manager(PoissonSamplingManager(8, 0.5))
+    assert strat2.fraction_fit == 0.5
+
+    strat3 = ClientLevelDPFedAvgM(weighted_aggregation=True)
+    strat3.bind_client_manager(FullParticipationManager(8))
+    assert strat3.fraction_fit == 1.0
+
+
+def test_client_dp_fraction_fit_mismatch_rejected_when_weighted():
+    from fl4health_tpu.server.client_manager import FixedFractionManager
+
+    strat = ClientLevelDPFedAvgM(weighted_aggregation=True, fraction_fit=1.0)
+    with pytest.raises(ValueError, match="does not match"):
+        strat.bind_client_manager(FixedFractionManager(8, 0.25))
+    # matching explicit value is accepted
+    ok = ClientLevelDPFedAvgM(weighted_aggregation=True, fraction_fit=0.25)
+    ok.bind_client_manager(FixedFractionManager(8, 0.25))
+    assert ok.fraction_fit == 0.25
+    # unweighted: q does not enter the coefficients — mismatch tolerated
+    uw = ClientLevelDPFedAvgM(weighted_aggregation=False, fraction_fit=1.0)
+    uw.bind_client_manager(FixedFractionManager(8, 0.25))
+    assert uw.fraction_fit == 1.0
+
+
+def test_client_dp_fraction_scales_weighted_sigma():
+    # same cohort/mask, q=0.5 vs q=1: coefficients (and hence the noised
+    # delta with a seeded PRNG) must differ by exactly 1/q in the zero-noise
+    # mean; with zero noise the aggregate scales by 1/q.
+    packets = ClippingBitPacket(
+        params={"w": jnp.asarray([[0.2], [0.4]])},
+        clipping_bit=jnp.asarray([0.0, 0.0]),
+    )
+
+    def agg(q):
+        strat = ClientLevelDPFedAvgM(
+            noise_multiplier=0.0, server_momentum=0.0,
+            weighted_aggregation=True, fraction_fit=q,
+        )
+        state = strat.init({"w": jnp.zeros((1,))})
+        return float(strat.aggregate(state, _results(packets), 1).params["w"][0])
+
+    np.testing.assert_allclose(agg(0.5), 2.0 * agg(1.0), rtol=1e-6)
+
+
+def test_client_dp_standalone_unbound_defaults_to_q1():
+    # never bound to a manager (pure unit-test usage): q falls back to 1.0
+    strat = ClientLevelDPFedAvgM(
+        noise_multiplier=0.0, server_momentum=0.0, weighted_aggregation=True,
+    )
+    state = strat.init({"w": jnp.zeros((1,))})
+    packets = ClippingBitPacket(
+        params={"w": jnp.asarray([[0.2], [0.4]])},
+        clipping_bit=jnp.asarray([0.0, 0.0]),
+    )
+    new = strat.aggregate(state, _results(packets), 1)
+    # q=1 fallback with equal unit counts: cap=2, w=[.5,.5], W=1,
+    # coef=[.5,.5]; (0.5*0.2 + 0.5*0.4)/|S|=2 -> 0.15
+    np.testing.assert_allclose(float(new.params["w"][0]), 0.15, atol=1e-6)
+
+
+def test_client_dp_derived_zero_fraction_rejected():
+    # a manager whose configured fraction is 0 must be rejected at bind time
+    # exactly like an explicit fraction_fit=0 is at construction — the
+    # weighted coefficients divide by q
+    class ZeroFractionManager:
+        fraction = 0.0
+
+    strat = ClientLevelDPFedAvgM(weighted_aggregation=True)
+    with pytest.raises(ValueError, match="not positive"):
+        strat.bind_client_manager(ZeroFractionManager())

@@ -5,7 +5,9 @@ once with the dense XLA attention core and once with the Pallas flash
 kernel, and writes CROSSOVER_tpu_<ts>.json. Answers, with silicon evidence,
 where `attention_fn=flash_attention` should become the default for
 `TransformerClassifier` (today: dense at seq 128 per the bench config,
-flash only in the long-context config).
+flash only in the long-context config). Both arms run with
+FL4HEALTH_BENCH_ANALYTIC_FLOPS=1, so every cell's tflops/mfu_pct uses the
+same analytic 3x-forward numerator and the columns compare directly.
 
 Usage (tunnel must be up; each cell costs one BERT compile, so the sweep
 is budgeted per child):
@@ -40,6 +42,11 @@ def run_cell(seq: int, flash: bool) -> dict:
         "FL4HEALTH_BENCH_ONLY": "transformer",
         "FL4HEALTH_BENCH_SEQ": str(seq),
         "FL4HEALTH_BENCH_FLASH": "1" if flash else "0",
+        # One analytic FLOP numerator for BOTH arms: the flash arm must use
+        # it (cost_analysis cannot see Pallas custom-call FLOPs) and the
+        # dense arm's cost-model figure counts extra non-matmul ops, so a
+        # mixed-numerator sweep would compare incomparable mfu_pct columns.
+        "FL4HEALTH_BENCH_ANALYTIC_FLOPS": "1",
     })
     try:
         res = subprocess.run(
