@@ -398,6 +398,73 @@ def timed_fit_overhead(sim) -> dict:
     }
 
 
+def timed_telemetry_overhead(sim) -> dict:
+    """Device cost of the in-graph telemetry outputs (observability PR
+    acceptance metric): per-round time of the compiled fit round WITHOUT
+    telemetry vs WITH the RoundTelemetry extra outputs compiled in.
+
+    Rebuilds the sim's round programs with an enabled (but artifact-less)
+    Observability so the telemetry variant exists, times both dispatch
+    loops fenced, and restores the original observability handle. The
+    telemetry stats are derived from values the round already computes, so
+    the expected overhead is a few extra reductions per round.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fl4health_tpu.observability import (
+        MetricsRegistry,
+        Observability,
+        Tracer,
+    )
+
+    mask = sim.client_manager.sample_all()
+    val_batches, _val_counts = sim._val_batches()
+    r = jnp.asarray(1, jnp.int32)
+
+    def timed_loop(fit_fn):
+        ss, cs = sim.server_state, sim.client_states
+        ss, cs, *rest = fit_fn(ss, cs, sim._round_batches(0), mask, r,
+                               val_batches)
+        jax.block_until_ready(rest[0])
+        t0 = time.perf_counter()
+        for i in range(TIMED_ROUNDS):
+            b = sim._round_batches(i + 1)
+            ss, cs, *rest = fit_fn(ss, cs, b, mask, r, val_batches)
+        jax.block_until_ready((jax.tree_util.tree_leaves(ss)[0], rest[0]))
+        per_round = (time.perf_counter() - t0) / TIMED_ROUNDS
+        sim.server_state, sim.client_states = ss, cs
+        return per_round
+
+    plain_s = timed_loop(sim._fit_round)
+    prev_obs = sim.observability
+    # sync_device=False + no output_dir: the handle exists only to flip the
+    # telemetry compile flag — no fences, no artifacts, no global state
+    temp_obs = Observability(
+        enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+        sync_device=False,
+    )
+    sim.observability = temp_obs
+    try:
+        sim._build_compiled()
+        telemetry_s = timed_loop(sim._fit_round_t)
+    finally:
+        # shutdown detaches the temp handle's CompileMonitor from the
+        # process-wide jax.monitoring fan-out (enabled __init__ installed it)
+        temp_obs.shutdown()
+        sim.observability = prev_obs
+        sim._build_compiled()
+    return {
+        "round_s_plain": round(plain_s, 5),
+        "round_s_telemetry": round(telemetry_s, 5),
+        "overhead_pct": (
+            round(100.0 * (telemetry_s - plain_s) / plain_s, 2)
+            if plain_s > 0 else None
+        ),
+        "rounds": TIMED_ROUNDS,
+    }
+
+
 def timed_eager_round(sim) -> tuple[float, int]:
     """Reference-style dispatch: Python loop over clients, eager step calls,
     per-round full-parameter host round-trip (numpy serialize/deserialize).
@@ -520,6 +587,18 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
         and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
     ):
         out["host_overhead"] = timed_fit_overhead(sim)
+    # Device cost of compiling in-graph telemetry outputs into the round
+    # (observability PR acceptance metric). Same gating shape as
+    # host_overhead: FL4HEALTH_BENCH_TELEMETRY=1 forces, =0 disables,
+    # "auto" skips only the CPU fallback (whose budget the extra
+    # telemetry-variant compile would strain). Runs LAST: it temporarily
+    # rebuilds the sim's compiled round programs.
+    want_t = os.environ.get("FL4HEALTH_BENCH_TELEMETRY", "auto")
+    if want_t == "1" or (
+        want_t == "auto"
+        and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+    ):
+        out["telemetry_overhead"] = timed_telemetry_overhead(sim)
     return out
 
 

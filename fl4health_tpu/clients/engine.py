@@ -73,6 +73,10 @@ class StepOutput:
     targets: jax.Array
     example_mask: jax.Array
     step_mask: jax.Array
+    # global norm of the post-transform_gradients gradient — populated only
+    # when the train maker was built with collect_telemetry=True (None is an
+    # empty pytree node, so the default costs nothing)
+    grad_norm: Any = None
 
 
 # ---------------------------------------------------------------------------
@@ -369,8 +373,16 @@ def _microbatched_value_and_grads(logic, tx, state, ctx, batch, step_rng):
     return backward, preds, additional, new_model_state, grads_scaled
 
 
-def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
-    """Returns step(state, ctx, batch) -> (state, StepOutput) — jit/scan-safe."""
+def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation,
+                    collect_telemetry: bool = False):
+    """Returns step(state, ctx, batch) -> (state, StepOutput) — jit/scan-safe.
+
+    ``collect_telemetry`` additionally populates ``StepOutput.grad_norm``
+    with the global norm of the gradient AFTER ``transform_gradients`` (what
+    the optimizer actually consumes — SCAFFOLD correction, DP noise etc.
+    included). A pure extra output: the parameter update math is untouched,
+    so telemetry-on trajectories stay bit-identical to telemetry-off
+    (tests/observability/test_telemetry.py)."""
     unreduced = getattr(tx, "expects_unreduced_grads", False)
     if unreduced:
         # The microbatch pre-scaling assumes the optimizer's uniform MEAN
@@ -422,16 +434,66 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation):
             step=state.step + keep.astype(jnp.int32),
         )
         new_state = logic.update_after_step(new_state, ctx, batch, preds=preds)
+        grad_norm = None
+        if collect_telemetry:
+            if unreduced:
+                # ZeRO-2 hands the optimizer an UNREDUCED [n_shards] stack;
+                # the true gradient is its uniform mean (the pre-scaling is
+                # calibrated for exactly that reduction)
+                grad_norm = optax.global_norm(
+                    jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads)
+                )
+            else:
+                grad_norm = optax.global_norm(grads)
         out = StepOutput(
             losses={"backward": backward, **additional},
             preds=preds["prediction"],
             targets=batch.y,
             example_mask=batch.example_mask * keep,
             step_mask=keep,
+            grad_norm=grad_norm,
         )
         return new_state, out
 
     return step
+
+
+# -- in-scan telemetry accumulation (observability/telemetry.py consumers) --
+
+def telemetry_acc_init() -> dict:
+    """Scan-carry accumulator for per-client loss min/max + grad-norm
+    statistics. NaN losses propagate through min/max by design — a poisoned
+    step must surface in the telemetry, not be filtered out of it."""
+    inf = jnp.asarray(jnp.inf, jnp.float32)
+    zero = jnp.zeros((), jnp.float32)
+    return {"loss_min": inf, "loss_max": -inf, "gn_sum": zero, "gn_max": zero}
+
+
+def telemetry_acc_update(acc: dict, out: StepOutput) -> dict:
+    loss = jnp.asarray(out.losses["backward"], jnp.float32)
+    gn = jnp.asarray(out.grad_norm, jnp.float32)
+    live = out.step_mask > 0  # padding steps must not move the stats
+    return {
+        "loss_min": jnp.minimum(acc["loss_min"], jnp.where(live, loss, jnp.inf)),
+        "loss_max": jnp.maximum(acc["loss_max"], jnp.where(live, loss, -jnp.inf)),
+        "gn_sum": acc["gn_sum"] + jnp.where(live, gn, 0.0),
+        "gn_max": jnp.maximum(acc["gn_max"], jnp.where(live, gn, 0.0)),
+    }
+
+
+def telemetry_acc_finalize(acc: dict, n_steps: jax.Array) -> dict:
+    """-> the engine's share of a RoundTelemetry row. A client that executed
+    zero steps reports NaN (not the init sentinels)."""
+    ran = n_steps > 0
+    nan = jnp.asarray(jnp.nan, jnp.float32)
+    return {
+        "train_loss_min": jnp.where(ran, acc["loss_min"], nan),
+        "train_loss_max": jnp.where(ran, acc["loss_max"], nan),
+        "grad_norm_mean": jnp.where(
+            ran, acc["gn_sum"] / jnp.maximum(n_steps, 1.0), nan
+        ),
+        "grad_norm_max": jnp.where(ran, acc["gn_max"], nan),
+    }
 
 
 def make_local_train(
@@ -439,31 +501,41 @@ def make_local_train(
     tx: optax.GradientTransformation,
     metric_manager: MetricManager,
     loss_keys: tuple[str, ...] = ("backward",),
+    collect_telemetry: bool = False,
 ):
     """Compiled local-training phase: scan the train step over stacked batches.
 
     Returns train(state, ctx, batches) -> (state, loss_dict, metric_dict,
     n_steps). ``batches`` is a Batch pytree with a leading [steps] axis.
+    With ``collect_telemetry`` a fifth output is appended: the engine's
+    telemetry dict (loss min/max, grad-norm mean/max over executed steps) —
+    extra scan outputs only; the training math is byte-for-byte the same.
     """
-    step_fn = make_train_step(logic, tx)
+    step_fn = make_train_step(logic, tx, collect_telemetry=collect_telemetry)
     meter_proto = LossMeter.create(loss_keys)
 
     def train(state: TrainState, ctx: Any, batches: Batch):
         def body(carry, batch):
-            st, meter, mstate = carry
+            st, meter, mstate, acc = carry
             st, out = step_fn(st, ctx, batch)
             meter = meter.update(out.losses, weight=out.step_mask)
             mstate = metric_manager.update(
                 mstate, out.preds, out.targets, out.example_mask
             )
-            return (st, meter, mstate), out.losses
+            if collect_telemetry:
+                acc = telemetry_acc_update(acc, out)
+            return (st, meter, mstate, acc), out.losses
 
-        (state, meter, mstate), _ = jax.lax.scan(
-            body, (state, meter_proto, metric_manager.init()), batches
+        acc0 = telemetry_acc_init() if collect_telemetry else None
+        (state, meter, mstate, acc), _ = jax.lax.scan(
+            body, (state, meter_proto, metric_manager.init(), acc0), batches
         )
         n_steps = jnp.sum(batches.step_mask)
         state = logic.finalize_round(state, ctx, n_steps)
-        return state, meter.compute(), metric_manager.compute(mstate), n_steps
+        outs = (state, meter.compute(), metric_manager.compute(mstate), n_steps)
+        if collect_telemetry:
+            return (*outs, telemetry_acc_finalize(acc, n_steps))
+        return outs
 
     return train
 
@@ -520,6 +592,7 @@ def make_local_train_with_early_stopping(
     metric_manager: MetricManager,
     config: EarlyStoppingConfig,
     loss_keys: tuple[str, ...] = ("backward",),
+    collect_telemetry: bool = False,
 ):
     """Early-stopped local training as ONE compiled program.
 
@@ -531,9 +604,12 @@ def make_local_train_with_early_stopping(
     basic_client.py:676,755).
 
     Returns train(state, ctx, batches, val_batches) with the same outputs as
-    ``make_local_train``.
+    ``make_local_train`` (including the telemetry dict when
+    ``collect_telemetry``; stats cover executed steps only — batches after
+    the stop flag have their step_mask zeroed and never touch the
+    accumulator).
     """
-    step_fn = make_train_step(logic, tx)
+    step_fn = make_train_step(logic, tx, collect_telemetry=collect_telemetry)
     evaluate = make_local_eval(logic, metric_manager)
     meter_proto = LossMeter.create(loss_keys)
     interval = config.interval_steps
@@ -554,19 +630,24 @@ def make_local_train_with_early_stopping(
         )
 
         def chunk_body(carry, chunk: Batch):
-            st, meter, mstate, best_state, best_score, bad, stopped, executed = carry
+            (st, meter, mstate, acc, best_state, best_score, bad, stopped,
+             executed) = carry
             chunk = chunk.replace(step_mask=chunk.step_mask * (1.0 - stopped))
 
             def body(c, b):
-                st2, meter2, ms2 = c
+                st2, meter2, ms2, acc2 = c
                 st2, out = step_fn(st2, ctx, b)
                 meter2 = meter2.update(out.losses, weight=out.step_mask)
                 ms2 = metric_manager.update(
                     ms2, out.preds, out.targets, out.example_mask
                 )
-                return (st2, meter2, ms2), None
+                if collect_telemetry:
+                    acc2 = telemetry_acc_update(acc2, out)
+                return (st2, meter2, ms2, acc2), None
 
-            (st, meter, mstate), _ = jax.lax.scan(body, (st, meter, mstate), chunk)
+            (st, meter, mstate, acc), _ = jax.lax.scan(
+                body, (st, meter, mstate, acc), chunk
+            )
             executed = executed + jnp.sum(chunk.step_mask)
 
             val_losses, _ = evaluate(st, ctx, val_batches)
@@ -579,20 +660,22 @@ def make_local_train_with_early_stopping(
             stopped = jnp.maximum(
                 stopped, (bad >= config.patience).astype(jnp.float32)
             )
-            return (st, meter, mstate, best_state, best_score, bad, stopped, executed), score
+            return (st, meter, mstate, acc, best_state, best_score, bad,
+                    stopped, executed), score
 
         init = (
             state,
             meter_proto,
             metric_manager.init(),
+            telemetry_acc_init() if collect_telemetry else None,
             state,
             jnp.asarray(jnp.inf, jnp.float32),
             jnp.zeros((), jnp.int32),
             jnp.zeros((), jnp.float32),
             jnp.zeros((), jnp.float32),
         )
-        (final, meter, mstate, best_state, _, _, _, executed), _ = jax.lax.scan(
-            chunk_body, init, chunked
+        (final, meter, mstate, acc, best_state, _, _, _, executed), _ = (
+            jax.lax.scan(chunk_body, init, chunked)
         )
         # restore the FULL best snapshot — params, optimizer, model_state and
         # algorithm extra move together (the reference snapshots model AND
@@ -601,7 +684,10 @@ def make_local_train_with_early_stopping(
         # restored state, matching update_after_train-after-restore ordering.
         state = best_state.replace(rng=final.rng)
         state = logic.finalize_round(state, ctx, executed)
-        return state, meter.compute(), metric_manager.compute(mstate), executed
+        outs = (state, meter.compute(), metric_manager.compute(mstate), executed)
+        if collect_telemetry:
+            return (*outs, telemetry_acc_finalize(acc, executed))
+        return outs
 
     return train
 

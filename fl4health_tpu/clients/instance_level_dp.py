@@ -36,6 +36,13 @@ class InstanceLevelDpMixin:
     kwargs consumed: ``clipping_bound`` (C), ``noise_multiplier`` (sigma).
     """
 
+    # In-graph telemetry channel (observability/telemetry.py): when the
+    # simulation compiles telemetry outputs it adds these keys to the loss
+    # meter, so the per-step clip fraction below surfaces as a per-client
+    # round statistic. Without telemetry the key is absent from the meter
+    # and XLA dead-code-eliminates the computation.
+    telemetry_loss_keys = ("clip_fraction",)
+
     def __init__(self, *args, clipping_bound: float, noise_multiplier: float, **kwargs):
         super().__init__(*args, **kwargs)
         self.clipping_bound = float(clipping_bound)
@@ -68,9 +75,10 @@ class InstanceLevelDpMixin:
             state.params, batch.x, batch.y
         )
 
-        grads = dpsgd.noisy_clipped_mean_grads(
+        grads, clip_fraction = dpsgd.noisy_clipped_mean_grads(
             per_grads, batch.example_mask, noise_rng,
             self.clipping_bound, self.noise_multiplier,
+            return_clip_fraction=True,
         )
 
         m = batch.example_mask.astype(jnp.float32)
@@ -81,6 +89,7 @@ class InstanceLevelDpMixin:
         additional = jax.tree_util.tree_map(
             lambda v: jnp.sum(v * m) / denom, per_additional
         )
+        additional = {**additional, "clip_fraction": clip_fraction}
         # per-example predict ran on singleton batches: squeeze back to [B,...]
         preds = jax.tree_util.tree_map(lambda p: p[:, 0], per_preds)
         return (backward, (preds, additional, state.model_state)), grads

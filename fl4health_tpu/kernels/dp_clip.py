@@ -138,10 +138,16 @@ def fused_clipped_masked_sum(
     clipping_bound: float,
     tile: int = 2048,
     interpret: bool | None = None,
+    return_norms: bool = False,
 ) -> Params:
     """sum_i mask[i] * min(1, C/||g_i||) * g_i over a [B,...]-leaved pytree,
     without materializing the clipped per-example tensor (the fused
     replacement for dpsgd.clip_per_example + masked sum).
+
+    ``return_norms=True`` additionally returns the pre-clip per-example
+    norms [B] — pass 1 already computes them, so exporting costs nothing
+    extra; the DP telemetry derives its clip fraction
+    (``mean(mask * [norm > C])``) from this without a third pass.
 
     Kernels run PER LEAF on [B, leaf_width] views (reshape of a contiguous
     leaf is metadata, not a copy) with the squared-norm partials accumulated
@@ -166,4 +172,7 @@ def fused_clipped_masked_sum(
         )
         for leaf, m in zip(leaves, mats)
     ]
-    return jax.tree_util.tree_unflatten(treedef, sums)
+    out = jax.tree_util.tree_unflatten(treedef, sums)
+    if return_norms:
+        return out, norms
+    return out

@@ -15,7 +15,15 @@ instruments, bundled here:
 - :mod:`~fl4health_tpu.observability.jaxmon` — JAX hooks: compile/cache
   event counting via ``jax.monitoring``, honest device-time fencing
   (``block_until_ready`` only when enabled), opt-in per-round
-  ``jax.profiler.trace`` capture.
+  ``jax.profiler.trace`` capture;
+- :mod:`~fl4health_tpu.observability.telemetry` — IN-GRAPH round
+  telemetry: a ``RoundTelemetry`` pytree of per-client training-health
+  statistics compiled into the round programs themselves, so observability
+  rides the chunked-scan fast path instead of forcing per-round dispatch;
+- :mod:`~fl4health_tpu.observability.health` — the ``HealthWatchdog``
+  consuming that telemetry against a declarative ``HealthPolicy``
+  (NaN/Inf, loss divergence, dead clients, contribution skew), able to
+  halt ``fit()`` with a structured ``TrainingHealthError``.
 
 :class:`Observability` is the facade ``FederatedSimulation`` accepts: it
 wires all three to the process-wide defaults (so transport byte counters
@@ -28,6 +36,11 @@ from __future__ import annotations
 import os
 from typing import Any
 
+from fl4health_tpu.observability.health import (
+    HealthPolicy,
+    HealthWatchdog,
+    TrainingHealthError,
+)
 from fl4health_tpu.observability.jaxmon import (
     CompileMonitor,
     profile_round,
@@ -58,6 +71,9 @@ __all__ = [
     "Gauge",
     "Histogram",
     "CompileMonitor",
+    "HealthPolicy",
+    "HealthWatchdog",
+    "TrainingHealthError",
     "get_tracer",
     "set_tracer",
     "get_registry",
@@ -77,6 +93,23 @@ class Observability:
     ``profile_round_idx`` selects ONE round for a ``jax.profiler.trace``
     capture under ``output_dir/xprof`` — device-level detail without paying
     profiler overhead on every round.
+
+    ``telemetry`` (default on) compiles the in-graph
+    :class:`~fl4health_tpu.observability.telemetry.RoundTelemetry` outputs
+    into the round programs — per-client loss/grad-norm/update-norm
+    statistics, non-finite counts, DP clip fraction and weight divergence —
+    so a telemetry-on run keeps the chunked-scan fast path (the telemetry
+    rides the existing fused transfers; loss trajectories stay
+    bit-identical). ``watchdog`` attaches a
+    :class:`~fl4health_tpu.observability.health.HealthWatchdog` that
+    screens the telemetry each round and can halt ``fit()`` with a
+    structured :class:`TrainingHealthError`.
+
+    ``per_round_spans`` (opt-in) forces ``fit()`` onto the pipelined
+    per-round path so the span timeline / device-time fences retain
+    per-round granularity — with it off, enabling observability no longer
+    demotes the chunked-scan execution mode (only ``profile_round_idx``
+    still does).
     """
 
     def __init__(
@@ -87,6 +120,9 @@ class Observability:
         registry: MetricsRegistry | None = None,
         profile_round_idx: int | None = None,
         sync_device: bool = True,
+        telemetry: bool = True,
+        per_round_spans: bool = False,
+        watchdog: "HealthWatchdog | None" = None,
     ):
         self.enabled = enabled
         self.output_dir = output_dir
@@ -94,6 +130,9 @@ class Observability:
         self.registry = registry if registry is not None else get_registry()
         self.profile_round_idx = profile_round_idx
         self.sync_device = sync_device
+        self.telemetry = telemetry
+        self.per_round_spans = per_round_spans
+        self.watchdog = watchdog
         self.compile_monitor = CompileMonitor(self.registry)
         # Ownership of the tracer's enabled flag: only the handle that
         # actually flipped it on may flip it off (and clear its events) at
@@ -103,12 +142,21 @@ class Observability:
         if enabled:
             self.start()
 
+    @property
+    def telemetry_enabled(self) -> bool:
+        """True when the round programs should compile in-graph
+        RoundTelemetry outputs."""
+        return self.enabled and self.telemetry
+
     def start(self) -> "Observability":
         """(Re-)arm the hooks: enable the tracer, install the compile
-        monitor. Called by ``__init__`` and again by ``FederatedSimulation``
-        at each ``fit()`` so a handle survives multiple runs (``shutdown``
-        disarms it between them). Idempotent; no-op when disabled."""
+        monitor, reset the watchdog's per-run state. Called by ``__init__``
+        and again by ``FederatedSimulation`` at each ``fit()`` so a handle
+        survives multiple runs (``shutdown`` disarms it between them).
+        Idempotent; no-op when disabled."""
         if self.enabled:
+            if self.watchdog is not None:
+                self.watchdog.reset()
             if not self.tracer.enabled:
                 # flipping the (possibly process-global) tracer on is what
                 # makes transport/engine spans visible

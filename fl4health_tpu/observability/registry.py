@@ -38,6 +38,8 @@ def _fmt_value(v: float) -> str:
         return "+Inf"
     if v == -math.inf:
         return "-Inf"
+    if math.isnan(v):
+        return "NaN"  # exposition-format canonical spelling
     if float(v).is_integer():
         return str(int(v))
     return repr(float(v))
@@ -45,6 +47,12 @@ def _fmt_value(v: float) -> str:
 
 def _escape_label(v: Any) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    # Exposition-format 0.0.4: HELP text escapes backslash and newline
+    # (quotes are NOT escaped in HELP, unlike label values).
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _label_str(labels: Mapping[str, str]) -> str:
@@ -79,8 +87,19 @@ class Counter:
     def value(self) -> float:
         return self._value
 
+    @property
+    def exposition_name(self) -> str:
+        # Prometheus conformance: counters MUST carry the _total suffix in
+        # the text exposition. Registry names stay as-given (snapshot() and
+        # the programmatic API are unchanged); only the exposed family name
+        # gains the suffix when the caller omitted it.
+        return self.name if self.name.endswith("_total") else f"{self.name}_total"
+
     def expose(self) -> list[str]:
-        return [f"{self.name}{_label_str(self.labels)} {_fmt_value(self._value)}"]
+        return [
+            f"{self.exposition_name}{_label_str(self.labels)} "
+            f"{_fmt_value(self._value)}"
+        ]
 
     def snapshot(self) -> float:
         return self._value
@@ -115,6 +134,10 @@ class Gauge:
     @property
     def value(self) -> float:
         return self._value
+
+    @property
+    def exposition_name(self) -> str:
+        return self.name
 
     def expose(self) -> list[str]:
         return [f"{self.name}{_label_str(self.labels)} {_fmt_value(self._value)}"]
@@ -164,6 +187,10 @@ class Histogram:
     @property
     def sum(self) -> float:
         return self._sum
+
+    @property
+    def exposition_name(self) -> str:
+        return self.name
 
     def expose(self) -> list[str]:
         lines = []
@@ -272,18 +299,24 @@ class MetricsRegistry:
         return out
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4: families grouped by
+        EXPOSITION name (counters gain the mandatory ``_total`` suffix if
+        registered without it), one ``# HELP``/``# TYPE`` pair per family,
+        HELP text escaped per the spec."""
         with self._lock:
             items = list(self._metrics.items())
+            helps = dict(self._helps)
         by_name: dict[str, list] = {}
+        raw_names: dict[str, str] = {}
         for (name, _), m in items:
-            by_name.setdefault(name, []).append(m)
+            by_name.setdefault(m.exposition_name, []).append(m)
+            raw_names.setdefault(m.exposition_name, name)
         lines: list[str] = []
         for name in sorted(by_name):
             ms = by_name[name]
-            help_text = self._helps.get(name, "")
+            help_text = helps.get(raw_names[name], "")
             if help_text:
-                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# HELP {name} {_escape_help(help_text)}")
             lines.append(f"# TYPE {name} {ms[0].prom_type}")
             for m in ms:
                 lines.extend(m.expose())

@@ -1,0 +1,208 @@
+"""In-graph round telemetry — training-health metrics that ride the round
+programs.
+
+PR 1's observability is host-side (spans, fences, compile counters), which
+is why enabling it used to force ``fit()`` off the chunked-scan fast path:
+per-round spans only mean something with per-round dispatch. The FedJAX
+lesson (PAPERS.md, arXiv:2108.02117) is that federated *diagnostics* belong
+INSIDE the compiled computation, as extra outputs of the round function —
+then observability is a property of the program, not a tax on the driver
+loop:
+
+- on the pipelined path the :class:`RoundTelemetry` pytree rides the
+  ``RoundConsumer``'s existing fused device->host transfer (zero extra
+  syncs);
+- on the chunked path it is a stacked per-round ``lax.scan`` output,
+  materialized by the run's single fused pull.
+
+Everything here is computed from values the round program already holds
+(losses, gradients, parameter stacks), so a telemetry-on run's loss
+trajectory is BIT-IDENTICAL to a telemetry-off run
+(tests/observability/test_telemetry.py pins this on both execution modes).
+
+Field provenance:
+
+- ``train_loss`` / ``train_loss_min`` / ``train_loss_max`` — per-client
+  backward-loss mean over local steps (the meter value) and the in-scan
+  min/max accumulated by ``clients/engine.py`` when telemetry is on;
+- ``grad_norm_mean`` / ``grad_norm_max`` — per-client global norm of the
+  post-``transform_gradients`` gradient (what the optimizer actually sees,
+  SCAFFOLD correction included), accumulated across local steps;
+- ``update_norm`` — ``||params_after_finalize - pulled_globals||`` per
+  client (the SCAFFOLD-style drift statistic; near-zero flags a dead
+  client);
+- ``clip_fraction`` — fraction of examples clipped by the DP path
+  (exported by ``kernels/dp_clip.py`` / ``privacy/dpsgd.py``); NaN when the
+  client logic has no DP clipping;
+- ``nonfinite_params`` / ``nonfinite_loss`` — per-client counts of
+  non-finite (NaN/Inf) entries in the post-fit parameter stack and the
+  per-client training losses;
+- ``divergence`` — ``||client_params - global||`` of each client's stack
+  from the freshly aggregated global (the strategy's
+  ``divergence_reference``), including never-exchanged personal subtrees
+  (personalization drift is signal, not noise);
+- ``nonfinite_eval_loss`` — per-client count of non-finite evaluation
+  losses, filled in by the eval round program.
+
+The :class:`~fl4health_tpu.observability.health.HealthWatchdog` consumes
+the host copy of this pytree in the consumer thread.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# Per-client [C] fields a RoundTelemetry always carries, in a stable order
+# (the JSONL `telemetry` event and the host summaries iterate this).
+TELEMETRY_FIELDS = (
+    "train_loss",
+    "train_loss_min",
+    "train_loss_max",
+    "grad_norm_mean",
+    "grad_norm_max",
+    "update_norm",
+    "clip_fraction",
+    "nonfinite_params",
+    "nonfinite_loss",
+    "divergence",
+    "nonfinite_eval_loss",
+)
+
+
+@struct.dataclass
+class RoundTelemetry:
+    """Per-client ([clients]-shaped) training-health metrics for one round.
+
+    A plain pytree: rides ``jax.device_get`` / ``lax.scan`` stacking
+    unchanged. Fields for statistics a particular training path cannot
+    produce (e.g. grad norms under the flash early-stop train, clip
+    fraction without DP) are NaN, never absent — the pytree structure is
+    static for the life of the compiled program.
+    """
+
+    train_loss: jax.Array
+    train_loss_min: jax.Array
+    train_loss_max: jax.Array
+    grad_norm_mean: jax.Array
+    grad_norm_max: jax.Array
+    update_norm: jax.Array
+    clip_fraction: jax.Array
+    nonfinite_params: jax.Array
+    nonfinite_loss: jax.Array
+    divergence: jax.Array
+    nonfinite_eval_loss: jax.Array
+
+    def as_dict(self) -> dict[str, Any]:
+        return {k: getattr(self, k) for k in TELEMETRY_FIELDS}
+
+
+# ---------------------------------------------------------------------------
+# In-graph helpers (jit-traceable; called from the round programs)
+# ---------------------------------------------------------------------------
+
+def per_client_nonfinite(stacked_tree: Any) -> jax.Array:
+    """[C]-leading pytree -> [C] count of non-finite entries.
+
+    Integer/bool leaves cannot be non-finite and are skipped (``isfinite``
+    is undefined for them in jax)."""
+    total = None
+    for leaf in jax.tree_util.tree_leaves(stacked_tree):
+        if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+            continue
+        bad = jnp.sum(
+            (~jnp.isfinite(leaf)).reshape(leaf.shape[0], -1).astype(jnp.float32),
+            axis=1,
+        )
+        total = bad if total is None else total + bad
+    if total is None:
+        raise ValueError("per_client_nonfinite: tree has no floating leaves")
+    return total
+
+
+def nonfinite_in_losses(losses: Mapping[str, jax.Array]) -> jax.Array:
+    """Dict of [C] loss arrays -> [C] count of non-finite values."""
+    vals = [jnp.asarray(v, jnp.float32) for v in losses.values()]
+    stacked = jnp.stack(vals) if vals else jnp.zeros((1, 1), jnp.float32)
+    return jnp.sum((~jnp.isfinite(stacked)).astype(jnp.float32), axis=0)
+
+
+def per_client_divergence(stacked_params: Any, ref_params: Any) -> jax.Array:
+    """[C]-leading client param stack vs an unstacked reference ->
+    [C] global l2 distance. Non-float leaves (integer masks) are cast to
+    f32 so e.g. FedPM score trees still measure."""
+    total = jnp.zeros((), jnp.float32)
+    for leaf, ref in zip(
+        jax.tree_util.tree_leaves(stacked_params),
+        jax.tree_util.tree_leaves(ref_params),
+    ):
+        d = leaf.astype(jnp.float32) - ref.astype(jnp.float32)[None]
+        total = total + jnp.sum(
+            jnp.square(d).reshape(d.shape[0], -1), axis=1
+        )
+    return jnp.sqrt(total)
+
+
+def global_norm_diff(a: Any, b: Any) -> jax.Array:
+    """||a - b|| over two same-structure pytrees (scalar). Used per client
+    (inside vmap) for the update-norm statistic."""
+    total = jnp.zeros((), jnp.float32)
+    for la, lb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        d = la.astype(jnp.float32) - lb.astype(jnp.float32)
+        total = total + jnp.sum(jnp.square(d))
+    return jnp.sqrt(total)
+
+
+def nan_engine_telemetry() -> dict[str, jax.Array]:
+    """Engine-stat placeholder for train paths that cannot accumulate them
+    (the flash early-stop train): structure-stable NaNs."""
+    nan = jnp.asarray(jnp.nan, jnp.float32)
+    return {
+        "train_loss_min": nan,
+        "train_loss_max": nan,
+        "grad_norm_mean": nan,
+        "grad_norm_max": nan,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Host-side summaries (consumer thread / chunked epilogue; pure numpy)
+# ---------------------------------------------------------------------------
+
+def _participating(values: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    v = np.asarray(values, np.float64)
+    return v[np.asarray(mask) > 0]
+
+
+def _nan_stat(fn, values: np.ndarray) -> float:
+    """Reduce ignoring NaN; empty/all-NaN -> nan (never a numpy warning)."""
+    v = values[np.isfinite(values)]
+    return float(fn(v)) if v.size else float("nan")
+
+
+def summarize_host(telemetry: Mapping[str, np.ndarray], mask) -> dict[str, float]:
+    """Scalar summary of a host-side telemetry dict over PARTICIPATING
+    clients — the fields merged into the per-round JSONL ``round`` event
+    and rendered by ``tools/perf_report.py``."""
+    t = {k: _participating(np.asarray(v), mask) for k, v in telemetry.items()}
+    nonfinite = (
+        float(np.sum(t["nonfinite_params"]))
+        + float(np.sum(t["nonfinite_loss"]))
+        + float(np.sum(t["nonfinite_eval_loss"]))
+    )
+    return {
+        "train_loss_min": _nan_stat(np.min, t["train_loss_min"]),
+        "train_loss_max": _nan_stat(np.max, t["train_loss_max"]),
+        "grad_norm_mean": _nan_stat(np.mean, t["grad_norm_mean"]),
+        "grad_norm_max": _nan_stat(np.max, t["grad_norm_max"]),
+        "update_norm_mean": _nan_stat(np.mean, t["update_norm"]),
+        "update_norm_min": _nan_stat(np.min, t["update_norm"]),
+        "clip_fraction": _nan_stat(np.mean, t["clip_fraction"]),
+        "nonfinite": nonfinite,
+        "divergence_mean": _nan_stat(np.mean, t["divergence"]),
+        "divergence_max": _nan_stat(np.max, t["divergence"]),
+    }

@@ -55,6 +55,7 @@ def noisy_clipped_mean_grads(
     clipping_bound: float,
     noise_multiplier: float,
     use_fused_kernel: bool = False,
+    return_clip_fraction: bool = False,
 ) -> Params:
     """DP-SGD gradient: clip each example to C, masked-sum, add N(0, (sigma C)^2)
     per coordinate, divide by the number of real examples (Opacus' mean-loss
@@ -65,16 +66,23 @@ def noisy_clipped_mean_grads(
     instead of three, no materialized clipped intermediate. Opt-in because
     the engine vmaps client logic over the clients axis and pallas_call
     batching support depends on the backend; the XLA path is always safe.
+
+    ``return_clip_fraction`` appends the fraction of real examples whose
+    pre-clip norm exceeded C — the classic DP tuning diagnostic (a clip
+    fraction pinned at 1.0 means the bound is strangling the signal; 0.0
+    means it is pure noise headroom). Derived from norms both paths already
+    compute, so it never adds a pass over the gradient tensor; the noised
+    gradient itself is bit-identical either way.
     """
     m = example_mask.astype(jnp.float32)
     if use_fused_kernel:
         from fl4health_tpu.kernels.dp_clip import fused_clipped_masked_sum
 
-        summed = fused_clipped_masked_sum(
-            per_example_grads, m, clipping_bound
+        summed, norms = fused_clipped_masked_sum(
+            per_example_grads, m, clipping_bound, return_norms=True
         )
     else:
-        clipped, _ = clip_per_example(per_example_grads, clipping_bound)
+        clipped, norms = clip_per_example(per_example_grads, clipping_bound)
 
         def masked_sum(g):
             return jnp.sum(g * m.reshape((-1,) + (1,) * (g.ndim - 1)), axis=0)
@@ -82,7 +90,11 @@ def noisy_clipped_mean_grads(
         summed = jax.tree_util.tree_map(masked_sum, clipped)
     noise = gaussian_noise_like(rng, summed, noise_multiplier * clipping_bound)
     denom = jnp.maximum(jnp.sum(m), 1.0)
-    return jax.tree_util.tree_map(lambda s, n: (s + n) / denom, summed, noise)
+    grads = jax.tree_util.tree_map(lambda s, n: (s + n) / denom, summed, noise)
+    if return_clip_fraction:
+        clip_fraction = jnp.sum((norms > clipping_bound) * m) / denom
+        return grads, clip_fraction
+    return grads
 
 
 def make_per_example_grads(

@@ -9,11 +9,15 @@ are the host half of that design for ``FederatedSimulation.fit``:
 
 - :class:`RoundConsumer` — a bounded single-worker queue that executes each
   round's host-side epilogue (failure policy, checkpoint decisions,
-  ``RoundRecord`` construction, reporter fan-out) in a background thread
+  ``RoundRecord`` construction, reporter fan-out, in-graph telemetry
+  recording + the ``HealthWatchdog`` screen) in a background thread
   while the device already runs the next round. FIFO ordering is guaranteed
   (one worker), ``flush()`` is a completion barrier, and the first exception
-  raised by round *r*'s epilogue (e.g. ``ClientFailuresError``) is re-raised
-  into the producer at the next ``submit``/``flush``.
+  raised by round *r*'s epilogue (e.g. ``ClientFailuresError`` or the
+  watchdog's ``TrainingHealthError``) is re-raised into the producer at the
+  next ``submit``/``flush``. The round's ``RoundTelemetry`` pytree rides the
+  consumer's single fused device->host transfer — enabling telemetry adds
+  zero producer-side syncs.
 
 - :class:`RoundPrefetcher` — builds round *r+1*'s host-side index plan
   (pure numpy) and stages its gathered batches on device while round *r*

@@ -34,6 +34,8 @@ from fl4health_tpu.checkpointing.async_writer import AsyncCheckpointWriter
 from fl4health_tpu.checkpointing.checkpointer import CheckpointMode
 from fl4health_tpu.clients import engine
 from fl4health_tpu.observability import Observability
+from fl4health_tpu.observability import telemetry as telem
+from fl4health_tpu.observability.telemetry import RoundTelemetry
 from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
 from fl4health_tpu.core import pytree as ptu
 from fl4health_tpu.exchange.exchanger import FullExchanger
@@ -403,23 +405,84 @@ class FederatedSimulation:
 
     # ------------------------------------------------------------------
     def _build_compiled(self):
+        # In-graph telemetry (observability/telemetry.py) is a compile-time
+        # property of the round programs: the plain 5-output fit_round /
+        # eval_round keep their signature for every external caller
+        # (servers.py warm starts, bench, direct-test drivers, fit_chunk),
+        # and telemetry-enabled fit() dispatches the *_t variants whose
+        # extra output is the RoundTelemetry pytree.
+        self._telemetry_enabled = self.observability.telemetry_enabled
+        self._fit_round_fn, self._eval_round_fn = self._build_round_fns(False)
+        # Donation (mirroring fit_chunk's donate_argnums=(0,1), per
+        # arXiv:2004.13336's reuse-the-replica-buffers rule): the full
+        # client-weight stack and server state are updated IN PLACE each
+        # round instead of copied — halves the steady-state footprint of the
+        # big-cohort configs and removes an alloc+copy from the hot path.
+        # CONTRACT for every caller: treat the passed-in states as INVALID
+        # after the call — always replace them with the returned ones.
+        # (Donation is gated off the CPU backend — see _donate_argnums —
+        # but call sites must stay donation-safe for the TPU path.) eval
+        # donates only the client stack: its server_state flows on to
+        # update_after_eval/test-eval on the caller side.
+        self._fit_round = jax.jit(
+            self._fit_round_fn, donate_argnums=_donate_argnums(0, 1)
+        )
+        self._eval_round = jax.jit(
+            self._eval_round_fn, donate_argnums=_donate_argnums(1)
+        )
+        self._fit_round_fn_t = self._eval_round_fn_t = None
+        self._fit_round_t = self._eval_round_t = None
+        if self._telemetry_enabled:
+            self._fit_round_fn_t, self._eval_round_fn_t = (
+                self._build_round_fns(True)
+            )
+            self._fit_round_t = jax.jit(
+                self._fit_round_fn_t, donate_argnums=_donate_argnums(0, 1)
+            )
+            self._eval_round_t = jax.jit(
+                self._eval_round_fn_t, donate_argnums=_donate_argnums(1)
+            )
+        self._chunked_fit = None  # compiled lazily by make_chunked_fit
+        self._chunked_fit_eval = None  # compiled lazily (fit()'s chunked route)
+
+    def _build_round_fns(self, collect_telemetry: bool):
+        """Build (fit_round, eval_round) closures. With ``collect_telemetry``
+        each appends one extra output — fit_round a :class:`RoundTelemetry`
+        pytree, eval_round the per-client non-finite eval-loss count — all
+        derived from values the program already computes, so the training
+        math (and thus the loss trajectory) is bit-identical either way."""
         logic, tx, strategy, exchanger = self.logic, self.tx, self.strategy, self.exchanger
         loss_keys = ("backward", *self._extra_keys())
+        if collect_telemetry:
+            # logic-declared telemetry channels (e.g. the DP clip fraction)
+            # enter the loss meter only on the telemetry build — the plain
+            # programs stay exactly as before
+            loss_keys += tuple(
+                k for k in getattr(logic, "telemetry_loss_keys", ())
+                if k not in loss_keys
+            )
         if self.early_stopping is not None:
             es_train = engine.make_local_train_with_early_stopping(
-                logic, tx, self.metrics, self.early_stopping, loss_keys
+                logic, tx, self.metrics, self.early_stopping, loss_keys,
+                collect_telemetry=collect_telemetry,
             )
             train = None
         elif self.flash_early_stopping is not None:
             from fl4health_tpu.clients.flash import make_flash_local_train
 
+            # flash's gamma-rule train has no telemetry accumulator: engine
+            # stats come back NaN (update_norm/divergence/nonfinite still
+            # measure — they are computed outside the train scan)
             es_train = make_flash_local_train(
                 logic, tx, self.metrics, self.flash_early_stopping, loss_keys
             )
             train = None
         else:
             es_train = None
-            train = engine.make_local_train(logic, tx, self.metrics, loss_keys)
+            train = engine.make_local_train(
+                logic, tx, self.metrics, loss_keys,
+                collect_telemetry=collect_telemetry,
+            )
         evaluate = engine.make_local_eval(logic, self.metrics, ("checkpoint", *self._eval_keys()))
 
         evaluate_after_fit = getattr(strategy, "evaluate_after_fit", False)
@@ -435,16 +498,33 @@ class FederatedSimulation:
             state = state.replace(params=pulled)
             ctx = logic.init_round_context(state, payload)
             if es_train is not None:
-                new_state, losses, metrics, n_steps = es_train(
-                    state, ctx, batches, val_batches
-                )
+                outs = es_train(state, ctx, batches, val_batches)
             else:
-                new_state, losses, metrics, n_steps = train(state, ctx, batches)
+                outs = train(state, ctx, batches)
+            if len(outs) == 5:
+                new_state, losses, metrics, n_steps, engine_telem = outs
+            else:
+                new_state, losses, metrics, n_steps = outs
+                engine_telem = (
+                    telem.nan_engine_telemetry() if collect_telemetry else None
+                )
             if evaluate_after_fit:
                 # pre-aggregation local validation (FedDG-GA's
                 # evaluate_after_fit=True requirement, feddg_ga.py:205-210)
                 post_fit_losses, _ = evaluate(new_state, ctx, val_batches)
                 losses = {**losses, "val_checkpoint_post_fit": post_fit_losses["checkpoint"]}
+            client_telem = None
+            if collect_telemetry:
+                # update norm against the pulled globals, on the TRAINED
+                # state (pre participation-masking: a non-participant's row
+                # is garbage-by-construction and the watchdog filters by
+                # mask, exactly like the loss rows)
+                client_telem = {
+                    **engine_telem,
+                    "update_norm": telem.global_norm_diff(
+                        new_state.params, pulled
+                    ),
+                }
             # non-participants neither pull nor train (their packet row is
             # garbage but aggregation hard-zeroes masked rows)
             new_state = jax.tree_util.tree_map(
@@ -452,14 +532,20 @@ class FederatedSimulation:
             )
             pushed = exchanger.push(new_state.params, pulled)
             packet = logic.pack(new_state, pushed, losses)
+            if collect_telemetry:
+                return new_state, packet, losses, metrics, client_telem
             return new_state, packet, losses, metrics
 
         def fit_round(server_state, client_states, batches, mask, round_idx,
                       val_batches):
             payload = strategy.client_payload(server_state, round_idx)
-            new_states, packets, losses, metrics = jax.vmap(
-                client_fit, in_axes=(0, None, 0, 0, 0)
-            )(client_states, payload, batches, mask, val_batches)
+            vmapped = jax.vmap(client_fit, in_axes=(0, None, 0, 0, 0))(
+                client_states, payload, batches, mask, val_batches
+            )
+            if collect_telemetry:
+                new_states, packets, losses, metrics, client_telem = vmapped
+            else:
+                new_states, packets, losses, metrics = vmapped
             # Failed clients (non-finite loss) are excluded from aggregation,
             # matching the reference where failures never enter results
             # (strategies/basic_fedavg.py:254-256 skips on failures; here the
@@ -483,7 +569,29 @@ class FederatedSimulation:
                 for k, v in losses.items()
             }
             agg_metrics = aggregate_metrics(metrics, self.sample_counts, results.mask)
-            return new_server_state, new_states, agg_losses, agg_metrics, losses
+            if not collect_telemetry:
+                return new_server_state, new_states, agg_losses, agg_metrics, losses
+            nan_row = jnp.full_like(
+                jnp.asarray(losses["backward"], jnp.float32), jnp.nan
+            )
+            round_telemetry = RoundTelemetry(
+                train_loss=jnp.asarray(losses["backward"], jnp.float32),
+                train_loss_min=client_telem["train_loss_min"],
+                train_loss_max=client_telem["train_loss_max"],
+                grad_norm_mean=client_telem["grad_norm_mean"],
+                grad_norm_max=client_telem["grad_norm_max"],
+                update_norm=client_telem["update_norm"],
+                clip_fraction=losses.get("clip_fraction", nan_row),
+                nonfinite_params=telem.per_client_nonfinite(new_states.params),
+                nonfinite_loss=telem.nonfinite_in_losses(losses),
+                divergence=telem.per_client_divergence(
+                    new_states.params,
+                    strategy.divergence_reference(new_server_state),
+                ),
+                nonfinite_eval_loss=jnp.zeros_like(nan_row),
+            )
+            return (new_server_state, new_states, agg_losses, agg_metrics,
+                    losses, round_telemetry)
 
         def client_eval(state: TrainState, payload, batches: Batch):
             payload_params = payload.params if hasattr(payload, "params") else payload
@@ -504,25 +612,12 @@ class FederatedSimulation:
                 for k, v in losses.items()
             }
             agg_metrics = aggregate_metrics(metrics, eval_counts)
+            if collect_telemetry:
+                return (new_states, agg_losses, agg_metrics, losses, metrics,
+                        telem.nonfinite_in_losses(losses))
             return new_states, agg_losses, agg_metrics, losses, metrics
 
-        self._fit_round_fn = fit_round  # raw (un-jitted) for the chunked scan
-        self._eval_round_fn = eval_round
-        # Donation (mirroring fit_chunk's donate_argnums=(0,1), per
-        # arXiv:2004.13336's reuse-the-replica-buffers rule): the full
-        # client-weight stack and server state are updated IN PLACE each
-        # round instead of copied — halves the steady-state footprint of the
-        # big-cohort configs and removes an alloc+copy from the hot path.
-        # CONTRACT for every caller: treat the passed-in states as INVALID
-        # after the call — always replace them with the returned ones.
-        # (Donation is gated off the CPU backend — see _donate_argnums —
-        # but call sites must stay donation-safe for the TPU path.) eval
-        # donates only the client stack: its server_state flows on to
-        # update_after_eval/test-eval on the caller side.
-        self._fit_round = jax.jit(fit_round, donate_argnums=_donate_argnums(0, 1))
-        self._eval_round = jax.jit(eval_round, donate_argnums=_donate_argnums(1))
-        self._chunked_fit = None  # compiled lazily by make_chunked_fit
-        self._chunked_fit_eval = None  # compiled lazily (fit()'s chunked route)
+        return fit_round, eval_round
 
     def _extra_keys(self):
         # explicit constructor keys win; else the logic's declared keys
@@ -671,11 +766,17 @@ class FederatedSimulation:
         the SAME fit_round + eval_round (+ optional test eval) sequence as
         one pipelined round — so a chunked fit() produces the same
         RoundRecord trajectory as the per-round path, in ONE dispatch for
-        the whole run. Donates the carried states like make_chunked_fit."""
+        the whole run. Donates the carried states like make_chunked_fit.
+
+        With telemetry enabled the scan body runs the telemetry round
+        variants and stacks each round's :class:`RoundTelemetry` into the
+        outputs — per-round training-health metrics ride the run's single
+        fused device->host pull."""
         if self._chunked_fit_eval is not None:
             return self._chunked_fit_eval
-        fit_round = self._fit_round_fn
-        eval_round = self._eval_round_fn
+        telemetry_on = self._telemetry_enabled
+        fit_round = self._fit_round_fn_t if telemetry_on else self._fit_round_fn
+        eval_round = self._eval_round_fn_t if telemetry_on else self._eval_round_fn
 
         def chunk(server_state, client_states, x_stack, y_stack, idx, em, sm,
                   masks, start_round, val_batches, val_counts,
@@ -684,15 +785,30 @@ class FederatedSimulation:
                 server_state, client_states, r = carry
                 idx_r, em_r, sm_r, mask_r = per_round
                 batches = engine.gather_batches(x_stack, y_stack, idx_r, em_r, sm_r)
-                server_state, client_states, fit_losses, fit_metrics, per_fit = (
-                    fit_round(server_state, client_states, batches, mask_r, r,
-                              val_batches)
+                fit_outs = fit_round(
+                    server_state, client_states, batches, mask_r, r,
+                    val_batches,
                 )
+                round_telemetry = None
+                if telemetry_on:
+                    (server_state, client_states, fit_losses, fit_metrics,
+                     per_fit, round_telemetry) = fit_outs
+                else:
+                    (server_state, client_states, fit_losses, fit_metrics,
+                     per_fit) = fit_outs
                 # mirror _run_round: post-aggregation eval refreshes the
                 # client stack with the pulled global params
-                client_states, ev_losses, ev_metrics, _pl, _pm = eval_round(
+                ev_outs = eval_round(
                     server_state, client_states, val_batches, val_counts
                 )
+                if telemetry_on:
+                    (client_states, ev_losses, ev_metrics, _pl, _pm,
+                     ev_nonfinite) = ev_outs
+                    round_telemetry = round_telemetry.replace(
+                        nonfinite_eval_loss=ev_nonfinite
+                    )
+                else:
+                    client_states, ev_losses, ev_metrics, _pl, _pm = ev_outs
                 out = {
                     "fit_losses": fit_losses,
                     "fit_metrics": fit_metrics,
@@ -700,12 +816,14 @@ class FederatedSimulation:
                     "eval_losses": ev_losses,
                     "eval_metrics": ev_metrics,
                 }
+                if round_telemetry is not None:
+                    out["telemetry"] = round_telemetry
                 if test_batches is not None:
-                    _, t_losses, t_metrics, _, _ = eval_round(
+                    t_outs = eval_round(
                         server_state, client_states, test_batches, test_counts
                     )
-                    out["test_losses"] = t_losses
-                    out["test_metrics"] = t_metrics
+                    out["test_losses"] = t_outs[1]
+                    out["test_metrics"] = t_outs[2]
                 return (server_state, client_states, r + 1), out
 
             (server_state, client_states, _), outs = jax.lax.scan(
@@ -766,9 +884,21 @@ class FederatedSimulation:
             return "per-round durable state checkpointing (and resume)"
         if not self.failure_policy.accept_failures:
             return "accept_failures=False must be able to terminate mid-run"
-        if self.observability.enabled:
-            return ("observability needs per-round spans/fences "
-                    "(per-round dispatch keeps them meaningful)")
+        # Observability per se no longer demotes the chunked path: in-graph
+        # telemetry rides the scan outputs and the per-round gauges/JSONL
+        # records are reconstructed from the stacked pull. Only the two
+        # hooks that intrinsically need per-round dispatch still force the
+        # pipelined path.
+        if (self.observability.enabled
+                and self.observability.profile_round_idx is not None
+                and self.observability.output_dir is not None):
+            # without an output_dir maybe_profile() is a guaranteed no-op —
+            # demoting for it would cost the fast path and capture nothing
+            return ("opt-in XProf capture (profile_round_idx) wraps one "
+                    "round's dispatch")
+        if self.observability.enabled and self.observability.per_round_spans:
+            return ("per-round span fencing requested "
+                    "(Observability(per_round_spans=True))")
         if type(self.strategy).update_after_eval is not Strategy.update_after_eval:
             return ("strategy overrides update_after_eval (host-side "
                     "per-round eval consumption)")
@@ -807,6 +937,12 @@ class FederatedSimulation:
         logging.getLogger(__name__).info(
             "fit: execution_mode=%s (%s)", mode, mode_reason
         )
+        if obs.watchdog is not None and not self._telemetry_enabled:
+            logging.getLogger(__name__).warning(
+                "HealthWatchdog attached but in-graph telemetry is off "
+                "(Observability(enabled=%s, telemetry=%s)) — no health "
+                "checks will run.", obs.enabled, obs.telemetry,
+            )
         if obs.enabled:
             obs.log_event("execution_mode", mode=mode, reason=mode_reason)
         for r in self.reporters:
@@ -920,17 +1056,31 @@ class FederatedSimulation:
             if prefetcher is not None and rnd < self._fit_n_rounds:
                 # stage round r+1's plan+gather while round r executes
                 prefetcher.schedule(rnd + 1)
+            telemetry = None
             with obs.span("fit_round", round=rnd) as fit_span:
-                (
-                    self.server_state,
-                    self.client_states,
-                    fit_losses,
-                    fit_metrics,
-                    per_client_fit_losses,
-                ) = self._fit_round(
-                    self.server_state, self.client_states, batches, mask,
-                    jnp.asarray(rnd, jnp.int32), val_batches,
-                )
+                if self._telemetry_enabled:
+                    (
+                        self.server_state,
+                        self.client_states,
+                        fit_losses,
+                        fit_metrics,
+                        per_client_fit_losses,
+                        telemetry,
+                    ) = self._fit_round_t(
+                        self.server_state, self.client_states, batches, mask,
+                        jnp.asarray(rnd, jnp.int32), val_batches,
+                    )
+                else:
+                    (
+                        self.server_state,
+                        self.client_states,
+                        fit_losses,
+                        fit_metrics,
+                        per_client_fit_losses,
+                    ) = self._fit_round(
+                        self.server_state, self.client_states, batches, mask,
+                        jnp.asarray(rnd, jnp.int32), val_batches,
+                    )
                 # Honest device time: the dispatch above returns at enqueue;
                 # fence (enabled path ONLY — disabled adds zero syncs) so the
                 # span covers actual device execution, not enqueue latency.
@@ -960,15 +1110,32 @@ class FederatedSimulation:
                     )
             t1 = time.time()
             with obs.span("eval_round", round=rnd) as eval_span:
-                (
-                    self.client_states,
-                    eval_losses,
-                    eval_metrics,
-                    per_client_eval_losses,
-                    per_client_eval_metrics,
-                ) = self._eval_round(
-                    self.server_state, self.client_states, val_batches, val_counts
-                )
+                if self._telemetry_enabled:
+                    (
+                        self.client_states,
+                        eval_losses,
+                        eval_metrics,
+                        per_client_eval_losses,
+                        per_client_eval_metrics,
+                        ev_nonfinite,
+                    ) = self._eval_round_t(
+                        self.server_state, self.client_states, val_batches,
+                        val_counts,
+                    )
+                    telemetry = telemetry.replace(
+                        nonfinite_eval_loss=ev_nonfinite
+                    )
+                else:
+                    (
+                        self.client_states,
+                        eval_losses,
+                        eval_metrics,
+                        per_client_eval_losses,
+                        per_client_eval_metrics,
+                    ) = self._eval_round(
+                        self.server_state, self.client_states, val_batches,
+                        val_counts,
+                    )
                 self.server_state = self.strategy.update_after_eval(
                     self.server_state, per_client_eval_losses,
                     per_client_eval_metrics, mask
@@ -983,11 +1150,11 @@ class FederatedSimulation:
                     # value-identical to the val-eval one (pull is
                     # idempotent) but must be re-assigned: the input stack
                     # was donated.
-                    (
-                        self.client_states, test_losses, test_metrics, _, _,
-                    ) = self._eval_round(
+                    ev = (self._eval_round_t if self._telemetry_enabled
+                          else self._eval_round)(
                         self.server_state, self.client_states, test[0], test[1]
                     )
+                    self.client_states, test_losses, test_metrics = ev[:3]
                     # fence the test dispatch too — its device time belongs
                     # in device_wait_s, not misattributed to host_s
                     _, test_wait = obs.fence((test_losses, test_metrics))
@@ -1029,6 +1196,10 @@ class FederatedSimulation:
                 "eval_losses": eval_losses,
                 "eval_metrics": eval_metrics,
             }
+            if telemetry is not None:
+                # the RoundTelemetry pytree rides the SAME fused transfer —
+                # in-graph observability adds zero extra host syncs
+                device_results["telemetry"] = telemetry
             if test_losses is not None:
                 device_results["test_losses"] = test_losses
                 device_results["test_metrics"] = test_metrics
@@ -1085,6 +1256,11 @@ class FederatedSimulation:
         pre_agg_params = host.pop("_pre_agg_params", None)
         post_agg_params = host.pop("_post_agg_params", None)
         state_trees = host.pop("_state_trees", None)
+        telemetry_obj = host.pop("telemetry", None)
+        telemetry_host = (
+            {k: np.asarray(v) for k, v in telemetry_obj.as_dict().items()}
+            if telemetry_obj is not None else None
+        )
         with obs.span("aggregate", round=rnd):
             # Failure policy screen (base_server.py:316-318): terminate
             # before checkpointing a poisoned aggregate when
@@ -1151,6 +1327,7 @@ class FederatedSimulation:
                 work.device_wait_s,
                 compiles_after=work.compiles_after,
                 compile_s_after=work.compile_s_after,
+                telemetry=telemetry_host,
             )
         with obs.span("report", round=rnd):
             for rep in self.reporters:
@@ -1168,6 +1345,15 @@ class FederatedSimulation:
                     # ReportsManager so JsonReporter/WandBReporter see it
                     payload["observability"] = dict(obs_summary)
                 rep.report(payload, round=rnd)
+        # watchdog LAST: the round's record/metrics/reports always land
+        # before a halt check tears the run down (the raise propagates to
+        # the producer via the RoundConsumer's exception channel)
+        if telemetry_host is not None and obs.watchdog is not None:
+            obs.watchdog.observe(
+                rnd, telemetry_host, mask,
+                rec.fit_losses.get("backward", float("nan")),
+                obs=obs, reporters=self.reporters,
+            )
 
     # -- chunked on-device path ----------------------------------------
     def _fit_chunked(self, n_rounds: int) -> None:
@@ -1176,8 +1362,23 @@ class FederatedSimulation:
         device->host pull materializes every RoundRecord. Per-round host
         overhead collapses to the record/report loop at the end. Per-round
         participation masks come from the same PRNG stream as the pipelined
-        path, so the trajectories match."""
+        path, so the trajectories match.
+
+        With observability enabled the per-round gauges, JSONL ``round`` /
+        ``telemetry`` events and reporter observability payloads are
+        reconstructed from the stacked outputs — the SAME
+        ``_record_round_metrics`` the pipelined consumer runs, so nothing
+        is pipelined-only. The HealthWatchdog screens each round's
+        telemetry in order; a halt raises ``TrainingHealthError`` naming
+        the first offending round (the device work has already completed —
+        one dispatch covers the run — but the failure is just as loud)."""
         obs = self.observability
+        compiles_before = compile_s_before = 0.0
+        if obs.enabled:
+            compiles_before = obs.registry.counter(
+                "jax_backend_compiles_total").value
+            compile_s_before = obs.registry.counter(
+                "jax_backend_compiles_seconds_total").value
         t_start = time.time()
         val_batches, val_counts = self._val_batches()
         test = self._test_batches()
@@ -1201,10 +1402,23 @@ class FederatedSimulation:
                 mask_stack, jnp.asarray(1, jnp.int32), val_batches, val_counts]
         if test is not None:
             args.extend(test)
-        with obs.span("fit_chunk", cat="fit", rounds=n_rounds):
+        with obs.span("fit_chunk", cat="fit", rounds=n_rounds) as chunk_span:
             self.server_state, self.client_states, outs = chunked(*args)
+            # fence (enabled path only): total device wait for the whole
+            # run, amortized per round below
+            _, device_wait_total = obs.fence(outs)
             stacked = jax.device_get(outs)  # the run's ONE fused host pull
+            if obs.enabled:
+                chunk_span.set(device_wait_s=device_wait_total)
+        compiles_after = compile_s_after = None
+        if obs.enabled:
+            compiles_after = obs.registry.counter(
+                "jax_backend_compiles_total").value
+            compile_s_after = obs.registry.counter(
+                "jax_backend_compiles_seconds_total").value
         per_round_s = (time.time() - t_start) / max(n_rounds, 1)
+        device_wait_round = device_wait_total / max(n_rounds, 1)
+        telemetry_stack = stacked.get("telemetry")
         for i in range(n_rounds):
             rnd = i + 1
             per_fit_i = {
@@ -1212,7 +1426,7 @@ class FederatedSimulation:
             }
             # logs per-round failures; cannot terminate (eligibility
             # guarantees accept_failures=True on this path)
-            self.failure_policy.check(per_fit_i, masks_np[i])
+            failed = self.failure_policy.check(per_fit_i, masks_np[i])
             eval_losses = {
                 k: float(v[i]) for k, v in stacked["eval_losses"].items()
             }
@@ -1244,8 +1458,27 @@ class FederatedSimulation:
                 eval_elapsed_s=0.0,
             )
             self.history.append(rec)
+            telemetry_i = None
+            if telemetry_stack is not None:
+                telemetry_i = {
+                    k: np.asarray(v[i])
+                    for k, v in telemetry_stack.as_dict().items()
+                }
+            obs_summary = None
+            if obs.enabled:
+                # the single dispatch's compiles/device time attribute to
+                # round 1 / amortize per round — disclosed by execution_mode
+                obs_summary = self._record_round_metrics(
+                    rnd, rec, masks_np[i], per_fit_i, failed,
+                    compiles_before, compile_s_before, device_wait_round,
+                    compiles_after=(compiles_after if i == 0
+                                    else compiles_before),
+                    compile_s_after=(compile_s_after if i == 0
+                                     else compile_s_before),
+                    telemetry=telemetry_i,
+                )
             for rep in self.reporters:
-                rep.report({
+                payload = {
                     "fit_losses": rec.fit_losses,
                     "fit_metrics": rec.fit_metrics,
                     "eval_losses": rec.eval_losses,
@@ -1253,7 +1486,16 @@ class FederatedSimulation:
                     "fit_elapsed_s": rec.fit_elapsed_s,
                     "eval_elapsed_s": rec.eval_elapsed_s,
                     "execution_mode": EXEC_CHUNKED,
-                }, round=rnd)
+                }
+                if obs_summary is not None:
+                    payload["observability"] = dict(obs_summary)
+                rep.report(payload, round=rnd)
+            if telemetry_i is not None and obs.watchdog is not None:
+                obs.watchdog.observe(
+                    rnd, telemetry_i, masks_np[i],
+                    rec.fit_losses.get("backward", float("nan")),
+                    obs=obs, reporters=self.reporters,
+                )
 
 
     def _payload_nbytes(self) -> tuple[int, int]:
@@ -1286,9 +1528,18 @@ class FederatedSimulation:
         compiles_before: float, compile_s_before: float, device_wait_s: float,
         *, compiles_after: float | None = None,
         compile_s_after: float | None = None,
+        telemetry: dict | None = None,
     ) -> dict:
         """Per-round gauges/counters + one JSONL ``round`` event; returns the
-        summary dict bridged into every reporter.
+        summary dict bridged into every reporter. Runs identically on the
+        pipelined path (consumer thread) and the chunked path (post-run
+        epilogue), so every per-round gauge is uniform across execution
+        modes.
+
+        ``telemetry``: host copy of the round's RoundTelemetry (dict of [C]
+        numpy arrays). Scalar summaries merge into the ``round`` event and
+        telemetry gauges; the per-client vectors land in one ``telemetry``
+        JSONL event.
 
         ``compiles_after``/``compile_s_after``: counter readings taken by the
         PRODUCER right after the round's dispatches. Under the pipelined loop
@@ -1353,6 +1604,39 @@ class FederatedSimulation:
             "fit_loss_std": loss_std,
             "fit_loss_spread": loss_spread,
         }
+        if telemetry is not None:
+            t_summary = telem.summarize_host(telemetry, mask_np)
+            summary.update(t_summary)
+            reg.gauge(
+                "fl_fit_grad_norm_max",
+                help="max per-client gradient norm this round "
+                     "(post transform_gradients)",
+            ).set(t_summary["grad_norm_max"])
+            reg.gauge(
+                "fl_fit_update_norm_min",
+                help="min participating client update norm (dead-client "
+                     "proxy)",
+            ).set(t_summary["update_norm_min"])
+            reg.gauge(
+                "fl_fit_divergence_max",
+                help="max client weight divergence from the aggregated "
+                     "global",
+            ).set(t_summary["divergence_max"])
+            reg.gauge(
+                "fl_dp_clip_fraction",
+                help="mean fraction of examples clipped by the DP path "
+                     "(NaN without DP)",
+            ).set(t_summary["clip_fraction"])
+            reg.gauge(
+                "fl_nonfinite_values",
+                help="non-finite entries across participating clients' "
+                     "params/losses this round",
+            ).set(t_summary["nonfinite"])
+            reg.log_event(
+                "telemetry", round=rnd,
+                **{k: np.asarray(v, np.float64).tolist()
+                   for k, v in telemetry.items()},
+            )
         reg.log_event("round", **summary)
         self.observability.tracer.counter(
             "fl_round_time_s", fit=rec.fit_elapsed_s, eval=rec.eval_elapsed_s

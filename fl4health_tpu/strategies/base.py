@@ -64,6 +64,16 @@ class Strategy:
         """The current global model params (for checkpointing/eval)."""
         return server_state.params
 
+    def divergence_reference(self, server_state: Any) -> Params:
+        """Reference point for the in-graph weight-divergence telemetry
+        (observability/telemetry.py): each client stack's l2 distance is
+        measured from THIS tree after aggregation. Default: the aggregated
+        global model. Strategies whose broadcast differs from their stored
+        globals (e.g. a server-momentum strategy whose payload folds in the
+        momentum step) may override so divergence measures distance from
+        what clients will actually pull next round. Jit-traceable."""
+        return self.global_params(server_state)
+
     def client_payload(self, server_state: Any, round_idx: jax.Array) -> Any:
         """What is broadcast to clients this round (configure_fit's parameters)."""
         return server_state.params

@@ -1,0 +1,219 @@
+"""HealthWatchdog: policy checks over synthetic telemetry, and the ISSUE
+acceptance path — a seeded NaN injection (poisoned client) caught with a
+structured TrainingHealthError naming round and client, on BOTH execution
+modes."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.observability import (
+    HealthPolicy,
+    HealthWatchdog,
+    MetricsRegistry,
+    Observability,
+    Tracer,
+    TrainingHealthError,
+)
+from fl4health_tpu.observability.telemetry import TELEMETRY_FIELDS
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+
+def _telemetry(n=3, **overrides):
+    base = {k: np.zeros(n) for k in TELEMETRY_FIELDS}
+    base["train_loss"] = np.full(n, 0.5)
+    base["update_norm"] = np.full(n, 1.0)
+    base.update({k: np.asarray(v, float) for k, v in overrides.items()})
+    return base
+
+
+ALL = np.ones(3)
+
+
+class TestPolicyChecks:
+    def test_invalid_action_rejected(self):
+        with pytest.raises(ValueError, match="on_nonfinite"):
+            HealthPolicy(on_nonfinite="explode")
+
+    def test_nonfinite_halts_naming_clients(self):
+        wd = HealthWatchdog(HealthPolicy(on_nonfinite="halt"))
+        with pytest.raises(TrainingHealthError) as exc:
+            wd.observe(4, _telemetry(nonfinite_loss=[0, 2, 0]), ALL, 0.5)
+        assert exc.value.round == 4
+        assert exc.value.clients == [1]
+        assert exc.value.check == "nonfinite"
+
+    def test_nonfinite_in_masked_out_client_ignored(self):
+        wd = HealthWatchdog(HealthPolicy(on_nonfinite="halt"))
+        # client 1 didn't participate: its garbage row must not halt
+        s = wd.observe(
+            1, _telemetry(nonfinite_params=[0, 9, 0]),
+            np.asarray([1.0, 0.0, 1.0]), 0.5,
+        )
+        assert s["status"] == "ok"
+
+    def test_nonfinite_warn_mode_does_not_raise(self):
+        wd = HealthWatchdog(HealthPolicy(on_nonfinite="warn"))
+        s = wd.observe(1, _telemetry(nonfinite_loss=[1, 0, 0]), ALL, 0.5)
+        assert s["status"] == "warn"
+        assert s["checks_tripped"] == ["nonfinite"]
+
+    def test_loss_divergence_window_counts_consecutive(self):
+        wd = HealthWatchdog(HealthPolicy(
+            loss_divergence_window=2, loss_divergence_factor=2.0,
+        ))
+        wd.observe(1, _telemetry(), ALL, 1.0)   # best = 1.0
+        wd.observe(2, _telemetry(), ALL, 2.5)   # 1 divergent round
+        wd.observe(3, _telemetry(), ALL, 1.5)   # recovered: streak resets
+        wd.observe(4, _telemetry(), ALL, 2.5)   # 1
+        with pytest.raises(TrainingHealthError) as exc:
+            wd.observe(5, _telemetry(), ALL, 3.0)  # 2 consecutive -> halt
+        assert exc.value.check == "loss_divergence"
+        assert exc.value.round == 5
+
+    def test_dead_client_needs_consecutive_participating_rounds(self):
+        wd = HealthWatchdog(HealthPolicy(
+            dead_client_norm=1e-6, dead_client_rounds=2, on_dead_client="halt",
+        ))
+        dead = _telemetry(update_norm=[1.0, 0.0, 1.0])
+        wd.observe(1, dead, ALL, 0.5)
+        # round 2: client 1 not sampled — streak must neither grow nor reset
+        wd.observe(2, dead, np.asarray([1.0, 0.0, 1.0]), 0.5)
+        # round 3: alive update -> streak resets
+        wd.observe(3, _telemetry(update_norm=[1.0, 0.5, 1.0]), ALL, 0.5)
+        wd.observe(4, dead, ALL, 0.5)
+        with pytest.raises(TrainingHealthError) as exc:
+            wd.observe(5, dead, ALL, 0.5)
+        assert exc.value.check == "dead_client"
+        assert exc.value.clients == [1]
+
+    def test_contribution_skew_warns_on_dominating_client(self):
+        wd = HealthWatchdog(HealthPolicy(skew_ratio=10.0, on_skew="warn"))
+        s = wd.observe(
+            1, _telemetry(update_norm=[1.0, 50.0, 1.0]), ALL, 0.5,
+        )
+        assert s["status"] == "warn"
+        assert "contribution_skew" in s["checks_tripped"]
+
+    def test_all_zero_updates_are_not_skew(self):
+        # frozen/converged cohort: peak == median == 0 means nobody
+        # dominates — must NOT report an infinite ratio
+        wd = HealthWatchdog(HealthPolicy(skew_ratio=10.0, on_skew="halt"))
+        s = wd.observe(1, _telemetry(update_norm=[0.0, 0.0, 0.0]), ALL, 0.5)
+        assert s["status"] == "ok"
+        assert s["update_norm_skew"] == 0.0
+
+    def test_zero_median_with_positive_peak_is_maximal_skew(self):
+        wd = HealthWatchdog(HealthPolicy(skew_ratio=10.0, on_skew="warn"))
+        s = wd.observe(
+            1, _telemetry(update_norm=[0.0, 5.0, 0.0]), ALL, 0.5,
+        )
+        assert "contribution_skew" in s["checks_tripped"]
+
+    def test_reset_clears_per_run_state(self):
+        wd = HealthWatchdog(HealthPolicy(
+            loss_divergence_window=1, on_loss_divergence="halt",
+        ))
+        wd.observe(1, _telemetry(), ALL, 1.0)
+        with pytest.raises(TrainingHealthError):
+            wd.observe(2, _telemetry(), ALL, 5.0)
+        wd.reset()
+        # fresh run: 5.0 is the new baseline, no stale best-loss
+        assert wd.observe(1, _telemetry(), ALL, 5.0)["status"] == "ok"
+
+    def test_observe_exports_through_obs_and_reporters(self):
+        reg = MetricsRegistry()
+        obs = Observability(enabled=True, tracer=Tracer(), registry=reg)
+        seen = []
+
+        class Rep:
+            def report(self, payload, **kw):
+                seen.append((payload, kw))
+
+        wd = HealthWatchdog(HealthPolicy(on_nonfinite="warn"))
+        wd.observe(3, _telemetry(nonfinite_loss=[1, 0, 0]), ALL, 0.5,
+                   obs=obs, reporters=[Rep()])
+        assert reg.snapshot()["fl_health_nonfinite_clients"] == 1.0
+        assert reg.snapshot()["fl_health_warnings_total"] == 1.0
+        assert [e["event"] for e in reg.events] == ["health"]
+        assert seen[0][0]["health"]["status"] == "warn"
+        assert seen[0][1]["round"] == 3
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: seeded NaN injection on both execution modes (ISSUE acceptance)
+# ---------------------------------------------------------------------------
+
+def _sim(mode, poison_client=1):
+    out = []
+    for i in range(3):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(20 + i), 48, (5,), 2
+        )
+        x = np.asarray(x)
+        if i == poison_client:
+            x = x.copy()
+            x[:, 0] = np.nan  # poisoned shard -> non-finite training loss
+        out.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+    obs = Observability(
+        enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+        watchdog=HealthWatchdog(HealthPolicy(on_nonfinite="halt")),
+    )
+    return FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(10,), n_outputs=2)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05), strategy=FedAvg(), datasets=out, batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)), local_steps=2,
+        seed=3, observability=obs, execution_mode=mode,
+    ), obs
+
+
+@pytest.mark.parametrize("mode", ["pipelined", "chunked"])
+def test_nan_injection_caught_with_round_and_client(mode):
+    sim, obs = _sim(mode)
+    with pytest.raises(TrainingHealthError, match="round 1") as exc:
+        sim.fit(3)
+    assert exc.value.round == 1
+    assert exc.value.clients == [1]
+    assert exc.value.check == "nonfinite"
+    # round 1's record and health event landed before the halt
+    assert len(sim.history) >= 1
+    health = [e for e in obs.registry.events if e["event"] == "health"]
+    assert health[0]["status"] == "halt"
+    # pipelined path: the consumer/prefetcher tore down cleanly
+    assert sim._consumer is None and sim._prefetcher is None
+
+
+def test_watchdog_without_telemetry_is_inert_but_warns(caplog):
+    import logging
+
+    obs = Observability(
+        enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+        telemetry=False,
+        watchdog=HealthWatchdog(HealthPolicy(on_nonfinite="halt")),
+    )
+    x, y = synthetic_classification(jax.random.PRNGKey(0), 32, (5,), 2)
+    x = np.asarray(x).copy()
+    x[:, 0] = np.nan
+    sim = FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(10,), n_outputs=2)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05), strategy=FedAvg(),
+        datasets=[ClientDataset(x[:16], y[:16], x[16:], y[16:])],
+        batch_size=8, metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=1, observability=obs,
+    )
+    with caplog.at_level(logging.WARNING):
+        sim.fit(1)  # no telemetry -> no checks -> no raise
+    assert any("HealthWatchdog" in r.message for r in caplog.records)

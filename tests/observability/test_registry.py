@@ -98,12 +98,71 @@ class TestPrometheusExposition:
 
     def test_type_line_emitted_once_per_name(self):
         reg = MetricsRegistry()
-        reg.counter("c", labels={"s": "1"}).inc()
-        reg.counter("c", labels={"s": "2"}).inc(3)
+        reg.counter("c_total", labels={"s": "1"}).inc()
+        reg.counter("c_total", labels={"s": "2"}).inc(3)
         text = reg.to_prometheus()
-        assert text.count("# TYPE c counter") == 1
-        assert 'c{s="1"} 1' in text
-        assert 'c{s="2"} 3' in text
+        assert text.count("# TYPE c_total counter") == 1
+        assert 'c_total{s="1"} 1' in text
+        assert 'c_total{s="2"} 3' in text
+
+
+class TestPrometheusConformance:
+    """Exposition-format 0.0.4 conformance (ISSUE 3 satellite)."""
+
+    def test_counter_without_total_suffix_gains_it_in_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("requests", help="req count").inc(5)
+        text = reg.to_prometheus()
+        assert "# HELP requests_total req count" in text
+        assert "# TYPE requests_total counter" in text
+        assert "requests_total 5" in text
+        # the raw line without the suffix must NOT appear
+        assert "\nrequests 5" not in "\n" + text
+        # programmatic surfaces keep the registered name untouched
+        assert reg.snapshot()["requests"] == 5.0
+
+    def test_counter_with_total_suffix_unchanged(self):
+        reg = MetricsRegistry()
+        reg.counter("fl_rounds_total").inc()
+        text = reg.to_prometheus()
+        assert "fl_rounds_total 1" in text
+        assert "fl_rounds_total_total" not in text
+
+    def test_gauge_and_histogram_names_never_suffixed(self):
+        reg = MetricsRegistry()
+        reg.gauge("level").set(1)
+        reg.histogram("lat", buckets=(1.0,)).observe(0.5)
+        text = reg.to_prometheus()
+        assert "level_total" not in text and "lat_total" not in text
+
+    def test_type_and_help_once_per_family_across_label_children(self):
+        reg = MetricsRegistry()
+        reg.counter("frames", help="frame count", labels={"kind": "a"}).inc()
+        reg.counter("frames", labels={"kind": "b"}).inc(2)
+        text = reg.to_prometheus()
+        assert text.count("# TYPE frames_total counter") == 1
+        assert text.count("# HELP frames_total frame count") == 1
+        assert 'frames_total{kind="a"} 1' in text
+        assert 'frames_total{kind="b"} 2' in text
+
+    def test_label_value_escaping_full_set(self):
+        reg = MetricsRegistry()
+        reg.gauge("g", labels={"p": 'a"b\\c\nd'}).set(1)
+        assert 'p="a\\"b\\\\c\\nd"' in reg.to_prometheus()
+
+    def test_nan_gauge_renders_canonical_spelling(self):
+        reg = MetricsRegistry()
+        reg.gauge("g").set(float("nan"))
+        assert "g NaN" in reg.to_prometheus()
+
+    def test_help_text_escaped(self):
+        reg = MetricsRegistry()
+        reg.gauge("g", help="line1\nline2 \\ slash").set(1)
+        text = reg.to_prometheus()
+        assert "# HELP g line1\\nline2 \\\\ slash" in text
+        # exactly one physical HELP line — the newline never leaks raw
+        assert sum(1 for l in text.splitlines()
+                   if l.startswith("# HELP g")) == 1
 
 
 class TestEventLog:

@@ -51,12 +51,17 @@ def _sim(**kwargs):
 
 @pytest.fixture
 def obs(tmp_path):
-    # private tracer/registry: process-global state stays untouched
+    # private tracer/registry: process-global state stays untouched.
+    # per_round_spans opts into the per-round span timeline these tests
+    # assert on (it forces the pipelined path; plain enabled observability
+    # now keeps the chunked fast path — tests/observability/test_telemetry.py
+    # covers that side).
     return Observability(
         enabled=True,
         output_dir=str(tmp_path / "obs"),
         tracer=Tracer(),
         registry=MetricsRegistry(),
+        per_round_spans=True,
     )
 
 
@@ -133,7 +138,7 @@ class TestEnabled:
         reg = MetricsRegistry()
         obs = Observability(
             enabled=True, output_dir=str(tmp_path / "obs"),
-            tracer=tr, registry=reg,
+            tracer=tr, registry=reg, per_round_spans=True,
         )
         sim = _sim(observability=obs)
         sim.fit(1)
@@ -156,7 +161,7 @@ class TestEnabled:
         tr = Tracer(enabled=False)
         obs = Observability(
             enabled=True, output_dir=str(tmp_path / "obs"),
-            tracer=tr, registry=MetricsRegistry(),
+            tracer=tr, registry=MetricsRegistry(), per_round_spans=True,
         )
         sim = _sim(observability=obs)
 

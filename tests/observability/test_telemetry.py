@@ -1,0 +1,222 @@
+"""In-graph round telemetry (ISSUE 3 acceptance surface): enabling
+observability keeps the chunked fast path, telemetry-on trajectories are
+bit-identical to telemetry-off on BOTH execution modes, chunked and
+pipelined telemetry values agree, and the per-client statistics carry the
+right signals (grad/update norms, DP clip fraction, non-finite counts,
+divergence). CPU; donation is gated off per the known jaxlib cache hazard."""
+
+import math
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.observability import MetricsRegistry, Observability, Tracer
+from fl4health_tpu.observability.telemetry import (
+    TELEMETRY_FIELDS,
+    summarize_host,
+)
+from fl4health_tpu.server.simulation import (
+    EXEC_CHUNKED,
+    ClientDataset,
+    FederatedSimulation,
+)
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+N_CLASSES = 2
+N_ROUNDS = 3
+
+
+def _datasets(poison_client=None):
+    out = []
+    for i in range(3):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(5 + i), 48, (5,), N_CLASSES
+        )
+        x = np.asarray(x)
+        if i == poison_client:
+            x = x.copy()
+            x[:, 0] = np.nan
+        out.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+    return out
+
+
+def _sim(obs=None, **kwargs):
+    defaults = dict(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(10,), n_outputs=N_CLASSES)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=_datasets(),
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=2,
+        seed=7,
+        observability=obs,
+    )
+    defaults.update(kwargs)
+    return FederatedSimulation(**defaults)
+
+
+def _obs(**kw):
+    return Observability(
+        enabled=True, tracer=Tracer(), registry=MetricsRegistry(), **kw
+    )
+
+
+def _telemetry_events(obs):
+    return [e for e in obs.registry.events if e["event"] == "telemetry"]
+
+
+# ---------------------------------------------------------------------------
+# Mode selection (the CI smoke test of the ISSUE: observability keeps auto
+# on the chunked path)
+# ---------------------------------------------------------------------------
+
+def test_observability_enabled_auto_selects_chunked_smoke():
+    obs = _obs()
+    sim = _sim(obs)
+    mode, reason = sim._select_execution_mode(N_ROUNDS)
+    assert mode == EXEC_CHUNKED
+    sim.fit(N_ROUNDS)
+    assert sim._active_execution_mode == EXEC_CHUNKED
+    # ...and the run actually produced per-round telemetry + round events
+    assert len(_telemetry_events(obs)) == N_ROUNDS
+    rounds = [e for e in obs.registry.events if e["event"] == "round"]
+    assert [e["round"] for e in rounds] == list(range(1, N_ROUNDS + 1))
+
+
+# ---------------------------------------------------------------------------
+# Bit-identical parity (telemetry must be a pure extra output)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["pipelined", "chunked"])
+def test_trajectory_bit_identical_with_and_without_telemetry(mode):
+    h_off = _sim(None, execution_mode=mode).fit(N_ROUNDS)
+    h_on = _sim(_obs(), execution_mode=mode).fit(N_ROUNDS)
+    # EXACT equality, not allclose: telemetry adds outputs, never math
+    assert [r.fit_losses["backward"] for r in h_on] == [
+        r.fit_losses["backward"] for r in h_off
+    ]
+    assert [r.eval_losses["checkpoint"] for r in h_on] == [
+        r.eval_losses["checkpoint"] for r in h_off
+    ]
+
+
+def test_chunked_and_pipelined_telemetry_agree():
+    obs_c, obs_p = _obs(), _obs()
+    _sim(obs_c, execution_mode="chunked").fit(N_ROUNDS)
+    _sim(obs_p, execution_mode="pipelined").fit(N_ROUNDS)
+    tel_c, tel_p = _telemetry_events(obs_c), _telemetry_events(obs_p)
+    assert len(tel_c) == len(tel_p) == N_ROUNDS
+    for ec, ep in zip(tel_c, tel_p):
+        assert ec["round"] == ep["round"]
+        for field in TELEMETRY_FIELDS:
+            np.testing.assert_allclose(
+                ec[field], ep[field], rtol=1e-5, atol=1e-7,
+                err_msg=f"round {ec['round']} field {field}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Field semantics
+# ---------------------------------------------------------------------------
+
+def test_telemetry_fields_sane_without_dp():
+    obs = _obs()
+    _sim(obs).fit(1)
+    t = _telemetry_events(obs)[0]
+    n = 3
+    for field in TELEMETRY_FIELDS:
+        assert len(t[field]) == n, field
+    assert all(g > 0 for g in t["grad_norm_mean"])
+    assert all(gmax >= gmean for gmax, gmean in
+               zip(t["grad_norm_max"], t["grad_norm_mean"]))
+    assert all(u > 0 for u in t["update_norm"])
+    assert all(d >= 0 for d in t["divergence"])
+    assert all(lo <= hi for lo, hi in
+               zip(t["train_loss_min"], t["train_loss_max"]))
+    # no DP in the logic: the clip channel reports NaN, not a fake 0
+    assert all(math.isnan(c) for c in t["clip_fraction"])
+    assert all(v == 0 for v in t["nonfinite_params"])
+    assert all(v == 0 for v in t["nonfinite_loss"])
+    assert all(v == 0 for v in t["nonfinite_eval_loss"])
+
+
+def test_poisoned_client_surfaces_in_nonfinite_counts():
+    obs = _obs()
+    _sim(obs, datasets=_datasets(poison_client=1)).fit(1)
+    t = _telemetry_events(obs)[0]
+    assert t["nonfinite_loss"][1] > 0
+    assert t["nonfinite_loss"][0] == 0 and t["nonfinite_loss"][2] == 0
+
+
+def test_dp_clip_fraction_measured():
+    from fl4health_tpu.clients.instance_level_dp import (
+        InstanceLevelDpClientLogic,
+    )
+
+    obs = _obs()
+    sim = _sim(
+        obs,
+        logic=InstanceLevelDpClientLogic(
+            engine.from_flax(Mlp(features=(10,), n_outputs=N_CLASSES)),
+            engine.masked_cross_entropy,
+            clipping_bound=0.05,  # tight bound: clipping must actually fire
+            noise_multiplier=0.3,
+        ),
+    )
+    sim.fit(1)
+    t = _telemetry_events(obs)[0]
+    assert all(0.0 <= c <= 1.0 for c in t["clip_fraction"])
+    assert any(c > 0 for c in t["clip_fraction"])
+    # and the summary gauge landed
+    assert 0.0 <= obs.registry.snapshot()["fl_dp_clip_fraction"] <= 1.0
+
+
+def test_round_event_carries_telemetry_summaries_on_both_modes():
+    for mode in ("chunked", "pipelined"):
+        obs = _obs()
+        _sim(obs, execution_mode=mode).fit(1)
+        rnd = [e for e in obs.registry.events if e["event"] == "round"][0]
+        for key in ("grad_norm_max", "update_norm_mean", "clip_fraction",
+                    "nonfinite", "divergence_max", "fit_loss_std",
+                    "fit_loss_spread"):
+            assert key in rnd, (mode, key)
+        # satellite: per-round gauges are uniform across execution modes
+        snap = obs.registry.snapshot()
+        assert snap["fl_rounds_total"] == 1.0, mode
+        for gauge in ("fl_fit_loss_std", "fl_fit_loss_spread",
+                      "fl_fit_grad_norm_max", "fl_fit_update_norm_min",
+                      "fl_fit_divergence_max", "fl_nonfinite_values"):
+            assert gauge in snap, (mode, gauge)
+        assert snap["fl_broadcast_bytes_total"] > 0, mode
+
+
+def test_early_stopping_path_collects_engine_telemetry():
+    obs = _obs()
+    sim = _sim(
+        obs, local_steps=None, local_epochs=2,
+        early_stopping=engine.EarlyStoppingConfig(interval_steps=2, patience=2),
+    )
+    sim.fit(1)
+    t = _telemetry_events(obs)[0]
+    assert all(np.isfinite(t["grad_norm_mean"]))
+    assert all(np.isfinite(t["train_loss_min"]))
+
+
+def test_summarize_host_filters_by_mask():
+    tel = {k: np.asarray([1.0, 100.0, 2.0]) for k in TELEMETRY_FIELDS}
+    s = summarize_host(tel, np.asarray([1.0, 0.0, 1.0]))
+    # client 1 (masked out) must not contaminate the summaries
+    assert s["grad_norm_max"] == 2.0
+    assert s["update_norm_mean"] == 1.5
+    assert s["divergence_max"] == 2.0

@@ -396,14 +396,38 @@ def test_invalid_execution_mode_rejected_at_construction():
         _sim(execution_mode="warp-speed")
 
 
-def test_observability_enabled_selects_pipelined():
+def test_observability_enabled_keeps_chunked_path():
+    """In-graph telemetry rides the chunked scan: enabling observability
+    alone must NOT demote auto off the single-dispatch fast path (the
+    visibility-vs-speed tradeoff this telemetry design removes)."""
     from fl4health_tpu.observability import MetricsRegistry, Observability, Tracer
 
     obs = Observability(enabled=True, tracer=Tracer(), registry=MetricsRegistry())
     sim = _sim(observability=obs)
-    mode, reason = sim._select_execution_mode(2)
-    assert mode == EXEC_PIPELINED
-    assert "observability" in reason
+    mode, _reason = sim._select_execution_mode(2)
+    assert mode == EXEC_CHUNKED
+
+
+def test_per_round_spans_and_xprof_still_select_pipelined():
+    """Only the two intrinsically per-round-dispatch hooks still demote."""
+    from fl4health_tpu.observability import MetricsRegistry, Observability, Tracer
+
+    obs = Observability(enabled=True, tracer=Tracer(),
+                        registry=MetricsRegistry(), per_round_spans=True)
+    mode, reason = _sim(observability=obs)._select_execution_mode(2)
+    assert mode == EXEC_PIPELINED and "per_round_spans" in reason
+
+    obs2 = Observability(enabled=True, tracer=Tracer(),
+                         registry=MetricsRegistry(), profile_round_idx=1,
+                         output_dir="/tmp/xprof-demote-test")
+    mode, reason = _sim(observability=obs2)._select_execution_mode(2)
+    assert mode == EXEC_PIPELINED and "XProf" in reason
+
+    # profile_round_idx without an output_dir can never capture anything:
+    # it must NOT cost the chunked fast path
+    obs3 = Observability(enabled=True, tracer=Tracer(),
+                         registry=MetricsRegistry(), profile_round_idx=1)
+    assert _sim(observability=obs3)._select_execution_mode(2)[0] == EXEC_CHUNKED
 
 
 def test_legacy_state_checkpointer_sees_consistent_round_state(tmp_path):

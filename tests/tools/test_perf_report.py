@@ -93,3 +93,59 @@ def test_cli_empty_log_fails(tmp_path):
         capture_output=True, text=True,
     )
     assert out.returncode == 1
+
+
+def test_cli_missing_file_exits_2_without_traceback(tmp_path):
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"),
+         str(tmp_path / "nope.jsonl")],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 2
+    assert "Traceback" not in out.stderr
+    assert "cannot read" in out.stderr
+
+
+def test_cli_unparseable_log_fails(tmp_path):
+    path = tmp_path / "garbage.jsonl"
+    path.write_text("{not json\nalso not json\n")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 1
+
+
+def test_telemetry_columns_render_when_present():
+    rounds = [_round(1, grad_norm_max=1.25, update_norm_mean=0.5,
+                     clip_fraction=0.75, nonfinite=0, divergence_max=0.01),
+              _round(2, grad_norm_max=1.5, update_norm_mean=0.4,
+                     clip_fraction=float("nan"), nonfinite=2,
+                     divergence_max=0.02)]
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    for col in ("grad_norm", "upd_norm", "clip_frac", "nonfinite", "diverg"):
+        assert col in header
+    # NaN telemetry (round 2's clip fraction) renders as '-'
+    assert "-" in table.splitlines()[3].split()
+    assert "0.75" in table.splitlines()[2]
+
+
+def test_telemetry_columns_absent_for_old_logs():
+    table = perf_report.render_table([_round(1), _round(2)])
+    header = table.splitlines()[0].split()
+    assert "grad_norm" not in header and "diverg" not in header
+    # exact legacy shape preserved
+    assert header == [h for h, _, _ in perf_report.COLUMNS]
+
+
+def test_json_mode_passes_telemetry_fields_through(tmp_path):
+    path = _log(tmp_path, [_round(1, grad_norm_max=2.0, nonfinite=1)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    )
+    doc = json.loads(out.stdout)
+    assert doc["rounds"][0]["grad_norm_max"] == 2.0
+    assert doc["rounds"][0]["nonfinite"] == 1

@@ -35,6 +35,17 @@ COLUMNS = (
     ("failures", "failures", lambda v: str(int(v))),
 )
 
+# In-graph telemetry summary fields (observability/telemetry.py). Optional:
+# a column renders only when at least one round event carries the field, so
+# pre-telemetry logs keep their exact old table shape.
+TELEMETRY_COLUMNS = (
+    ("grad_norm", "grad_norm_max", lambda v: f"{v:.3g}"),
+    ("upd_norm", "update_norm_mean", lambda v: f"{v:.3g}"),
+    ("clip_frac", "clip_fraction", lambda v: f"{v:.2f}"),
+    ("nonfinite", "nonfinite", lambda v: str(int(v))),
+    ("diverg", "divergence_max", lambda v: f"{v:.3g}"),
+)
+
 
 def load_round_events(path: str) -> list[dict]:
     """Parse the JSONL log, keeping only ``round`` events (other event kinds
@@ -57,16 +68,31 @@ def load_round_events(path: str) -> list[dict]:
     return sorted(rounds, key=lambda r: r.get("round", 0))
 
 
+def active_columns(rounds: list[dict]) -> tuple:
+    """Base columns plus any telemetry column present in >=1 round event."""
+    extra = tuple(
+        col for col in TELEMETRY_COLUMNS
+        if any(col[1] in rec for rec in rounds)
+    )
+    return COLUMNS + extra
+
+
 def render_table(rounds: Iterable[dict]) -> str:
-    """Aligned plain-text table; missing fields render as '-'."""
-    rows = [[h for h, _, _ in COLUMNS]]
+    """Aligned plain-text table; missing fields render as '-'; NaN
+    telemetry values (e.g. clip fraction without DP) render as '-' too."""
+    rounds = list(rounds)
+    columns = active_columns(rounds)
+    rows = [[h for h, _, _ in columns]]
     for rec in rounds:
         row = []
-        for _, field, fmt in COLUMNS:
+        for _, field, fmt in columns:
             v = rec.get(field)
-            row.append("-" if v is None else fmt(float(v)))
+            if v is None or (isinstance(v, float) and v != v):
+                row.append("-")
+            else:
+                row.append(fmt(float(v)))
         rows.append(row)
-    widths = [max(len(r[i]) for r in rows) for i in range(len(COLUMNS))]
+    widths = [max(len(r[i]) for r in rows) for i in range(len(columns))]
     lines = []
     for n, row in enumerate(rows):
         lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
@@ -105,8 +131,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
     args = ap.parse_args(argv)
-    rounds = load_round_events(args.log)
+    try:
+        rounds = load_round_events(args.log)
+    except OSError as e:
+        # a missing/unreadable log is an error exit, not a traceback
+        print(f"perf_report: cannot read {args.log}: {e}", file=sys.stderr)
+        return 2
     if not rounds:
+        # empty or fully-unparseable JSONL: loud non-zero exit, never an
+        # empty table a CI grep would happily accept
         print(f"no 'round' events in {args.log}", file=sys.stderr)
         return 1
     if args.json:
