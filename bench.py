@@ -6,8 +6,16 @@ Prints ONE JSON line. Primary fields {"metric", "value", "unit",
 the eager-dispatch speedup; provenance and speed facts ride along:
   platform/device_kind  — which backend actually ran (a CPU fallback can
                           never masquerade as the TPU number),
-  tflops/mfu_pct        — achieved TFLOP/s from XLA's compiled cost analysis
-                          and the fraction of the chip's bf16 peak,
+  tflops/mfu_pct        — achieved TFLOP/s and the fraction of the chip's
+                          bf16 peak; tflops_measured (XLA compiled cost
+                          analysis) vs tflops_analytic (formula count) are
+                          reported separately, and every one of these is
+                          null — never 0.0 — when no measured or applicable
+                          analytic number exists for the backend,
+  program_introspection — the compiled fit_round's cost/memory analysis
+                          (flops, bytes accessed, HBM footprint, compile
+                          wall) plus hbm_headroom_bytes where capacity is
+                          known,
   dtype                 — compute dtype (bf16 on TPU, fp32 on CPU fallback),
   transformer           — the same measurements for the transformer config.
 
@@ -41,16 +49,10 @@ LOCAL_STEPS = int(os.environ.get("FL4HEALTH_BENCH_STEPS", 5))
 TIMED_ROUNDS = int(os.environ.get("FL4HEALTH_BENCH_ROUNDS", 3))
 CHILD_TIMEOUT_S = int(os.environ.get("FL4HEALTH_BENCH_TIMEOUT_S", 1500))
 
-# Published bf16 peak matmul throughput per chip (dense, per-device). Used
-# only to express achieved FLOP/s as a fraction; unknown kinds report no MFU.
-PEAK_BF16_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,  # v6e / Trillium
-}
+# Published bf16 peak matmul throughput per chip lives in the shared spec
+# table (observability/device_specs.py — also the MFU denominator for the
+# per-round measured numbers fit() now records). Unknown kinds report no MFU.
+from fl4health_tpu.observability import device_specs  # noqa: E402 (no jax at import)
 
 # FLOP-based bridge to the north star (BASELINE.json: >=10x vs single-A100
 # Flower simulation). The A100 run cannot exist in this environment, so the
@@ -266,30 +268,40 @@ def make_sim(model_kind: str = "cifar_cnn"):
 
 
 def compile_fit_round(sim):
-    """AOT-compile fit_round ONCE; return (compiled, flops_per_round).
+    """AOT-compile fit_round ONCE; return (compiled, ProgramReport).
 
     The compiled executable is reused for the timed rounds so the multi-
-    minute XLA compile of the big configs is paid a single time, and its
-    cost_analysis() provides the MFU numerator. flops is 0.0 when the
-    backend doesn't expose a cost model.
+    minute XLA compile of the big configs is paid a single time; its XLA
+    cost/memory analysis (observability/introspect.py) provides the MFU
+    numerator plus the HBM footprint. Report fields are ``None`` (never a
+    fake 0.0) where the backend exposes no analysis.
     """
+    import jax
     import jax.numpy as jnp
+
+    from fl4health_tpu.observability.introspect import (
+        ProgramReport,
+        analyze_compiled,
+    )
 
     mask = sim.client_manager.sample_all()
     batches = sim._round_batches(0)
     val_batches, _ = sim._val_batches()
+    t0 = time.perf_counter()
     compiled = sim._fit_round.lower(
         sim.server_state, sim.client_states, batches, mask,
         jnp.asarray(1, jnp.int32), val_batches,
     ).compile()
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops = float((cost or {}).get("flops", 0.0))
-    except Exception:
-        flops = 0.0
-    return compiled, flops
+    compile_s = time.perf_counter() - t0
+    d = jax.devices()[0]
+    report = ProgramReport(
+        name="fit_round",
+        backend=d.platform,
+        device_kind=getattr(d, "device_kind", "unknown"),
+        compile_seconds=compile_s,
+        **analyze_compiled(compiled),
+    )
+    return compiled, report
 
 
 def timed_chunked_rounds(sim) -> float:
@@ -513,20 +525,30 @@ def timed_eager_round(sim) -> tuple[float, int]:
 
 def _measure_config(model_kind: str, with_eager: bool) -> dict:
     analytic_flops, sim = make_sim(model_kind)
-    compiled, round_flops = compile_fit_round(sim)
-    flops_source = "xla_cost_analysis"
+    compiled, prog = compile_fit_round(sim)
+    measured_flops = prog.flops  # None where XLA exposes no cost model
     if analytic_flops is not None:
         # Pallas custom-call FLOPs are invisible to the cost model; the
         # analytic count is the honest MFU numerator for those configs —
         # and, under FL4HEALTH_BENCH_ANALYTIC_FLOPS=1, for the dense arm of
         # an A/B too, so both arms share one numerator. Keep the cost-model
-        # figure in the artifact for transparency.
-        xla_flops, round_flops = round_flops, analytic_flops
+        # figure in the artifact for transparency (tflops_measured).
+        round_flops = analytic_flops
+        cm = (f"{measured_flops / 1e12:.3f}" if measured_flops is not None
+              else "nothing")
         flops_source = (
             "analytic_3x_fwd (one numerator for all attention arms; XLA "
             "cost_analysis cannot see Pallas custom-call FLOPs — cost model "
-            f"said {xla_flops / 1e12:.3f} TFLOP/round)"
+            f"said {cm} TFLOP/round)"
         )
+    elif measured_flops:
+        round_flops = measured_flops
+        flops_source = "xla_cost_analysis"
+    else:
+        # no measured AND no applicable analytic number: every downstream
+        # tflops/mfu field must be null, never a misleading 0.0
+        round_flops = None
+        flops_source = None
     per_round_dispatch = timed_compiled_rounds(sim, compiled)
     # Two supported execution modes: per-round dispatch and the on-device
     # multi-round scan (one dispatch per TIMED_ROUNDS rounds; semantics
@@ -543,9 +565,10 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
     steps_per_round = sim.n_clients * LOCAL_STEPS
     compiled_sps = steps_per_round / per_round
 
-    achieved_flops = round_flops / per_round if round_flops else 0.0
+    achieved_flops = round_flops / per_round if round_flops else None
     _, device_kind = _provenance()
-    peak = PEAK_BF16_FLOPS.get(device_kind)
+    peak = device_specs.peak_bf16_flops(device_kind)
+    hbm_total = device_specs.device_memory_bytes()
     out = {
         "steps_per_sec_per_chip": round(compiled_sps, 2),
         "execution_mode": (
@@ -560,13 +583,32 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
             round(steps_per_round / per_round_chunked, 2)
             if per_round_chunked != float("inf") else None
         ),
-        "tflops": round(achieved_flops / 1e12, 3),
-        "mfu_pct": round(100.0 * achieved_flops / peak, 2) if peak else None,
+        # headline tflops = the flops_source numerator over the fastest
+        # measured mode; null (not 0.0) when no numerator exists
+        "tflops": (round(achieved_flops / 1e12, 3)
+                   if achieved_flops else None),
+        # measured vs analytic split: tflops_measured is XLA's cost-model
+        # count over the same wall time, tflops_analytic the formula count
+        "tflops_measured": (round(measured_flops / per_round / 1e12, 3)
+                            if measured_flops else None),
+        "tflops_analytic": (round(analytic_flops / per_round / 1e12, 3)
+                            if analytic_flops else None),
+        "mfu_pct": (round(100.0 * achieved_flops / peak, 2)
+                    if peak and achieved_flops else None),
         "flops_source": flops_source,
+        # compiled fit_round's cost/memory introspection (flops, bytes
+        # accessed, HBM footprint, compile wall) — the per-program
+        # accounting the observability subsystem records for fit()
+        "program_introspection": {"fit_round": prog.as_dict()},
+        "hbm_headroom_bytes": (
+            int(hbm_total - prog.peak_hbm_bytes)
+            if hbm_total is not None and prog.peak_hbm_bytes is not None
+            else None
+        ),
     }
     # Only meaningful against a real accelerator measurement: the bridge on
     # a CPU-fallback number would "model" nothing.
-    if peak:
+    if peak and achieved_flops:
         out["vs_a100_flower_modeled"] = modeled_vs_a100_flower(achieved_flops)
     if with_eager:
         eager_time, eager_measured = timed_eager_round(sim)
@@ -667,8 +709,16 @@ def run_measurement() -> None:
         # No real CIFAR/MNIST exists on this box (zero egress); the moment a
         # real corpus drives the bench this field must say so.
         "data_provenance": "synthetic",
+        # null (never 0.0) when no measured or applicable analytic FLOP
+        # number exists for this backend/config
         "tflops": cifar["tflops"],
+        "tflops_measured": cifar["tflops_measured"],
+        "tflops_analytic": cifar["tflops_analytic"],
         "mfu_pct": cifar["mfu_pct"],
+        "flops_source": cifar["flops_source"],
+        # per-program XLA cost/memory introspection + HBM headroom
+        "program_introspection": cifar["program_introspection"],
+        "hbm_headroom_bytes": cifar["hbm_headroom_bytes"],
         # Assumption-based bridge to BASELINE.json's >=10x-vs-A100-Flower
         # north star (see modeled_vs_a100_flower); null off-TPU.
         "vs_a100_flower_modeled": cifar.get("vs_a100_flower_modeled"),

@@ -23,7 +23,18 @@ instruments, bundled here:
 - :mod:`~fl4health_tpu.observability.health` — the ``HealthWatchdog``
   consuming that telemetry against a declarative ``HealthPolicy``
   (NaN/Inf, loss divergence, dead clients, contribution skew), able to
-  halt ``fit()`` with a structured ``TrainingHealthError``.
+  halt ``fit()`` with a structured ``TrainingHealthError``;
+- :mod:`~fl4health_tpu.observability.introspect` — COMPILED-program
+  introspection: per-program XLA cost/memory analysis (FLOPs, bytes
+  accessed, HBM footprint), compile time and persistent-cache
+  attribution, feeding measured MFU and the HBM-headroom gauge;
+- :mod:`~fl4health_tpu.observability.exposition` /
+  :mod:`~fl4health_tpu.observability.manifest` — a stdlib-only HTTP pull
+  endpoint (``/metrics`` Prometheus text, ``/manifest`` run-provenance
+  JSON) so a live ``fit()`` can be scraped mid-run;
+- :mod:`~fl4health_tpu.observability.device_specs` — published per-chip
+  peaks (bf16 FLOP/s, HBM capacity/bandwidth), the denominators for MFU
+  and roofline positions.
 
 :class:`Observability` is the facade ``FederatedSimulation`` accepts: it
 wires all three to the process-wide defaults (so transport byte counters
@@ -36,11 +47,17 @@ from __future__ import annotations
 import os
 from typing import Any
 
+from fl4health_tpu.observability.exposition import ScrapeServer
 from fl4health_tpu.observability.health import (
     HealthPolicy,
     HealthWatchdog,
     TrainingHealthError,
 )
+from fl4health_tpu.observability.introspect import (
+    ProgramIntrospector,
+    ProgramReport,
+)
+from fl4health_tpu.observability.manifest import config_hash, run_manifest
 from fl4health_tpu.observability.jaxmon import (
     CompileMonitor,
     profile_round,
@@ -74,6 +91,11 @@ __all__ = [
     "HealthPolicy",
     "HealthWatchdog",
     "TrainingHealthError",
+    "ProgramIntrospector",
+    "ProgramReport",
+    "ScrapeServer",
+    "run_manifest",
+    "config_hash",
     "get_tracer",
     "set_tracer",
     "get_registry",
@@ -110,6 +132,16 @@ class Observability:
     per-round granularity — with it off, enabling observability no longer
     demotes the chunked-scan execution mode (only ``profile_round_idx``
     still does).
+
+    ``introspection`` (default on) captures each compiled round program's
+    XLA cost/memory analysis at build time (``ProgramIntrospector``),
+    which powers measured per-round MFU and the HBM-headroom gauge — all
+    at program-build time, zero per-round cost. ``http_port`` (opt-in)
+    starts the :class:`ScrapeServer` pull endpoint (``/metrics`` +
+    ``/manifest``) for the handle's armed lifetime; ``http_port=0`` binds
+    an OS-assigned port, readable from ``scrape_url``. The endpoint binds
+    loopback by default — set ``http_host="0.0.0.0"`` for a remote
+    Prometheus to reach it.
     """
 
     def __init__(
@@ -123,6 +155,9 @@ class Observability:
         telemetry: bool = True,
         per_round_spans: bool = False,
         watchdog: "HealthWatchdog | None" = None,
+        introspection: bool = True,
+        http_port: int | None = None,
+        http_host: str = "127.0.0.1",
     ):
         self.enabled = enabled
         self.output_dir = output_dir
@@ -133,6 +168,12 @@ class Observability:
         self.telemetry = telemetry
         self.per_round_spans = per_round_spans
         self.watchdog = watchdog
+        self.introspection = introspection
+        self.http_port = http_port
+        self.http_host = http_host
+        self.introspector = ProgramIntrospector(self.registry)
+        self._manifest: dict[str, Any] = {}
+        self._scrape_server: ScrapeServer | None = None
         self.compile_monitor = CompileMonitor(self.registry)
         # Ownership of the tracer's enabled flag: only the handle that
         # actually flipped it on may flip it off (and clear its events) at
@@ -147,6 +188,28 @@ class Observability:
         """True when the round programs should compile in-graph
         RoundTelemetry outputs."""
         return self.enabled and self.telemetry
+
+    @property
+    def introspection_enabled(self) -> bool:
+        """True when compiled-program introspection should run at program
+        build time."""
+        return self.enabled and self.introspection
+
+    @property
+    def scrape_url(self) -> str | None:
+        """Base URL of the live scrape endpoint, or None when not serving."""
+        return self._scrape_server.url if self._scrape_server else None
+
+    # -- run manifest ----------------------------------------------------
+    def update_manifest(self, fields: "dict[str, Any]") -> dict:
+        """Merge ``fields`` into the run manifest served at ``/manifest``
+        (and exported as manifest.json). Returns the current manifest."""
+        self._manifest.update(fields)
+        return dict(self._manifest)
+
+    @property
+    def manifest(self) -> dict:
+        return dict(self._manifest)
 
     def start(self) -> "Observability":
         """(Re-)arm the hooks: enable the tracer, install the compile
@@ -163,6 +226,15 @@ class Observability:
                 self.tracer.enabled = True
                 self._owns_tracer_enable = True
             self.compile_monitor.install()
+            if self.http_port is not None and self._scrape_server is None:
+                # live pull endpoint for the armed lifetime of the handle —
+                # a scrape reads host-side floats only (no device work)
+                self._scrape_server = ScrapeServer(
+                    self.registry,
+                    manifest_provider=lambda: dict(self._manifest),
+                    host=self.http_host,
+                    port=self.http_port,
+                )
         return self
 
     # -- tracing ---------------------------------------------------------
@@ -218,7 +290,7 @@ class Observability:
         if not self.enabled or self.output_dir is None:
             return {}
         os.makedirs(self.output_dir, exist_ok=True)
-        return {
+        paths = {
             "trace": self.tracer.export(os.path.join(self.output_dir, "trace.json")),
             "prometheus": self.registry.export_prometheus(
                 os.path.join(self.output_dir, "metrics.prom")
@@ -227,6 +299,16 @@ class Observability:
                 os.path.join(self.output_dir, "metrics.jsonl")
             ),
         }
+        if self._manifest:
+            import json
+
+            from fl4health_tpu.core.io import atomic_write
+
+            mpath = os.path.join(self.output_dir, "manifest.json")
+            with atomic_write(mpath) as f:
+                f.write(json.dumps(self._manifest, indent=2, default=str))
+            paths["manifest"] = mpath
+        return paths
 
     def shutdown(self) -> dict[str, str]:
         """Export artifacts and disarm every hook: detach the compile
@@ -237,6 +319,9 @@ class Observability:
         run 1's events into run 2's trace). ``start()`` re-arms."""
         paths = self.export()
         self.compile_monitor.uninstall()
+        if self._scrape_server is not None:
+            self._scrape_server.close()
+            self._scrape_server = None
         if self._owns_tracer_enable:
             self.tracer.enabled = False
             self.tracer.clear()

@@ -1,0 +1,117 @@
+"""Published per-chip peak specs — the denominators for MFU and roofline.
+
+XLA's ``cost_analysis()`` gives the numerator (FLOPs, bytes accessed per
+compiled program); turning that into "how close to the hardware are we"
+needs the chip's peak matmul throughput, HBM capacity and HBM bandwidth.
+This table holds the published numbers keyed by JAX's ``device_kind``
+string, normalized so v5e/"v5 lite"-style aliases resolve to one entry.
+
+Capacity prefers the *live* number: ``device.memory_stats()["bytes_limit"]``
+is what the runtime will actually let a program allocate (it accounts for
+reserved framework memory); the spec byte count is the fallback when the
+backend exposes no stats (CPU, some plugin builds).
+
+No module-level ``jax`` import: ``bench.py`` and the exposition endpoint
+import this before/without touching the backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+GIB = 1024**3
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Published per-chip peaks (dense, per-device)."""
+
+    peak_bf16_flops: float  # matmul peak, FLOP/s
+    hbm_bytes: int          # on-chip high-bandwidth memory capacity
+    hbm_bw_bytes_per_s: float  # HBM bandwidth (roofline ridge denominator)
+
+
+# Keyed by normalized device_kind (see _normalize). Sources: published TPU
+# spec sheets; the bf16 peaks match the table bench.py has carried since r1.
+DEVICE_SPECS: dict[str, DeviceSpec] = {
+    "TPU v2": DeviceSpec(45e12, 8 * GIB, 700e9),
+    "TPU v3": DeviceSpec(123e12, 16 * GIB, 900e9),
+    "TPU v4": DeviceSpec(275e12, 32 * GIB, 1228e9),
+    "TPU v5e": DeviceSpec(197e12, 16 * GIB, 819e9),
+    "TPU v5p": DeviceSpec(459e12, 95 * GIB, 2765e9),
+    "TPU v6e": DeviceSpec(918e12, 32 * GIB, 1640e9),
+}
+
+# device_kind spellings observed in the wild -> canonical table key.
+_ALIASES = {
+    "TPU v5 lite": "TPU v5e",
+    "TPU v5litepod": "TPU v5e",
+    "TPU v5": "TPU v5p",
+    "TPU v6 lite": "TPU v6e",
+    "TPU v6": "TPU v6e",
+}
+
+
+def _normalize(device_kind: str | None) -> str | None:
+    if not device_kind:
+        return None
+    kind = device_kind.strip()
+    return _ALIASES.get(kind, kind)
+
+
+def lookup(device_kind: str | None) -> DeviceSpec | None:
+    """Spec for a ``device_kind`` string, or None when unknown (CPU, new
+    chips the table hasn't learned yet — callers must treat peaks as
+    unavailable rather than guessing)."""
+    kind = _normalize(device_kind)
+    return DEVICE_SPECS.get(kind) if kind else None
+
+
+def peak_bf16_flops(device_kind: str | None) -> float | None:
+    spec = lookup(device_kind)
+    return spec.peak_bf16_flops if spec else None
+
+
+def device_memory_bytes(device=None) -> int | None:
+    """Usable device memory in bytes: the runtime's live ``bytes_limit``
+    when exposed, else the spec-table capacity, else None (CPU)."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats and stats.get("bytes_limit"):
+        return int(stats["bytes_limit"])
+    spec = lookup(getattr(device, "device_kind", None))
+    return spec.hbm_bytes if spec else None
+
+
+def mfu_pct(achieved_flops_per_s: float, device_kind: str | None) -> float | None:
+    """Achieved FLOP/s as a percent of the chip's bf16 peak; None when the
+    peak is unknown (never report a made-up MFU)."""
+    peak = peak_bf16_flops(device_kind)
+    if not peak or achieved_flops_per_s is None:
+        return None
+    return 100.0 * achieved_flops_per_s / peak
+
+
+def roofline(flops: float | None, bytes_accessed: float | None,
+             device_kind: str | None) -> dict | None:
+    """Roofline position of one program: arithmetic intensity (FLOPs per
+    HBM byte) against the chip's ridge point (peak FLOP/s ÷ HBM BW). A
+    program left of the ridge is bandwidth-bound — more MFU requires less
+    memory traffic, not more compute. Returns None without both numerators.
+    """
+    if not flops or not bytes_accessed:
+        return None
+    intensity = flops / bytes_accessed
+    spec = lookup(device_kind)
+    out = {"intensity_flops_per_byte": intensity}
+    if spec:
+        ridge = spec.peak_bf16_flops / spec.hbm_bw_bytes_per_s
+        out["ridge_flops_per_byte"] = ridge
+        out["compute_bound"] = intensity >= ridge
+    return out

@@ -1,0 +1,97 @@
+"""Live scrape endpoint — pull-based exposition of the metrics registry.
+
+Until now the Prometheus text rendering only landed on disk at
+``Observability.export()`` (end of ``fit()``), so a multi-hour run was a
+black box while it mattered most. This module serves the SAME registry
+over a stdlib-only HTTP endpoint so a live ``fit()`` can be scraped
+mid-run by an actual Prometheus (or ``curl``):
+
+- ``GET /metrics``  — ``MetricsRegistry.to_prometheus()``, text
+  exposition format 0.0.4 (the conformance rules ``registry.py`` already
+  enforces: ``_total`` suffixes, one HELP/TYPE per family, escaping);
+- ``GET /manifest`` — the run manifest JSON
+  (``observability/manifest.py``): versions, backend, device kind/count,
+  execution mode + reason, donation gating, config hash;
+- ``GET /healthz``  — liveness probe.
+
+Zero third-party deps (zero-egress box) and zero cost on the round hot
+path: a scrape reads host-side floats under the registry lock — it never
+touches the device, so it cannot add a sync or perturb the trajectory.
+
+Wired by ``Observability(http_port=...)``; ``port=0`` binds an
+OS-assigned port (tests), a fixed port for real deployments. The server
+runs on daemon threads and is torn down by ``Observability.shutdown()``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+from fl4health_tpu.observability.registry import MetricsRegistry
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class ScrapeServer:
+    """Threaded HTTP server over one registry + manifest provider.
+
+    ``manifest_provider`` is called per ``/manifest`` request so the
+    served document tracks live updates (e.g. the execution mode chosen
+    by the current ``fit()``), not a bind-time snapshot.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        manifest_provider: Callable[[], dict[str, Any]] | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        registry_ref = registry
+        provider = manifest_provider
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    body = registry_ref.to_prometheus().encode("utf-8")
+                    self._send(200, body, PROM_CONTENT_TYPE)
+                elif path == "/manifest":
+                    mani = provider() if provider is not None else {}
+                    self._send(200, json.dumps(mani, default=str).encode(),
+                               "application/json")
+                elif path == "/healthz":
+                    self._send(200, b"ok\n", "text/plain; charset=utf-8")
+                else:
+                    self._send(404, b"not found\n",
+                               "text/plain; charset=utf-8")
+
+            def log_message(self, *args):  # no stderr spam per scrape
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fl4h-scrape", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)

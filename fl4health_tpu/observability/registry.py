@@ -211,14 +211,26 @@ class Histogram:
     prom_type = "histogram"
 
 
+DEFAULT_MAX_EVENTS = 100_000
+
+
 class MetricsRegistry:
     """Names + label sets -> instruments. Getter-or-create semantics: the
     same (name, labels) always returns the same instrument, so call sites
     never coordinate registration. Re-requesting a name as a different
     instrument kind raises (a counter silently shadowed by a gauge is the
-    classic metrics-soup bug)."""
+    classic metrics-soup bug).
 
-    def __init__(self):
+    The JSONL event log is CAPPED at ``max_events`` records (rollover:
+    oldest dropped first, counted by ``fl_events_dropped_total``) so a
+    multi-thousand-round run — a few events per round plus per-client
+    telemetry vectors — cannot grow host memory and the dumped log without
+    bound. ``max_events=None`` disables the cap."""
+
+    def __init__(self, max_events: int | None = DEFAULT_MAX_EVENTS):
+        if max_events is not None and max_events < 1:
+            raise ValueError(f"max_events must be >= 1 or None, got {max_events}")
+        self.max_events = max_events
         self._metrics: dict[tuple[str, tuple], Any] = {}
         self._helps: dict[str, str] = {}
         self._events: list[dict] = []
@@ -264,10 +276,22 @@ class MetricsRegistry:
     # -- event log -------------------------------------------------------
     def log_event(self, event: str, **fields: Any) -> dict:
         """Append one structured event (stamped with wall time) to the JSONL
-        log. Returns the record for immediate reuse (reporter bridging)."""
+        log. Returns the record for immediate reuse (reporter bridging).
+        Past ``max_events`` the log rolls over (oldest records dropped,
+        visible in ``fl_events_dropped_total``)."""
         rec = {"ts": time.time(), "event": event, **fields}
+        dropped = 0
         with self._lock:
             self._events.append(rec)
+            if self.max_events is not None and len(self._events) > self.max_events:
+                dropped = len(self._events) - self.max_events
+                del self._events[:dropped]
+        if dropped:
+            # outside the registry lock: counter() re-acquires it
+            self.counter(
+                "fl_events_dropped_total",
+                help="JSONL event-log records dropped by size rollover",
+            ).inc(dropped)
         return rec
 
     @property

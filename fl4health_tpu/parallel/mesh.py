@@ -64,3 +64,19 @@ def replicate(tree: PyTree, mesh: Mesh) -> PyTree:
 
 def client_axis_size(mesh: Mesh) -> int:
     return int(np.prod([mesh.shape[a] for a in ("clients",) if a in mesh.shape]))
+
+
+def mesh_descriptor(mesh: Mesh | None) -> dict | None:
+    """JSON-able description of a mesh — axis names/sizes plus the device
+    kinds backing it. This is what the observability run manifest records
+    so a scraped metrics page can be matched to its hardware topology."""
+    if mesh is None:
+        return None
+    kinds = sorted({
+        getattr(d, "device_kind", "unknown") for d in mesh.devices.flat
+    })
+    return {
+        "axes": {name: int(size) for name, size in mesh.shape.items()},
+        "n_devices": int(mesh.devices.size),
+        "device_kinds": kinds,
+    }

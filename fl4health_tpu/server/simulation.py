@@ -34,7 +34,9 @@ from fl4health_tpu.checkpointing.async_writer import AsyncCheckpointWriter
 from fl4health_tpu.checkpointing.checkpointer import CheckpointMode
 from fl4health_tpu.clients import engine
 from fl4health_tpu.observability import Observability
+from fl4health_tpu.observability import device_specs
 from fl4health_tpu.observability import telemetry as telem
+from fl4health_tpu.observability.manifest import run_manifest
 from fl4health_tpu.observability.telemetry import RoundTelemetry
 from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
 from fl4health_tpu.core import pytree as ptu
@@ -298,7 +300,12 @@ class FederatedSimulation:
         self._prefetcher: RoundPrefetcher | None = None
         self._ckpt_writer: AsyncCheckpointWriter | None = None
         self._fit_n_rounds = 0
+        # Measured per-round program FLOPs from build-time introspection
+        # (observability/introspect.py); None until a fit() captures it.
+        # Feeds the measured-MFU numbers in _record_round_metrics.
+        self._round_program_flops: float | None = None
         self.rng = jax.random.PRNGKey(seed)
+        self._device_kind = getattr(jax.devices()[0], "device_kind", None)
         self.sample_counts = jnp.asarray(
             [d.n_train for d in self.datasets], jnp.float32
         )
@@ -934,6 +941,7 @@ class FederatedSimulation:
         obs.start()  # re-arm after a previous fit()'s shutdown (idempotent)
         mode, mode_reason = self._select_execution_mode(n_rounds)
         self._active_execution_mode = mode
+        self._round_program_flops = None  # re-measured per fit() (mode-shaped)
         logging.getLogger(__name__).info(
             "fit: execution_mode=%s (%s)", mode, mode_reason
         )
@@ -945,6 +953,26 @@ class FederatedSimulation:
             )
         if obs.enabled:
             obs.log_event("execution_mode", mode=mode, reason=mode_reason)
+            # run manifest (served live at /manifest when http_port is set,
+            # exported as manifest.json): provenance that makes a scraped
+            # metrics page interpretable — versions, chip, mode, config hash
+            try:
+                obs.update_manifest(run_manifest(
+                    execution_mode=mode,
+                    execution_mode_reason=mode_reason,
+                    donation=bool(_donate_argnums(0, 1)),
+                    config=self._manifest_config(n_rounds),
+                ))
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "run manifest construction failed", exc_info=True
+                )
+            if obs.introspection and n_rounds >= 1:
+                # compiled-program introspection at BUILD time: XLA
+                # cost/memory analysis, compile wall, cache attribution —
+                # zero per-round cost, measured MFU for every round record
+                with obs.span("introspect", cat="fit"):
+                    self._introspect_programs(mode, n_rounds)
         for r in self.reporters:
             r.report({"host_type": "server", "fit_start": time.time(),
                       "num_rounds": n_rounds, "execution_mode": mode,
@@ -968,6 +996,104 @@ class FederatedSimulation:
             rep.report({"fit_end": time.time()})
             rep.shutdown()
         return self.history
+
+    def _manifest_config(self, n_rounds: int) -> dict:
+        """JSON-able run-config facts for the manifest's ``config_hash`` —
+        the experiment identity two scrapes can be matched on."""
+        return {
+            "n_clients": self.n_clients,
+            "batch_size": self.batch_size,
+            "local_epochs": self.local_epochs,
+            "local_steps": self.local_steps,
+            "n_rounds": n_rounds,
+            "strategy": type(self.strategy).__name__,
+            "exchanger": type(self.exchanger).__name__,
+            "client_manager": type(self.client_manager).__name__,
+            "execution_mode": self.execution_mode,
+            "telemetry": self._telemetry_enabled,
+        }
+
+    def _introspect_programs(self, mode: str, n_rounds: int) -> None:
+        """Capture XLA cost/memory analysis for the round programs this
+        ``fit()`` will dispatch (``observability/introspect.py``).
+
+        Lowering happens against abstract ``ShapeDtypeStruct`` args, so no
+        device work runs and the training trajectory cannot change; the
+        compile goes through XLA's cached-compile path, so with the
+        persistent compilation cache the later jit dispatch of the same
+        program is a disk hit, not a second backend compile (without the
+        cache this is one extra build-time compile per program — never a
+        per-round cost). Failures degrade to a warning: introspection must
+        not take down a run."""
+        obs = self.observability
+        intro = obs.introspector
+        try:
+            val_batches, val_counts = self._val_batches()
+            mask = self.client_manager.sample(
+                jax.random.fold_in(self.rng, 2000 + 1), 1
+            )
+            r = jnp.asarray(1, jnp.int32)
+            test = self._test_batches()
+            if mode == EXEC_CHUNKED:
+                p_idx, p_em, p_sm = self._round_plan(1)
+
+                def stacked_sds(a):
+                    a1 = jnp.asarray(a)
+                    return jax.ShapeDtypeStruct((n_rounds,) + a1.shape, a1.dtype)
+
+                args = [self.server_state, self.client_states,
+                        self._x_train_stack, self._y_train_stack,
+                        stacked_sds(p_idx), stacked_sds(p_em),
+                        stacked_sds(p_sm),
+                        jax.ShapeDtypeStruct((n_rounds,) + mask.shape,
+                                             mask.dtype),
+                        r, val_batches, val_counts]
+                if test is not None:
+                    args.extend(test)
+                intro.introspect_jit(
+                    "fit_chunk_eval", self._make_chunked_fit_with_eval(),
+                    tuple(args), rounds_per_dispatch=n_rounds,
+                )
+                names: tuple[str, ...] = ("fit_chunk_eval",)
+            else:
+                idx, em, sm = self._round_plan(1)
+                batches = jax.eval_shape(
+                    engine.gather_batches, self._x_train_stack,
+                    self._y_train_stack, idx, em, sm,
+                )
+                t = self._telemetry_enabled
+                fit_fn = self._fit_round_t if t else self._fit_round
+                eval_fn = self._eval_round_t if t else self._eval_round
+                fit_name = "fit_round_t" if t else "fit_round"
+                eval_name = "eval_round_t" if t else "eval_round"
+                intro.introspect_jit(
+                    fit_name, fit_fn,
+                    (self.server_state, self.client_states, batches, mask,
+                     r, val_batches),
+                )
+                intro.introspect_jit(
+                    eval_name, eval_fn,
+                    (self.server_state, self.client_states, val_batches,
+                     val_counts),
+                )
+                names = (fit_name, eval_name)
+                if test is not None:
+                    # same eval program, test-split shapes -> its own
+                    # executable, so it gets its own report
+                    test_name = eval_name + "_test"
+                    intro.introspect_jit(
+                        test_name, eval_fn,
+                        (self.server_state, self.client_states,
+                         test[0], test[1]),
+                    )
+                    names = names + (test_name,)
+            self._round_program_flops = intro.round_flops(names)
+            intro.hbm_headroom_bytes()
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "compiled-program introspection failed (continuing without "
+                "measured MFU)", exc_info=True,
+            )
 
     # -- pipelined per-round path --------------------------------------
     def _fit_pipelined(self, n_rounds: int) -> None:
@@ -1637,6 +1763,37 @@ class FederatedSimulation:
                 **{k: np.asarray(v, np.float64).tolist()
                    for k, v in telemetry.items()},
             )
+        # MEASURED throughput denominator: the fenced device-execution time
+        # when observability fenced this round (it excludes XLA compiles by
+        # construction), else the round wall minus its compile delta — a
+        # compile-inflated wall would understate MFU ~100x on exactly the
+        # big-compile configs this number exists for (round 1, and every
+        # amortized chunked round).
+        wall = rec.fit_elapsed_s + rec.eval_elapsed_s
+        exec_s = (device_wait_s if device_wait_s > 0
+                  else wall - summary["compile_s"])
+        if self._round_program_flops and exec_s > 0:
+            # build-time cost_analysis FLOPs over device-execution time —
+            # hardware-grounded, unlike bench.py's old analytic formula.
+            # mfu_pct only where the chip's peak is known (device_specs);
+            # never a made-up percentage.
+            achieved = self._round_program_flops / exec_s
+            summary["program_flops_round"] = self._round_program_flops
+            summary["program_exec_s"] = exec_s
+            summary["tflops_measured"] = achieved / 1e12
+            reg.gauge(
+                "fl_round_tflops_measured",
+                help="measured TFLOP/s this round (cost-model FLOPs / "
+                     "device-execution time)",
+            ).set(achieved / 1e12)
+            mfu = device_specs.mfu_pct(achieved, self._device_kind)
+            if mfu is not None:
+                summary["mfu_pct"] = mfu
+                reg.gauge(
+                    "fl_round_mfu_pct",
+                    help="measured model FLOPs utilization vs the chip's "
+                         "bf16 peak",
+                ).set(mfu)
         reg.log_event("round", **summary)
         self.observability.tracer.counter(
             "fl_round_time_s", fit=rec.fit_elapsed_s, eval=rec.eval_elapsed_s

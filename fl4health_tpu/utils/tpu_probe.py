@@ -51,6 +51,29 @@ def is_accelerator(platform: str) -> bool:
     return platform not in ("", "cpu", "down") and not platform.startswith("error")
 
 
+def live_device_summary() -> dict:
+    """Identity + published peaks of the ALREADY-initialized backend's
+    first device — the in-process complement of ``probe_platform`` (which
+    exists for the pre-init "is the tunnel even alive" question). Shared by
+    the observability run manifest and ``bench.py`` provenance so the
+    "which chip, what peak" policy lives in one place."""
+    import jax
+
+    from fl4health_tpu.observability import device_specs
+
+    devices = jax.devices()
+    d = devices[0]
+    kind = getattr(d, "device_kind", "unknown")
+    return {
+        "platform": d.platform,
+        "device_kind": kind,
+        "device_count": len(devices),
+        "accelerator": is_accelerator(d.platform),
+        "peak_bf16_flops": device_specs.peak_bf16_flops(kind),
+        "device_memory_bytes": device_specs.device_memory_bytes(d),
+    }
+
+
 def last_json_line(text: str) -> dict | None:
     """Parse the LAST valid JSON object line from child stdout (later lines
     supersede earlier partial/progress output)."""

@@ -1,0 +1,280 @@
+"""Live scrape endpoint end-to-end: a REAL HTTP scrape of /metrics must
+pass a Prometheus text-format 0.0.4 conformance parse (HELP/TYPE grouping,
+mandatory counter ``_total`` suffix, escaping, value lexicon), /manifest
+must serve the run provenance JSON, and a scrape must work MID-``fit()``
+without perturbing the trajectory."""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import jax
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.observability import (
+    MetricsRegistry,
+    Observability,
+    ScrapeServer,
+    Tracer,
+    config_hash,
+    run_manifest,
+)
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+# Prometheus text exposition 0.0.4 lexicon
+_METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(
+    rf"^(?P<name>{_METRIC_NAME})"
+    r"(?:\{(?P<labels>.*)\})? "
+    r"(?P<value>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|[+-]Inf|NaN)$"
+)
+_LABEL_RE = re.compile(
+    rf'^(?P<k>{_METRIC_NAME})="(?P<v>(?:[^"\\]|\\\\|\\"|\\n)*)"$'
+)
+
+
+def parse_exposition(text: str) -> dict:
+    """Strict conformance parse -> {family: {"type", "help", "samples"}}.
+    Raises AssertionError on any spec violation."""
+    families: dict = {}
+    current_meta: dict[str, dict] = {}
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert re.fullmatch(_METRIC_NAME, name), f"bad HELP name {name!r}"
+            assert name not in families, f"HELP for {name} after samples"
+            assert "help" not in current_meta.get(name, {}), \
+                f"duplicate HELP for {name}"
+            current_meta.setdefault(name, {})["help"] = help_text
+            assert "\n" not in help_text
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"bad TYPE line {line!r}"
+            name, prom_type = parts[2], parts[3]
+            assert prom_type in ("counter", "gauge", "histogram", "summary",
+                                 "untyped")
+            assert name not in families, f"TYPE for {name} after its samples"
+            assert "type" not in current_meta.get(name, {}), \
+                f"duplicate TYPE for {name}"
+            current_meta.setdefault(name, {})["type"] = prom_type
+            continue
+        assert not line.startswith("#"), f"unparseable comment {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line {line!r}"
+        sample_name = m.group("name")
+        labels = {}
+        if m.group("labels"):
+            # split on commas not inside quotes (label values are escaped)
+            for pair in re.findall(r'[^,]*?="(?:[^"\\]|\\.)*"', m.group("labels")):
+                lm = _LABEL_RE.match(pair)
+                assert lm, f"unparseable label {pair!r} in {line!r}"
+                labels[lm.group("k")] = lm.group("v")
+        # histogram child samples group under the family name
+        family = re.sub(r"_(bucket|sum|count)$", "", sample_name)
+        meta = current_meta.get(family) or current_meta.get(sample_name) or {}
+        family = family if family in current_meta else sample_name
+        fam = families.setdefault(family, {**meta, "samples": []})
+        fam["samples"].append((sample_name, labels, m.group("value")))
+    for name, fam in families.items():
+        if fam.get("type") == "counter":
+            assert name.endswith("_total"), \
+                f"counter family {name} lacks _total suffix"
+    return families
+
+
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        assert resp.status == 200
+        return resp.read().decode("utf-8")
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    # exercise every instrument kind + escaping-hostile content
+    reg.counter("requests", help="req count").inc(5)  # gains _total
+    reg.counter("fl_rounds_total", help="completed rounds").inc(2)
+    reg.gauge("fl_hbm_headroom_bytes",
+              help="line1\nline2 \\ slash").set(float("nan"))
+    reg.histogram("rpc_seconds", labels={"silo": 'h"1\\x'},
+                  buckets=(0.5,)).observe(0.1)
+    return reg
+
+
+class TestScrapeServer:
+    def test_metrics_scrape_passes_conformance_parse(self, registry):
+        srv = ScrapeServer(registry, port=0)
+        try:
+            text = _scrape(srv.url + "/metrics")
+        finally:
+            srv.close()
+        fams = parse_exposition(text)
+        assert fams["requests_total"]["type"] == "counter"
+        assert fams["requests_total"]["samples"] == [
+            ("requests_total", {}, "5")
+        ]
+        assert fams["fl_rounds_total"]["samples"][0][2] == "2"
+        # NaN gauge survives the round trip with canonical spelling
+        assert fams["fl_hbm_headroom_bytes"]["samples"][0][2] == "NaN"
+        # escaped HELP stays one physical line, parsed back
+        assert fams["fl_hbm_headroom_bytes"]["help"] == "line1\\nline2 \\\\ slash"
+        # histogram children group under one family with escaped labels
+        hist = fams["rpc_seconds"]
+        assert hist["type"] == "histogram"
+        names = [s[0] for s in hist["samples"]]
+        assert "rpc_seconds_bucket" in names
+        assert "rpc_seconds_sum" in names and "rpc_seconds_count" in names
+
+    def test_content_type_and_routes(self, registry):
+        srv = ScrapeServer(registry, port=0)
+        try:
+            with urllib.request.urlopen(srv.url + "/metrics", timeout=5) as r:
+                assert "version=0.0.4" in r.headers["Content-Type"]
+            assert _scrape(srv.url + "/healthz") == "ok\n"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _scrape(srv.url + "/nope")
+            assert err.value.code == 404
+        finally:
+            srv.close()
+
+    def test_manifest_provider_called_per_request(self, registry):
+        state = {"n": 0}
+
+        def provider():
+            state["n"] += 1
+            return {"n": state["n"]}
+
+        srv = ScrapeServer(registry, manifest_provider=provider, port=0)
+        try:
+            assert json.loads(_scrape(srv.url + "/manifest")) == {"n": 1}
+            assert json.loads(_scrape(srv.url + "/manifest")) == {"n": 2}
+        finally:
+            srv.close()
+
+    def test_close_stops_serving(self, registry):
+        srv = ScrapeServer(registry, port=0)
+        url = srv.url
+        srv.close()
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            urllib.request.urlopen(url + "/healthz", timeout=1)
+
+
+class TestRunManifest:
+    def test_fields_and_config_hash(self):
+        mani = run_manifest(execution_mode="chunked_scan",
+                            execution_mode_reason="auto",
+                            donation=False,
+                            config={"a": 1, "b": "x"})
+        assert mani["jax_version"] == jax.__version__
+        assert mani["backend"] == "cpu"
+        assert mani["device_count"] == len(jax.devices())
+        assert mani["execution_mode"] == "chunked_scan"
+        assert mani["donation"] is False
+        assert mani["config_hash"] == config_hash({"b": "x", "a": 1})
+
+    def test_config_hash_order_insensitive_and_stable(self):
+        h1 = config_hash({"a": 1, "b": 2})
+        h2 = config_hash({"b": 2, "a": 1})
+        assert h1 == h2 and len(h1) == 16
+        assert config_hash({"a": 1, "b": 3}) != h1
+
+    def test_mesh_descriptor_in_manifest(self):
+        from fl4health_tpu.parallel.mesh import client_mesh, mesh_descriptor
+
+        mesh = client_mesh(2)
+        desc = mesh_descriptor(mesh)
+        assert desc["axes"] == {"clients": 2} and desc["n_devices"] == 2
+        mani = run_manifest(mesh=mesh)
+        assert mani["mesh"]["axes"] == {"clients": 2}
+        assert mesh_descriptor(None) is None
+
+
+class TestScrapeDuringFit:
+    """Acceptance surface: a live fit() is scrapable mid-run, and the scrape
+    (a host-side registry read) cannot perturb the trajectory."""
+
+    def _sim(self, **kwargs):
+        x, y = synthetic_classification(jax.random.PRNGKey(0), 48, (4,), 2)
+        datasets = [
+            ClientDataset(x[:16], y[:16], x[32:40], y[32:40]),
+            ClientDataset(x[16:32], y[16:32], x[40:], y[40:]),
+        ]
+        defaults = dict(
+            logic=engine.ClientLogic(
+                engine.from_flax(Mlp(features=(8,), n_outputs=2)),
+                engine.masked_cross_entropy,
+            ),
+            tx=optax.sgd(0.05),
+            strategy=FedAvg(),
+            datasets=datasets,
+            batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_steps=2,
+            seed=0,
+        )
+        defaults.update(kwargs)
+        return FederatedSimulation(**defaults)
+
+    def test_mid_fit_scrape_conforms_and_trajectory_unperturbed(self):
+        obs = Observability(enabled=True, tracer=Tracer(),
+                            registry=MetricsRegistry(), http_port=0)
+        scrapes: dict = {}
+        outer = self
+
+        class ScrapingReporter:
+            """Scrapes from the round-report callback — i.e. while fit()
+            is live (chunked epilogue / consumer thread)."""
+
+            def report(self, data, round=None, **kw):
+                if round is not None and "metrics" not in scrapes:
+                    scrapes["metrics"] = _scrape(obs.scrape_url + "/metrics")
+                    scrapes["manifest"] = json.loads(
+                        _scrape(obs.scrape_url + "/manifest")
+                    )
+
+            def shutdown(self):
+                pass
+
+        sim = outer._sim(observability=obs, reporters=[ScrapingReporter()])
+        history = sim.fit(2)
+        assert len(history) == 2
+        assert "metrics" in scrapes, "reporter never scraped mid-fit"
+        fams = parse_exposition(scrapes["metrics"])
+        # round metrics + program introspection were live in the scrape
+        assert fams["fl_rounds_total"]["type"] == "counter"
+        assert any(f.startswith("fl_program_flops") for f in fams)
+        # manifest served the run provenance incl. mode + config hash
+        assert scrapes["manifest"]["execution_mode"] in (
+            "chunked_scan", "pipelined_per_round"
+        )
+        assert "config_hash" in scrapes["manifest"]
+        assert scrapes["manifest"]["jax_version"] == jax.__version__
+        # endpoint torn down with the run
+        assert obs.scrape_url is None
+        # trajectory identical to a run with no endpoint and no introspection
+        plain = outer._sim().fit(2)
+        assert [r.fit_losses for r in history] == [r.fit_losses for r in plain]
+        assert ([r.eval_losses for r in history]
+                == [r.eval_losses for r in plain])
+
+    def test_manifest_exported_with_artifacts(self, tmp_path):
+        obs = Observability(enabled=True, output_dir=str(tmp_path / "obs"),
+                            tracer=Tracer(), registry=MetricsRegistry())
+        sim = self._sim(observability=obs)
+        sim.fit(1)
+        mani = json.loads((tmp_path / "obs" / "manifest.json").read_text())
+        assert mani["backend"] == "cpu"
+        assert "config_hash" in mani and "execution_mode" in mani

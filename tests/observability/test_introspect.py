@@ -1,0 +1,170 @@
+"""Compiled-program introspection: XLA cost/memory analysis capture,
+registry/JSONL recording, HBM headroom, and the device spec table."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fl4health_tpu.observability import device_specs
+from fl4health_tpu.observability.introspect import (
+    ProgramIntrospector,
+    ProgramReport,
+    abstractify,
+    analyze_compiled,
+)
+from fl4health_tpu.observability.registry import MetricsRegistry
+
+
+def _matmul_jit():
+    return jax.jit(lambda a, b: (a @ b, jnp.sin(a).sum()))
+
+
+class TestAnalyzeCompiled:
+    def test_cost_and_memory_fields(self):
+        f = _matmul_jit()
+        sds = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+        out = analyze_compiled(f.lower(sds, sds).compile())
+        # 64^3 * 2 matmul FLOPs plus the sin/sum tail
+        assert out["flops"] >= 2 * 64**3
+        assert out["bytes_accessed"] > 0
+        assert out["transcendentals"] >= 64 * 64  # the sin
+        assert out["argument_bytes"] == 2 * 64 * 64 * 4
+        assert out["temp_bytes"] is not None
+
+    def test_broken_executable_degrades_to_none(self):
+        class Broken:
+            def cost_analysis(self):
+                raise RuntimeError("no cost model")
+
+            def memory_analysis(self):
+                raise RuntimeError("no memory model")
+
+        out = analyze_compiled(Broken())
+        assert all(v is None for v in out.values())
+
+
+class TestAbstractify:
+    def test_arrays_become_shape_dtype_structs(self):
+        tree = {"a": jnp.ones((2, 3)), "b": [jnp.zeros(4, jnp.int32)]}
+        sds = abstractify(tree)
+        assert sds["a"] == jax.ShapeDtypeStruct((2, 3), jnp.float32)
+        assert sds["b"][0].dtype == jnp.int32
+
+    def test_existing_sds_pass_through(self):
+        s = jax.ShapeDtypeStruct((5,), jnp.float32)
+        assert abstractify((s,))[0] is s
+
+
+class TestProgramIntrospector:
+    def test_introspect_jit_records_report_gauges_and_event(self):
+        reg = MetricsRegistry()
+        intro = ProgramIntrospector(reg)
+        f = _matmul_jit()
+        x = jnp.ones((32, 32))
+        rep = intro.introspect_jit("mm", f, (x, x))
+        assert rep is not None and rep.name == "mm"
+        assert rep.flops and rep.flops >= 2 * 32**3
+        assert rep.compile_seconds > 0
+        assert rep.peak_hbm_bytes and rep.peak_hbm_bytes > 0
+        snap = reg.snapshot()
+        assert snap["fl_program_flops"]['{program="mm"}'] == rep.flops
+        assert (snap["fl_program_hbm_peak_bytes"]['{program="mm"}']
+                == rep.peak_hbm_bytes)
+        events = [e for e in reg.events if e["event"] == "program"]
+        assert len(events) == 1 and events[0]["name"] == "mm"
+        assert events[0]["peak_hbm_bytes"] == rep.peak_hbm_bytes
+
+    def test_introspection_failure_returns_none_not_raise(self):
+        intro = ProgramIntrospector(MetricsRegistry())
+        assert intro.introspect_jit("bad", object(), (jnp.ones(2),)) is None
+
+    def test_round_flops_sums_per_round(self):
+        reg = MetricsRegistry()
+        intro = ProgramIntrospector(reg)
+        intro.record(ProgramReport("fit", "cpu", "cpu", flops=100.0))
+        intro.record(ProgramReport("eval", "cpu", "cpu", flops=20.0))
+        intro.record(ProgramReport("chunk", "cpu", "cpu", flops=1000.0,
+                                   rounds_per_dispatch=10))
+        assert intro.round_flops(("fit", "eval")) == 120.0
+        assert intro.round_flops(("chunk",)) == 100.0
+        # missing / cost-model-less programs contribute nothing
+        assert intro.round_flops(("nope",)) is None
+        intro.record(ProgramReport("nocost", "cpu", "cpu"))
+        assert intro.round_flops(("nocost",)) is None
+
+    def test_hbm_headroom_none_on_cpu_gauge_set_when_known(self, monkeypatch):
+        reg = MetricsRegistry()
+        intro = ProgramIntrospector(reg)
+        intro.record(ProgramReport("p", "cpu", "cpu", argument_bytes=100,
+                                   output_bytes=50, temp_bytes=25,
+                                   generated_code_bytes=0))
+        # CPU exposes no memory_stats and has no spec entry
+        assert intro.hbm_headroom_bytes() is None
+        assert "fl_hbm_headroom_bytes" not in reg.snapshot()
+        monkeypatch.setattr(device_specs, "device_memory_bytes",
+                            lambda device=None: 1000)
+        assert intro.hbm_headroom_bytes() == 1000 - 175
+        assert reg.snapshot()["fl_hbm_headroom_bytes"] == 825.0
+
+
+class TestProgramReport:
+    def test_peak_hbm_none_without_memory_analysis(self):
+        rep = ProgramReport("p", "cpu", "cpu", flops=1.0)
+        assert rep.peak_hbm_bytes is None
+
+    def test_cache_hit_attribution(self):
+        assert ProgramReport("p", "cpu", "cpu").cache_hit is None
+        assert ProgramReport("p", "cpu", "cpu", cache_hits=1).cache_hit is True
+        assert ProgramReport("p", "cpu", "cpu", cache_misses=1,
+                             cache_hits=1).cache_hit is False
+
+    def test_as_dict_carries_derived_fields(self):
+        d = ProgramReport("p", "cpu", "TPU v4", flops=100.0,
+                          bytes_accessed=10.0, argument_bytes=4,
+                          output_bytes=4, temp_bytes=2,
+                          generated_code_bytes=0).as_dict()
+        assert d["peak_hbm_bytes"] == 10
+        assert d["roofline"]["intensity_flops_per_byte"] == 10.0
+        assert d["roofline"]["compute_bound"] is False  # 10 << v4 ridge
+
+
+class TestDeviceSpecs:
+    def test_alias_normalization(self):
+        assert (device_specs.peak_bf16_flops("TPU v5 lite")
+                == device_specs.peak_bf16_flops("TPU v5e"))
+        assert device_specs.peak_bf16_flops("TPU v6 lite") == 918e12
+
+    def test_unknown_kind_has_no_peak(self):
+        assert device_specs.peak_bf16_flops("cpu") is None
+        assert device_specs.peak_bf16_flops(None) is None
+        assert device_specs.lookup("Quantum TPU v99") is None
+
+    def test_mfu_pct(self):
+        assert device_specs.mfu_pct(27.5e12, "TPU v4") == pytest.approx(10.0)
+        assert device_specs.mfu_pct(1e12, "cpu") is None
+
+    def test_roofline_ridge(self):
+        r = device_specs.roofline(flops=1e12, bytes_accessed=1e9,
+                                  device_kind="TPU v4")
+        assert r["intensity_flops_per_byte"] == pytest.approx(1000.0)
+        assert r["ridge_flops_per_byte"] == pytest.approx(275e12 / 1228e9)
+        assert r["compute_bound"] is True
+        assert device_specs.roofline(None, 1.0, "TPU v4") is None
+
+    def test_device_memory_bytes_prefers_live_stats(self):
+        class Dev:
+            device_kind = "TPU v4"
+
+            def memory_stats(self):
+                return {"bytes_limit": 123}
+
+        assert device_specs.device_memory_bytes(Dev()) == 123
+
+        class SpecOnly:
+            device_kind = "TPU v4"
+
+            def memory_stats(self):
+                return None
+
+        assert (device_specs.device_memory_bytes(SpecOnly())
+                == device_specs.DEVICE_SPECS["TPU v4"].hbm_bytes)

@@ -190,3 +190,45 @@ class TestEventLog:
         reg.clear()
         assert reg.snapshot() == {}
         assert reg.events == []
+
+
+class TestEventLogRollover:
+    """ISSUE 4 satellite: the in-memory JSONL event log is capped so a
+    multi-thousand-round run cannot grow it (and the dumped file) without
+    bound; drops are visible in fl_events_dropped_total."""
+
+    def test_rollover_drops_oldest_and_counts(self):
+        reg = MetricsRegistry(max_events=3)
+        for i in range(7):
+            reg.log_event("e", i=i)
+        assert [e["i"] for e in reg.events] == [4, 5, 6]
+        assert reg.counter("fl_events_dropped_total").value == 4.0
+
+    def test_no_counter_until_a_drop_happens(self):
+        reg = MetricsRegistry(max_events=10)
+        reg.log_event("e")
+        assert "fl_events_dropped_total" not in reg.snapshot()
+
+    def test_dump_after_rollover_holds_capped_tail(self, tmp_path):
+        reg = MetricsRegistry(max_events=2)
+        for i in range(5):
+            reg.log_event("round", round=i)
+        path = reg.dump_jsonl(str(tmp_path / "m.jsonl"))
+        recs = [json.loads(line) for line in open(path)]
+        assert [r["round"] for r in recs] == [3, 4]
+
+    def test_uncapped_when_none(self):
+        reg = MetricsRegistry(max_events=None)
+        for i in range(500):
+            reg.log_event("e", i=i)
+        assert len(reg.events) == 500
+
+    def test_invalid_cap_raises(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry(max_events=0)
+
+    def test_default_cap_is_set(self):
+        from fl4health_tpu.observability.registry import DEFAULT_MAX_EVENTS
+
+        assert MetricsRegistry().max_events == DEFAULT_MAX_EVENTS
+        assert DEFAULT_MAX_EVENTS >= 10_000  # thousands of rounds still fit

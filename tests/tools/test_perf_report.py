@@ -149,3 +149,86 @@ def test_json_mode_passes_telemetry_fields_through(tmp_path):
     doc = json.loads(out.stdout)
     assert doc["rounds"][0]["grad_norm_max"] == 2.0
     assert doc["rounds"][0]["nonfinite"] == 1
+
+
+# -- ProgramReport ('program' event) rendering -------------------------------
+
+def _program(name, **kw):
+    base = dict(name=name, backend="cpu", device_kind="cpu",
+                flops=6.6e8, bytes_accessed=1.27e8, peak_hbm_bytes=23688704,
+                compile_seconds=1.7, cache_hits=0, cache_misses=1)
+    base.update(kw)
+    return base
+
+
+def _log_with_programs(tmp_path, rounds, programs):
+    path = _log(tmp_path, rounds)
+    with open(path, "a") as f:
+        for p in programs:
+            f.write(json.dumps({"ts": 0, "event": "program", **p}) + "\n")
+    return path
+
+
+def test_program_events_loaded_last_per_name_sorted(tmp_path):
+    path = _log_with_programs(tmp_path, [_round(1)], [
+        _program("fit_round", flops=1.0),
+        _program("eval_round"),
+        _program("fit_round", flops=2.0),  # later report supersedes
+    ])
+    progs = perf_report.load_program_events(path)
+    assert [p["name"] for p in progs] == ["eval_round", "fit_round"]
+    assert progs[1]["flops"] == 2.0
+
+
+def test_program_table_renders_flops_hbm_compile_cache():
+    # cache_hit is the derived field carried by the event record
+    table = perf_report.render_program_table([
+        {**_program("fit_round"), "cache_hit": True},
+        {**_program("eval_round"), "flops": None, "peak_hbm_bytes": None,
+         "cache_hit": None},
+    ])
+    lines = table.splitlines()
+    assert lines[0].split() == ["program", "flops", "bytes", "hbm_peak",
+                                "compile_ms", "cache"]
+    assert all(len(line) == len(lines[0]) for line in lines)
+    fit_row = next(line for line in lines if "fit_round" in line)
+    assert "6.6e+08" in fit_row and "23688704" in fit_row
+    assert "1700.0" in fit_row and "hit" in fit_row
+    eval_row = next(line for line in lines if "eval_round" in line)
+    assert "-" in eval_row.split()  # None flops/hbm/cache render as '-'
+
+
+def test_cli_renders_program_table_when_present(tmp_path):
+    path = _log_with_programs(
+        tmp_path, [_round(1)],
+        [{**_program("fit_chunk_eval"), "cache_hit": False}],
+    )
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    )
+    assert "fit_chunk_eval" in out.stdout and "hbm_peak" in out.stdout
+    out_json = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    )
+    doc = json.loads(out_json.stdout)
+    assert doc["programs"][0]["name"] == "fit_chunk_eval"
+
+
+def test_cli_output_byte_stable_without_program_events(tmp_path):
+    """Legacy logs (no introspection) must render the exact pre-PR shape:
+    no program table, no 'programs' JSON key."""
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    )
+    assert "hbm_peak" not in out.stdout and "program" not in out.stdout
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert "programs" not in doc

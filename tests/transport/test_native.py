@@ -1,0 +1,185 @@
+"""transport/native.py: framing codec contract (native C++ + pure-Python
+twin) and the RPC observability accounting exercised THROUGH the native
+transport — the per-silo latency histograms / failure counters were pinned
+for loopback/coordinator in PR 1 but never driven over the native framing
+path."""
+
+import struct
+import zlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.observability.registry import (
+    MetricsRegistry,
+    set_registry,
+)
+from fl4health_tpu.transport import native
+from fl4health_tpu.transport.native import (
+    FrameError,
+    PyFraming,
+    get_framing,
+    get_native,
+)
+
+CASES = (
+    (b"", b""),
+    (b"h", b"p"),
+    (b'{"leaves": []}', b"\x00" * 1024),
+    (b"x" * 300, bytes(range(256)) * 17),
+)
+
+
+class TestPyFraming:
+    @pytest.mark.parametrize("header,payload", CASES)
+    def test_roundtrip(self, header, payload):
+        f = PyFraming()
+        h, p, flags = f.unframe(f.frame(header, payload, flags=3))
+        assert (h, p, flags) == (header, payload, 3)
+
+    def test_short_frame(self):
+        with pytest.raises(FrameError, match="short frame"):
+            PyFraming().unframe(b"tiny")
+
+    def test_bad_magic(self):
+        buf = bytearray(PyFraming().frame(b"h", b"p"))
+        buf[0] ^= 0xFF
+        with pytest.raises(FrameError, match="bad magic"):
+            PyFraming().unframe(bytes(buf))
+
+    def test_bad_version(self):
+        f = PyFraming()
+        body = struct.pack("<IHHIQ", 0x464C3448, 99, 0, 1, 1) + b"hp"
+        buf = body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+        with pytest.raises(FrameError, match="bad version"):
+            f.unframe(buf)
+
+    def test_bad_crc(self):
+        buf = bytearray(PyFraming().frame(b"head", b"payload"))
+        buf[-6] ^= 0x01  # corrupt a payload byte, CRC now mismatches
+        with pytest.raises(FrameError, match="bad crc"):
+            PyFraming().unframe(bytes(buf))
+
+    def test_truncated_payload(self):
+        buf = PyFraming().frame(b"head", b"payload" * 100)
+        with pytest.raises(FrameError, match="short frame"):
+            PyFraming().unframe(buf[: len(buf) // 2])
+
+    def test_crc32_matches_zlib(self):
+        data = b"the wire contract"
+        assert PyFraming().crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
+
+
+class TestNoNativeEnv:
+    def test_fl4health_no_native_forces_python_fallback(self, monkeypatch):
+        monkeypatch.setenv("FL4HEALTH_NO_NATIVE", "1")
+        assert get_native() is None
+        assert isinstance(get_framing(), PyFraming)
+
+
+needs_native = pytest.mark.skipif(
+    get_native() is None, reason="native codec unavailable (no compiler)"
+)
+
+
+@needs_native
+class TestNativeFraming:
+    """The C++ codec must be BYTE-identical to the Python twin — a frame
+    produced by either side decodes on the other (mixed deployments)."""
+
+    @pytest.mark.parametrize("header,payload", CASES)
+    def test_bytes_identical_to_python(self, header, payload):
+        assert (get_framing().frame(header, payload, flags=1)
+                == PyFraming().frame(header, payload, flags=1))
+
+    @pytest.mark.parametrize("header,payload", CASES)
+    def test_cross_unframe(self, header, payload):
+        nat, py = get_framing(), PyFraming()
+        assert py.unframe(nat.frame(header, payload)) == (header, payload, 0)
+        assert nat.unframe(py.frame(header, payload)) == (header, payload, 0)
+
+    def test_native_error_codes(self):
+        nat = get_framing()
+        with pytest.raises(FrameError, match="short frame"):
+            nat.unframe(b"tiny")
+        buf = bytearray(nat.frame(b"h", b"p"))
+        buf[0] ^= 0xFF
+        with pytest.raises(FrameError, match="bad magic"):
+            nat.unframe(bytes(buf))
+        buf = bytearray(nat.frame(b"head", b"payload"))
+        buf[-6] ^= 0x01
+        with pytest.raises(FrameError, match="bad crc"):
+            nat.unframe(bytes(buf))
+
+    def test_crc32_parity(self):
+        data = bytes(range(256)) * 3
+        assert get_framing().crc32(data) == PyFraming().crc32(data)
+
+
+class TestRpcAccountingOverNativeTransport:
+    """PR 1's per-silo latency histograms / failure counters, driven through
+    the REAL transport stack (codec with the active framing -> loopback TCP
+    -> coordinator), not just the coordinator unit seam."""
+
+    @pytest.fixture
+    def registry(self):
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        yield reg
+        set_registry(prev)
+
+    def test_latency_histogram_and_byte_counters(self, registry):
+        from fl4health_tpu.transport import (
+            LoopbackServer,
+            broadcast_round,
+            decode,
+            encode,
+        )
+
+        def handler(frame: bytes) -> bytes:
+            params = decode(frame, like={"w": jnp.zeros(3)})
+            return encode({"params": {"w": params["w"] + 1.0},
+                           "n": jnp.asarray(2.0)})
+
+        silos = [LoopbackServer(handler) for _ in range(2)]
+        try:
+            replies = broadcast_round(
+                [(s.host, s.port) for s in silos],
+                {"w": jnp.asarray([1.0, 2.0, 3.0])},
+                {"params": {"w": jnp.zeros(3)}, "n": jnp.zeros(())},
+            )
+        finally:
+            for s in silos:
+                s.close()
+        np.testing.assert_allclose(np.asarray(replies[0]["params"]["w"]),
+                                   [2.0, 3.0, 4.0])
+        snap = registry.snapshot()
+        # one latency observation per live silo, labeled per silo
+        hist = snap["transport_rpc_latency_seconds"]
+        assert len(hist) == 2
+        assert all(h["count"] == 1 for h in hist.values())
+        # the codec's wire-byte accounting ran through the active framing
+        assert snap["transport_bytes_encoded_total"] > 0
+        assert snap["transport_bytes_decoded_total"] > 0
+
+    def test_failure_counter_on_dead_silo(self, registry):
+        from fl4health_tpu.transport import LoopbackServer, broadcast_round
+
+        # allocate-and-close: a port with nothing listening
+        dead = LoopbackServer(lambda b: b)
+        dead.close()
+        with pytest.raises(Exception):
+            broadcast_round(
+                [(dead.host, dead.port)],
+                {"w": jnp.zeros(2)},
+                {"params": {"w": jnp.zeros(2)}, "n": jnp.zeros(())},
+                timeout=0.5,
+            )
+        snap = registry.snapshot()
+        silo = f'{{silo="{dead.host}:{dead.port}"}}'
+        assert snap["transport_rpc_failures_total"][silo] == 1.0
+        # no latency observation for the failed round trip (failures must
+        # not drag the percentiles of working silos) — the instrument is
+        # registered up front but stays empty
+        assert snap["transport_rpc_latency_seconds"][silo]["count"] == 0

@@ -47,11 +47,11 @@ TELEMETRY_COLUMNS = (
 )
 
 
-def load_round_events(path: str) -> list[dict]:
-    """Parse the JSONL log, keeping only ``round`` events (other event kinds
-    share the file). Malformed lines are skipped with a note on stderr — a
-    crash mid-append must not make the whole log unreadable."""
-    rounds = []
+def load_events(path: str) -> dict[str, list[dict]]:
+    """Parse the JSONL log into {event_kind: [records]}. Malformed lines
+    are skipped with a note on stderr — a crash mid-append must not make
+    the whole log unreadable."""
+    events: dict[str, list[dict]] = {}
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -63,9 +63,35 @@ def load_round_events(path: str) -> list[dict]:
                 print(f"{path}:{lineno}: skipping malformed line",
                       file=sys.stderr)
                 continue
-            if rec.get("event") == "round":
-                rounds.append(rec)
+            kind = rec.get("event")
+            if kind:
+                events.setdefault(kind, []).append(rec)
+    return events
+
+
+def _sorted_rounds(rounds: list[dict]) -> list[dict]:
     return sorted(rounds, key=lambda r: r.get("round", 0))
+
+
+def _latest_programs(programs: list[dict]) -> list[dict]:
+    """LAST report per program name (a log may hold several fits), sorted
+    by name."""
+    latest: dict[str, dict] = {}
+    for rec in programs:
+        if rec.get("name"):
+            latest[rec["name"]] = rec
+    return [latest[n] for n in sorted(latest)]
+
+
+def load_round_events(path: str) -> list[dict]:
+    """The ``round`` events of the log, sorted by round."""
+    return _sorted_rounds(load_events(path).get("round", []))
+
+
+def load_program_events(path: str) -> list[dict]:
+    """The ``program`` introspection records (observability/introspect.py),
+    deduped to the latest report per program."""
+    return _latest_programs(load_events(path).get("program", []))
 
 
 def active_columns(rounds: list[dict]) -> tuple:
@@ -93,6 +119,40 @@ def render_table(rounds: Iterable[dict]) -> str:
                 row.append(fmt(float(v)))
         rows.append(row)
     widths = [max(len(r[i]) for r in rows) for i in range(len(columns))]
+    lines = []
+    for n, row in enumerate(rows):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if n == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def _fmt_program_cell(field: str, rec: dict) -> str:
+    v = rec.get(field)
+    if v is None or (isinstance(v, float) and v != v):
+        return "-"
+    if field == "cache_hit":
+        return "hit" if v else "miss"
+    if field == "name":
+        return str(v)
+    if field == "compile_seconds":
+        return f"{float(v) * 1000:.1f}"
+    if field in ("flops", "bytes_accessed"):
+        return f"{float(v):.4g}"
+    return str(int(v))
+
+
+def render_program_table(programs: list[dict]) -> str:
+    """Per-compiled-program table from ``program`` introspection events:
+    cost-model FLOPs/bytes, HBM footprint, compile wall, persistent-cache
+    attribution."""
+    fields = ("name", "flops", "bytes_accessed", "peak_hbm_bytes",
+              "compile_seconds", "cache_hit")
+    headers = ("program", "flops", "bytes", "hbm_peak", "compile_ms", "cache")
+    rows = [list(headers)]
+    for rec in programs:
+        rows.append([_fmt_program_cell(f, rec) for f in fields])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(headers))]
     lines = []
     for n, row in enumerate(rows):
         lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
@@ -132,7 +192,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit the summary as JSON instead of a table")
     args = ap.parse_args(argv)
     try:
-        rounds = load_round_events(args.log)
+        events = load_events(args.log)  # ONE parse serves both tables
+        rounds = _sorted_rounds(events.get("round", []))
+        programs = _latest_programs(events.get("program", []))
     except OSError as e:
         # a missing/unreadable log is an error exit, not a traceback
         print(f"perf_report: cannot read {args.log}: {e}", file=sys.stderr)
@@ -143,10 +205,17 @@ def main(argv: list[str] | None = None) -> int:
         print(f"no 'round' events in {args.log}", file=sys.stderr)
         return 1
     if args.json:
-        print(json.dumps({"summary": summarize(rounds), "rounds": rounds},
-                         indent=2))
+        doc = {"summary": summarize(rounds), "rounds": rounds}
+        if programs:
+            doc["programs"] = programs
+        print(json.dumps(doc, indent=2))
         return 0
     print(render_table(rounds))
+    if programs:
+        # ProgramReport records present (introspection was on): one row per
+        # compiled program — legacy logs keep the exact old output shape
+        print()
+        print(render_program_table(programs))
     print()
     for k, v in summarize(rounds).items():
         print(f"{k}: {v}")
