@@ -410,6 +410,31 @@ def timed_fit_overhead(sim) -> dict:
     }
 
 
+def _timed_round_loop(sim, fit_fn) -> float:
+    """Fenced per-round wall of ``fit_fn`` dispatch loops (one warmup
+    dispatch, donation-safe state threading, TIMED_ROUNDS measured).
+    Shared by the telemetry/resilience overhead blocks so the two numbers
+    stay measured under identical discipline."""
+    import jax
+    import jax.numpy as jnp
+
+    mask = sim.client_manager.sample_all()
+    val_batches, _ = sim._val_batches()
+    r = jnp.asarray(1, jnp.int32)
+    ss, cs = sim.server_state, sim.client_states
+    ss, cs, *rest = fit_fn(ss, cs, sim._round_batches(0), mask, r,
+                           val_batches)
+    jax.block_until_ready(rest[0])
+    t0 = time.perf_counter()
+    for i in range(TIMED_ROUNDS):
+        b = sim._round_batches(i + 1)
+        ss, cs, *rest = fit_fn(ss, cs, b, mask, r, val_batches)
+    jax.block_until_ready((jax.tree_util.tree_leaves(ss)[0], rest[0]))
+    per_round = (time.perf_counter() - t0) / TIMED_ROUNDS
+    sim.server_state, sim.client_states = ss, cs
+    return per_round
+
+
 def timed_telemetry_overhead(sim) -> dict:
     """Device cost of the in-graph telemetry outputs (observability PR
     acceptance metric): per-round time of the compiled fit round WITHOUT
@@ -421,34 +446,13 @@ def timed_telemetry_overhead(sim) -> dict:
     telemetry stats are derived from values the round already computes, so
     the expected overhead is a few extra reductions per round.
     """
-    import jax
-    import jax.numpy as jnp
-
     from fl4health_tpu.observability import (
         MetricsRegistry,
         Observability,
         Tracer,
     )
 
-    mask = sim.client_manager.sample_all()
-    val_batches, _val_counts = sim._val_batches()
-    r = jnp.asarray(1, jnp.int32)
-
-    def timed_loop(fit_fn):
-        ss, cs = sim.server_state, sim.client_states
-        ss, cs, *rest = fit_fn(ss, cs, sim._round_batches(0), mask, r,
-                               val_batches)
-        jax.block_until_ready(rest[0])
-        t0 = time.perf_counter()
-        for i in range(TIMED_ROUNDS):
-            b = sim._round_batches(i + 1)
-            ss, cs, *rest = fit_fn(ss, cs, b, mask, r, val_batches)
-        jax.block_until_ready((jax.tree_util.tree_leaves(ss)[0], rest[0]))
-        per_round = (time.perf_counter() - t0) / TIMED_ROUNDS
-        sim.server_state, sim.client_states = ss, cs
-        return per_round
-
-    plain_s = timed_loop(sim._fit_round)
+    plain_s = _timed_round_loop(sim, sim._fit_round)
     prev_obs = sim.observability
     # sync_device=False + no output_dir: the handle exists only to flip the
     # telemetry compile flag — no fences, no artifacts, no global state
@@ -459,7 +463,7 @@ def timed_telemetry_overhead(sim) -> dict:
     sim.observability = temp_obs
     try:
         sim._build_compiled()
-        telemetry_s = timed_loop(sim._fit_round_t)
+        telemetry_s = _timed_round_loop(sim, sim._fit_round_t)
     finally:
         # shutdown detaches the temp handle's CompileMonitor from the
         # process-wide jax.monitoring fan-out (enabled __init__ installed it)
@@ -471,6 +475,40 @@ def timed_telemetry_overhead(sim) -> dict:
         "round_s_telemetry": round(telemetry_s, 5),
         "overhead_pct": (
             round(100.0 * (telemetry_s - plain_s) / plain_s, 2)
+            if plain_s > 0 else None
+        ),
+        "rounds": TIMED_ROUNDS,
+    }
+
+
+def timed_resilience_overhead(sim) -> dict:
+    """Device cost of Byzantine-robust aggregation (resilience PR
+    acceptance metric): per-round time of the compiled fit round under the
+    plain weighted-mean FedAvg vs the robust trimmed-mean reduction.
+
+    RobustFedAvg's state is the plain FedAvgState, so the strategy swaps in
+    place (same server-state pytree, no sim rebuild beyond the round
+    programs); both loops are fenced. The robust reduction replaces one
+    masked weighted sum with a per-coordinate sort — the number this block
+    exists to track on real accelerators."""
+    from fl4health_tpu.resilience import RobustFedAvg
+
+    plain_s = _timed_round_loop(sim, sim._fit_round)
+    prev_strategy = sim.strategy
+    method = os.environ.get("FL4HEALTH_BENCH_ROBUST_METHOD", "trimmed_mean")
+    sim.strategy = RobustFedAvg(method)
+    try:
+        sim._build_compiled()
+        robust_s = _timed_round_loop(sim, sim._fit_round)
+    finally:
+        sim.strategy = prev_strategy
+        sim._build_compiled()
+    return {
+        "round_s_plain": round(plain_s, 5),
+        "round_s_robust": round(robust_s, 5),
+        "robust_method": method,
+        "overhead_pct": (
+            round(100.0 * (robust_s - plain_s) / plain_s, 2)
             if plain_s > 0 else None
         ),
         "rounds": TIMED_ROUNDS,
@@ -641,6 +679,17 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
         and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
     ):
         out["telemetry_overhead"] = timed_telemetry_overhead(sim)
+    # Robust-aggregator round time vs the plain weighted mean (resilience
+    # PR acceptance metric). Same gating shape: FL4HEALTH_BENCH_RESILIENCE
+    # =1 forces, =0 disables, "auto" skips only the CPU fallback. Runs
+    # after telemetry_overhead — both temporarily rebuild the round
+    # programs and restore them.
+    want_r = os.environ.get("FL4HEALTH_BENCH_RESILIENCE", "auto")
+    if want_r == "1" or (
+        want_r == "auto"
+        and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+    ):
+        out["resilience_overhead"] = timed_resilience_overhead(sim)
     return out
 
 
@@ -731,6 +780,11 @@ def run_measurement() -> None:
         # (host_busy_s, device_busy_s, host_device_ratio) — the async-round-
         # pipeline win, tracked per BENCH_* artifact from that PR onward.
         "host_overhead": cifar.get("host_overhead"),
+        # in-graph telemetry and robust-aggregation round-time costs
+        # ({round_s_plain, round_s_telemetry/round_s_robust, overhead_pct}),
+        # tracked per BENCH_* artifact from their PRs onward
+        "telemetry_overhead": cifar.get("telemetry_overhead"),
+        "resilience_overhead": cifar.get("resilience_overhead"),
     }
     if fallback_note:
         record["note"] = fallback_note

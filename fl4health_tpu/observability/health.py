@@ -24,16 +24,18 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 from typing import Any, Mapping, Sequence
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
 
-_ACTIONS = ("halt", "warn", "off")
+_ACTIONS = ("halt", "warn", "mitigate", "off")
 
 HALT = "halt"
 WARN = "warn"
+MITIGATE = "mitigate"
 OFF = "off"
 
 
@@ -56,7 +58,17 @@ class TrainingHealthError(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class HealthPolicy:
     """Declarative thresholds; each check carries its own action
-    (``"halt"`` | ``"warn"`` | ``"off"``).
+    (``"halt"`` | ``"warn"`` | ``"mitigate"`` | ``"off"``).
+
+    ``"mitigate"`` (resilience subsystem) masks the offending clients out
+    of subsequent rounds instead of halting: the watchdog quarantines them
+    for ``quarantine_rounds`` rounds and ``FederatedSimulation`` multiplies
+    its sampling mask by :meth:`HealthWatchdog.quarantine_keep_mask` on the
+    pipelined path (probation served, the client is re-admitted; a
+    re-offender re-enters). Cohort-level checks with no client attribution
+    (loss divergence) degrade mitigate to warn. On the chunked path the
+    run has already executed when telemetry is screened — use the in-graph
+    ``resilience.QuarantiningStrategy`` there.
 
     - **non-finite** (``on_nonfinite``): a participating client produced
       NaN/Inf in its training loss, parameter stack, or eval loss.
@@ -81,6 +93,7 @@ class HealthPolicy:
     on_dead_client: str = WARN
     skew_ratio: float = 0.0
     on_skew: str = WARN
+    quarantine_rounds: int = 5
 
     def __post_init__(self):
         for field in ("on_nonfinite", "on_loss_divergence", "on_dead_client",
@@ -92,6 +105,8 @@ class HealthPolicy:
                 )
         if self.loss_divergence_window < 0 or self.dead_client_rounds < 1:
             raise ValueError("HealthPolicy windows must be positive")
+        if self.quarantine_rounds < 1:
+            raise ValueError("HealthPolicy.quarantine_rounds must be >= 1")
 
 
 class HealthWatchdog:
@@ -105,12 +120,36 @@ class HealthWatchdog:
 
     def __init__(self, policy: HealthPolicy | None = None):
         self.policy = policy or HealthPolicy()
+        # producer thread reads the quarantine while the consumer thread
+        # writes it (pipelined path) — one lock covers both
+        self._quarantine_lock = threading.Lock()
         self.reset()
 
     def reset(self) -> None:
         self._best_loss = float("inf")
         self._divergent_rounds = 0
         self._dead_streak: dict[int, int] = {}
+        with self._quarantine_lock:
+            # client -> first round at which it is released again
+            self._quarantine: dict[int, int] = {}
+
+    # -- mitigation (action="mitigate") --------------------------------
+    def active_quarantine(self) -> list[int]:
+        """Clients currently quarantined by mitigate checks (sorted)."""
+        with self._quarantine_lock:
+            return sorted(self._quarantine)
+
+    def quarantine_keep_mask(self, n_clients: int) -> "np.ndarray | None":
+        """[n_clients] keep-mask (0.0 = quarantined), or None while nothing
+        is quarantined — the caller's fast path multiplies nothing."""
+        with self._quarantine_lock:
+            if not self._quarantine:
+                return None
+            keep = np.ones((n_clients,), np.float32)
+            for c in self._quarantine:
+                if 0 <= c < n_clients:
+                    keep[c] = 0.0
+            return keep
 
     # ------------------------------------------------------------------
     def observe(
@@ -134,6 +173,19 @@ class HealthWatchdog:
         participants = np.nonzero(mask > 0)[0]
         summary: dict[str, Any] = {"round": int(round_idx), "status": "ok"}
         problems: list[tuple[str, str, list[int], str]] = []
+
+        # -- probation expiry (mitigate recovery) -----------------------
+        released: list[int] = []
+        with self._quarantine_lock:
+            for c, until in list(self._quarantine.items()):
+                if until <= round_idx:
+                    del self._quarantine[c]
+                    released.append(c)
+        if released:
+            logger.info(
+                "health: clients %s released from quarantine at round %d "
+                "(probation served)", sorted(released), round_idx,
+            )
 
         # -- non-finite --------------------------------------------------
         if pol.on_nonfinite != OFF:
@@ -218,9 +270,37 @@ class HealthWatchdog:
 
         halts = [p for p in problems if p[1] == HALT]
         warns = [p for p in problems if p[1] == WARN]
+        mitigations = [p for p in problems if p[1] == MITIGATE]
+        # -- mitigation: quarantine offenders instead of halting --------
+        entered: list[int] = []
+        for check, _action, clients, msg in mitigations:
+            if not clients:
+                # cohort-level checks carry no client attribution; masking
+                # "nobody in particular" is a warn, not a mitigation
+                logger.warning(
+                    "health[%s] round %d: %s (mitigate has no client "
+                    "attribution for this check — treated as warn)",
+                    check, round_idx, msg,
+                )
+                continue
+            with self._quarantine_lock:
+                for c in clients:
+                    c = int(c)
+                    if c not in self._quarantine:
+                        entered.append(c)
+                    self._quarantine[c] = round_idx + pol.quarantine_rounds
+            logger.warning(
+                "health[%s] round %d: %s — quarantining clients %s for "
+                "%d rounds", check, round_idx, msg, clients,
+                pol.quarantine_rounds,
+            )
         if problems:
-            summary["status"] = "halt" if halts else "warn"
+            summary["status"] = ("halt" if halts
+                                 else "mitigate" if mitigations else "warn")
             summary["checks_tripped"] = [p[0] for p in problems]
+        if entered or released or self._quarantine:
+            summary["quarantined_clients"] = self.active_quarantine()
+            summary["released_clients"] = sorted(released)
         for check, _action, clients, msg in warns:
             logger.warning("health[%s] round %d: %s", check, round_idx, msg)
 
@@ -243,6 +323,31 @@ class HealthWatchdog:
                     "fl_health_warnings_total",
                     help="health checks that tripped with action=warn",
                 ).inc(len(warns))
+            if entered or released or self._quarantine:
+                # guarded like the counters below: a halt/warn-only policy
+                # must not grow a new always-zero metric family
+                obs.gauge(
+                    "fl_quarantine_active_clients",
+                    help="clients currently masked out of aggregation by "
+                         "quarantine",
+                ).set(float(len(self.active_quarantine())))
+            if entered:
+                obs.counter(
+                    "fl_quarantine_entries_total",
+                    help="clients entering quarantine",
+                ).inc(len(entered))
+            if released:
+                obs.counter(
+                    "fl_quarantine_releases_total",
+                    help="clients released from quarantine (probation "
+                         "served)",
+                ).inc(len(released))
+            if entered or released:
+                obs.log_event(
+                    "quarantine", round=int(round_idx), source="watchdog",
+                    active=self.active_quarantine(),
+                    entered=sorted(entered), released=sorted(released),
+                )
             obs.log_event("health", **summary)
         for rep in reporters:
             rep.report({"health": dict(summary)}, round=int(round_idx))

@@ -1,0 +1,78 @@
+"""Resilience — tolerate and route around client/silo failures without
+leaving the compiled fast path.
+
+After the observability PRs the framework can *see* every failure (in-graph
+telemetry, HealthWatchdog, program introspection) but could only warn or
+halt. This subsystem is the next step, four pillars:
+
+- :mod:`~fl4health_tpu.resilience.aggregators` — jit-compatible,
+  statically-shaped Byzantine-robust aggregation (coordinate median,
+  trimmed mean, norm-bounded mean, Krum/multi-Krum) packaged as the
+  drop-in :class:`RobustFedAvg` strategy; runs INSIDE the compiled round
+  programs on both execution modes;
+- :mod:`~fl4health_tpu.resilience.quarantine` — an in-graph quarantine
+  mask carried in server state with strike/probation/recovery semantics
+  (:class:`QuarantiningStrategy` wraps any inner strategy); offenders are
+  masked, never dropped, so shapes — and compiled programs — never change;
+- :mod:`~fl4health_tpu.resilience.faults` — the deterministic, seeded
+  :class:`FaultPlan` chaos layer (client dropout, update corruption,
+  straggler/drop/corrupt wire faults) robustness claims are tested
+  against, not asserted;
+- :mod:`~fl4health_tpu.resilience.retry` — retry/backoff, failure-reason
+  classification and per-silo circuit breakers for the concurrent
+  quorum-based ``broadcast_round`` in ``transport/coordinator.py``.
+"""
+
+from fl4health_tpu.resilience.aggregators import (
+    ROBUST_METHODS,
+    RobustFedAvg,
+    coordinate_median,
+    krum_weights,
+    norm_bounded_mean,
+    trimmed_mean,
+)
+from fl4health_tpu.resilience.faults import (
+    ClientFault,
+    FaultPlan,
+    TransportFaultPolicy,
+    chaos_handler,
+)
+from fl4health_tpu.resilience.quarantine import (
+    QuarantinePolicy,
+    QuarantineServerState,
+    QuarantineState,
+    QuarantiningStrategy,
+    init_quarantine,
+    quarantine_step,
+)
+from fl4health_tpu.resilience.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+    call_with_retry,
+    classify_failure,
+)
+
+__all__ = [
+    "ROBUST_METHODS",
+    "RobustFedAvg",
+    "coordinate_median",
+    "trimmed_mean",
+    "norm_bounded_mean",
+    "krum_weights",
+    "QuarantinePolicy",
+    "QuarantineState",
+    "QuarantineServerState",
+    "QuarantiningStrategy",
+    "init_quarantine",
+    "quarantine_step",
+    "ClientFault",
+    "FaultPlan",
+    "TransportFaultPolicy",
+    "chaos_handler",
+    "RetryPolicy",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "call_with_retry",
+    "classify_failure",
+]

@@ -1,0 +1,302 @@
+"""Byzantine-robust aggregation combinators — jit-compatible, statically
+shaped, mask-driven.
+
+The reference handles a misbehaving client by *not calling* it again
+(Flower drops the gRPC peer); the SPMD build cannot drop a row from a
+compiled program without recompiling, so robustness has to be expressed the
+same way sampling already is: as math over a fixed ``[clients]`` axis with
+masks. Every combinator here
+
+- accepts the client-stacked packet pytree (leading ``[clients]`` axis on
+  every leaf) plus a ``[clients]`` participation mask,
+- treats non-finite submissions from *participating* clients as adversarial
+  (they sort to the top and are out-voted/trimmed, never propagated),
+- keeps all shapes static, so a quarantined or dropped client costs zero
+  recompiles on either execution path (pipelined or chunked scan).
+
+Estimators (classical Byzantine-robust FL menu):
+
+- :func:`coordinate_median` — coordinate-wise median over participating
+  clients (breakdown point ~1/2);
+- :func:`trimmed_mean` — coordinate-wise mean after trimming the
+  ``trim_fraction`` extremes from each end (Yin et al.-style);
+- :func:`norm_bounded_mean` — weighted mean after clipping each client's
+  update norm relative to a reference (the norm-bounding defense; also the
+  only combinator here that honors sample-count weighting);
+- :func:`krum_weights` — Krum / multi-Krum selection scores (Blanchard et
+  al.): average the ``m`` clients whose closest-neighbor distance sums are
+  smallest.
+
+:class:`RobustFedAvg` packages them as a drop-in
+:class:`~fl4health_tpu.strategies.base.Strategy` whose state is the plain
+``FedAvgState`` — swappable with FedAvg without touching server state
+structure, which is what lets ``bench.py`` time the robust-vs-plain
+aggregation overhead in place.
+
+Median/trimmed-mean/Krum are deliberately UNWEIGHTED: in the Byzantine
+model the per-client sample counts are attacker-controlled inputs, so
+weighting by them hands the adversary the estimator.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.core.aggregate import effective_weights, weighted_mean
+from fl4health_tpu.core.types import Params, PyTree, StackedParams
+from fl4health_tpu.strategies.base import FitResults, Strategy
+from fl4health_tpu.strategies.fedavg import FedAvgState
+
+ROBUST_METHODS = ("median", "trimmed_mean", "norm_bounded", "krum",
+                  "multi_krum")
+
+
+def _expand(v: jax.Array, leaf: jax.Array) -> jax.Array:
+    """Reshape [clients] vector to broadcast against a [clients, ...] leaf."""
+    return v.reshape((-1,) + (1,) * (leaf.ndim - 1))
+
+
+def _sanitized(leaf: jax.Array, mask: jax.Array) -> jax.Array:
+    """f32 copy with masked-out rows AND non-finite entries set to +inf, so
+    an ascending sort pushes both past every honest value. A NaN would
+    otherwise sort *after* +inf and break the 'first k rows are the
+    participants' invariant the order statistics below rely on."""
+    v = leaf.astype(jnp.float32)
+    keep = _expand(mask > 0, v) & jnp.isfinite(v)
+    return jnp.where(keep, v, jnp.inf)
+
+
+def coordinate_median(stacked: StackedParams, mask: jax.Array) -> PyTree:
+    """Masked coordinate-wise median over the clients axis.
+
+    ``k = |participants|`` is a traced value: the sort is over the full
+    static axis and the median indices are dynamic gathers, so partial
+    cohorts never change program shapes. An empty cohort yields +inf
+    coordinates — callers guard with their usual empty-cohort fallback
+    (as :class:`RobustFedAvg` does)."""
+    k = jnp.sum(jnp.asarray(mask) > 0).astype(jnp.int32)
+    lo = jnp.maximum((k - 1) // 2, 0)
+    hi = jnp.maximum(k // 2, 0)
+
+    def _med(leaf: jax.Array) -> jax.Array:
+        s = jnp.sort(_sanitized(leaf, mask), axis=0)
+        return 0.5 * (jnp.take(s, lo, axis=0) + jnp.take(s, hi, axis=0))
+
+    return jax.tree_util.tree_map(_med, stacked)
+
+
+def trimmed_mean(
+    stacked: StackedParams, mask: jax.Array, trim_fraction: float = 0.2
+) -> PyTree:
+    """Masked coordinate-wise trimmed mean: drop ``floor(trim_fraction*k)``
+    values from EACH end of the sorted participating values, average the
+    middle. ``trim_fraction`` is static config; the realized trim count is
+    clamped so at least the median survives tiny cohorts. Non-finite
+    submissions sort to the top end and are removed whenever the trim
+    budget covers the attacker count — the estimator's usual guarantee."""
+    if not 0.0 <= trim_fraction < 0.5:
+        raise ValueError(
+            f"trim_fraction must be in [0, 0.5); got {trim_fraction} "
+            "(trimming half or more from each end leaves nothing)"
+        )
+    m = jnp.asarray(mask)
+    k = jnp.sum(m > 0).astype(jnp.int32)
+    t = jnp.clip(
+        jnp.floor(trim_fraction * k.astype(jnp.float32)).astype(jnp.int32),
+        0,
+        jnp.maximum((k - 1) // 2, 0),
+    )
+    pos = jnp.arange(m.shape[0], dtype=jnp.int32)
+    w = ((pos >= t) & (pos < k - t)).astype(jnp.float32)  # sorted-rank weights
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+
+    def _tm(leaf: jax.Array) -> jax.Array:
+        s = jnp.sort(_sanitized(leaf, mask), axis=0)
+        ww = _expand(w, s)
+        # where() then multiply: an untrimmed +inf must flow through (real
+        # breakdown), but a trimmed one must not poison the sum (inf*0=nan)
+        return jnp.sum(jnp.where(ww > 0, s, 0.0) * ww, axis=0) / denom
+
+    return jax.tree_util.tree_map(_tm, stacked)
+
+
+def _per_client_nonfinite_flag(stacked: StackedParams) -> jax.Array:
+    """[C] bool — client row contains any NaN/Inf in a float leaf."""
+    bad = None
+    for leaf in jax.tree_util.tree_leaves(stacked):
+        if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+            continue
+        row = jnp.any(
+            ~jnp.isfinite(leaf.reshape(leaf.shape[0], -1)), axis=1
+        )
+        bad = row if bad is None else bad | row
+    if bad is None:
+        n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+        return jnp.zeros((n,), bool)
+    return bad
+
+
+def norm_bounded_mean(
+    stacked: StackedParams,
+    reference: Params,
+    sample_counts: jax.Array,
+    mask: jax.Array,
+    max_norm: float,
+    weighted: bool = True,
+) -> PyTree:
+    """Weighted mean after clipping each client's global update norm
+    ``||packet - reference||`` to ``max_norm`` (the norm-bounding defense:
+    a single scaled-up update can shift the mean by at most ``max_norm``).
+    Non-finite coordinates are treated as zero *delta* — a NaN-poisoned
+    client degrades to re-submitting the reference, not to poisoning the
+    aggregate."""
+    n2 = None
+    for leaf, ref in zip(
+        jax.tree_util.tree_leaves(stacked), jax.tree_util.tree_leaves(reference)
+    ):
+        d = leaf.astype(jnp.float32) - ref.astype(jnp.float32)[None]
+        d = jnp.where(jnp.isfinite(d), d, 0.0)
+        s = jnp.sum(jnp.square(d).reshape(d.shape[0], -1), axis=1)
+        n2 = s if n2 is None else n2 + s
+    norm = jnp.sqrt(n2)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+
+    def _clip(leaf: jax.Array, ref: jax.Array) -> jax.Array:
+        r = ref.astype(jnp.float32)[None]
+        d = leaf.astype(jnp.float32) - r
+        d = jnp.where(jnp.isfinite(d), d, 0.0)
+        return r + _expand(scale, d) * d
+
+    clipped = jax.tree_util.tree_map(_clip, stacked, reference)
+    w = effective_weights(sample_counts, mask, weighted)
+    out = weighted_mean(clipped, w)
+    return jax.tree_util.tree_map(
+        lambda o, ref: o.astype(ref.dtype), out, reference
+    )
+
+
+def krum_weights(
+    stacked: StackedParams,
+    mask: jax.Array,
+    num_byzantine: int,
+    multi_m: int = 1,
+) -> jax.Array:
+    """Krum / multi-Krum selection as [C] normalized aggregation weights.
+
+    Each participating client is scored by the sum of its squared distances
+    to its ``n - f - 2`` closest participating peers (``f`` =
+    ``num_byzantine``); the ``multi_m`` lowest scores are selected and
+    averaged (``multi_m=1`` is classical Krum). Clients with non-finite
+    rows, masked-out clients, and selections whose score is +inf (cohort
+    smaller than ``multi_m``) get weight 0. All shapes static; ``multi_m``
+    and ``num_byzantine`` are compile-time config."""
+    m = jnp.asarray(mask)
+    n_clients = m.shape[0]
+    if not 1 <= multi_m <= n_clients:
+        raise ValueError(f"multi_m must be in [1, {n_clients}]; got {multi_m}")
+    part = m > 0
+    n = jnp.sum(part).astype(jnp.int32)
+    bad = _per_client_nonfinite_flag(stacked)
+
+    d2 = jnp.zeros((n_clients, n_clients), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(stacked):
+        v = leaf.astype(jnp.float32).reshape(n_clients, -1)
+        v = jnp.where(jnp.isfinite(v), v, 0.0)
+        sq = jnp.sum(jnp.square(v), axis=1)
+        d2 = d2 + (sq[:, None] + sq[None, :] - 2.0 * (v @ v.T))
+    d2 = jnp.maximum(d2, 0.0)  # matmul round-off can dip tiny negatives
+    unusable = ~part | bad
+    d2 = jnp.where(unusable[:, None] | unusable[None, :], jnp.inf, d2)
+    d2 = jnp.where(jnp.eye(n_clients, dtype=bool), jnp.inf, d2)
+
+    # closest c = n - f - 2 neighbors; clamp so tiny cohorts still score
+    c = jnp.clip(n - num_byzantine - 2, 1, n_clients - 1)
+    sorted_d = jnp.sort(d2, axis=1)
+    csum = jnp.cumsum(sorted_d, axis=1)  # an inf neighbor poisons the score
+    score = jnp.take_along_axis(
+        csum, jnp.full((n_clients, 1), c - 1), axis=1
+    )[:, 0]
+    score = jnp.where(part & ~bad, score, jnp.inf)
+
+    neg_vals, idx = jax.lax.top_k(-score, multi_m)
+    sel = jnp.zeros((n_clients,), jnp.float32).at[idx].add(
+        jnp.where(jnp.isfinite(neg_vals), 1.0, 0.0)
+    )
+    total = jnp.sum(sel)
+    return jnp.where(total > 0, sel / jnp.maximum(total, 1.0), sel)
+
+
+class RobustFedAvg(Strategy):
+    """FedAvg with a Byzantine-robust reduction — a drop-in ``Strategy``.
+
+    ``method`` selects the combinator (``"median"``, ``"trimmed_mean"``,
+    ``"norm_bounded"``, ``"krum"``, ``"multi_krum"``); all run inside the
+    compiled round programs on both execution modes. State is the plain
+    ``FedAvgState``, so swapping FedAvg <-> RobustFedAvg never changes the
+    server-state pytree (``bench.py`` relies on this to time the overhead
+    in place). An effectively-empty cohort (all weights zero — empty mask,
+    or every client rejected) keeps the previous params, mirroring FedAvg's
+    empty-cohort rule."""
+
+    def __init__(
+        self,
+        method: str = "median",
+        *,
+        trim_fraction: float = 0.2,
+        max_update_norm: float = 10.0,
+        num_byzantine: int = 1,
+        multi_krum_m: int = 3,
+        weighted_aggregation: bool = True,
+    ):
+        if method not in ROBUST_METHODS:
+            raise ValueError(
+                f"method must be one of {ROBUST_METHODS}; got {method!r}"
+            )
+        if max_update_norm <= 0:
+            raise ValueError("max_update_norm must be positive")
+        if num_byzantine < 0:
+            raise ValueError("num_byzantine must be >= 0")
+        self.method = method
+        self.trim_fraction = trim_fraction
+        self.max_update_norm = max_update_norm
+        self.num_byzantine = num_byzantine
+        self.multi_krum_m = multi_krum_m
+        # honored by norm_bounded only; the order statistics are unweighted
+        # by construction (see module docstring)
+        self.weighted_aggregation = weighted_aggregation
+
+    def init(self, params: Params) -> FedAvgState:
+        return FedAvgState(params=params)
+
+    def aggregate(
+        self, server_state: FedAvgState, results: FitResults, round_idx
+    ) -> FedAvgState:
+        stacked, mask = results.packets, results.mask
+        if self.method == "median":
+            new = coordinate_median(stacked, mask)
+            ok = jnp.sum(mask) > 0
+        elif self.method == "trimmed_mean":
+            new = trimmed_mean(stacked, mask, self.trim_fraction)
+            ok = jnp.sum(mask) > 0
+        elif self.method == "norm_bounded":
+            new = norm_bounded_mean(
+                stacked,
+                server_state.params,
+                results.sample_counts,
+                mask,
+                self.max_update_norm,
+                self.weighted_aggregation,
+            )
+            ok = jnp.sum(mask) > 0
+        else:  # krum / multi_krum
+            m = 1 if self.method == "krum" else self.multi_krum_m
+            w = krum_weights(stacked, mask, self.num_byzantine, m)
+            new = weighted_mean(stacked, w)
+            ok = jnp.sum(w) > 0
+        new_params = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(ok, n.astype(o.dtype), o),
+            new,
+            server_state.params,
+        )
+        return server_state.replace(params=new_params)

@@ -1,0 +1,274 @@
+"""Deterministic, seeded fault injection — the chaos layer robustness
+claims are tested against.
+
+Two fault surfaces, one plan:
+
+- **Simulation faults** (in-graph): client dropout and update corruption
+  (NaN-poison, scaling, sign-flip) compiled into the round programs. All
+  draws come from ``jax.random`` keys folded from ``(seed, fault_index,
+  round)``, so the SAME :class:`FaultPlan` produces the SAME faults on the
+  pipelined and chunked execution paths, under resume, and across
+  processes — a robustness experiment is exactly reproducible. Dropout is
+  a mask multiply and corruption a packet transform: shapes never change,
+  so a fault-ridden run costs zero recompiles.
+- **Transport faults** (host-side): frame drop, frame corruption and
+  straggler delay injected by wrapping a silo's handler
+  (:func:`chaos_handler`) — deterministic per ``(seed, silo, request
+  counter)`` via ``random.Random``. This is what the retry/quorum path
+  (``transport/retry.py``, ``broadcast_round``) is exercised against.
+
+Corruption semantics: a corrupted packet is ``payload + s * (packet -
+payload)`` relative to the round's broadcast payload — ``s = -1`` is the
+classical sign-flip attack on the update, ``s = k`` the scaling attack,
+``s = NaN`` the poison. When the packet pytree is not param-shaped the
+factor applies multiplicatively to each float leaf instead (checked
+statically at trace time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _pyrandom
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CLIENT_FAULT_KINDS = ("dropout", "nan", "scale", "sign_flip")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientFault:
+    """One fault spec over a static set of clients.
+
+    ``probability`` is per (client, round); 1.0 means every round in the
+    active window. The window is ``[start_round, end_round]`` inclusive
+    (``end_round=None`` = forever)."""
+
+    clients: tuple[int, ...]
+    kind: str
+    scale: float = 10.0
+    probability: float = 1.0
+    start_round: int = 1
+    end_round: int | None = None
+
+    def __post_init__(self):
+        if self.kind not in CLIENT_FAULT_KINDS:
+            raise ValueError(
+                f"ClientFault.kind must be one of {CLIENT_FAULT_KINDS}; "
+                f"got {self.kind!r}"
+            )
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        if not self.clients:
+            raise ValueError("ClientFault.clients must name at least one client")
+        # tuple-ify defensively: specs are hashable static config
+        object.__setattr__(self, "clients", tuple(int(c) for c in self.clients))
+
+
+@dataclasses.dataclass(frozen=True)
+class TransportFaultPolicy:
+    """Host-side wire chaos for one silo handler (all probabilities are per
+    request, drawn deterministically from the plan seed)."""
+
+    drop_probability: float = 0.0      # handler raises -> peer sees a reset
+    corrupt_probability: float = 0.0   # reply frame byte-flipped (CRC fails)
+    delay_s: float = 0.0               # straggler: sleep before replying
+    delay_probability: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A seeded, declarative chaos schedule.
+
+    Pass to ``FederatedSimulation(fault_plan=...)`` for the in-graph client
+    faults; wrap silo handlers with :func:`chaos_handler` for the wire
+    faults. An empty plan is exactly a no-op: the round programs compile
+    byte-identically to ``fault_plan=None`` (pinned by
+    ``tests/resilience/test_faults.py``)."""
+
+    seed: int = 0
+    client_faults: tuple[ClientFault, ...] = ()
+    transport: TransportFaultPolicy | None = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "client_faults", tuple(self.client_faults))
+
+    # -- static views ---------------------------------------------------
+    @property
+    def dropout_faults(self) -> tuple[ClientFault, ...]:
+        return tuple(f for f in self.client_faults if f.kind == "dropout")
+
+    @property
+    def corruption_faults(self) -> tuple[ClientFault, ...]:
+        return tuple(f for f in self.client_faults if f.kind != "dropout")
+
+    @property
+    def has_client_faults(self) -> bool:
+        return bool(self.client_faults)
+
+    def _check_clients(self, n_clients: int) -> None:
+        """Every spec'd client must exist: JAX drops out-of-bounds scatter
+        indices silently, so a typo'd id would inject NO fault anywhere and
+        the robustness experiment would pass vacuously."""
+        for f in self.client_faults:
+            bad = [c for c in f.clients if not 0 <= c < n_clients]
+            if bad:
+                raise ValueError(
+                    f"FaultPlan: ClientFault({f.kind!r}) names clients "
+                    f"{bad} but the cohort has {n_clients} clients "
+                    f"(valid ids: 0..{n_clients - 1})"
+                )
+
+    # -- in-graph draws (jit-traceable; round_idx may be traced) ---------
+    def _fired(self, fault: ClientFault, fault_idx: int, round_idx,
+               n_clients: int) -> jax.Array:
+        """[C] float 1.0 where this fault fires this round."""
+        member = jnp.zeros((n_clients,), jnp.float32).at[
+            jnp.asarray(fault.clients, jnp.int32)
+        ].set(1.0)
+        r = jnp.asarray(round_idx, jnp.int32)
+        active = (r >= fault.start_round)
+        if fault.end_round is not None:
+            active &= r <= fault.end_round
+        fired = member * active.astype(jnp.float32)
+        if fault.probability < 1.0:
+            # distinct stream per (seed, fault index, round): deterministic
+            # across execution modes and resumes
+            key = jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.PRNGKey(self.seed), 7919 * fault_idx + 13
+                ),
+                r,
+            )
+            u = jax.random.uniform(key, (n_clients,))
+            fired = fired * (u < fault.probability).astype(jnp.float32)
+        return fired
+
+    def participation_factor(self, round_idx, n_clients: int) -> jax.Array:
+        """[C] keep-mask (1.0 = client reachable) from the dropout specs —
+        multiplied into the round's sampled participation mask in-graph."""
+        self._check_clients(n_clients)
+        keep = jnp.ones((n_clients,), jnp.float32)
+        for i, f in enumerate(self.client_faults):
+            if f.kind != "dropout":
+                continue
+            keep = keep * (1.0 - self._fired(f, i, round_idx, n_clients))
+        return keep
+
+    def corruption_factors(self, round_idx, n_clients: int) -> jax.Array:
+        """[C] per-client update multiplier ``s`` (1.0 = honest, -1 =
+        sign-flip, k = scale, NaN = poison). Later specs win on overlap."""
+        self._check_clients(n_clients)
+        factors = jnp.ones((n_clients,), jnp.float32)
+        for i, f in enumerate(self.client_faults):
+            if f.kind == "dropout":
+                continue
+            value = {
+                "nan": jnp.nan,
+                "sign_flip": -1.0,
+                "scale": float(f.scale),
+            }[f.kind]
+            fired = self._fired(f, i, round_idx, n_clients)
+            factors = jnp.where(fired > 0, value, factors)
+        return factors
+
+    def corrupt_packets(self, packets: Any, payload_params: Any,
+                        round_idx, n_clients: int) -> Any:
+        """Apply this round's corruption to the client-stacked packets
+        (jit-traceable; identity when no corruption specs exist)."""
+        if not self.corruption_faults:
+            return packets
+        factors = self.corruption_factors(round_idx, n_clients)
+
+        def expand(leaf):
+            return factors.reshape((-1,) + (1,) * (leaf.ndim - 1))
+
+        if (jax.tree_util.tree_structure(packets)
+                == jax.tree_util.tree_structure(payload_params)):
+            # attack the UPDATE relative to the broadcast payload
+            return jax.tree_util.tree_map(
+                lambda leaf, ref: (
+                    ref.astype(leaf.dtype)[None]
+                    + expand(leaf) * (leaf - ref.astype(leaf.dtype)[None])
+                ).astype(leaf.dtype)
+                if jnp.issubdtype(leaf.dtype, jnp.inexact) else leaf,
+                packets, payload_params,
+            )
+        # exotic packet layout: multiplicative on float leaves
+        return jax.tree_util.tree_map(
+            lambda leaf: (expand(leaf) * leaf).astype(leaf.dtype)
+            if jnp.issubdtype(leaf.dtype, jnp.inexact) else leaf,
+            packets,
+        )
+
+    # -- host mirror (observability) ------------------------------------
+    def summarize_round(self, round_idx: int, n_clients: int) -> dict | None:
+        """Host-side mirror of the round's draws for the ``fault`` JSONL
+        event — same seeded computation evaluated eagerly, so the log
+        reports exactly what the compiled program injected."""
+        if not self.client_faults:
+            return None
+        keep = np.asarray(self.participation_factor(round_idx, n_clients))
+        factors = np.asarray(self.corruption_factors(round_idx, n_clients))
+        dropped = [int(c) for c in np.nonzero(keep < 1.0)[0]]
+        kinds: dict[str, list[int]] = {}
+        for c in range(n_clients):
+            f = factors[c]
+            if np.isnan(f):
+                kinds.setdefault("nan", []).append(c)
+            elif f == -1.0:
+                kinds.setdefault("sign_flip", []).append(c)
+            elif f != 1.0:
+                kinds.setdefault("scale", []).append(c)
+        corrupted = sorted({c for cs in kinds.values() for c in cs})
+        if not dropped and not corrupted:
+            return None
+        return {
+            "round": int(round_idx),
+            "dropped": dropped,
+            "corrupted": corrupted,
+            "kinds": kinds,
+        }
+
+
+class _InjectedDrop(RuntimeError):
+    """Raised inside a chaos-wrapped handler to kill the reply — the
+    loopback server logs it and closes the connection, which the caller
+    observes as a connection failure (exactly a crashed silo)."""
+
+
+def chaos_handler(
+    handler: Callable[[bytes], bytes],
+    policy: TransportFaultPolicy,
+    seed: int = 0,
+    silo_idx: int = 0,
+) -> Callable[[bytes], bytes]:
+    """Wrap a silo request handler with deterministic wire chaos.
+
+    Draws come from ``random.Random(f"{seed}:{silo_idx}")`` in a fixed order
+    per request (delay, drop, corrupt), so a given plan produces the same
+    fault sequence every run — tests assert against it. Thread-safe enough
+    for the one-connection-at-a-time loopback server."""
+    rng = _pyrandom.Random(f"{seed}:{silo_idx}")
+
+    def wrapped(frame: bytes) -> bytes:
+        r_delay, r_drop, r_corrupt = rng.random(), rng.random(), rng.random()
+        if policy.delay_s > 0 and r_delay < policy.delay_probability:
+            time.sleep(policy.delay_s)
+        if r_drop < policy.drop_probability:
+            raise _InjectedDrop(
+                f"chaos: dropped request at silo {silo_idx}"
+            )
+        reply = handler(frame)
+        if r_corrupt < policy.corrupt_probability and reply:
+            # flip one mid-frame byte: framing CRC catches it and the
+            # caller sees a decode failure, not silent corruption
+            buf = bytearray(reply)
+            buf[len(buf) // 2] ^= 0xFF
+            reply = bytes(buf)
+        return reply
+
+    return wrapped

@@ -1,0 +1,325 @@
+"""In-graph client quarantine — strike/probation state carried in server
+state, updated inside the compiled round programs.
+
+The :class:`~fl4health_tpu.observability.health.HealthWatchdog` (PR 3) can
+*see* a misbehaving client from host telemetry, but on the chunked-scan
+execution mode the whole run is one dispatch — by the time the host sees
+round *r*'s telemetry, round *r+1* has already aggregated the offender.
+Quarantine therefore has to live where aggregation lives: inside the
+graph, as a ``[clients]``-shaped mask in server state, so masking an
+offender out of round *r+1* costs zero recompiles and works identically on
+both execution modes.
+
+Mechanics (all jit-traceable, static shapes):
+
+- :class:`QuarantineState` rides in the strategy's server-state pytree:
+  ``quarantined`` mask, per-client ``strikes``, probation countdown
+  (``release_in``), and a dead-update streak;
+- :func:`quarantine_step` folds one round's signals — per-client non-finite
+  counts, update norms — into that state under a static
+  :class:`QuarantinePolicy` (offense -> strike; enough strikes ->
+  quarantine; ``quarantine_rounds`` of probation -> release/recovery; a
+  re-offender simply re-enters);
+- :class:`QuarantiningStrategy` wraps ANY inner strategy: it zeroes
+  quarantined clients out of the aggregation mask (the inner strategy never
+  sees them), derives the signals from the round's own packets/losses, and
+  steps the state — all inside ``Strategy.aggregate``, which both the
+  pipelined and chunked round programs already compile.
+
+Host-side visibility (``fl_quarantine_*`` gauges + ``quarantine`` JSONL
+events) is emitted by ``FederatedSimulation``, which snapshots the mask per
+round on both execution paths. The complementary HOST-side mitigation — the
+watchdog's ``mitigate`` action gating next-round sampling on the pipelined
+path — lives in ``observability/health.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.observability import telemetry as telem
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class QuarantineState:
+    """Per-client quarantine bookkeeping, all ``[clients]`` float32 (a plain
+    pytree: scans, donation and ``device_get`` handle it unchanged)."""
+
+    quarantined: jax.Array  # 1.0 = masked out of aggregation
+    strikes: jax.Array      # consecutive offense count while healthy
+    release_in: jax.Array   # probation rounds remaining while quarantined
+    dead_streak: jax.Array  # consecutive near-zero-update participations
+
+
+def init_quarantine(n_clients: int) -> QuarantineState:
+    z = jnp.zeros((n_clients,), jnp.float32)
+    return QuarantineState(quarantined=z, strikes=z, release_in=z,
+                           dead_streak=z)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantinePolicy:
+    """Static thresholds compiled into the round program.
+
+    - ``on_nonfinite``: a participating client whose packet or losses
+      contain NaN/Inf commits an offense (the poisoned-update signal);
+    - ``norm_outlier_ratio`` > 0 enables: update norm beyond that multiple
+      of the healthy-cohort median is an offense (scaled/sign-flip attack
+      proxy; requires the wrapped packets to be param-shaped);
+    - ``dead_norm`` >= 0 enables: update norm at or below it for
+      ``dead_rounds`` consecutive participations is an offense (a client
+      pushing the pulled model straight back);
+    - ``strikes_to_quarantine`` consecutive offenses trigger quarantine;
+      an offense-free participation clears the strike count;
+    - ``quarantine_rounds`` of probation later the client is released
+      (recovery) with a clean record — re-offending re-quarantines it.
+    """
+
+    on_nonfinite: bool = True
+    norm_outlier_ratio: float = 0.0
+    dead_norm: float = -1.0
+    dead_rounds: int = 3
+    strikes_to_quarantine: int = 1
+    quarantine_rounds: int = 5
+
+    def __post_init__(self):
+        if self.strikes_to_quarantine < 1:
+            raise ValueError("strikes_to_quarantine must be >= 1")
+        if self.quarantine_rounds < 1:
+            raise ValueError("quarantine_rounds must be >= 1")
+        if self.dead_rounds < 1:
+            raise ValueError("dead_rounds must be >= 1")
+
+
+def _masked_median(values: jax.Array, keep: jax.Array) -> jax.Array:
+    """Median of ``values`` where ``keep`` — +inf padding sort trick, same
+    order-statistics approach as the robust aggregators."""
+    v = jnp.where(keep, values, jnp.inf)
+    s = jnp.sort(v)
+    k = jnp.sum(keep).astype(jnp.int32)
+    lo = jnp.maximum((k - 1) // 2, 0)
+    hi = jnp.maximum(k // 2, 0)
+    return 0.5 * (jnp.take(s, lo) + jnp.take(s, hi))
+
+
+def quarantine_step(
+    q: QuarantineState,
+    policy: QuarantinePolicy,
+    *,
+    mask: jax.Array,
+    nonfinite: jax.Array,
+    update_norm: jax.Array,
+) -> QuarantineState:
+    """One round of strike/quarantine/probation bookkeeping (jit-traceable).
+
+    ``mask`` is the round's SAMPLED participation (pre-quarantine): only
+    healthy sampled clients are judged, quarantined clients only serve
+    probation. ``update_norm`` may be all-NaN when the packet layout gives
+    no norm signal — the norm-driven checks then never fire."""
+    part = (jnp.asarray(mask) > 0) & (q.quarantined < 0.5)
+    finite_norm = jnp.isfinite(update_norm)
+
+    offense = jnp.zeros_like(part)
+    if policy.on_nonfinite:
+        offense |= part & (jnp.asarray(nonfinite) > 0)
+    if policy.norm_outlier_ratio > 0:
+        healthy = part & finite_norm
+        med = _masked_median(update_norm, healthy)
+        outlier = (
+            part
+            & finite_norm
+            & (update_norm
+               > policy.norm_outlier_ratio * jnp.maximum(med, 1e-12))
+        )
+        # a median needs a cohort: with <3 healthy norms "outlier" is noise
+        offense |= outlier & (jnp.sum(healthy) >= 3) & jnp.isfinite(med)
+
+    dead_streak = q.dead_streak
+    if policy.dead_norm >= 0:
+        is_dead = part & finite_norm & (update_norm <= policy.dead_norm)
+        dead_streak = jnp.where(
+            part, jnp.where(is_dead, dead_streak + 1.0, 0.0), dead_streak
+        )
+        tripped = dead_streak >= policy.dead_rounds
+        offense |= part & tripped
+        dead_streak = jnp.where(tripped, 0.0, dead_streak)
+
+    strikes = jnp.where(
+        part, jnp.where(offense, q.strikes + 1.0, 0.0), q.strikes
+    )
+
+    # probation countdown first, then release, then (re-)entries — a client
+    # released this round can immediately re-enter on a fresh offense next
+    # round, never this one (its strikes were cleared on entry)
+    release_in = jnp.where(
+        q.quarantined > 0, jnp.maximum(q.release_in - 1.0, 0.0), q.release_in
+    )
+    released = (q.quarantined > 0) & (release_in <= 0)
+    quarantined = jnp.where(released, 0.0, q.quarantined)
+    strikes = jnp.where(released, 0.0, strikes)
+    dead_streak = jnp.where(released, 0.0, dead_streak)
+
+    entering = strikes >= policy.strikes_to_quarantine
+    quarantined = jnp.where(entering, 1.0, quarantined)
+    release_in = jnp.where(
+        entering, float(policy.quarantine_rounds), release_in
+    )
+    strikes = jnp.where(entering, 0.0, strikes)
+
+    return QuarantineState(
+        quarantined=quarantined,
+        strikes=strikes,
+        release_in=release_in,
+        dead_streak=dead_streak,
+    )
+
+
+@struct.dataclass
+class QuarantineServerState:
+    """Wrapper server state: the inner strategy's state + quarantine."""
+
+    inner: Any
+    quarantine: QuarantineState
+
+
+class QuarantiningStrategy(Strategy):
+    """Wrap any strategy with in-graph quarantine.
+
+    Quarantined clients are removed from the aggregation mask BEFORE the
+    inner ``aggregate`` runs (the inner strategy treats them exactly like
+    unsampled clients — zero weight, no recompile), and the quarantine
+    state is stepped from signals the round already computes:
+
+    - per-client non-finite counts over the packet stack + train losses;
+    - per-client update norm ``||packet - previous_global||`` when the
+      packet pytree is param-shaped (checked statically at trace time —
+      exotic packet layouts simply disable the norm-driven checks).
+
+    ``n_clients`` is normally learned from ``bind_client_manager`` (the
+    simulation calls it before ``init``); pass it explicitly for direct
+    use. ``quarantine_mask(server_state)`` exposes the live mask — the
+    simulation snapshots it per round for ``fl_quarantine_*`` gauges and
+    ``quarantine`` JSONL events on both execution modes.
+    """
+
+    def __init__(
+        self,
+        inner: Strategy,
+        policy: QuarantinePolicy | None = None,
+        n_clients: int | None = None,
+    ):
+        self.inner = inner
+        self.policy = policy or QuarantinePolicy()
+        self._n_clients = n_clients
+        self.weighted_aggregation = inner.weighted_aggregation
+        self.weighted_eval_aggregation = inner.weighted_eval_aggregation
+        # chunk-eligibility passthrough (server/simulation.py consults this
+        # before the type-level check): only a host-consuming INNER
+        # update_after_eval should force the pipelined path
+        self.overrides_update_after_eval = (
+            type(inner).update_after_eval is not Strategy.update_after_eval
+        )
+
+    @property
+    def evaluate_after_fit(self) -> bool:
+        return bool(getattr(self.inner, "evaluate_after_fit", False))
+
+    def bind_client_manager(self, client_manager: Any) -> None:
+        self._n_clients = client_manager.n_clients
+        bind = getattr(self.inner, "bind_client_manager", None)
+        if bind is not None:
+            bind(client_manager)
+
+    def init(self, params) -> QuarantineServerState:
+        if self._n_clients is None:
+            raise ValueError(
+                "QuarantiningStrategy needs n_clients: pass it to the "
+                "constructor or let FederatedSimulation bind its client "
+                "manager first"
+            )
+        return QuarantineServerState(
+            inner=self.inner.init(params),
+            quarantine=init_quarantine(self._n_clients),
+        )
+
+    def global_params(self, server_state: QuarantineServerState):
+        return self.inner.global_params(server_state.inner)
+
+    def divergence_reference(self, server_state: QuarantineServerState):
+        return self.inner.divergence_reference(server_state.inner)
+
+    def client_payload(self, server_state: QuarantineServerState, round_idx):
+        return self.inner.client_payload(server_state.inner, round_idx)
+
+    def quarantine_mask(self, server_state: QuarantineServerState) -> jax.Array:
+        """[clients] 1.0 = currently quarantined (jit-traceable accessor)."""
+        return server_state.quarantine.quarantined
+
+    def _signals(self, results: FitResults, prev_global):
+        """(nonfinite [C], update_norm [C]) from the round's own outputs."""
+        try:
+            nonfinite = telem.per_client_nonfinite(results.packets)
+        except ValueError:  # no float leaves in the packet stack
+            nonfinite = jnp.zeros_like(jnp.asarray(results.mask, jnp.float32))
+        nonfinite = nonfinite + telem.nonfinite_in_losses(results.train_losses)
+        # static structure check: packets that aren't param-shaped give no
+        # norm signal (NaN disables the norm-driven policy checks)
+        if (jax.tree_util.tree_structure(results.packets)
+                == jax.tree_util.tree_structure(prev_global)):
+            n2 = None
+            for leaf, ref in zip(
+                jax.tree_util.tree_leaves(results.packets),
+                jax.tree_util.tree_leaves(prev_global),
+            ):
+                d = leaf.astype(jnp.float32) - ref.astype(jnp.float32)[None]
+                d = jnp.where(jnp.isfinite(d), d, 0.0)
+                s = jnp.sum(jnp.square(d).reshape(d.shape[0], -1), axis=1)
+                n2 = s if n2 is None else n2 + s
+            update_norm = jnp.sqrt(n2)
+        else:
+            update_norm = jnp.full_like(nonfinite, jnp.nan)
+        return nonfinite, update_norm
+
+    def aggregate(
+        self, server_state: QuarantineServerState, results: FitResults,
+        round_idx,
+    ) -> QuarantineServerState:
+        prev_global = self.inner.global_params(server_state.inner)
+        nonfinite, update_norm = self._signals(results, prev_global)
+        healthy_mask = results.mask * (
+            1.0 - server_state.quarantine.quarantined
+        )
+        if self.policy.on_nonfinite:
+            # instant screen: a NaN/Inf packet is masked out of THIS round's
+            # aggregate, not just future ones — detection after the poison
+            # lands would be one round too late (the strike/quarantine state
+            # then keeps the offender out while it keeps misbehaving)
+            healthy_mask = healthy_mask * (
+                1.0 - (nonfinite > 0).astype(healthy_mask.dtype)
+            )
+        new_inner = self.inner.aggregate(
+            server_state.inner, results.replace(mask=healthy_mask), round_idx
+        )
+        new_q = quarantine_step(
+            server_state.quarantine,
+            self.policy,
+            mask=results.mask,
+            nonfinite=nonfinite,
+            update_norm=update_norm,
+        )
+        return QuarantineServerState(inner=new_inner, quarantine=new_q)
+
+    def update_after_eval(
+        self, server_state: QuarantineServerState, eval_losses, eval_metrics,
+        mask,
+    ) -> QuarantineServerState:
+        new_inner = self.inner.update_after_eval(
+            server_state.inner, eval_losses, eval_metrics, mask
+        )
+        return server_state.replace(inner=new_inner)

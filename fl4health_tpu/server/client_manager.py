@@ -53,10 +53,15 @@ class FixedFractionManager(ClientManager):
 
     def __init__(self, n_clients: int, fraction: float, min_clients: int = 1):
         super().__init__(n_clients)
+        if min_clients > n_clients:
+            raise ValueError(
+                f"min_clients={min_clients} exceeds n_clients={n_clients}"
+            )
         # the CONFIGURED q (what a DP accountant composes with); the realized
-        # count k may round/floor away from q*n
+        # count k may round/floor away from q*n (and never exceeds n)
         self.fraction = fraction
-        self.k = max(min_clients, int(fraction * n_clients))
+        self.min_clients = min_clients
+        self.k = min(n_clients, max(min_clients, int(fraction * n_clients)))
 
     def sample(self, rng, round_idx):
         rng = jax.random.fold_in(rng, round_idx)
@@ -67,17 +72,35 @@ class FixedFractionManager(ClientManager):
 
 class PoissonSamplingManager(ClientManager):
     """Independent Bernoulli(fraction) per client — matches the DP accounting
-    assumptions; cohort can legitimately be empty."""
+    assumptions; cohort can legitimately be empty.
 
-    def __init__(self, n_clients: int, fraction: float):
+    ``min_clients`` (default 0 — the legacy, accounting-faithful behavior)
+    optionally tops the cohort up to a floor: the clients with the smallest
+    uniform draws are forced in, so the top-up is deterministic under the
+    same rng and every Bernoulli success is always kept. A non-zero floor
+    breaks the pure-Poisson assumption DP accountants compose with —
+    useful for robustness experiments, not for accounting."""
+
+    def __init__(self, n_clients: int, fraction: float, min_clients: int = 0):
         super().__init__(n_clients)
+        if not 0 <= min_clients <= n_clients:
+            raise ValueError(
+                f"min_clients must be in [0, {n_clients}]; got {min_clients}"
+            )
         self.fraction = fraction
+        self.min_clients = min_clients
 
     def sample(self, rng, round_idx):
         rng = jax.random.fold_in(rng, round_idx)
-        return (
-            jax.random.uniform(rng, (self.n_clients,)) < self.fraction
-        ).astype(jnp.float32)
+        u = jax.random.uniform(rng, (self.n_clients,))
+        mask = u < self.fraction
+        if self.min_clients > 0:
+            # force the min_clients smallest draws in: a superset of the
+            # Bernoulli successes (u < fraction implies smallest-ranked),
+            # one sort, static shapes
+            threshold = jnp.sort(u)[self.min_clients - 1]
+            mask = mask | (u <= threshold)
+        return mask.astype(jnp.float32)
 
 
 class FixedSamplingManager(ClientManager):

@@ -218,6 +218,7 @@ class FederatedSimulation:
         observability: Observability | None = None,
         execution_mode: str = "auto",
         pipeline_depth: int = 2,
+        fault_plan: Any = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -295,6 +296,16 @@ class FederatedSimulation:
         # How many rounds of host epilogue work may be in flight behind the
         # device on the pipelined path (bounded RoundConsumer queue).
         self.pipeline_depth = pipeline_depth
+        # Deterministic chaos layer (resilience/faults.py FaultPlan): client
+        # dropout multiplies the participation mask and update corruption
+        # transforms the packet stack INSIDE the round programs, so the same
+        # plan injects the same faults on both execution modes and a faulted
+        # run never recompiles. None (or an empty plan) leaves the round
+        # closures untouched — trajectories stay bit-identical.
+        self._fault_plan = fault_plan
+        # host mirror of the in-graph quarantine mask (strategy-driven), for
+        # entered/released transition accounting in the per-round metrics
+        self._last_quarantine: list[int] | None = None
         self._active_execution_mode = EXEC_PIPELINED
         self._consumer: RoundConsumer | None = None
         self._prefetcher: RoundPrefetcher | None = None
@@ -543,9 +554,28 @@ class FederatedSimulation:
                 return new_state, packet, losses, metrics, client_telem
             return new_state, packet, losses, metrics
 
+        # Chaos layer (resilience/faults.py): compiled into the round
+        # program so the same seeded plan injects identical faults on both
+        # execution modes. With no plan (or an empty one) neither branch
+        # traces — the closure is exactly the pre-resilience program.
+        fault_plan = self._fault_plan
+        inject_dropout = (fault_plan is not None
+                          and bool(getattr(fault_plan, "dropout_faults", ())))
+        inject_corruption = (
+            fault_plan is not None
+            and bool(getattr(fault_plan, "corruption_faults", ()))
+        )
+        n_clients = self.n_clients
+
         def fit_round(server_state, client_states, batches, mask, round_idx,
                       val_batches):
             payload = strategy.client_payload(server_state, round_idx)
+            if inject_dropout:
+                # a dropped client is exactly an unsampled one: mask math,
+                # never a shape change
+                mask = mask * fault_plan.participation_factor(
+                    round_idx, n_clients
+                )
             vmapped = jax.vmap(client_fit, in_axes=(0, None, 0, 0, 0))(
                 client_states, payload, batches, mask, val_batches
             )
@@ -553,6 +583,15 @@ class FederatedSimulation:
                 new_states, packets, losses, metrics, client_telem = vmapped
             else:
                 new_states, packets, losses, metrics = vmapped
+            if inject_corruption:
+                # corrupt the WIRE update (what aggregation consumes), not
+                # the client's local state — byzantine clients train
+                # honestly and lie upstream, the standard attack model
+                payload_params = (payload.params if hasattr(payload, "params")
+                                  else payload)
+                packets = fault_plan.corrupt_packets(
+                    packets, payload_params, round_idx, n_clients
+                )
             # Failed clients (non-finite loss) are excluded from aggregation,
             # matching the reference where failures never enter results
             # (strategies/basic_fedavg.py:254-256 skips on failures; here the
@@ -784,6 +823,8 @@ class FederatedSimulation:
         telemetry_on = self._telemetry_enabled
         fit_round = self._fit_round_fn_t if telemetry_on else self._fit_round_fn
         eval_round = self._eval_round_fn_t if telemetry_on else self._eval_round_fn
+        quarantine_fn = (getattr(self.strategy, "quarantine_mask", None)
+                         if self.observability.enabled else None)
 
         def chunk(server_state, client_states, x_stack, y_stack, idx, em, sm,
                   masks, start_round, val_batches, val_counts,
@@ -825,6 +866,10 @@ class FederatedSimulation:
                 }
                 if round_telemetry is not None:
                     out["telemetry"] = round_telemetry
+                if quarantine_fn is not None:
+                    # per-round in-graph quarantine mask stacks with the
+                    # scan outputs — same fused pull, per-round visibility
+                    out["quarantine"] = quarantine_fn(server_state)
                 if test_batches is not None:
                     t_outs = eval_round(
                         server_state, client_states, test_batches, test_counts
@@ -906,7 +951,14 @@ class FederatedSimulation:
         if self.observability.enabled and self.observability.per_round_spans:
             return ("per-round span fencing requested "
                     "(Observability(per_round_spans=True))")
-        if type(self.strategy).update_after_eval is not Strategy.update_after_eval:
+        # wrapper strategies (e.g. resilience.QuarantiningStrategy) override
+        # update_after_eval only to delegate — they declare whether the
+        # WRAPPED strategy actually consumes per-round eval on the host
+        overrides = getattr(self.strategy, "overrides_update_after_eval", None)
+        if overrides is None:
+            overrides = (type(self.strategy).update_after_eval
+                         is not Strategy.update_after_eval)
+        if overrides:
             return ("strategy overrides update_after_eval (host-side "
                     "per-round eval consumption)")
         return None
@@ -942,6 +994,7 @@ class FederatedSimulation:
         mode, mode_reason = self._select_execution_mode(n_rounds)
         self._active_execution_mode = mode
         self._round_program_flops = None  # re-measured per fit() (mode-shaped)
+        self._last_quarantine = None  # transition accounting is per-run
         logging.getLogger(__name__).info(
             "fit: execution_mode=%s (%s)", mode, mode_reason
         )
@@ -1177,6 +1230,18 @@ class FederatedSimulation:
                 mask = self.client_manager.sample(
                     jax.random.fold_in(self.rng, 2000 + rnd), rnd
                 )
+                if obs.watchdog is not None:
+                    # host-side mitigation (HealthPolicy action="mitigate"):
+                    # clients the watchdog quarantined are sampled out of
+                    # later rounds. None while nothing is quarantined, so
+                    # the un-mitigated mask values stay untouched. With the
+                    # pipeline running depth rounds ahead, a new quarantine
+                    # takes effect once the producer catches up (pipelined
+                    # path only — in-graph quarantine covers the chunked
+                    # scan, resilience/quarantine.py).
+                    keep = obs.watchdog.quarantine_keep_mask(self.n_clients)
+                    if keep is not None:
+                        mask = mask * jnp.asarray(keep, jnp.float32)
                 batches = (prefetcher.take(rnd) if prefetcher is not None
                            else self._round_batches(rnd))
             if prefetcher is not None and rnd < self._fit_n_rounds:
@@ -1326,6 +1391,15 @@ class FederatedSimulation:
                 # the RoundTelemetry pytree rides the SAME fused transfer —
                 # in-graph observability adds zero extra host syncs
                 device_results["telemetry"] = telemetry
+            q_fn = getattr(self.strategy, "quarantine_mask", None)
+            if q_fn is not None and obs.enabled:
+                # in-graph quarantine visibility: device-side copy (the
+                # server-state buffer will be donated into the next round)
+                # riding the consumer's fused transfer; quarantine itself
+                # lives in the strategy and needs no observability
+                device_results["_quarantine"] = jnp.copy(
+                    q_fn(self.server_state)
+                )
             if test_losses is not None:
                 device_results["test_losses"] = test_losses
                 device_results["test_metrics"] = test_metrics
@@ -1382,6 +1456,7 @@ class FederatedSimulation:
         pre_agg_params = host.pop("_pre_agg_params", None)
         post_agg_params = host.pop("_post_agg_params", None)
         state_trees = host.pop("_state_trees", None)
+        quarantine_mask = host.pop("_quarantine", None)
         telemetry_obj = host.pop("telemetry", None)
         telemetry_host = (
             {k: np.asarray(v) for k, v in telemetry_obj.as_dict().items()}
@@ -1455,6 +1530,8 @@ class FederatedSimulation:
                 compile_s_after=work.compile_s_after,
                 telemetry=telemetry_host,
             )
+        if quarantine_mask is not None:
+            self._emit_quarantine_metrics(rnd, np.asarray(quarantine_mask))
         with obs.span("report", round=rnd):
             for rep in self.reporters:
                 payload = {
@@ -1545,6 +1622,7 @@ class FederatedSimulation:
         per_round_s = (time.time() - t_start) / max(n_rounds, 1)
         device_wait_round = device_wait_total / max(n_rounds, 1)
         telemetry_stack = stacked.get("telemetry")
+        quarantine_stack = stacked.get("quarantine")
         for i in range(n_rounds):
             rnd = i + 1
             per_fit_i = {
@@ -1603,6 +1681,10 @@ class FederatedSimulation:
                                      else compile_s_before),
                     telemetry=telemetry_i,
                 )
+            if quarantine_stack is not None:
+                self._emit_quarantine_metrics(
+                    rnd, np.asarray(quarantine_stack[i])
+                )
             for rep in self.reporters:
                 payload = {
                     "fit_losses": rec.fit_losses,
@@ -1623,6 +1705,41 @@ class FederatedSimulation:
                     obs=obs, reporters=self.reporters,
                 )
 
+
+    def _emit_quarantine_metrics(self, rnd: int, q_np: np.ndarray) -> None:
+        """``fl_quarantine_*`` gauges/counters + one ``quarantine`` JSONL
+        event from a host copy of the in-graph quarantine mask. Shared by
+        the pipelined consumer and the chunked epilogue, so quarantine
+        visibility is uniform across execution modes. Transition accounting
+        (entered/released) diffs against the previous round's mask."""
+        obs = self.observability
+        if not obs.enabled:
+            return
+        reg = obs.registry
+        active = [int(c) for c in np.nonzero(np.asarray(q_np) > 0)[0]]
+        prev = self._last_quarantine or []
+        entered = sorted(set(active) - set(prev))
+        released = sorted(set(prev) - set(active))
+        self._last_quarantine = active
+        reg.gauge(
+            "fl_quarantine_active_clients",
+            help="clients currently masked out of aggregation by quarantine",
+        ).set(float(len(active)))
+        if entered:
+            reg.counter(
+                "fl_quarantine_entries_total",
+                help="clients entering quarantine",
+            ).inc(len(entered))
+        if released:
+            reg.counter(
+                "fl_quarantine_releases_total",
+                help="clients released from quarantine (probation served)",
+            ).inc(len(released))
+        if active or entered or released:
+            reg.log_event(
+                "quarantine", round=rnd, source="strategy",
+                active=active, entered=entered, released=released,
+            )
 
     def _payload_nbytes(self) -> tuple[int, int]:
         """(broadcast, gather) logical payload bytes per participating client
@@ -1794,6 +1911,27 @@ class FederatedSimulation:
                     help="measured model FLOPs utilization vs the chip's "
                          "bf16 peak",
                 ).set(mfu)
+        if self._fault_plan is not None:
+            # host mirror of the round's seeded in-graph fault draws — the
+            # log reports exactly what the compiled program injected
+            try:
+                fault = self._fault_plan.summarize_round(rnd, self.n_clients)
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "fault-plan summary failed for round %d", rnd,
+                    exc_info=True,
+                )
+                fault = None
+            if fault:
+                reg.counter(
+                    "fl_resilience_faults_injected_total",
+                    help="client faults injected by the active FaultPlan "
+                         "(dropouts + corruptions)",
+                ).inc(len(fault["dropped"]) + len(fault["corrupted"]))
+                reg.log_event("fault", **fault)
+                summary["faults_injected"] = (
+                    len(fault["dropped"]) + len(fault["corrupted"])
+                )
         reg.log_event("round", **summary)
         self.observability.tracer.counter(
             "fl_round_time_s", fit=rec.fit_elapsed_s, eval=rec.eval_elapsed_s

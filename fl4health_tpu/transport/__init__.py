@@ -12,12 +12,20 @@ from fl4health_tpu.transport.codec import (
     encode,
     encode_sparse,
 )
-from fl4health_tpu.transport.coordinator import broadcast_round, weighted_merge
+from fl4health_tpu.transport.coordinator import (
+    BroadcastReport,
+    QuorumError,
+    SiloResult,
+    broadcast_round,
+    broadcast_round_detailed,
+    weighted_merge,
+)
 from fl4health_tpu.transport.loopback import LoopbackServer, call
 from fl4health_tpu.transport.native import FrameError, get_framing
 
 __all__ = [
     "encode", "decode", "encode_sparse", "decode_sparse",
     "LoopbackServer", "call", "FrameError", "get_framing",
-    "broadcast_round", "weighted_merge",
+    "broadcast_round", "broadcast_round_detailed", "weighted_merge",
+    "BroadcastReport", "QuorumError", "SiloResult",
 ]

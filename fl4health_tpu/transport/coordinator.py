@@ -7,11 +7,34 @@ research/fedprox_cluster) — the role Flower's server-side
 (/root/reference/fl4health/strategies/basic_fedavg.py ``aggregate_fit``
 over gRPC results). One implementation so the wire pattern (single
 serialization per round, n-weighted FedAvg over reply trees) has one home.
+
+Resilience rework (resilience subsystem PR): the round fan-out is
+CONCURRENT — every silo is dialed in parallel, so round wall time tracks
+the slowest *surviving* silo instead of the sum of the chain — with
+per-silo retry/backoff, circuit breakers and quorum semantics layered from
+``fl4health_tpu.resilience.retry``:
+
+- ``retry=RetryPolicy(...)`` re-dials a failed silo with jittered
+  exponential backoff (each attempt bounded by the policy's per-attempt
+  timeout);
+- ``breakers=`` (a ``dict[str, CircuitBreaker]``, keyed ``"host:port"``)
+  skips a silo whose circuit is open without paying its connect timeout;
+- ``quorum=`` proceeds once enough silos replied — the missing silos'
+  weights simply never enter ``weighted_merge``'s normalization, which is
+  the renormalize-and-continue semantics of partial participation.
+
+Failures land in ``transport_rpc_failures_total`` with a ``reason`` label
+(``timeout`` / ``connection`` / ``decode`` / ``circuit_open`` / ``other``)
+per attempt, and retries in ``transport_rpc_retries_total`` — dead-silo
+triage reads off the metrics page, not the logs.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import math
 import time
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Any, Mapping, Sequence
 
 import jax
@@ -19,6 +42,12 @@ import numpy as np
 
 from fl4health_tpu.observability.registry import get_registry
 from fl4health_tpu.observability.spans import get_tracer
+from fl4health_tpu.resilience.retry import (
+    CircuitBreaker,
+    RetryPolicy,
+    call_with_retry,
+    classify_failure,
+)
 from fl4health_tpu.transport.codec import decode, encode
 from fl4health_tpu.transport.loopback import call
 
@@ -27,52 +56,238 @@ _RPC_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                 10.0, 30.0, 60.0)
 
 
+class QuorumError(RuntimeError):
+    """Raised when fewer silos replied than the round's quorum requires.
+
+    Attributes: ``required``, ``succeeded``, ``failures`` (list of
+    ``(silo, reason)``)."""
+
+    def __init__(self, message: str, *, required: int, succeeded: int,
+                 failures: Sequence[tuple[str, str]]):
+        super().__init__(message)
+        self.required = required
+        self.succeeded = succeeded
+        self.failures = list(failures)
+
+
+@dataclasses.dataclass
+class SiloResult:
+    """Outcome of one silo's round trip (success XOR error)."""
+
+    silo: str
+    index: int
+    reply: dict[str, Any] | None = None
+    error: Exception | None = None
+    reason: str | None = None
+    attempts: int = 0
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.reply is not None
+
+
+@dataclasses.dataclass
+class BroadcastReport:
+    """Per-silo results of one concurrent broadcast, in silo order."""
+
+    results: list[SiloResult]
+
+    @property
+    def replies(self) -> list[dict[str, Any]]:
+        return [r.reply for r in self.results if r.ok]
+
+    @property
+    def failures(self) -> list[SiloResult]:
+        return [r for r in self.results if not r.ok]
+
+
+def _required_replies(quorum: int | float | None, n_silos: int) -> int:
+    """Quorum spec -> required success count. ``None`` = every silo; a
+    float in (0, 1] is a fraction (ceil); an int is an absolute count."""
+    if quorum is None:
+        return n_silos
+    if isinstance(quorum, float):
+        if not 0.0 < quorum <= 1.0:
+            raise ValueError(f"fractional quorum must be in (0, 1]; got {quorum}")
+        return max(1, math.ceil(quorum * n_silos))
+    q = int(quorum)
+    if not 1 <= q <= n_silos:
+        raise ValueError(
+            f"quorum must be in [1, {n_silos}] for {n_silos} silos; got {q}"
+        )
+    return q
+
+
+def _silo_round_trip(
+    index: int,
+    host: str,
+    port: int,
+    frame: bytes,
+    reply_template: Mapping[str, Any],
+    timeout: float | None,
+    retry: RetryPolicy | None,
+    breaker: CircuitBreaker | None,
+) -> SiloResult:
+    """One silo's full round trip (runs on a fan-out worker thread)."""
+    reg, tracer = get_registry(), get_tracer()
+    silo = f"{host}:{port}"
+    hist = reg.histogram(
+        "transport_rpc_latency_seconds",
+        help="per-silo round-trip latency (request + decode)",
+        labels={"silo": silo},
+        buckets=_RPC_BUCKETS,
+    )
+    attempt_timeout = timeout
+    if attempt_timeout is None and retry is not None:
+        attempt_timeout = retry.timeout_s
+    kwargs = {} if attempt_timeout is None else {"timeout": attempt_timeout}
+    result = SiloResult(silo=silo, index=index)
+
+    def do_call():
+        result.attempts += 1
+        raw = call(host, port, frame, **kwargs)
+        return decode(raw, like=reply_template), len(raw)
+
+    def on_failure(exc: BaseException, attempt: int, will_retry: bool):
+        reg.counter(
+            "transport_rpc_failures_total",
+            help="silo round trips that raised, by failure reason",
+            labels={"silo": silo, "reason": classify_failure(exc)},
+        ).inc()
+        if will_retry:
+            reg.counter(
+                "transport_rpc_retries_total",
+                help="re-dials of a failed silo round trip",
+                labels={"silo": silo},
+            ).inc()
+
+    t0 = time.perf_counter()
+    with tracer.span("rpc", cat="transport", silo=silo,
+                     request_bytes=len(frame)) as sp:
+        try:
+            reply, raw_len = call_with_retry(
+                do_call, policy=retry, breaker=breaker, on_failure=on_failure
+            )
+        except Exception as e:  # noqa: BLE001 — reported per silo, quorum decides
+            result.error = e
+            result.reason = classify_failure(e)
+            result.elapsed_s = time.perf_counter() - t0
+            sp.set(failed=True, reason=result.reason)
+            return result
+        result.elapsed_s = time.perf_counter() - t0
+        # successes only: a timed-out silo's 60s ceiling in the latency
+        # histogram would swamp the percentiles of working round trips
+        # (dead-silo visibility lives in the failure counter above)
+        hist.observe(result.elapsed_s)
+        sp.set(reply_bytes=raw_len)
+    result.reply = reply
+    return result
+
+
+def broadcast_round_detailed(
+    silos: Sequence[tuple[str, int]],
+    global_params: Any,
+    reply_template: Mapping[str, Any],
+    timeout: float | None = None,
+    *,
+    retry: RetryPolicy | None = None,
+    breakers: Mapping[str, CircuitBreaker] | None = None,
+    max_workers: int | None = None,
+    fail_fast: bool = False,
+) -> BroadcastReport:
+    """Concurrent fan-out: encode ONCE (the frame is identical for every
+    silo), dial every silo in parallel, decode each reply against
+    ``reply_template``. Never raises for a silo failure — the report
+    carries per-silo success/error/reason and the caller applies its
+    quorum policy (``broadcast_round`` does).
+
+    ``fail_fast`` (the no-quorum legacy profile): return as soon as the
+    first failure is KNOWN instead of waiting out the slowest silo —
+    not-yet-dialed silos are cancelled (their results are absent from the
+    report); in-flight round trips finish on their worker threads but the
+    caller stops waiting. Without a quorum the round is doomed the moment
+    one silo fails, so there is nothing to wait for."""
+    frame = encode(global_params)
+    if not silos:
+        return BroadcastReport(results=[])
+    workers = max_workers or min(len(silos), 32)
+
+    def task(i: int, host: str, port: int) -> SiloResult:
+        breaker = (breakers or {}).get(f"{host}:{port}")
+        return _silo_round_trip(
+            i, host, port, frame, reply_template, timeout, retry, breaker
+        )
+
+    pool = ThreadPoolExecutor(max_workers=workers)
+    try:
+        futures = [pool.submit(task, i, host, port)
+                   for i, (host, port) in enumerate(silos)]
+        results: list[SiloResult] = []
+        for fut in as_completed(futures):
+            res = fut.result()
+            results.append(res)
+            if fail_fast and not res.ok:
+                for f in futures:
+                    f.cancel()
+                break
+        results.sort(key=lambda r: r.index)
+        return BroadcastReport(results=results)
+    finally:
+        pool.shutdown(wait=not fail_fast, cancel_futures=fail_fast)
+
+
 def broadcast_round(
     silos: Sequence[tuple[str, int]],
     global_params: Any,
     reply_template: Mapping[str, Any],
     timeout: float | None = None,
+    *,
+    retry: RetryPolicy | None = None,
+    quorum: int | float | None = None,
+    breakers: Mapping[str, CircuitBreaker] | None = None,
+    max_workers: int | None = None,
 ) -> list[dict[str, Any]]:
-    """Send the global params to every silo (ONE serialization — the frame
-    is identical) and decode each reply against ``reply_template``.
+    """Send the global params to every silo concurrently and decode each
+    reply against ``reply_template``; returns the successful replies in
+    silo order.
 
-    Observability: each silo's request/decode round trip lands in a
-    per-silo ``transport_rpc_latency_seconds`` histogram and a ``rpc`` span
-    (no-ops while the process tracer is disabled); failures bump
-    ``transport_rpc_failures_total`` before re-raising so partial rounds
-    stay visible in the metrics even when the exception unwinds the round.
+    Quorum semantics: with ``quorum=None`` every silo must reply and the
+    first failure (in silo order) re-raises — the legacy contract. With a
+    quorum (absolute count, or fraction of the cohort) the round proceeds
+    once enough silos replied; the survivors' replies feed
+    ``weighted_merge``, whose normalization IS the weight renormalization
+    over the surviving cohort. Too few survivors raise :class:`QuorumError`
+    naming every failed silo and its reason.
+
+    Observability: each silo's round trip lands in a per-silo
+    ``transport_rpc_latency_seconds`` histogram and an ``rpc`` span; every
+    failed attempt bumps ``transport_rpc_failures_total`` with a
+    ``reason`` label and retries bump ``transport_rpc_retries_total`` —
+    partial rounds stay visible in the metrics even when an exception
+    unwinds the round.
     """
-    reg, tracer = get_registry(), get_tracer()
-    frame = encode(global_params)
-    kwargs = {} if timeout is None else {"timeout": timeout}
-    replies = []
-    for host, port in silos:
-        silo = f"{host}:{port}"
-        hist = reg.histogram(
-            "transport_rpc_latency_seconds",
-            help="per-silo round-trip latency (request + decode)",
-            labels={"silo": silo},
-            buckets=_RPC_BUCKETS,
+    required = _required_replies(quorum, len(silos))
+    report = broadcast_round_detailed(
+        silos, global_params, reply_template, timeout,
+        retry=retry, breakers=breakers, max_workers=max_workers,
+        # no quorum = the round cannot survive any failure, so stop waiting
+        # the moment one is known (legacy fail-fast profile)
+        fail_fast=quorum is None,
+    )
+    failures = report.failures
+    if quorum is None and failures:
+        raise failures[0].error
+    replies = report.replies
+    if len(replies) < required:
+        raise QuorumError(
+            f"broadcast_round: {len(replies)}/{len(silos)} silos replied "
+            f"but quorum requires {required} "
+            f"(failed: {[(f.silo, f.reason) for f in failures]})",
+            required=required,
+            succeeded=len(replies),
+            failures=[(f.silo, f.reason or "unknown") for f in failures],
         )
-        t0 = time.perf_counter()
-        with tracer.span("rpc", cat="transport", silo=silo,
-                         request_bytes=len(frame)) as sp:
-            try:
-                raw = call(host, port, frame, **kwargs)
-                reply = decode(raw, like=reply_template)
-            except Exception:
-                reg.counter(
-                    "transport_rpc_failures_total",
-                    help="silo round trips that raised",
-                    labels={"silo": silo},
-                ).inc()
-                raise
-            # successes only: a timed-out silo's 60s ceiling in the latency
-            # histogram would swamp the percentiles of working round trips
-            # (dead-silo visibility lives in the failure counter above)
-            hist.observe(time.perf_counter() - t0)
-            sp.set(reply_bytes=len(raw))
-        replies.append(reply)
     return replies
 
 
@@ -81,7 +296,11 @@ def weighted_merge(
     params_key: str = "params",
     weight_key: str = "n",
 ) -> tuple[Any, np.ndarray]:
-    """n-weighted FedAvg over reply param trees -> (merged, weights)."""
+    """n-weighted FedAvg over reply param trees -> (merged, weights).
+
+    Normalizing by the sum of the PRESENT replies' weights is exactly the
+    quorum path's renormalization: silos that missed the round contribute
+    neither numerator nor denominator."""
     weights = np.asarray([float(r[weight_key]) for r in replies])
     total = weights.sum()
     if total <= 0:

@@ -46,6 +46,12 @@ def pytest_configure(config):
         "1-core box; run with FL4HEALTH_RUN_SLOW=1 (the CI/driver lane) "
         "or -m slow.",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection lanes (resilience "
+        "subsystem). The smoke subset is tier-1-safe and runs by default; "
+        "heavier scenarios also carry 'slow'. Select with -m chaos.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -129,8 +129,10 @@ class TestCoordinatorAccounting:
                 {"params": {"w": jnp.zeros(2)}, "n": jnp.zeros(())},
             )
         snap = registry.snapshot()
+        # reason-labeled (dead-silo triage without log spelunking): nothing
+        # listening on port 1 classifies as a connection failure
         assert snap["transport_rpc_failures_total"] == {
-            '{silo="127.0.0.1:1"}': 1.0
+            '{reason="connection",silo="127.0.0.1:1"}': 1.0
         }
         # failures are NOT folded into the latency histogram: a timeout
         # ceiling observed as "latency" would swamp real percentiles

@@ -1,0 +1,56 @@
+"""Shared tiny-FL fixtures for the resilience suite.
+
+One small Dense model + fixed per-client synthetic shards, so every test
+in this directory traces the same program shapes (the persistent compile
+cache then makes the whole suite cheap after the first run)."""
+
+import flax.linen as nn
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+
+N_CLIENTS = 8
+
+
+class TinyNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Dense(8)(x)
+        x = nn.relu(x)
+        return nn.Dense(2)(x)
+
+
+def _dataset(i: int) -> ClientDataset:
+    r = np.random.default_rng(100 + i)
+    x = r.normal(size=(32, 4)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    return ClientDataset(x_train=x, y_train=y, x_val=x[:8], y_val=y[:8])
+
+
+def make_sim(strategy, fault_plan=None, execution_mode="auto", seed=7,
+             **kwargs) -> FederatedSimulation:
+    args = dict(
+        logic=engine.ClientLogic(
+            engine.from_flax(TinyNet()), engine.masked_cross_entropy
+        ),
+        tx=optax.sgd(0.1),
+        strategy=strategy,
+        datasets=[_dataset(i) for i in range(N_CLIENTS)],
+        batch_size=8,
+        metrics=MetricManager(()),
+        local_steps=2,
+        seed=seed,
+        execution_mode=execution_mode,
+        fault_plan=fault_plan,
+    )
+    args.update(kwargs)
+    return FederatedSimulation(**args)
+
+
+@pytest.fixture
+def tiny_sim_factory():
+    return make_sim

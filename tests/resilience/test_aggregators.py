@@ -1,0 +1,222 @@
+"""Robust aggregation combinators: numpy-reference parity, poison
+tolerance, and drop-in Strategy behavior inside compiled rounds on both
+execution modes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.resilience import (
+    RobustFedAvg,
+    coordinate_median,
+    krum_weights,
+    norm_bounded_mean,
+    trimmed_mean,
+)
+from fl4health_tpu.strategies.base import FitResults
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+from tests.resilience.conftest import make_sim
+
+C = 8
+
+
+def _stack(seed=0, shape=(C, 3, 2)):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestCoordinateMedian:
+    def test_matches_numpy_on_participants(self):
+        vals = _stack()
+        mask = jnp.asarray([1, 1, 1, 1, 1, 1, 0, 0], jnp.float32)
+        out = coordinate_median({"w": jnp.asarray(vals)}, mask)
+        np.testing.assert_allclose(
+            np.asarray(out["w"]), np.median(vals[:6], axis=0), rtol=1e-6
+        )
+
+    def test_even_and_odd_cohorts(self):
+        vals = _stack(1)
+        for k in (3, 4, 5, 8):
+            mask = jnp.asarray([1.0] * k + [0.0] * (C - k))
+            out = coordinate_median({"w": jnp.asarray(vals)}, mask)
+            np.testing.assert_allclose(
+                np.asarray(out["w"]), np.median(vals[:k], axis=0), rtol=1e-6
+            )
+
+    def test_nan_poisoned_minority_cannot_move_it_past_breakdown(self):
+        vals = _stack(2)
+        poisoned = vals.copy()
+        poisoned[0] = np.nan
+        poisoned[1] = np.inf
+        out = coordinate_median(
+            {"w": jnp.asarray(poisoned)}, jnp.ones((C,), jnp.float32)
+        )
+        # 2 of 8 poisoned: the median stays finite (poison sorts to the top)
+        assert np.isfinite(np.asarray(out["w"])).all()
+
+    def test_runs_under_jit(self):
+        vals = jnp.asarray(_stack(3))
+        f = jax.jit(lambda s, m: coordinate_median(s, m))
+        out = f({"w": vals}, jnp.ones((C,), jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(out["w"]), np.median(np.asarray(vals), axis=0),
+            rtol=1e-6,
+        )
+
+
+class TestTrimmedMean:
+    def test_matches_numpy_trim(self):
+        vals = _stack(4)
+        mask = jnp.asarray([1, 1, 1, 1, 1, 1, 0, 0], jnp.float32)
+        out = trimmed_mean({"w": jnp.asarray(vals)}, mask, 0.2)
+        s = np.sort(vals[:6], axis=0)  # k=6, t=floor(1.2)=1 -> ranks 1..4
+        np.testing.assert_allclose(
+            np.asarray(out["w"]), s[1:5].mean(axis=0), rtol=1e-5
+        )
+
+    def test_trims_scaled_attacker(self):
+        vals = _stack(5)
+        attacked = vals.copy()
+        attacked[3] = 1e6
+        out = trimmed_mean(
+            {"w": jnp.asarray(attacked)}, jnp.ones((C,), jnp.float32), 0.2
+        )
+        clean = trimmed_mean(
+            {"w": jnp.asarray(vals)}, jnp.ones((C,), jnp.float32), 0.2
+        )
+        # the attacker occupies a trimmed rank: result is bounded by the
+        # honest value range, nowhere near 1e6
+        assert np.abs(np.asarray(out["w"])).max() < 10.0
+        assert np.isfinite(np.asarray(out["w"])).all()
+        del clean
+
+    def test_invalid_fraction_raises(self):
+        with pytest.raises(ValueError, match="trim_fraction"):
+            trimmed_mean({"w": jnp.zeros((C, 2))}, jnp.ones(C), 0.5)
+
+
+class TestNormBoundedMean:
+    def test_clips_large_update(self):
+        ref = {"w": jnp.zeros((4,))}
+        stacked = {"w": jnp.concatenate([
+            jnp.ones((C - 1, 4)) * 0.1,
+            jnp.ones((1, 4)) * 1e4,  # scaled attacker
+        ])}
+        out = norm_bounded_mean(
+            stacked, ref, jnp.ones(C), jnp.ones(C), max_norm=1.0
+        )
+        # the attacker contributes at most max_norm/C of shift
+        assert np.abs(np.asarray(out["w"])).max() < 1.0
+
+    def test_nan_client_degrades_to_reference(self):
+        ref = {"w": jnp.ones((4,)) * 2.0}
+        honest = np.full((C, 4), 2.1, np.float32)
+        honest[0] = np.nan
+        out = norm_bounded_mean(
+            {"w": jnp.asarray(honest)}, ref, jnp.ones(C), jnp.ones(C), 10.0
+        )
+        v = np.asarray(out["w"])
+        assert np.isfinite(v).all()
+        # NaN client's delta is zeroed -> it pulls toward the reference
+        assert (v >= 2.0).all() and (v <= 2.1).all()
+
+
+class TestKrum:
+    def test_selects_honest_cluster(self):
+        honest = np.random.default_rng(6).normal(size=(C, 5)).astype(np.float32) * 0.1
+        honest[2] += 100.0
+        w = np.asarray(krum_weights(
+            {"w": jnp.asarray(honest)}, jnp.ones(C), num_byzantine=1,
+            multi_m=3,
+        ))
+        assert w[2] == 0.0
+        assert abs(w.sum() - 1.0) < 1e-6
+        assert (w >= 0).all()
+
+    def test_nan_row_never_selected(self):
+        honest = np.random.default_rng(7).normal(size=(C, 5)).astype(np.float32) * 0.1
+        honest[4] = np.nan
+        w = np.asarray(krum_weights(
+            {"w": jnp.asarray(honest)}, jnp.ones(C), num_byzantine=1,
+            multi_m=2,
+        ))
+        assert w[4] == 0.0
+        assert abs(w.sum() - 1.0) < 1e-6
+
+    def test_masked_clients_excluded(self):
+        vals = _stack(8, shape=(C, 5))
+        mask = jnp.asarray([1, 1, 1, 1, 0, 0, 0, 0], jnp.float32)
+        w = np.asarray(krum_weights(
+            {"w": jnp.asarray(vals)}, mask, num_byzantine=0, multi_m=2
+        ))
+        assert (w[4:] == 0).all()
+
+    def test_invalid_multi_m_raises(self):
+        with pytest.raises(ValueError, match="multi_m"):
+            krum_weights({"w": jnp.zeros((C, 2))}, jnp.ones(C), 1, multi_m=0)
+
+
+class TestRobustFedAvgStrategy:
+    def _results(self, packets, mask):
+        return FitResults(
+            packets=packets,
+            sample_counts=jnp.ones((C,), jnp.float32),
+            train_losses={"backward": jnp.zeros((C,))},
+            train_metrics={},
+            mask=mask,
+        )
+
+    def test_empty_cohort_keeps_params(self):
+        for method in ("median", "trimmed_mean", "norm_bounded", "krum"):
+            strat = RobustFedAvg(method)
+            state = strat.init({"w": jnp.ones((3,))})
+            new = strat.aggregate(
+                state,
+                self._results({"w": jnp.zeros((C, 3))}, jnp.zeros((C,))),
+                jnp.asarray(1, jnp.int32),
+            )
+            np.testing.assert_allclose(np.asarray(new.params["w"]), 1.0)
+
+    def test_unknown_method_raises(self):
+        with pytest.raises(ValueError, match="method"):
+            RobustFedAvg("mean_of_means")
+
+    def test_median_matches_fedavg_on_identical_packets(self):
+        """When every client pushes the same tree, every estimator agrees
+        with plain FedAvg — the drop-in sanity anchor."""
+        packets = {"w": jnp.broadcast_to(jnp.arange(3.0), (C, 3))}
+        mask = jnp.ones((C,))
+        fed = FedAvg().init({"w": jnp.zeros((3,))})
+        fed_out = FedAvg().aggregate(
+            fed, self._results(packets, mask), jnp.asarray(1, jnp.int32)
+        )
+        for method in ("median", "trimmed_mean", "krum", "multi_krum"):
+            strat = RobustFedAvg(method)
+            out = strat.aggregate(
+                strat.init({"w": jnp.zeros((3,))}),
+                self._results(packets, mask),
+                jnp.asarray(1, jnp.int32),
+            )
+            np.testing.assert_allclose(
+                np.asarray(out.params["w"]), np.asarray(fed_out.params["w"]),
+                rtol=1e-6,
+            )
+
+
+class TestRobustInSimulation:
+    """RobustFedAvg as a drop-in inside compiled rounds, both exec modes."""
+
+    def test_trajectories_match_across_execution_modes(self):
+        losses = {}
+        for mode in ("pipelined", "chunked"):
+            sim = make_sim(RobustFedAvg("trimmed_mean", trim_fraction=0.25),
+                           execution_mode=mode)
+            hist = sim.fit(3)
+            losses[mode] = [r.fit_losses["backward"] for r in hist]
+        assert losses["pipelined"] == losses["chunked"]
+
+    def test_median_learns_without_faults(self):
+        sim = make_sim(RobustFedAvg("median"))
+        hist = sim.fit(5)
+        assert hist[-1].fit_losses["backward"] < hist[0].fit_losses["backward"]

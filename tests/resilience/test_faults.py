@@ -1,0 +1,232 @@
+"""The chaos layer itself: deterministic seeded draws, in-graph no-op
+guarantees, transport chaos — and the pinned robustness claim (FedAvg
+diverges under amplified sign-flip clients while trimmed-mean/median keep
+converging on the SAME seeds and the SAME FaultPlan)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.resilience import (
+    ClientFault,
+    FaultPlan,
+    QuarantinePolicy,
+    QuarantiningStrategy,
+    RobustFedAvg,
+    TransportFaultPolicy,
+    chaos_handler,
+)
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+from tests.resilience.conftest import N_CLIENTS, make_sim
+
+pytestmark = pytest.mark.chaos
+
+
+class TestFaultPlanDraws:
+    def test_deterministic_across_calls_and_jit(self):
+        plan = FaultPlan(seed=5, client_faults=(
+            ClientFault(clients=(1, 4), kind="scale", scale=3.0,
+                        probability=0.5),
+            ClientFault(clients=(2,), kind="dropout", probability=0.5),
+        ))
+        eager = [np.asarray(plan.corruption_factors(r, N_CLIENTS))
+                 for r in range(1, 6)]
+        jitted_fn = jax.jit(
+            lambda r: plan.corruption_factors(r, N_CLIENTS)
+        )
+        jitted = [np.asarray(jitted_fn(jnp.asarray(r, jnp.int32)))
+                  for r in range(1, 6)]
+        for a, b in zip(eager, jitted):
+            np.testing.assert_array_equal(a, b)
+        # probability < 1 actually varies across rounds
+        assert any((a != eager[0]).any() for a in eager[1:])
+
+    def test_round_window_gates_faults(self):
+        plan = FaultPlan(seed=0, client_faults=(
+            ClientFault(clients=(0,), kind="nan", start_round=3,
+                        end_round=4),
+        ))
+        for r, expect_nan in ((2, False), (3, True), (4, True), (5, False)):
+            f = np.asarray(plan.corruption_factors(r, N_CLIENTS))
+            assert np.isnan(f[0]) == expect_nan, (r, f)
+
+    def test_dropout_only_touches_named_clients(self):
+        plan = FaultPlan(seed=0, client_faults=(
+            ClientFault(clients=(2, 5), kind="dropout"),
+        ))
+        keep = np.asarray(plan.participation_factor(1, N_CLIENTS))
+        np.testing.assert_array_equal(keep[[2, 5]], 0.0)
+        assert (np.delete(keep, [2, 5]) == 1.0).all()
+
+    def test_summarize_round_mirrors_in_graph_draws(self):
+        plan = FaultPlan(seed=9, client_faults=(
+            ClientFault(clients=(1,), kind="sign_flip"),
+            ClientFault(clients=(6,), kind="dropout"),
+        ))
+        s = plan.summarize_round(2, N_CLIENTS)
+        assert s == {
+            "round": 2, "dropped": [6], "corrupted": [1],
+            "kinds": {"sign_flip": [1]},
+        }
+        assert plan.summarize_round(0, N_CLIENTS) is None  # window not open
+
+    def test_bad_specs_raise(self):
+        with pytest.raises(ValueError, match="kind"):
+            ClientFault(clients=(0,), kind="gamma_ray")
+        with pytest.raises(ValueError, match="probability"):
+            ClientFault(clients=(0,), kind="nan", probability=1.5)
+        with pytest.raises(ValueError, match="at least one"):
+            ClientFault(clients=(), kind="nan")
+
+    def test_out_of_range_client_raises_not_silently_noops(self):
+        """JAX drops out-of-bounds scatter indices — without this check a
+        typo'd client id would inject NO fault and the experiment would
+        pass vacuously."""
+        plan = FaultPlan(seed=0, client_faults=(
+            ClientFault(clients=(N_CLIENTS,), kind="nan"),
+        ))
+        with pytest.raises(ValueError, match="cohort has"):
+            plan.corruption_factors(1, N_CLIENTS)
+        with pytest.raises(ValueError, match="cohort has"):
+            plan.participation_factor(1, N_CLIENTS)
+
+
+class TestInGraphInjection:
+    def test_empty_plan_is_bit_identical_to_no_plan(self):
+        """Resilience disabled == pre-PR trajectories, pinned."""
+        h_none = make_sim(FedAvg()).fit(3)
+        h_empty = make_sim(FedAvg(), fault_plan=FaultPlan()).fit(3)
+        assert ([r.fit_losses["backward"] for r in h_none]
+                == [r.fit_losses["backward"] for r in h_empty])
+
+    def test_faulted_run_matches_across_execution_modes(self):
+        """The same seeded plan injects the same faults on the pipelined
+        and chunked paths — trajectories agree exactly."""
+        plan = FaultPlan(seed=3, client_faults=(
+            ClientFault(clients=(0,), kind="scale", scale=-5.0,
+                        probability=0.7),
+            ClientFault(clients=(5,), kind="dropout", probability=0.5),
+        ))
+        losses = {}
+        for mode in ("pipelined", "chunked"):
+            hist = make_sim(FedAvg(), fault_plan=plan,
+                            execution_mode=mode).fit(4)
+            losses[mode] = [r.fit_losses["backward"] for r in hist]
+        assert losses["pipelined"] == losses["chunked"]
+
+    def test_dropout_excludes_client_from_aggregate(self):
+        """Dropping every OTHER client leaves the aggregate equal to the
+        survivor's own push — the mask math, verified end to end."""
+        plan = FaultPlan(seed=0, client_faults=(
+            ClientFault(clients=tuple(range(1, N_CLIENTS)), kind="dropout"),
+        ))
+        sim = make_sim(FedAvg(), fault_plan=plan)
+        sim.fit(1)
+        g = np.asarray(
+            jax.tree_util.tree_leaves(sim.global_params)[0]
+        )
+        solo = np.asarray(
+            jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(lambda l: l[0],
+                                       sim.client_states.params)
+            )[0]
+        )
+        np.testing.assert_allclose(g, solo, rtol=1e-6)
+
+
+class TestRobustnessClaim:
+    """THE acceptance pin: same seeds, same FaultPlan — plain FedAvg
+    diverges under k amplified sign-flipped clients; trimmed-mean and
+    median keep converging."""
+
+    PLAN = FaultPlan(seed=1, client_faults=(
+        ClientFault(clients=(0, 1), kind="scale", scale=-15.0),
+    ))
+    ROUNDS = 8
+
+    def _trajectory(self, strategy):
+        hist = make_sim(strategy, fault_plan=self.PLAN).fit(self.ROUNDS)
+        return [r.fit_losses["backward"] for r in hist]
+
+    def test_fedavg_mean_diverges(self):
+        t = self._trajectory(FedAvg())
+        assert t[-1] > 2.0 * t[0], t  # loss blew up (or went non-finite)
+
+    def test_median_keeps_converging(self):
+        t = self._trajectory(RobustFedAvg("median"))
+        assert all(np.isfinite(t)), t
+        assert t[-1] < t[0], t
+
+    def test_trimmed_mean_keeps_converging(self):
+        t = self._trajectory(
+            RobustFedAvg("trimmed_mean", trim_fraction=0.25)
+        )
+        assert all(np.isfinite(t)), t
+        assert t[-1] < t[0], t
+
+    def test_quarantine_contains_nan_poison(self):
+        """NaN-poisoning one client under a quarantining FedAvg: the run
+        stays finite and the offender ends up quarantined — on both
+        execution modes, with identical masks."""
+        plan = FaultPlan(seed=2, client_faults=(
+            ClientFault(clients=(3,), kind="nan"),
+        ))
+        masks = {}
+        for mode in ("pipelined", "chunked"):
+            sim = make_sim(
+                QuarantiningStrategy(
+                    FedAvg(), QuarantinePolicy(quarantine_rounds=10)
+                ),
+                fault_plan=plan, execution_mode=mode,
+            )
+            hist = sim.fit(4)
+            losses = [r.fit_losses["backward"] for r in hist]
+            assert all(np.isfinite(losses)), (mode, losses)
+            masks[mode] = np.asarray(sim.server_state.quarantine.quarantined)
+            assert masks[mode][3] == 1.0, (mode, masks[mode])
+        np.testing.assert_array_equal(masks["pipelined"], masks["chunked"])
+
+
+class TestTransportChaos:
+    def test_delay_drop_corrupt_are_deterministic(self):
+        calls = []
+
+        def handler(frame):
+            calls.append(frame)
+            return b"reply-" + frame
+
+        policy = TransportFaultPolicy(drop_probability=0.4,
+                                      corrupt_probability=0.4)
+        outcomes_a = self._drive(handler, policy)
+        calls.clear()
+        outcomes_b = self._drive(handler, policy)
+        assert outcomes_a == outcomes_b
+        assert "dropped" in outcomes_a and "corrupted" in outcomes_a
+
+    @staticmethod
+    def _drive(handler, policy, n=16):
+        wrapped = chaos_handler(handler, policy, seed=11, silo_idx=0)
+        outcomes = []
+        for i in range(n):
+            try:
+                reply = wrapped(b"req%d" % i)
+            except RuntimeError:
+                outcomes.append("dropped")
+                continue
+            outcomes.append(
+                "ok" if reply == b"reply-req%d" % i else "corrupted"
+            )
+        return outcomes
+
+    def test_corruption_is_detected_by_framing_crc(self):
+        from fl4health_tpu.transport import FrameError, encode, get_framing
+
+        frame = encode({"w": np.ones(4, np.float32)})
+        policy = TransportFaultPolicy(corrupt_probability=1.0)
+        wrapped = chaos_handler(lambda b: b, policy, seed=0)
+        corrupted = wrapped(frame)
+        assert corrupted != frame
+        with pytest.raises(FrameError):
+            get_framing().unframe(corrupted)

@@ -1,0 +1,298 @@
+"""Quarantine state machine (strikes/probation/recovery), the strategy
+wrapper's masking semantics, the watchdog's mitigate action, and the
+fl_quarantine_* observability surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.observability import (
+    HealthPolicy,
+    HealthWatchdog,
+    MetricsRegistry,
+    Observability,
+    Tracer,
+)
+from fl4health_tpu.resilience import (
+    ClientFault,
+    FaultPlan,
+    QuarantinePolicy,
+    QuarantineServerState,
+    QuarantiningStrategy,
+    init_quarantine,
+    quarantine_step,
+)
+from fl4health_tpu.strategies.base import FitResults
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+from tests.resilience.conftest import N_CLIENTS, make_sim
+
+C = 6
+
+
+def _step(q, policy, mask=None, nonfinite=None, update_norm=None):
+    return quarantine_step(
+        q, policy,
+        mask=jnp.ones((C,)) if mask is None else jnp.asarray(mask),
+        nonfinite=jnp.zeros((C,)) if nonfinite is None
+        else jnp.asarray(nonfinite, jnp.float32),
+        update_norm=jnp.ones((C,)) if update_norm is None
+        else jnp.asarray(update_norm, jnp.float32),
+    )
+
+
+class TestQuarantineStep:
+    def test_nonfinite_offense_quarantines_after_strikes(self):
+        pol = QuarantinePolicy(strikes_to_quarantine=2, quarantine_rounds=3)
+        q = init_quarantine(C)
+        bad = [0.0] * C
+        bad[2] = 1.0
+        q = _step(q, pol, nonfinite=bad)
+        assert np.asarray(q.quarantined)[2] == 0.0  # one strike, not enough
+        assert np.asarray(q.strikes)[2] == 1.0
+        q = _step(q, pol, nonfinite=bad)
+        assert np.asarray(q.quarantined)[2] == 1.0
+        assert np.asarray(q.strikes)[2] == 0.0  # reset on entry
+
+    def test_clean_round_clears_strikes(self):
+        pol = QuarantinePolicy(strikes_to_quarantine=3)
+        q = init_quarantine(C)
+        bad = [0.0] * C
+        bad[1] = 2.0
+        q = _step(q, pol, nonfinite=bad)
+        q = _step(q, pol)  # clean participation
+        assert np.asarray(q.strikes)[1] == 0.0
+
+    def test_probation_counts_down_and_releases(self):
+        pol = QuarantinePolicy(quarantine_rounds=2)
+        q = init_quarantine(C)
+        bad = [0.0] * C
+        bad[0] = 1.0
+        q = _step(q, pol, nonfinite=bad)  # enters, release_in=2
+        assert np.asarray(q.quarantined)[0] == 1.0
+        q = _step(q, pol)  # countdown 2 -> 1
+        assert np.asarray(q.quarantined)[0] == 1.0
+        q = _step(q, pol)  # countdown 1 -> 0: released (recovery)
+        assert np.asarray(q.quarantined)[0] == 0.0
+        # re-offense re-enters immediately
+        q = _step(q, pol, nonfinite=bad)
+        assert np.asarray(q.quarantined)[0] == 1.0
+
+    def test_quarantined_client_not_judged(self):
+        pol = QuarantinePolicy(strikes_to_quarantine=1, quarantine_rounds=5)
+        q = init_quarantine(C)
+        bad = [0.0] * C
+        bad[4] = 1.0
+        q = _step(q, pol, nonfinite=bad)
+        strikes_before = np.asarray(q.strikes)[4]
+        q = _step(q, pol, nonfinite=bad)  # still offending, but quarantined
+        assert np.asarray(q.strikes)[4] == strikes_before
+
+    def test_norm_outlier_offense(self):
+        pol = QuarantinePolicy(norm_outlier_ratio=5.0,
+                               strikes_to_quarantine=1)
+        q = init_quarantine(C)
+        norms = [1.0] * C
+        norms[3] = 100.0
+        q = _step(q, pol, update_norm=norms)
+        assert np.asarray(q.quarantined)[3] == 1.0
+        assert np.asarray(q.quarantined).sum() == 1.0
+
+    def test_dead_client_streak(self):
+        pol = QuarantinePolicy(dead_norm=1e-6, dead_rounds=2,
+                               strikes_to_quarantine=1)
+        q = init_quarantine(C)
+        norms = [1.0] * C
+        norms[5] = 0.0
+        q = _step(q, pol, update_norm=norms)
+        assert np.asarray(q.quarantined)[5] == 0.0  # streak 1 of 2
+        q = _step(q, pol, update_norm=norms)
+        assert np.asarray(q.quarantined)[5] == 1.0
+
+    def test_nan_update_norm_disables_norm_checks(self):
+        pol = QuarantinePolicy(norm_outlier_ratio=2.0, dead_norm=1e-6,
+                               strikes_to_quarantine=1)
+        q = init_quarantine(C)
+        q = _step(q, pol, update_norm=[np.nan] * C)
+        assert np.asarray(q.quarantined).sum() == 0.0
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            QuarantinePolicy(strikes_to_quarantine=0)
+        with pytest.raises(ValueError):
+            QuarantinePolicy(quarantine_rounds=0)
+
+
+class TestQuarantiningStrategy:
+    def _results(self, packets, mask=None):
+        return FitResults(
+            packets=packets,
+            sample_counts=jnp.ones((C,)),
+            train_losses={"backward": jnp.zeros((C,))},
+            train_metrics={},
+            mask=jnp.ones((C,)) if mask is None else jnp.asarray(mask),
+        )
+
+    def test_requires_n_clients(self):
+        strat = QuarantiningStrategy(FedAvg())
+        with pytest.raises(ValueError, match="n_clients"):
+            strat.init({"w": jnp.zeros((2,))})
+
+    def test_nonfinite_packet_masked_out_of_current_round(self):
+        """The instant screen: a NaN packet never reaches the aggregate,
+        even on the round it first appears."""
+        strat = QuarantiningStrategy(FedAvg(), n_clients=C)
+        state = strat.init({"w": jnp.ones((3,))})
+        packets = np.full((C, 3), 2.0, np.float32)
+        packets[1] = np.nan
+        new = strat.aggregate(
+            state, self._results({"w": jnp.asarray(packets)}),
+            jnp.asarray(1, jnp.int32),
+        )
+        np.testing.assert_allclose(np.asarray(new.inner.params["w"]), 2.0)
+        assert np.asarray(new.quarantine.quarantined)[1] == 1.0
+
+    def test_passthrough_surfaces(self):
+        inner = FedAvg()
+        strat = QuarantiningStrategy(inner, n_clients=C)
+        state = strat.init({"w": jnp.ones((2,))})
+        assert isinstance(state, QuarantineServerState)
+        np.testing.assert_allclose(
+            np.asarray(strat.global_params(state)["w"]), 1.0
+        )
+        assert strat.overrides_update_after_eval is False
+        assert strat.weighted_aggregation == inner.weighted_aggregation
+
+    def test_chunk_eligibility_preserved(self):
+        """Wrapping must not demote fit() off the chunked fast path."""
+        sim = make_sim(QuarantiningStrategy(FedAvg()))
+        assert sim._chunk_ineligibility() is None
+        mode, _ = sim._select_execution_mode(2)
+        assert mode == "chunked_scan"
+
+
+class TestQuarantineObservability:
+    def _obs(self):
+        return Observability(
+            enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+            sync_device=False, introspection=False,
+        )
+
+    def test_gauges_and_events_on_both_modes(self):
+        plan = FaultPlan(seed=2, client_faults=(
+            ClientFault(clients=(3,), kind="nan"),
+        ))
+        for mode in ("pipelined", "chunked"):
+            obs = self._obs()
+            sim = make_sim(
+                QuarantiningStrategy(
+                    FedAvg(), QuarantinePolicy(quarantine_rounds=10)
+                ),
+                fault_plan=plan, execution_mode=mode, observability=obs,
+            )
+            sim.fit(3)
+            snap = obs.registry.snapshot()
+            assert snap["fl_quarantine_active_clients"] == 1.0, mode
+            assert snap["fl_quarantine_entries_total"] == 1.0, mode
+            events = [e for e in obs.registry.events
+                      if e["event"] == "quarantine"]
+            assert events and events[0]["source"] == "strategy"
+            assert any(e["entered"] == [3] for e in events), mode
+            faults = [e for e in obs.registry.events
+                      if e["event"] == "fault"]
+            assert faults and faults[0]["corrupted"] == [3], mode
+
+
+class TestWatchdogMitigate:
+    def _telemetry(self, n=4, nonfinite_client=None):
+        t = {
+            "train_loss": np.full(n, 0.5),
+            "nonfinite_loss": np.zeros(n),
+            "nonfinite_params": np.zeros(n),
+            "nonfinite_eval_loss": np.zeros(n),
+            "update_norm": np.ones(n),
+        }
+        if nonfinite_client is not None:
+            t["nonfinite_params"][nonfinite_client] = 3.0
+        return t
+
+    def test_mitigate_quarantines_instead_of_halting(self):
+        wd = HealthWatchdog(HealthPolicy(on_nonfinite="mitigate",
+                                         quarantine_rounds=2))
+        summary = wd.observe(
+            1, self._telemetry(nonfinite_client=2), np.ones(4), 0.5
+        )
+        assert summary["status"] == "mitigate"
+        assert wd.active_quarantine() == [2]
+        keep = wd.quarantine_keep_mask(4)
+        np.testing.assert_array_equal(keep, [1, 1, 0, 1])
+
+    def test_probation_release(self):
+        wd = HealthWatchdog(HealthPolicy(on_nonfinite="mitigate",
+                                         quarantine_rounds=2))
+        wd.observe(1, self._telemetry(nonfinite_client=0), np.ones(4), 0.5)
+        assert wd.active_quarantine() == [0]
+        wd.observe(2, self._telemetry(), np.ones(4), 0.5)
+        assert wd.active_quarantine() == [0]  # released at round 1+2=3
+        summary = wd.observe(3, self._telemetry(), np.ones(4), 0.5)
+        assert wd.active_quarantine() == []
+        assert summary["released_clients"] == [0]
+        assert wd.quarantine_keep_mask(4) is None
+
+    def test_mitigate_emits_quarantine_metrics(self):
+        obs = Observability(
+            enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+            sync_device=False, introspection=False,
+        )
+        wd = HealthWatchdog(HealthPolicy(on_nonfinite="mitigate"))
+        wd.observe(1, self._telemetry(nonfinite_client=1), np.ones(4), 0.5,
+                   obs=obs)
+        snap = obs.registry.snapshot()
+        assert snap["fl_quarantine_active_clients"] == 1.0
+        assert snap["fl_quarantine_entries_total"] == 1.0
+        events = [e for e in obs.registry.events
+                  if e["event"] == "quarantine"]
+        assert events and events[0]["source"] == "watchdog"
+        obs.shutdown()
+
+    def test_invalid_action_still_rejected(self):
+        with pytest.raises(ValueError, match="must be one of"):
+            HealthPolicy(on_nonfinite="retaliate")
+
+    def test_pipelined_fit_masks_mitigated_client(self):
+        """End to end on the pipelined path: a client whose LOCAL training
+        produces non-finite losses (poisoned shard — the round program
+        already screens it out of aggregation) is quarantined by the
+        watchdog and sampled out of later rounds, so the run completes and
+        the failure signal stops recurring. (Wire-level NaN packets need
+        the in-graph QuarantiningStrategy instead — host mitigation sees
+        the telemetry one round too late by construction.)"""
+        from tests.resilience.conftest import _dataset
+
+        datasets = [_dataset(i) for i in range(N_CLIENTS)]
+        poisoned = _dataset(2)
+        datasets[2] = type(poisoned)(
+            x_train=np.full_like(poisoned.x_train, np.nan),
+            y_train=poisoned.y_train,
+            x_val=poisoned.x_val, y_val=poisoned.y_val,
+        )
+        wd = HealthWatchdog(HealthPolicy(on_nonfinite="mitigate",
+                                         quarantine_rounds=50))
+        obs = Observability(
+            enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+            sync_device=False, introspection=False, watchdog=wd,
+        )
+        sim = make_sim(FedAvg(), execution_mode="pipelined",
+                       observability=obs, pipeline_depth=1,
+                       datasets=datasets)
+        hist = sim.fit(5)
+        assert len(hist) == 5
+        assert wd.active_quarantine() == [2]
+        # the aggregate stayed clean (the finite-loss screen plus the
+        # quarantine) and the offender left the participant set, so the
+        # last rounds observe no nonfinite participants
+        assert all(np.isfinite([r.fit_losses["backward"] for r in hist]))
+        health = [e for e in obs.registry.events if e["event"] == "health"]
+        assert health[0]["nonfinite_clients"] == [2]
+        assert health[-1]["nonfinite_clients"] == []

@@ -1,0 +1,317 @@
+"""Retry/backoff, failure classification, circuit breakers — and the
+reworked concurrent broadcast_round: quorum survival, weight
+renormalization, wall-clock ~= slowest surviving silo."""
+
+import socket
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.observability import MetricsRegistry
+from fl4health_tpu.observability.registry import set_registry
+from fl4health_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+    call_with_retry,
+    classify_failure,
+)
+from fl4health_tpu.transport import (
+    FrameError,
+    LoopbackServer,
+    QuorumError,
+    broadcast_round,
+    broadcast_round_detailed,
+    decode,
+    encode,
+    weighted_merge,
+)
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+class TestClassifyFailure:
+    def test_families(self):
+        assert classify_failure(socket.timeout()) == "timeout"
+        assert classify_failure(TimeoutError()) == "timeout"
+        assert classify_failure(ConnectionRefusedError()) == "connection"
+        assert classify_failure(ConnectionError()) == "connection"
+        assert classify_failure(OSError()) == "connection"
+        assert classify_failure(FrameError("bad crc")) == "decode"
+        assert classify_failure(ValueError("missing leaf")) == "decode"
+        assert classify_failure(KeyError("n")) == "decode"
+        assert classify_failure(CircuitOpenError()) == "circuit_open"
+        assert classify_failure(RuntimeError()) == "other"
+
+
+class TestRetryPolicy:
+    def test_backoff_grows_and_caps(self):
+        pol = RetryPolicy(base_delay_s=0.1, max_delay_s=0.5,
+                          backoff_factor=2.0, jitter=0.0)
+        delays = [pol.backoff_s(a) for a in range(5)]
+        assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+    def test_jitter_bounded_below_raw(self):
+        pol = RetryPolicy(base_delay_s=1.0, max_delay_s=1.0, jitter=0.5)
+
+        class FixedRng:
+            def random(self):
+                return 1.0  # maximum jitter
+
+        assert pol.backoff_s(0, FixedRng()) == pytest.approx(0.5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=2.0)
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_half_open_probe(self):
+        clock = [0.0]
+        br = CircuitBreaker(failure_threshold=2, reset_after_s=10.0,
+                            clock=lambda: clock[0])
+        assert br.allow()
+        br.record_failure()
+        assert br.state == br.CLOSED
+        br.record_failure()
+        assert br.state == br.OPEN
+        assert not br.allow()  # open, within cooldown
+        clock[0] = 11.0
+        assert br.allow()  # half-open probe admitted
+        assert not br.allow()  # only ONE probe at a time
+        br.record_failure()  # probe failed -> re-open
+        assert br.state == br.OPEN
+        clock[0] = 22.0
+        assert br.allow()
+        br.record_success()
+        assert br.state == br.CLOSED
+        assert br.allow()
+
+
+class TestCallWithRetry:
+    def test_retries_until_success(self):
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ConnectionError("flap")
+            return "ok"
+
+        failures = []
+        out = call_with_retry(
+            flaky, RetryPolicy(max_attempts=3, base_delay_s=0.0),
+            on_failure=lambda e, a, r: failures.append((a, r)),
+            sleep=lambda s: None,
+        )
+        assert out == "ok"
+        assert len(attempts) == 3
+        assert failures == [(0, True), (1, True)]
+
+    def test_exhausted_attempts_reraise_last(self):
+        with pytest.raises(ConnectionError):
+            call_with_retry(
+                lambda: (_ for _ in ()).throw(ConnectionError("dead")),
+                RetryPolicy(max_attempts=2, base_delay_s=0.0),
+                sleep=lambda s: None,
+            )
+
+    def test_open_breaker_short_circuits(self):
+        br = CircuitBreaker(failure_threshold=1, reset_after_s=1e9)
+        br.record_failure()
+        calls = []
+        with pytest.raises(CircuitOpenError):
+            call_with_retry(lambda: calls.append(1), breaker=br)
+        assert calls == []  # never dialed
+
+
+def _echo_silos(n, offsets=None, delays=None):
+    """Live silos; silo i replies params+offset_i with weight offset_i."""
+    offsets = offsets or list(range(1, n + 1))
+    delays = delays or [0.0] * n
+
+    def make_handler(offset, delay):
+        def handler(frame):
+            if delay:
+                time.sleep(delay)
+            params = decode(frame, like={"w": jnp.zeros(2)})
+            return encode({"params": {"w": params["w"] + offset},
+                           "n": jnp.asarray(float(offset))})
+        return handler
+
+    return [LoopbackServer(make_handler(o, d))
+            for o, d in zip(offsets, delays)]
+
+
+TEMPLATE = {"params": {"w": jnp.zeros(2)}, "n": jnp.zeros(())}
+
+
+class TestConcurrentBroadcast:
+    def test_replies_stay_in_silo_order(self, registry):
+        silos = _echo_silos(3)
+        try:
+            replies = broadcast_round(
+                [(s.host, s.port) for s in silos],
+                {"w": jnp.asarray([1.0, 2.0])}, TEMPLATE,
+            )
+        finally:
+            for s in silos:
+                s.close()
+        assert [float(r["n"]) for r in replies] == [1.0, 2.0, 3.0]
+
+    def test_wall_clock_tracks_slowest_not_sum(self, registry):
+        """4 silos, 0.3s each: the serial loop would take >= 1.2s; the
+        concurrent fan-out completes in ~one delay."""
+        silos = _echo_silos(4, delays=[0.3] * 4)
+        try:
+            t0 = time.perf_counter()
+            replies = broadcast_round(
+                [(s.host, s.port) for s in silos],
+                {"w": jnp.zeros(2)}, TEMPLATE,
+            )
+            wall = time.perf_counter() - t0
+        finally:
+            for s in silos:
+                s.close()
+        assert len(replies) == 4
+        assert wall < 0.9, wall  # ~0.3s + overhead, far under the 1.2s sum
+
+    def test_quorum_survives_dead_silo_and_renormalizes(self, registry):
+        """THE acceptance pin: one injected silo dropout, quorum proceeds
+        with the survivors and weighted_merge renormalizes their weights."""
+        silos = _echo_silos(2, offsets=[1.0, 3.0])
+        dead = LoopbackServer(lambda b: b)
+        dead.close()  # allocated-then-closed: nothing listens
+        addrs = [(silos[0].host, silos[0].port), (dead.host, dead.port),
+                 (silos[1].host, silos[1].port)]
+        try:
+            replies = broadcast_round(
+                addrs, {"w": jnp.asarray([10.0, 20.0])}, TEMPLATE,
+                timeout=0.5, quorum=2,
+            )
+        finally:
+            for s in silos:
+                s.close()
+        assert len(replies) == 2
+        merged, weights = weighted_merge(replies)
+        np.testing.assert_allclose(weights, [0.25, 0.75])  # renormalized
+        np.testing.assert_allclose(
+            np.asarray(merged["w"]),
+            [0.25 * 11 + 0.75 * 13, 0.25 * 21 + 0.75 * 23],
+        )
+        # the failure is still visible, reason-labeled
+        snap = registry.snapshot()
+        key = f'{{reason="connection",silo="{dead.host}:{dead.port}"}}'
+        assert snap["transport_rpc_failures_total"][key] >= 1.0
+
+    def test_quorum_shortfall_raises_quorum_error(self, registry):
+        dead = LoopbackServer(lambda b: b)
+        dead.close()
+        with pytest.raises(QuorumError) as ei:
+            broadcast_round(
+                [(dead.host, dead.port)], {"w": jnp.zeros(2)}, TEMPLATE,
+                timeout=0.5, quorum=1,
+            )
+        assert ei.value.required == 1 and ei.value.succeeded == 0
+        assert ei.value.failures[0][1] == "connection"
+
+    def test_no_quorum_keeps_legacy_raise(self, registry):
+        dead = LoopbackServer(lambda b: b)
+        dead.close()
+        with pytest.raises(Exception):
+            broadcast_round(
+                [(dead.host, dead.port)], {"w": jnp.zeros(2)}, TEMPLATE,
+                timeout=0.5,
+            )
+
+    def test_fractional_quorum(self, registry):
+        silos = _echo_silos(2)
+        dead = LoopbackServer(lambda b: b)
+        dead.close()
+        addrs = [(s.host, s.port) for s in silos] + [(dead.host, dead.port)]
+        try:
+            replies = broadcast_round(
+                addrs, {"w": jnp.zeros(2)}, TEMPLATE,
+                timeout=0.5, quorum=0.5,  # ceil(1.5) = 2 of 3
+            )
+        finally:
+            for s in silos:
+                s.close()
+        assert len(replies) == 2
+
+    def test_invalid_quorum_raises(self, registry):
+        with pytest.raises(ValueError, match="quorum"):
+            broadcast_round([("h", 1)], {"w": jnp.zeros(2)}, TEMPLATE,
+                            quorum=7)
+
+    def test_retry_recovers_from_transient_drops(self, registry):
+        """A silo that drops the first request succeeds on the retry — and
+        the retry counter says so."""
+        seen = []
+
+        def flaky(frame):
+            seen.append(frame)
+            if len(seen) == 1:
+                raise RuntimeError("injected transient drop")
+            params = decode(frame, like={"w": jnp.zeros(2)})
+            return encode({"params": {"w": params["w"]}, "n": jnp.asarray(1.0)})
+
+        silo = LoopbackServer(flaky)
+        try:
+            replies = broadcast_round(
+                [(silo.host, silo.port)], {"w": jnp.zeros(2)}, TEMPLATE,
+                retry=RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                  timeout_s=1.0),
+            )
+        finally:
+            silo.close()
+        assert len(replies) == 1
+        assert len(seen) == 2
+        snap = registry.snapshot()
+        retries = snap.get("transport_rpc_retries_total", {})
+        assert sum(retries.values()) >= 1
+
+    def test_breaker_skips_dead_silo_without_dialing(self, registry):
+        br = CircuitBreaker(failure_threshold=1, reset_after_s=1e9)
+        dead = LoopbackServer(lambda b: b)
+        dead.close()
+        breakers = {f"{dead.host}:{dead.port}": br}
+        with pytest.raises(Exception):
+            broadcast_round([(dead.host, dead.port)], {"w": jnp.zeros(2)},
+                            TEMPLATE, timeout=0.5, breakers=breakers)
+        assert br.state == br.OPEN
+        t0 = time.perf_counter()
+        report = broadcast_round_detailed(
+            [(dead.host, dead.port)], {"w": jnp.zeros(2)}, TEMPLATE,
+            timeout=5.0, breakers=breakers,
+        )
+        fast = time.perf_counter() - t0
+        assert not report.results[0].ok
+        assert report.results[0].reason == "circuit_open"
+        assert fast < 1.0  # skipped, never paid the 5s timeout
+
+    def test_detailed_report_carries_per_silo_outcomes(self, registry):
+        silos = _echo_silos(1)
+        dead = LoopbackServer(lambda b: b)
+        dead.close()
+        try:
+            report = broadcast_round_detailed(
+                [(silos[0].host, silos[0].port), (dead.host, dead.port)],
+                {"w": jnp.zeros(2)}, TEMPLATE, timeout=0.5,
+            )
+        finally:
+            silos[0].close()
+        assert report.results[0].ok and report.results[0].attempts == 1
+        assert not report.results[1].ok
+        assert report.results[1].reason == "connection"
+        assert len(report.replies) == 1 and len(report.failures) == 1

@@ -1,0 +1,142 @@
+"""Property-style tests for the sampling managers: masks respect
+min_clients, are deterministic under a fixed rng, stay binary/in-range,
+and never select out-of-range indices. (No hypothesis on this box —
+properties are swept over seeds x configurations instead.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.server.client_manager import (
+    FixedFractionManager,
+    FixedSamplingManager,
+    FullParticipationManager,
+    PoissonSamplingManager,
+)
+
+SEEDS = [0, 1, 7, 42, 1234]
+CONFIGS = [  # (n_clients, fraction, min_clients)
+    (4, 0.5, 1),
+    (8, 0.25, 2),
+    (8, 0.9, 1),
+    (16, 0.1, 3),
+    (5, 0.0, 1),
+    (7, 1.0, 1),
+]
+
+
+def _mask_np(manager, seed, round_idx):
+    return np.asarray(manager.sample(jax.random.PRNGKey(seed), round_idx))
+
+
+class TestFixedFractionManager:
+    @pytest.mark.parametrize("n,frac,min_clients", CONFIGS)
+    def test_mask_is_binary_right_shape_and_exact_k(self, n, frac,
+                                                    min_clients):
+        mgr = FixedFractionManager(n, frac, min_clients=min_clients)
+        expected_k = min(n, max(min_clients, int(frac * n)))
+        for seed in SEEDS:
+            m = _mask_np(mgr, seed, round_idx=3)
+            assert m.shape == (n,)
+            assert set(np.unique(m)).issubset({0.0, 1.0})
+            assert int(m.sum()) == expected_k
+
+    @pytest.mark.parametrize("n,frac,min_clients", CONFIGS)
+    def test_respects_min_clients(self, n, frac, min_clients):
+        mgr = FixedFractionManager(n, frac, min_clients=min_clients)
+        for seed in SEEDS:
+            assert int(_mask_np(mgr, seed, 1).sum()) >= min_clients
+
+    def test_deterministic_under_fixed_rng(self):
+        mgr = FixedFractionManager(12, 0.4, min_clients=2)
+        for seed in SEEDS:
+            for rnd in (1, 5):
+                a = _mask_np(mgr, seed, rnd)
+                b = _mask_np(mgr, seed, rnd)
+                np.testing.assert_array_equal(a, b)
+
+    def test_redrawn_across_rounds(self):
+        mgr = FixedFractionManager(32, 0.25)
+        masks = [_mask_np(mgr, 0, r) for r in range(1, 9)]
+        assert any((masks[0] != m).any() for m in masks[1:])
+
+    def test_min_clients_above_n_raises(self):
+        with pytest.raises(ValueError, match="min_clients"):
+            FixedFractionManager(4, 0.5, min_clients=5)
+
+    def test_k_never_exceeds_n(self):
+        mgr = FixedFractionManager(3, 1.0, min_clients=3)
+        assert mgr.k == 3
+        assert int(_mask_np(mgr, 0, 1).sum()) == 3
+
+
+class TestPoissonSamplingManager:
+    @pytest.mark.parametrize("n,frac,min_clients", CONFIGS)
+    def test_mask_binary_shape_and_min_clients(self, n, frac, min_clients):
+        mgr = PoissonSamplingManager(n, frac, min_clients=min_clients)
+        for seed in SEEDS:
+            m = _mask_np(mgr, seed, 2)
+            assert m.shape == (n,)
+            assert set(np.unique(m)).issubset({0.0, 1.0})
+            assert int(m.sum()) >= min_clients
+
+    def test_deterministic_under_fixed_rng(self):
+        mgr = PoissonSamplingManager(16, 0.3, min_clients=2)
+        for seed in SEEDS:
+            np.testing.assert_array_equal(
+                _mask_np(mgr, seed, 4), _mask_np(mgr, seed, 4)
+            )
+
+    def test_topup_is_superset_of_bernoulli_draw(self):
+        """min_clients forces extra clients IN but never drops a Bernoulli
+        success — the accounting-relevant inclusion events survive."""
+        for seed in SEEDS:
+            for frac in (0.1, 0.3, 0.6):
+                plain = _mask_np(PoissonSamplingManager(16, frac), seed, 1)
+                topped = _mask_np(
+                    PoissonSamplingManager(16, frac, min_clients=5), seed, 1
+                )
+                assert (topped >= plain).all()
+                assert int(topped.sum()) >= 5
+
+    def test_default_min_clients_keeps_legacy_draws(self):
+        """min_clients=0 is bit-identical to the pre-resilience sampler —
+        the DP accounting path sees exactly the old masks."""
+        for seed in SEEDS:
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), 3)
+            legacy = (
+                jax.random.uniform(rng, (16,)) < 0.3
+            ).astype(jnp.float32)
+            np.testing.assert_array_equal(
+                _mask_np(PoissonSamplingManager(16, 0.3), seed, 3),
+                np.asarray(legacy),
+            )
+
+    def test_empty_cohort_allowed_without_floor(self):
+        mgr = PoissonSamplingManager(8, 0.0)
+        for seed in SEEDS:
+            assert _mask_np(mgr, seed, 1).sum() == 0
+
+    def test_invalid_min_clients_raises(self):
+        with pytest.raises(ValueError, match="min_clients"):
+            PoissonSamplingManager(4, 0.5, min_clients=5)
+        with pytest.raises(ValueError, match="min_clients"):
+            PoissonSamplingManager(4, 0.5, min_clients=-1)
+
+
+class TestOtherManagers:
+    def test_full_participation_all_ones(self):
+        mgr = FullParticipationManager(6)
+        m = _mask_np(mgr, 0, 1)
+        np.testing.assert_array_equal(m, np.ones(6))
+
+    def test_fixed_sampling_caches_across_rounds(self):
+        mgr = FixedSamplingManager(10, 0.5)
+        a = _mask_np(mgr, 0, 1)
+        b = _mask_np(mgr, 999, 7)  # different rng/round: cached draw wins
+        np.testing.assert_array_equal(a, b)
+        mgr.reset_sample()
+        c = _mask_np(mgr, 999, 7)
+        assert c.shape == (10,) and int(c.sum()) == 5
+        del c

@@ -232,3 +232,81 @@ def test_cli_output_byte_stable_without_program_events(tmp_path):
         capture_output=True, text=True, check=True,
     ).stdout)
     assert "programs" not in doc
+
+
+# -- resilience fault / quarantine tables (resilience subsystem PR) --------
+
+def _log_with_events(tmp_path, rounds, extra):
+    path = _log(tmp_path, rounds)
+    with open(path, "a") as f:
+        for rec in extra:
+            f.write(json.dumps({"ts": 0, **rec}) + "\n")
+    return path
+
+
+def test_fault_table_renders_drops_and_kinds():
+    faults = [
+        {"round": 1, "dropped": [6], "corrupted": [1, 2],
+         "kinds": {"sign_flip": [1], "nan": [2]}},
+        {"round": 2, "dropped": [], "corrupted": [1],
+         "kinds": {"sign_flip": [1]}},
+    ]
+    table = perf_report.render_fault_table(faults)
+    lines = table.splitlines()
+    assert lines[0].split() == ["round", "dropped", "corrupted", "kinds"]
+    assert "1,2" in lines[2] and "nan,sign_flip" in lines[2]
+    assert lines[3].split()[1] == "-"  # no drops in round 2
+
+
+def test_quarantine_table_renders_transitions():
+    events = [
+        {"round": 3, "source": "strategy", "active": [2, 5],
+         "entered": [5], "released": []},
+        {"round": 7, "source": "watchdog", "active": [2],
+         "entered": [], "released": [5]},
+    ]
+    table = perf_report.render_quarantine_table(events)
+    lines = table.splitlines()
+    assert lines[0].split() == ["round", "source", "active", "entered",
+                                "released"]
+    assert lines[2].split() == ["3", "strategy", "2", "5", "-"]
+    assert lines[3].split() == ["7", "watchdog", "1", "-", "5"]
+
+
+def test_cli_renders_fault_and_quarantine_tables(tmp_path):
+    path = _log_with_events(
+        tmp_path, [_round(1)],
+        [{"event": "fault", "round": 1, "dropped": [0], "corrupted": [3],
+          "kinds": {"nan": [3]}},
+         {"event": "quarantine", "round": 1, "source": "strategy",
+          "active": [3], "entered": [3], "released": []}],
+    )
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path)],
+        capture_output=True, text=True, check=True,
+    )
+    assert "dropped" in out.stdout and "entered" in out.stdout
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path),
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert doc["faults"][0]["corrupted"] == [3]
+    assert doc["quarantine"][0]["active"] == [3]
+
+
+def test_cli_output_byte_stable_without_resilience_events(tmp_path):
+    """Legacy logs (no fault plan, no quarantine) render the exact pre-PR
+    shape: no fault/quarantine tables, no new JSON keys."""
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    )
+    assert "dropped" not in out.stdout and "quarantine" not in out.stdout
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert "faults" not in doc and "quarantine" not in doc

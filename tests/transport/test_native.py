@@ -177,8 +177,11 @@ class TestRpcAccountingOverNativeTransport:
                 timeout=0.5,
             )
         snap = registry.snapshot()
+        # failures carry a reason label (labels serialize sorted): a dead
+        # port is a connection failure, not a timeout or decode error
+        failure_key = f'{{reason="connection",silo="{dead.host}:{dead.port}"}}'
+        assert snap["transport_rpc_failures_total"][failure_key] == 1.0
         silo = f'{{silo="{dead.host}:{dead.port}"}}'
-        assert snap["transport_rpc_failures_total"][silo] == 1.0
         # no latency observation for the failed round trip (failures must
         # not drag the percentiles of working silos) — the instrument is
         # registered up front but stays empty

@@ -142,6 +142,70 @@ def _fmt_program_cell(field: str, rec: dict) -> str:
     return str(int(v))
 
 
+def load_fault_events(path: str) -> list[dict]:
+    """The ``fault`` injection records (resilience/faults.py FaultPlan
+    host mirror), sorted by round."""
+    return _sorted_rounds(load_events(path).get("fault", []))
+
+
+def load_quarantine_events(path: str) -> list[dict]:
+    """The ``quarantine`` transition records (resilience subsystem),
+    sorted by round."""
+    return _sorted_rounds(load_events(path).get("quarantine", []))
+
+
+def _render_generic_table(headers, rows_of_cells) -> str:
+    rows = [list(headers)] + [list(r) for r in rows_of_cells]
+    widths = [max(len(r[i]) for r in rows) for i in range(len(headers))]
+    lines = []
+    for n, row in enumerate(rows):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if n == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def _ids(v: Any) -> str:
+    if not v:
+        return "-"
+    return ",".join(str(int(c)) for c in v)
+
+
+def render_fault_table(faults: list[dict]) -> str:
+    """Per-round fault-injection table: which clients the active FaultPlan
+    dropped/corrupted and with what attack kinds."""
+    return _render_generic_table(
+        ("round", "dropped", "corrupted", "kinds"),
+        (
+            [
+                str(int(rec.get("round", 0))),
+                _ids(rec.get("dropped")),
+                _ids(rec.get("corrupted")),
+                ",".join(sorted((rec.get("kinds") or {}).keys())) or "-",
+            ]
+            for rec in faults
+        ),
+    )
+
+
+def render_quarantine_table(events: list[dict]) -> str:
+    """Per-round quarantine transitions (source = in-graph strategy or
+    watchdog mitigation): active count, entries, releases."""
+    return _render_generic_table(
+        ("round", "source", "active", "entered", "released"),
+        (
+            [
+                str(int(rec.get("round", 0))),
+                str(rec.get("source", "-")),
+                str(len(rec.get("active") or [])),
+                _ids(rec.get("entered")),
+                _ids(rec.get("released")),
+            ]
+            for rec in events
+        ),
+    )
+
+
 def render_program_table(programs: list[dict]) -> str:
     """Per-compiled-program table from ``program`` introspection events:
     cost-model FLOPs/bytes, HBM footprint, compile wall, persistent-cache
@@ -192,9 +256,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit the summary as JSON instead of a table")
     args = ap.parse_args(argv)
     try:
-        events = load_events(args.log)  # ONE parse serves both tables
+        events = load_events(args.log)  # ONE parse serves every table
         rounds = _sorted_rounds(events.get("round", []))
         programs = _latest_programs(events.get("program", []))
+        faults = _sorted_rounds(events.get("fault", []))
+        quarantine = _sorted_rounds(events.get("quarantine", []))
     except OSError as e:
         # a missing/unreadable log is an error exit, not a traceback
         print(f"perf_report: cannot read {args.log}: {e}", file=sys.stderr)
@@ -208,6 +274,10 @@ def main(argv: list[str] | None = None) -> int:
         doc = {"summary": summarize(rounds), "rounds": rounds}
         if programs:
             doc["programs"] = programs
+        if faults:
+            doc["faults"] = faults
+        if quarantine:
+            doc["quarantine"] = quarantine
         print(json.dumps(doc, indent=2))
         return 0
     print(render_table(rounds))
@@ -216,6 +286,13 @@ def main(argv: list[str] | None = None) -> int:
         # compiled program — legacy logs keep the exact old output shape
         print()
         print(render_program_table(programs))
+    if faults:
+        # resilience chaos layer active: disclose what was injected
+        print()
+        print(render_fault_table(faults))
+    if quarantine:
+        print()
+        print(render_quarantine_table(quarantine))
     print()
     for k, v in summarize(rounds).items():
         print(f"{k}: {v}")
