@@ -1,0 +1,55 @@
+"""Communication-efficient exchange — in-graph compressed update codecs.
+
+The lossy client->server channel of Konečný et al. (arXiv:1610.05492,
+"Federated Learning: Strategies for Improving Communication Efficiency"),
+built TPU-native: the encode->decode round trip is pure jittable math
+compiled INTO the round programs, so chunked mode keeps one dispatch per
+N rounds and both execution modes draw identical stochastic codes.
+
+- :mod:`~fl4health_tpu.compression.config` — :class:`CompressionConfig`,
+  the static codec recipe (top-k fraction, error feedback, int8/int4
+  stochastic quantization, seeded random rotation);
+- :mod:`~fl4health_tpu.compression.codecs` — the pure transforms (global
+  magnitude top-k, per-leaf stochastic uniform quantization, randomized
+  Hadamard rotation, error-feedback residual accounting) plus the shared
+  wire-byte arithmetic (:func:`estimate_wire_nbytes`);
+- :mod:`~fl4health_tpu.compression.strategy` —
+  :class:`CompressingStrategy`, the wrapper that runs the channel inside
+  ``Strategy.aggregate`` so any inner strategy (FedAvg, RobustFedAvg,
+  QuarantiningStrategy, Scaffold) aggregates exactly what a real wire
+  receiver would reconstruct.
+
+The matching BYTE format for the cross-silo path (int8/int4 payloads,
+gap-uint16 index sidecars, per-leaf scales, CRC framing) lives in
+``transport/codec.py`` (``encode_compressed``/``decode_compressed``).
+Enable end-to-end with ``FederatedSimulation(compression=
+CompressionConfig(...))``; compression off keeps trajectories
+bit-identical to an uncompressed build (pinned by tests/compression).
+"""
+
+from fl4health_tpu.compression.codecs import (
+    compress_update,
+    estimate_wire_nbytes,
+    logical_nbytes,
+    stochastic_quantize_leaf,
+    topk_count,
+    topk_mask,
+)
+from fl4health_tpu.compression.config import QUANT_LEVELS, CompressionConfig
+from fl4health_tpu.compression.strategy import (
+    CompressedExchangeState,
+    CompressingStrategy,
+)
+
+__all__ = [
+    "CompressionConfig",
+    "QUANT_LEVELS",
+    "CompressingStrategy",
+    "CompressedExchangeState",
+    "compress_update",
+    "estimate_wire_nbytes",
+    "logical_nbytes",
+    "stochastic_quantize_leaf",
+    "topk_count",
+    "topk_mask",
+]

@@ -1,0 +1,290 @@
+"""In-graph compressed-update codecs — pure jittable encode/decode.
+
+The lossy channel of Konečný et al. (arXiv:1610.05492), expressed as pure
+functions over pytrees so the whole encode->decode round trip compiles
+INTO the round programs: chunked mode keeps one dispatch per N rounds, and
+pipelined/chunked trajectories stay bit-identical because every stochastic
+draw is a counter-based ``fold_in`` of (seed, round, client).
+
+Pipeline (per client, on the update ``packet - broadcast_reference``):
+
+1. add the client's error-feedback residual (unsent mass from earlier
+   rounds, SEC/EF-SGD memory) when enabled;
+2. optional seeded randomized-Hadamard rotation per leaf (sign flip by a
+   Rademacher diagonal, then an orthonormal fast Walsh-Hadamard
+   transform): spreads outlier coordinates so the uniform quantization
+   grid wastes less range;
+3. optional global magnitude top-k over the flat update (the
+   :class:`~fl4health_tpu.exchange.exchanger.SparseExchanger` selection
+   rule: exact top-k, ties broken by lowest index);
+4. optional stochastic uniform quantization of the surviving values to a
+   symmetric signed int8/int4 grid with one scale per leaf — unbiased
+   given the scale (``E[decode(encode(v))] = v``);
+5. decode (dequantize, inverse-rotate) immediately — aggregation consumes
+   the reconstruction a real wire receiver would see;
+6. the new residual is ``(update + old_residual) - decoded``: exactly the
+   mass this round failed to transmit.
+
+The matching *byte* format for the cross-silo path lives in
+``transport/codec.py`` (``encode_compressed``/``decode_compressed``);
+:func:`estimate_wire_nbytes` is the shared arithmetic both the simulation's
+``fl_wire_*`` accounting and ``bench.py`` use for it.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fl4health_tpu.compression.config import QUANT_LEVELS, CompressionConfig
+from fl4health_tpu.core.types import PyTree
+
+
+# ---------------------------------------------------------------------------
+# Randomized Hadamard rotation
+# ---------------------------------------------------------------------------
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def _fwht(x: jax.Array) -> jax.Array:
+    """Orthonormal fast Walsh-Hadamard transform of a length-2^m vector.
+
+    Static Python loop over log2(n) butterfly stages — shapes are
+    compile-time constants, so the whole transform fuses under jit. The
+    orthonormal scaling (1/sqrt(n)) makes the transform an involution:
+    ``_fwht(_fwht(x)) == x`` up to float round-off."""
+    n = x.shape[0]
+    h = 1
+    while h < n:
+        x = x.reshape(-1, 2, h)
+        a, b = x[:, 0, :], x[:, 1, :]
+        x = jnp.stack([a + b, a - b], axis=1).reshape(-1)
+        h *= 2
+    return x / jnp.sqrt(jnp.float32(n))
+
+
+def _rotation_signs(seed: int, leaf_idx: int, n_pad: int) -> jax.Array:
+    """Rademacher diagonal for one leaf's rotation — a FIXED draw from
+    (config.seed, leaf index), shared by encoder and decoder (and, on a
+    real wire, by client and server) without any per-round negotiation."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), leaf_idx)
+    return jax.random.rademacher(key, (n_pad,), jnp.float32)
+
+
+def rotate_leaf(flat: jax.Array, signs: jax.Array) -> jax.Array:
+    """Flat leaf -> rotated padded vector (length next_pow2(n))."""
+    n_pad = signs.shape[0]
+    padded = jnp.zeros((n_pad,), jnp.float32).at[: flat.shape[0]].set(
+        flat.astype(jnp.float32)
+    )
+    return _fwht(padded * signs)
+
+
+def unrotate_leaf(rotated: jax.Array, signs: jax.Array, n: int) -> jax.Array:
+    """Inverse of :func:`rotate_leaf` (orthonormal H is its own inverse;
+    the Rademacher diagonal squares to identity); truncates the padding."""
+    return (signs * _fwht(rotated))[:n]
+
+
+# ---------------------------------------------------------------------------
+# Top-k selection
+# ---------------------------------------------------------------------------
+
+def topk_count(n_total: int, fraction: float) -> int:
+    """Static k for a global top-k over ``n_total`` coordinates."""
+    return max(1, min(n_total, int(round(fraction * n_total))))
+
+
+def topk_mask(flat: jax.Array, k: int) -> jax.Array:
+    """0/1 mask keeping the ``k`` largest-magnitude coordinates.
+
+    ``jax.lax.top_k`` is deterministic (ties broken by lowest index), so
+    the same values always produce the same mask — across calls, backends
+    and execution modes (pinned by tests/exchange + tests/compression)."""
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    return jnp.zeros_like(flat, jnp.float32).at[idx].set(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Stochastic uniform quantization
+# ---------------------------------------------------------------------------
+
+def stochastic_quantize_leaf(
+    flat: jax.Array, bits: int, key: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(quantized ints [as f32], scale) for one leaf's flat values.
+
+    Symmetric signed grid {-L..L}, one scale per leaf: ``scale =
+    max|v|/L``; stochastic rounding makes the dequantized value unbiased
+    given the scale. An all-zero leaf keeps scale 0 and quantizes to 0. A
+    NaN/Inf leaf quantizes to NaN — a poisoned submission must stay
+    VISIBLY poisoned through the channel (the robust aggregators and the
+    quarantine nonfinite signal key off it), never silently launder to
+    zeros."""
+    if flat.size == 0:
+        # zero-size leaf: jnp.max has no identity; ship it as-is
+        return flat.astype(jnp.float32), jnp.zeros((), jnp.float32)
+    L = QUANT_LEVELS[bits]
+    vmax = jnp.max(jnp.abs(flat))
+    scale = vmax / L
+    safe = jnp.where(scale > 0, scale, 1.0)
+    y = flat / safe
+    lower = jnp.floor(y)
+    frac = y - lower
+    q = lower + jax.random.bernoulli(key, jnp.clip(frac, 0.0, 1.0)).astype(
+        jnp.float32
+    )
+    q = jnp.clip(q, -L, L)
+    q = jnp.where(scale > 0, q, 0.0)
+    return jnp.where(jnp.isfinite(vmax), q, jnp.nan), scale
+
+
+def dequantize_leaf(q: jax.Array, scale: jax.Array) -> jax.Array:
+    return q * scale
+
+
+# ---------------------------------------------------------------------------
+# The full encode->decode transform over an update pytree
+# ---------------------------------------------------------------------------
+
+def compress_update(
+    update: PyTree,
+    residual: PyTree | None,
+    key: jax.Array,
+    config: CompressionConfig,
+) -> tuple[PyTree, PyTree | None]:
+    """Lossy-channel round trip for ONE client's update pytree.
+
+    Returns ``(decoded_update, new_residual)`` where ``decoded_update`` is
+    what the server-side decoder reconstructs and ``new_residual`` the
+    error-feedback memory (``None`` in == ``None`` out). Pure and
+    jit/vmap-compatible; with no lossy stage enabled it is the identity.
+    """
+    if not config.enabled:
+        return update, residual
+
+    leaves, treedef = jax.tree_util.tree_flatten(update)
+    res_leaves = (jax.tree_util.tree_leaves(residual)
+                  if residual is not None else [None] * len(leaves))
+    sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+    n_total = sum(sizes)
+    if n_total == 0:
+        # an all-empty update tree has nothing to select or scale
+        return update, residual
+
+    # 1. flat f32 working vectors (+ error feedback)
+    flats = []
+    for leaf, res in zip(leaves, res_leaves):
+        v = leaf.astype(jnp.float32).reshape(-1)
+        if res is not None:
+            v = v + res.astype(jnp.float32).reshape(-1)
+        flats.append(v)
+    carried = flats  # pre-rotation domain, for the residual below
+
+    # 2. rotation (per leaf, fixed seeded Rademacher + orthonormal FWHT)
+    signs = None
+    if config.rotation:
+        signs = [
+            _rotation_signs(config.seed, i, _next_pow2(sizes[i]))
+            for i in range(len(flats))
+        ]
+        flats = [rotate_leaf(v, s) for v, s in zip(flats, signs)]
+
+    # 3. global magnitude top-k over the concatenated update
+    if config.topk_fraction is not None:
+        n_sel = sum(v.shape[0] for v in flats)  # padded sizes under rotation
+        k = topk_count(n_total, config.topk_fraction)
+        mask = topk_mask(jnp.concatenate(flats), min(k, n_sel))
+        out, off = [], 0
+        for v in flats:
+            out.append(v * mask[off: off + v.shape[0]])
+            off += v.shape[0]
+        flats = out
+
+    # 4. stochastic quantization, one scale per leaf
+    if config.quant_bits is not None:
+        out = []
+        for i, v in enumerate(flats):
+            q, scale = stochastic_quantize_leaf(
+                v, config.quant_bits, jax.random.fold_in(key, i)
+            )
+            out.append(dequantize_leaf(q, scale))
+        flats = out
+
+    # 5. decode back to the original domain
+    if config.rotation:
+        flats = [
+            unrotate_leaf(v, s, n)
+            for v, s, n in zip(flats, signs, sizes)
+        ]
+
+    # integer leaves round rather than truncate toward zero (parity with
+    # the wire decoder's rule in transport/codec.py); `flats` becomes the
+    # DELIVERED values so the residual below accounts the rounding too
+    flats = [
+        jnp.rint(v) if jnp.issubdtype(leaf.dtype, jnp.integer) else v
+        for v, leaf in zip(flats, leaves)
+    ]
+    decoded = [
+        v.reshape(leaf.shape).astype(leaf.dtype)
+        for v, leaf in zip(flats, leaves)
+    ]
+
+    # 6. error feedback: exactly the mass this round failed to transmit.
+    # Non-finite residual entries reset to 0 — EF memory must not carry a
+    # poisoned (NaN/Inf) submission into every later round.
+    new_residual = residual
+    if residual is not None:
+        res_out = []
+        for v_pre, dec, res in zip(carried, flats, res_leaves):
+            r = (v_pre - dec).astype(res.dtype).reshape(res.shape)
+            res_out.append(jnp.where(jnp.isfinite(r), r, 0.0))
+        new_residual = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(residual), res_out
+        )
+
+    return jax.tree_util.tree_unflatten(treedef, decoded), new_residual
+
+
+# ---------------------------------------------------------------------------
+# Wire-byte arithmetic (shared with transport/codec.py + bench.py)
+# ---------------------------------------------------------------------------
+
+def estimate_wire_nbytes(tree: PyTree, config: CompressionConfig) -> int:
+    """Estimated compressed client->server PAYLOAD bytes for one client's
+    update under ``config`` — the arithmetic the wire codec's frames
+    realize (gap-uint16 index sidecar + int8/int4/f32 values + one f32
+    scale per leaf; JSON header excluded). Works from shape/dtype metadata
+    only (concrete arrays or ``jax.eval_shape`` structs)."""
+    sizes = [
+        int(np.prod(l.shape, dtype=np.int64)) if getattr(l, "shape", ()) else 1
+        for l in jax.tree_util.tree_leaves(tree)
+    ]
+    n_total = int(sum(sizes))
+    if not config.enabled or n_total == 0:
+        return 4 * n_total
+    if config.topk_fraction is not None:
+        nnz = topk_count(n_total, config.topk_fraction)
+        index_bytes = 2 * nnz  # uint16 gap encoding (escapes ~0 at <50% density)
+    else:
+        nnz = n_total
+        index_bytes = 0
+    if config.quant_bits is not None:
+        value_bytes = math.ceil(nnz * config.quant_bits / 8)
+        scale_bytes = 4 * len(sizes)
+    else:
+        value_bytes = 4 * nnz
+        scale_bytes = 0
+    return index_bytes + value_bytes + scale_bytes
+
+
+def logical_nbytes(tree: PyTree) -> int:
+    """Dense f32 byte footprint of the same update (the logical payload)."""
+    from fl4health_tpu.core.pytree import tree_nbytes
+
+    return tree_nbytes(tree)

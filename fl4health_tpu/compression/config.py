@@ -1,0 +1,90 @@
+"""CompressionConfig — the static recipe for the compressed exchange.
+
+One frozen dataclass describes the whole codec pipeline (Konečný et al.,
+arXiv:1610.05492 "structured and sketched updates"): top-k sparsification
+with error feedback, stochastic uniform int8/int4 quantization with
+per-leaf scales, and an optional seeded random-rotation (randomized
+Hadamard) preconditioner. Every field is compile-time config — the
+in-graph transforms (compression/codecs.py) trace it into the round
+programs, and the wire codec (transport/codec.py encode_compressed) uses
+the same recipe for the cross-silo byte format, so the simulated lossy
+exchange and the real wire agree on what was kept.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: bits -> max quantization level L of the symmetric signed grid
+#: {-L, ..., -1, 0, 1, ..., L}; int8 uses the full signed-byte range less
+#: the asymmetric -128, int4 the signed-nibble range less -8.
+QUANT_LEVELS = {8: 127, 4: 7}
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionConfig:
+    """Static codec recipe for client->server update compression.
+
+    - ``topk_fraction``: keep only this fraction of the update's
+      coordinates (global magnitude top-k over the flat update, matching
+      :class:`~fl4health_tpu.exchange.exchanger.SparseExchanger`
+      semantics); ``None`` disables sparsification.
+    - ``error_feedback``: carry each client's unsent mass (sparsification
+      + quantization error) in a per-client residual that is added to the
+      next round's update before encoding (SEC/EF-SGD memory). Only
+      meaningful when a lossy stage is enabled.
+    - ``quant_bits``: stochastic uniform quantization of the (selected)
+      values to a symmetric signed int8/int4 grid with one scale per
+      leaf; ``None`` ships f32 values.
+    - ``rotation``: precondition each leaf with a seeded randomized
+      Hadamard transform before top-k/quantization (spreads outlier
+      coordinates so a uniform grid wastes less range); the decode side
+      applies the inverse rotation with the same seed.
+    - ``seed``: base seed for every stochastic draw (rotation signs,
+      quantization rounding); folded with the round index and client index
+      so both execution modes draw identically.
+    """
+
+    topk_fraction: float | None = None
+    error_feedback: bool = True
+    quant_bits: int | None = None
+    rotation: bool = False
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.topk_fraction is not None and not (
+            0.0 < self.topk_fraction <= 1.0
+        ):
+            raise ValueError(
+                f"topk_fraction must be in (0, 1]; got {self.topk_fraction}"
+            )
+        if self.quant_bits is not None and self.quant_bits not in QUANT_LEVELS:
+            raise ValueError(
+                f"quant_bits must be one of {sorted(QUANT_LEVELS)}; "
+                f"got {self.quant_bits}"
+            )
+        if self.rotation and self.quant_bits is None:
+            raise ValueError(
+                "rotation is a quantization preconditioner; enable "
+                "quant_bits with it (rotation alone is lossless and only "
+                "spends compute)"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        """True when any lossy stage is configured."""
+        return self.topk_fraction is not None or self.quant_bits is not None
+
+    @property
+    def uses_error_feedback(self) -> bool:
+        return self.error_feedback and self.enabled
+
+    def describe(self) -> dict:
+        """JSON-able config facts (run manifest / bench artifacts)."""
+        return {
+            "topk_fraction": self.topk_fraction,
+            "error_feedback": self.uses_error_feedback,
+            "quant_bits": self.quant_bits,
+            "rotation": self.rotation,
+            "seed": self.seed,
+        }

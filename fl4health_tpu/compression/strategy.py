@@ -1,0 +1,251 @@
+"""CompressingStrategy — the lossy exchange channel as a strategy wrapper.
+
+The SPMD "wire" is the stacked packet pytree the round program hands to
+``Strategy.aggregate``; compression therefore lives exactly there: a
+wrapper that encode->decodes every client's update through the configured
+lossy channel (``compression/codecs.py``) BEFORE the inner strategy
+aggregates, inside the compiled round programs on both execution modes.
+The inner strategy — ``FedAvg``, ``RobustFedAvg``,
+``QuarantiningStrategy(...)``, ``Scaffold`` — sees exactly what a real
+wire receiver would have reconstructed, so robustness/quarantine claims
+under compression are tested against the genuine lossy updates.
+
+Error-feedback residual state is per-client ``[C, ...]`` and rides in the
+server-state pytree (:class:`CompressedExchangeState`), so it scans,
+donates and checkpoints like every other server state. Residuals update
+only for clients in the round's aggregation mask — an unsampled (or
+failure-screened) client's garbage packet row never enters its memory.
+
+DP composition (documented check, tests/compression): the instance-level
+DP path clips + noises per-example gradients INSIDE local training
+(privacy/dpsgd.py), i.e. strictly before the packet exists. Compression
+consumes only ``FitResults.packets`` — it is post-processing of the
+already-privatized release, so the DP guarantee (and the accountant's
+sigma) is unchanged by quantization/sparsification.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fl4health_tpu.compression.codecs import compress_update
+from fl4health_tpu.compression.config import CompressionConfig
+from fl4health_tpu.strategies.base import FitResults, Strategy
+
+
+@struct.dataclass
+class CompressedExchangeState:
+    """Wrapper server state: the inner strategy's state + per-client
+    error-feedback residual (``None`` when error feedback is off)."""
+
+    inner: Any
+    residual: Any
+
+
+class CompressingStrategy(Strategy):
+    """Wrap any strategy with the in-graph lossy exchange channel.
+
+    The main update (``packets`` itself, or the ``params`` field of a
+    structured packet) is compressed relative to what the clients pulled
+    this round (``inner.client_payload``), with per-client error-feedback
+    residuals when configured. A ``control_variates`` field (SCAFFOLD's
+    auxiliary packet, exchange/packer.py) is compressed too — statelessly,
+    against a zero reference, since the EF residual tree is shaped by
+    ``init`` before the packet layout exists.
+
+    Masked/partial-exchange packet layouts (``LayerMaskPacket`` /
+    ``SparseMaskPacket``) are rejected at trace time: their zeroed
+    non-selected entries would read as real ``-reference`` deltas and the
+    residual would accumulate junk. Compose compression with full-model
+    exchange (the reference's sketched-update setting).
+
+    ``n_clients`` is normally learned from ``bind_client_manager`` (the
+    simulation calls it before ``init``); pass it explicitly for direct
+    use.
+    """
+
+    def __init__(
+        self,
+        inner: Strategy,
+        config: CompressionConfig,
+        n_clients: int | None = None,
+    ):
+        if not isinstance(config, CompressionConfig):
+            raise TypeError(
+                f"config must be a CompressionConfig; got {type(config).__name__}"
+            )
+        if not config.enabled:
+            raise ValueError(
+                "CompressionConfig has no lossy stage enabled; drop the "
+                "wrapper instead of compiling an identity channel"
+            )
+        self.inner = inner
+        self.config = config
+        self._n_clients = n_clients
+        self.weighted_aggregation = inner.weighted_aggregation
+        self.weighted_eval_aggregation = inner.weighted_eval_aggregation
+        # chunk-eligibility passthrough (server/simulation.py consults this
+        # before the type-level check): only a host-consuming INNER
+        # update_after_eval should force the pipelined path
+        inner_overrides = getattr(inner, "overrides_update_after_eval", None)
+        if inner_overrides is None:
+            inner_overrides = (type(inner).update_after_eval
+                               is not Strategy.update_after_eval)
+        self.overrides_update_after_eval = inner_overrides
+        # quarantine visibility passthrough: the simulation snapshots
+        # strategy.quarantine_mask per round when present
+        inner_qmask = getattr(inner, "quarantine_mask", None)
+        if inner_qmask is not None:
+            self.quarantine_mask = (
+                lambda server_state: inner_qmask(server_state.inner)
+            )
+
+    @property
+    def evaluate_after_fit(self) -> bool:
+        return bool(getattr(self.inner, "evaluate_after_fit", False))
+
+    def bind_client_manager(self, client_manager: Any) -> None:
+        self._n_clients = client_manager.n_clients
+        bind = getattr(self.inner, "bind_client_manager", None)
+        if bind is not None:
+            bind(client_manager)
+
+    def init(self, params) -> CompressedExchangeState:
+        residual = None
+        if self.config.uses_error_feedback:
+            if self._n_clients is None:
+                raise ValueError(
+                    "CompressingStrategy with error feedback needs "
+                    "n_clients: pass it to the constructor or let "
+                    "FederatedSimulation bind its client manager first"
+                )
+            n = self._n_clients
+            residual = jax.tree_util.tree_map(
+                lambda p: jnp.zeros((n, *p.shape), jnp.float32), params
+            )
+        return CompressedExchangeState(
+            inner=self.inner.init(params), residual=residual
+        )
+
+    def global_params(self, server_state: CompressedExchangeState):
+        return self.inner.global_params(server_state.inner)
+
+    def divergence_reference(self, server_state: CompressedExchangeState):
+        return self.inner.divergence_reference(server_state.inner)
+
+    def client_payload(self, server_state: CompressedExchangeState, round_idx):
+        return self.inner.client_payload(server_state.inner, round_idx)
+
+    # -- the channel ----------------------------------------------------
+
+    def _round_key(self, round_idx) -> jax.Array:
+        return jax.random.fold_in(
+            jax.random.PRNGKey(self.config.seed), round_idx
+        )
+
+    def _compress_stacked(
+        self, stacked, reference, residuals, round_key, mask
+    ):
+        """vmap the per-client channel over the ``[C, ...]`` packet stack.
+
+        ``reference`` is what every client pulled (broadcast, unstacked);
+        residual rows update only where ``mask`` participates."""
+        n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+        keys = jax.vmap(
+            lambda i: jax.random.fold_in(round_key, i)
+        )(jnp.arange(n))
+
+        def one(packet_c, residual_c, key_c):
+            update = jax.tree_util.tree_map(
+                lambda p, r: p.astype(jnp.float32) - r.astype(jnp.float32),
+                packet_c, reference,
+            )
+            decoded, new_res = compress_update(
+                update, residual_c, key_c, self.config
+            )
+            def cast_back(r, d):
+                v = r.astype(jnp.float32) + d
+                if jnp.issubdtype(r.dtype, jnp.integer):
+                    # round, don't truncate toward zero — same rule as both
+                    # decoders (codecs.compress_update, codec.decode_compressed)
+                    v = jnp.rint(v)
+                return v.astype(r.dtype)
+
+            lossy = jax.tree_util.tree_map(cast_back, reference, decoded)
+            return lossy, new_res
+
+        lossy, new_res = jax.vmap(one)(stacked, residuals, keys)
+        if residuals is not None:
+            keep = jnp.asarray(mask) > 0
+            new_res = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(
+                    keep.reshape((-1,) + (1,) * (new.ndim - 1)), new, old
+                ),
+                new_res, residuals,
+            )
+        return lossy, new_res
+
+    def aggregate(
+        self,
+        server_state: CompressedExchangeState,
+        results: FitResults,
+        round_idx,
+    ) -> CompressedExchangeState:
+        packets = results.packets
+        for bad in ("leaf_mask", "element_mask"):
+            if hasattr(packets, bad):
+                raise ValueError(
+                    f"CompressingStrategy cannot compress {type(packets).__name__} "
+                    "packets (masked partial exchange): zeroed non-selected "
+                    "entries would read as real deltas. Use full-model "
+                    "exchange with compression."
+                )
+        payload = self.inner.client_payload(server_state.inner, round_idx)
+        reference = payload.params if hasattr(payload, "params") else payload
+        main = packets.params if hasattr(packets, "params") else packets
+        ref_def = jax.tree_util.tree_structure(reference)
+        if jax.tree_util.tree_structure(main) != ref_def:
+            raise ValueError(
+                "CompressingStrategy: packet params structure "
+                f"{jax.tree_util.tree_structure(main)} does not match the "
+                f"broadcast payload structure {ref_def}; compression needs "
+                "param-shaped packets (full-model exchange)."
+            )
+        round_key = self._round_key(round_idx)
+        lossy_main, new_residual = self._compress_stacked(
+            main, reference, server_state.residual, round_key, results.mask
+        )
+        if hasattr(packets, "params"):
+            new_packets = packets.replace(params=lossy_main)
+        else:
+            new_packets = lossy_main
+        if hasattr(packets, "control_variates"):
+            # SCAFFOLD auxiliary packet: same channel, zero reference (the
+            # field is already a delta), stateless (no EF memory)
+            cv = packets.control_variates
+            cv_ref = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape[1:], jnp.float32), cv
+            )
+            lossy_cv, _ = self._compress_stacked(
+                cv, cv_ref, None,
+                jax.random.fold_in(round_key, 0x5CAF), results.mask,
+            )
+            new_packets = new_packets.replace(control_variates=lossy_cv)
+        new_inner = self.inner.aggregate(
+            server_state.inner, results.replace(packets=new_packets),
+            round_idx,
+        )
+        return CompressedExchangeState(inner=new_inner, residual=new_residual)
+
+    def update_after_eval(
+        self, server_state: CompressedExchangeState, eval_losses,
+        eval_metrics, mask,
+    ) -> CompressedExchangeState:
+        new_inner = self.inner.update_after_eval(
+            server_state.inner, eval_losses, eval_metrics, mask
+        )
+        return server_state.replace(inner=new_inner)

@@ -80,8 +80,41 @@ def poll_sample_counts(sim: FederatedSimulation) -> list[int]:
 # Failure policy lives in simulation.py (wired into the round loop there);
 # re-exported here because the reference groups it with the server layer.
 # ---------------------------------------------------------------------------
+# Wrapper-strategy plumbing
+# ---------------------------------------------------------------------------
+
+def _unwrap_strategy(strategy):
+    """Innermost strategy through any wrapper nesting (CompressingStrategy,
+    QuarantiningStrategy, ... — wrappers expose ``.inner``)."""
+    while hasattr(strategy, "inner"):
+        strategy = strategy.inner
+    return strategy
+
+
+def _set_global_params(strategy, server_state, params):
+    """Nesting-safe params install — strategies.base.replace_global_params
+    (one shared definition with FederatedSimulation.set_global_params)."""
+    from fl4health_tpu.strategies.base import replace_global_params
+
+    return replace_global_params(strategy, server_state, params)
+
+
+# ---------------------------------------------------------------------------
 # SCAFFOLD warm start
 # ---------------------------------------------------------------------------
+
+def _keep_warmed_variates(strategy, warmed_state, pre_state, pre_params):
+    """Post-warm-up server state: the innermost (Scaffold) state keeps its
+    warmed control variates with the ORIGINAL params restored; every
+    wrapper layer's bookkeeping (compression EF residual, quarantine
+    strikes) rolls back to its pre-warm-up value — the discarded warm-up
+    round must not leak into round 1."""
+    if hasattr(strategy, "inner") and hasattr(warmed_state, "inner"):
+        return pre_state.replace(inner=_keep_warmed_variates(
+            strategy.inner, warmed_state.inner, pre_state.inner, pre_params
+        ))
+    return warmed_state.replace(params=pre_params)
+
 
 def scaffold_warm_start(sim: FederatedSimulation) -> None:
     """ScaffoldServer warm start (scaffold_server.py:89-163): run one local
@@ -91,6 +124,7 @@ def scaffold_warm_start(sim: FederatedSimulation) -> None:
     with c = 0). The server's variates are warm-started from the aggregated
     deltas while its weights x remain the initial ones."""
     pre_client_states = sim.client_states
+    pre_server_state = sim.server_state
     pre_params = sim.global_params
     mask = jnp.ones((sim.n_clients,), jnp.float32)
     batches = sim._round_batches(0)
@@ -108,8 +142,11 @@ def scaffold_warm_start(sim: FederatedSimulation) -> None:
     # Keep only the warmed variates: client weights/opt/rng/step roll back.
     sim.client_states = pre_client_states.replace(extra=client_states.extra)
     # Server keeps warmed c, original x (scaffold_server.py:139-158 discards
-    # the aggregated weights from the warm-up round).
-    sim.server_state = server_state.replace(params=pre_params)
+    # the aggregated weights from the warm-up round); wrapper layers
+    # (compression residual, quarantine) roll back wholesale.
+    sim.server_state = _keep_warmed_variates(
+        sim.strategy, server_state, pre_server_state, pre_params
+    )
     logger.info("SCAFFOLD warm start complete: control variates initialized "
                 "from average local gradients; model weights unchanged.")
 
@@ -121,7 +158,10 @@ class ScaffoldServer:
     def __init__(self, sim: FederatedSimulation, warm_start: bool = False):
         from fl4health_tpu.strategies.scaffold import Scaffold
 
-        assert isinstance(sim.strategy, Scaffold), "ScaffoldServer requires the Scaffold strategy"
+        assert isinstance(_unwrap_strategy(sim.strategy), Scaffold), (
+            "ScaffoldServer requires the Scaffold strategy (possibly "
+            "wrapped, e.g. by compression)"
+        )
         self.sim = sim
         self.warm_start = warm_start
 
@@ -276,8 +316,11 @@ class EvaluateServer:
         sim = self.sim
         if self.params is not None:
             # Hydrate the server model from the provided checkpoint params
-            # (evaluate_server.py loads from model checkpoint path).
-            sim.server_state = sim.server_state.replace(params=self.params)
+            # (evaluate_server.py loads from model checkpoint path) —
+            # through any strategy wrappers (compression/quarantine).
+            sim.server_state = _set_global_params(
+                sim.strategy, sim.server_state, self.params
+            )
         val_batches, val_counts = sim._val_batches()
         # _eval_round donates the client stack — re-assign the returned one
         # (value-identical modulo the pulled params) so the sim stays usable.

@@ -219,6 +219,7 @@ class FederatedSimulation:
         execution_mode: str = "auto",
         pipeline_depth: int = 2,
         fault_plan: Any = None,
+        compression: Any = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -240,6 +241,46 @@ class FederatedSimulation:
         self.local_epochs = local_epochs
         self.local_steps = local_steps
         self.exchanger = exchanger or FullExchanger()
+        # Compressed exchange (compression/: CompressionConfig): the lossy
+        # client->server channel compiles INTO the round programs via a
+        # CompressingStrategy wrapper, so chunked mode keeps one dispatch
+        # per N rounds and both execution modes draw identical stochastic
+        # codes. None (or a config with no lossy stage) wraps nothing —
+        # trajectories stay bit-identical to an uncompressed build.
+        self.compression = compression
+        if compression is not None:
+            from fl4health_tpu.compression.config import CompressionConfig
+
+            if not isinstance(compression, CompressionConfig):
+                raise TypeError(
+                    "compression must be a CompressionConfig (or None); got "
+                    f"{type(compression).__name__} — a duck-typed config "
+                    "would silently train uncompressed"
+                )
+        self._compression_active = bool(
+            compression is not None and compression.enabled
+        )
+        self._wire_bytes_cache: int | None = None
+        if self._compression_active:
+            from fl4health_tpu.exchange.exchanger import FixedLayerExchanger
+
+            if (getattr(self.exchanger, "wants_packet_payload", False)
+                    or isinstance(self.exchanger, FixedLayerExchanger)):
+                # FixedLayerExchanger (FedBN et al.) zeroes non-exchanged
+                # leaves in push(), so each would read as a huge fake
+                # -reference delta dominating the top-k and poisoning the
+                # EF residual — reject it like the packet-shaped partials
+                raise ValueError(
+                    "compression composes with full-model exchange only: "
+                    f"{type(self.exchanger).__name__} ships partial "
+                    "payloads whose zeroed/masked entries would read as "
+                    "real deltas (it is already a compression scheme)"
+                )
+            from fl4health_tpu.compression.strategy import CompressingStrategy
+
+            strategy = self.strategy = CompressingStrategy(
+                strategy, compression
+            )
         self.client_manager = client_manager or FullParticipationManager(self.n_clients)
         # setup-time strategy <-> sampling-scheme validation (e.g. the DP
         # strategies derive/check fraction_fit against the manager's sampling
@@ -1064,6 +1105,8 @@ class FederatedSimulation:
             "client_manager": type(self.client_manager).__name__,
             "execution_mode": self.execution_mode,
             "telemetry": self._telemetry_enabled,
+            "compression": (self.compression.describe()
+                            if self._compression_active else None),
         }
 
     def _introspect_programs(self, mode: str, n_rounds: int) -> None:
@@ -1766,6 +1809,27 @@ class FederatedSimulation:
         self._payload_bytes_cache = (tree_bytes(down_tree), tree_bytes(up_tree))
         return self._payload_bytes_cache
 
+    def _compressed_gather_nbytes(self) -> int | None:
+        """Estimated compressed client->server wire bytes per participating
+        client under the active CompressionConfig — the arithmetic the
+        transport codec's compressed frames realize
+        (compression.codecs.estimate_wire_nbytes). None without
+        compression. Shape-metadata only (eval_shape), cached like
+        ``_payload_nbytes``."""
+        if not self._compression_active:
+            return None
+        if self._wire_bytes_cache is not None:
+            return self._wire_bytes_cache
+        from fl4health_tpu.compression.codecs import estimate_wire_nbytes
+
+        gp = self.strategy.global_params(self.server_state)
+        try:
+            up_tree = jax.eval_shape(lambda p: self.exchanger.push(p, p), gp)
+        except Exception:
+            up_tree = gp
+        self._wire_bytes_cache = estimate_wire_nbytes(up_tree, self.compression)
+        return self._wire_bytes_cache
+
     def _record_round_metrics(
         self, rnd: int, rec: RoundRecord, mask, host_fit_losses, failed,
         compiles_before: float, compile_s_before: float, device_wait_s: float,
@@ -1824,6 +1888,17 @@ class FederatedSimulation:
             "fl_gather_bytes_total",
             help="logical client->server payload bytes",
         ).inc(gather)
+        gather_wire = None
+        wire_per_client = self._compressed_gather_nbytes()
+        if wire_per_client is not None:
+            # compressed exchange active: fl_wire_* distinguishes the
+            # logical payload from what the compressed frames would ship —
+            # the SAME accounting helper the transport codec bumps for
+            # real frames, under direction="gather"
+            from fl4health_tpu.transport.codec import account_wire
+
+            gather_wire = wire_per_client * participants
+            account_wire(gather, gather_wire, "gather")
         if compiles_after is None:
             compiles_after = reg.counter("jax_backend_compiles_total").value
         if compile_s_after is None:
@@ -1847,6 +1922,11 @@ class FederatedSimulation:
             "fit_loss_std": loss_std,
             "fit_loss_spread": loss_spread,
         }
+        if gather_wire is not None:
+            summary["gather_bytes_wire"] = gather_wire
+            summary["wire_compression_ratio"] = (
+                gather / gather_wire if gather_wire > 0 else None
+            )
         if telemetry is not None:
             t_summary = telem.summarize_host(telemetry, mask_np)
             summary.update(t_summary)
@@ -1979,7 +2059,13 @@ class FederatedSimulation:
             params = jax.tree_util.tree_map(
                 lambda x, y: x.astype(y.dtype), params, ref
             )
-        self.server_state = self.server_state.replace(params=params)
+        # nesting-safe: a wrapper strategy's state (compression/quarantine)
+        # carries the params inside its .inner chain, not at top level
+        from fl4health_tpu.strategies.base import replace_global_params
+
+        self.server_state = replace_global_params(
+            self.strategy, self.server_state, params
+        )
         if broadcast_to_clients:
             n = self.n_clients
             self.client_states = self.client_states.replace(

@@ -41,6 +41,20 @@ class FitResults:
     mask: jax.Array
 
 
+def replace_global_params(strategy: "Strategy", server_state: Any, params) -> Any:
+    """``server_state`` with the innermost strategy's params replaced,
+    through any wrapper nesting (CompressingStrategy, QuarantiningStrategy,
+    ... — wrappers expose ``.inner`` on both the strategy and its state).
+    The direct ``state.replace(params=...)`` only works on unwrapped
+    states; every params-installation path (checkpoint import, evaluate
+    server hydration) must go through this instead."""
+    if hasattr(strategy, "inner") and hasattr(server_state, "inner"):
+        return server_state.replace(inner=replace_global_params(
+            strategy.inner, server_state.inner, params
+        ))
+    return server_state.replace(params=params)
+
+
 class Strategy:
     """Base protocol. Subclasses override any of the four methods.
 

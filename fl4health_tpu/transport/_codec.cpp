@@ -26,6 +26,15 @@
 //                     u32* header_off, u32* header_len,
 //                     u64* payload_off, u64* payload_len, u16* flags)
 //     returns 0 ok; -1 short; -2 bad magic; -3 bad version; -4 bad crc
+//   i64  fl4h_pack_nibbles(const i8* vals, u64 n, u8* out, u64 out_cap)
+//     packs signed int4 values (each in [-8, 7]) two per byte (low nibble
+//     first); returns packed byte count or -1 on short buffer
+//   i64  fl4h_unpack_nibbles(const u8* packed, u64 n_vals,
+//                            i8* out, u64 out_cap)
+//     inverse (sign-extends each nibble); returns n_vals or -1
+// The nibble helpers are the hot byte loop of the compressed int4 wire
+// frames (codec.py encode_compressed) — the Python twin matches them
+// byte-for-byte (tests/transport/test_native.py).
 
 #include <cstdint>
 #include <cstring>
@@ -107,6 +116,29 @@ int64_t fl4h_unframe(const uint8_t* buf, uint64_t len,
     *payload_len = plen;
     *flags = fl;
     return 0;
+}
+
+int64_t fl4h_pack_nibbles(const int8_t* vals, uint64_t n,
+                          uint8_t* out, uint64_t out_cap) {
+    uint64_t packed = (n + 1) / 2;
+    if (out_cap < packed) return -1;
+    for (uint64_t i = 0; i < packed; i++) {
+        uint8_t lo = (uint8_t)(vals[2 * i]) & 0xF;
+        uint8_t hi = (2 * i + 1 < n) ? ((uint8_t)(vals[2 * i + 1]) & 0xF) : 0;
+        out[i] = (uint8_t)(lo | (hi << 4));
+    }
+    return (int64_t)packed;
+}
+
+int64_t fl4h_unpack_nibbles(const uint8_t* packed, uint64_t n_vals,
+                            int8_t* out, uint64_t out_cap) {
+    if (out_cap < n_vals) return -1;
+    for (uint64_t i = 0; i < n_vals; i++) {
+        uint8_t nib = (i & 1) ? (packed[i / 2] >> 4) : (packed[i / 2] & 0xF);
+        // sign-extend the 4-bit two's-complement value
+        out[i] = (int8_t)((nib ^ 0x8) - 0x8);
+    }
+    return (int64_t)n_vals;
 }
 
 }  // extern "C"

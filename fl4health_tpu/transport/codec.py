@@ -16,25 +16,34 @@ Design:
   0/1-mask encoding used on-device (exchange/packer.py SparseMaskPacket)
   converts at this host boundary, reproducing the reference's
   SparseCooParameterPacker wire compactness (parameter_packer.py:94,124);
+- compressed updates cross as COMPRESSED frames (flag bit 1): per leaf an
+  optional gap-uint16 index sidecar (global magnitude top-k), int8/int4
+  quantized values with one f32 scale per leaf (packed nibbles for int4),
+  CRC-checked by the same framing — the byte realization of the in-graph
+  lossy channel (fl4health_tpu/compression/), arXiv:1610.05492;
 - ``decode(data, like=template)`` restores the EXACT pytree structure
   (flax struct dataclasses included) by unflattening into the template's
-  treedef; without a template the result is nested dicts.
+  treedef; a path set that does not match the template raises naming the
+  first mismatched path; without a template the result is nested dicts.
 """
 
 from __future__ import annotations
 
 import json
+import math
 from typing import Any
 
 import jax
 import numpy as np
 
+from fl4health_tpu.compression.config import QUANT_LEVELS, CompressionConfig
 from fl4health_tpu.core.types import PyTree
 from fl4health_tpu.exchange.packer import SparseMaskPacket
 from fl4health_tpu.observability.registry import get_registry
-from fl4health_tpu.transport.native import get_framing
+from fl4health_tpu.transport.native import get_framing, pack_int4, unpack_int4
 
 FLAG_COO = 1
+FLAG_COMPRESSED = 2
 
 
 def _account(direction: str, nbytes: int, kind: str) -> None:
@@ -60,6 +69,38 @@ def _paths_and_leaves(tree: PyTree) -> list[tuple[str, np.ndarray]]:
         dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
         out.append((dotted, np.asarray(leaf)))
     return out
+
+
+def _match_template_paths(
+    payload_paths: list[str], like: PyTree, what: str
+) -> "tuple[list[str], Any]":
+    """Template leaf paths + treedef, validated against the payload's paths.
+
+    A mismatch raises naming the FIRST mismatched path (template leaf the
+    payload lacks, else payload leaf the template lacks) — previously a
+    missing leaf surfaced as whatever zip/KeyError misalignment produced."""
+    flat_t, treedef = jax.tree_util.tree_flatten_with_path(like)
+    template_paths = [
+        ".".join(str(getattr(k, "key", k)) for k in key_path)
+        for key_path, _ in flat_t
+    ]
+    have = set(payload_paths)
+    for p in template_paths:
+        if p not in have:
+            raise ValueError(
+                f"{what}: payload is missing leaf {p!r} required by the "
+                f"decode template ({len(payload_paths)} payload leaves vs "
+                f"{len(template_paths)} template leaves)"
+            )
+    want = set(template_paths)
+    for p in payload_paths:
+        if p not in want:
+            raise ValueError(
+                f"{what}: payload leaf {p!r} does not exist in the decode "
+                f"template ({len(payload_paths)} payload leaves vs "
+                f"{len(template_paths)} template leaves)"
+            )
+    return template_paths, treedef
 
 
 def encode(tree: PyTree) -> bytes:
@@ -98,6 +139,8 @@ def decode(data: bytes, like: PyTree | None = None) -> PyTree:
     meta = json.loads(header.decode("utf-8"))
     if flags & FLAG_COO:
         raise ValueError("COO frame: use decode_sparse()")
+    if flags & FLAG_COMPRESSED:
+        raise ValueError("compressed frame: use decode_compressed()")
     _account("decoded", len(data), "dense")
     items: list[tuple[str, np.ndarray]] = []
     off = 0
@@ -110,15 +153,13 @@ def decode(data: bytes, like: PyTree | None = None) -> PyTree:
         off += nbytes
     if like is None:
         return _rebuild_nested(items)
-    flat_t, treedef = jax.tree_util.tree_flatten_with_path(like)
     by_path = dict(items)
-    leaves = []
-    for key_path, template_leaf in flat_t:
-        dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
-        if dotted not in by_path:
-            raise ValueError(f"wire frame missing leaf {dotted!r}")
-        leaves.append(by_path[dotted])
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+    template_paths, treedef = _match_template_paths(
+        [p for p, _ in items], like, "dense wire frame"
+    )
+    return jax.tree_util.tree_unflatten(
+        treedef, [by_path[p] for p in template_paths]
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -178,14 +219,269 @@ def decode_sparse(data: bytes, like: SparseMaskPacket | None = None) -> SparseMa
         return SparseMaskPacket(
             params=_rebuild_nested(items), element_mask=_rebuild_nested(mask_items)
         )
-    flat_t, treedef = jax.tree_util.tree_flatten_with_path(like.params)
     by_path, by_path_m = dict(items), dict(mask_items)
-    leaves, mask_leaves = [], []
-    for key_path, _ in flat_t:
-        dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
-        leaves.append(by_path[dotted])
-        mask_leaves.append(by_path_m[dotted])
+    template_paths, treedef = _match_template_paths(
+        [p for p, _ in items], like.params, "COO wire frame"
+    )
     return SparseMaskPacket(
-        params=jax.tree_util.tree_unflatten(treedef, leaves),
-        element_mask=jax.tree_util.tree_unflatten(treedef, mask_leaves),
+        params=jax.tree_util.tree_unflatten(
+            treedef, [by_path[p] for p in template_paths]
+        ),
+        element_mask=jax.tree_util.tree_unflatten(
+            treedef, [by_path_m[p] for p in template_paths]
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Compressed boundary (top-k + int8/int4 quantized frames)
+# ---------------------------------------------------------------------------
+
+def account_wire(logical: int, wire: int, direction: str) -> None:
+    """fl_wire_* accounting for the compressed exchange: logical (dense)
+    vs actual wire bytes, plus the live compression-ratio gauge. Shared by
+    the real compressed frames here (direction encoded/decoded) and the
+    simulation's per-round estimate (direction gather) so the metric
+    family has ONE definition."""
+    reg = get_registry()
+    reg.counter(
+        "fl_wire_bytes_logical_total",
+        help="dense byte footprint of trees crossing the compressed codec",
+        labels={"direction": direction},
+    ).inc(logical)
+    reg.counter(
+        "fl_wire_bytes_compressed_total",
+        help="actual wire bytes of compressed frames",
+        labels={"direction": direction},
+    ).inc(wire)
+    if wire > 0:
+        # labeled like the counters: real frames (encoded/decoded, full
+        # frame length) and the simulation's payload-only estimate
+        # (gather) are different definitions — last-writer-wins on one
+        # unlabeled gauge would let the optimistic estimate masquerade as
+        # a measured frame ratio
+        reg.gauge(
+            "fl_wire_compression_ratio",
+            help="logical/wire byte ratio of the last compressed exchange",
+            labels={"direction": direction},
+        ).set(logical / wire)
+
+
+def _encode_gaps(idx: np.ndarray) -> np.ndarray:
+    """Sorted flat indices -> uint16 gap tokens. A token of 0xFFFF is an
+    ESCAPE meaning "add 65535 and keep reading"; every real gap token is
+    < 0xFFFF, so the stream is unambiguous at any density."""
+    idx = np.asarray(idx, np.int64)
+    gaps = np.empty_like(idx)
+    if idx.size:
+        gaps[0] = idx[0]
+        gaps[1:] = np.diff(idx)
+    esc = gaps // 0xFFFF
+    rem = (gaps % 0xFFFF).astype(np.uint16)
+    total = int(esc.sum()) + idx.size
+    tokens = np.full(total, 0xFFFF, np.uint16)
+    tokens[np.cumsum(esc + 1) - 1] = rem
+    return tokens
+
+
+def _decode_gaps(tokens: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`_encode_gaps` (indices at the non-escape tokens
+    of the running sum)."""
+    t = np.asarray(tokens, np.int64)
+    return np.cumsum(t)[t != 0xFFFF]
+
+
+def _global_topk_indices(abs_concat: np.ndarray, k: int) -> np.ndarray:
+    """Exact global top-k with the in-graph tie rule (largest magnitude,
+    ties broken by LOWEST flat index — jax.lax.top_k semantics, which
+    also sorts NaN past every finite value: a poisoned coordinate is
+    SELECTED, so the frame carries the poison visibly instead of
+    laundering it to zeros)."""
+    n = abs_concat.size
+    k = max(1, min(int(k), n))
+    a = np.where(np.isfinite(abs_concat), abs_concat, np.inf)
+    part = np.argpartition(-a, k - 1)[:k]
+    kth = a[part].min()
+    if np.isinf(kth):
+        # >= k non-finite coordinates: lax.top_k ranks NaN above Inf,
+        # each group by ascending index (verified empirically) — mirror
+        # that exactly so both channels poison the same coordinates
+        nan_idx = np.nonzero(np.isnan(abs_concat))[0]
+        inf_idx = np.nonzero(np.isinf(abs_concat))[0]
+        return np.sort(
+            np.concatenate([nan_idx, inf_idx])[:k].astype(np.int64)
+        )
+    # Everything strictly above the kth magnitude is selected (< k entries
+    # by construction); the kth-level plateau fills the remainder by
+    # LOWEST index (np.nonzero is already ascending). O(n) with no sort
+    # over value ties — a dense plateau (quantized grids, zero tails)
+    # costs nothing extra.
+    greater = np.nonzero(a > kth)[0]
+    ties = np.nonzero(a == kth)[0]
+    cand = np.concatenate([greater, ties[: k - greater.size]])
+    return np.sort(cand.astype(np.int64))
+
+
+def compressed_frame_kind(config: CompressionConfig) -> str:
+    """Frame-kind label for the byte counters (``topk+int8``-style)."""
+    parts = []
+    if config.topk_fraction is not None:
+        parts.append("topk")
+    if config.quant_bits is not None:
+        parts.append(f"int{config.quant_bits}")
+    return "+".join(parts) if parts else "dense"
+
+
+def encode_compressed(tree: PyTree, config: CompressionConfig) -> bytes:
+    """Dense pytree -> one COMPRESSED wire frame under ``config``.
+
+    The byte realization of the in-graph channel: global magnitude top-k
+    (same tie rule, non-finite coordinates selected first so poison stays
+    visible), per-leaf f32 scales, int8 bytes / packed int4 nibbles,
+    gap-uint16 index sidecars — all CRC-checked by the shared framing.
+    Quantization here is DETERMINISTIC round-to-nearest with the scale
+    re-derived from the serialized values (max|v|/L): one round trip is
+    bounded by half a grid step, and the codec is IDEMPOTENT — a decoded
+    frame re-encodes bit-stably, and values whose max magnitude attains
+    the grid's top level (fresh in-graph quantization of the same leaf)
+    round-trip exactly. The stochastic draw belongs to the client-side
+    in-graph transform, not the serializer. ``rotation`` is an in-graph
+    preconditioner and does not change the byte format (serializing an
+    unrotated reconstruction adds this codec's own bounded quantization
+    step on top — see docs/module_guides/compression.md)."""
+    entries = _paths_and_leaves(tree)
+    logical = sum(a.nbytes for _, a in entries)
+    flats = [np.asarray(a, np.float32).ravel() for _, a in entries]
+    sizes = [f.size for f in flats]
+    n_total = int(sum(sizes))
+
+    leaf_idx: list[np.ndarray | None]
+    if config.topk_fraction is not None and n_total:
+        from fl4health_tpu.compression.codecs import topk_count
+
+        sel = _global_topk_indices(
+            np.abs(np.concatenate(flats)) if flats else np.zeros((0,)),
+            topk_count(n_total, config.topk_fraction),
+        )
+        leaf_idx = []
+        off = 0
+        for n in sizes:
+            local = sel[(sel >= off) & (sel < off + n)] - off
+            leaf_idx.append(local.astype(np.int64))
+            off += n
+    else:
+        leaf_idx = [None] * len(flats)
+
+    meta, chunks = [], []
+    for (path, arr), flat, idx in zip(entries, flats, leaf_idx):
+        values = flat if idx is None else flat[idx]
+        entry: dict[str, Any] = {
+            "path": path,
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+        if idx is not None:
+            tokens = _encode_gaps(idx)
+            entry["nnz"] = int(idx.size)
+            entry["idx_tokens"] = int(tokens.size)
+            chunks.append(tokens.astype("<u2").tobytes())
+        if config.quant_bits is not None:
+            L = QUANT_LEVELS[config.quant_bits]
+            vmax = float(np.max(np.abs(values))) if values.size else 0.0
+            entry["bits"] = config.quant_bits
+            if not np.isfinite(vmax):
+                # poisoned leaf: a NaN scale makes every selected value
+                # decode as NaN — the poison stays visible on the far side
+                # (int8 has no NaN, so it rides in the scale sidecar)
+                entry["scale"] = float("nan")
+                q = np.zeros(values.shape, np.int8)
+            else:
+                scale = np.float32(vmax / L)
+                entry["scale"] = float(scale)
+                q = (np.rint(values / scale) if scale > 0
+                     else np.zeros_like(values)).clip(-L, L).astype(np.int8)
+            chunks.append(pack_int4(q) if config.quant_bits == 4
+                          else q.tobytes())
+        else:
+            chunks.append(values.astype("<f4").tobytes())
+        meta.append(entry)
+    header = json.dumps({"comp": meta}).encode("utf-8")
+    frame = get_framing().frame(
+        header, b"".join(chunks), flags=FLAG_COMPRESSED
+    )
+    _account("encoded", len(frame), compressed_frame_kind(config))
+    account_wire(logical, len(frame), "encoded")
+    return frame
+
+
+def decode_compressed(data: bytes, like: PyTree | None = None) -> PyTree:
+    """COMPRESSED wire frame -> dense pytree (unselected coordinates are
+    zero; values dequantized by the per-leaf scale, cast to the encoded
+    dtype). With ``like``, leaves unflatten into the template's treedef —
+    a path mismatch raises naming the first mismatched path."""
+    header, payload, flags = get_framing().unframe(data)
+    if not flags & FLAG_COMPRESSED:
+        raise ValueError("not a compressed frame: use decode()/decode_sparse()")
+    meta = json.loads(header.decode("utf-8"))
+    logical = 0
+    items: list[tuple[str, np.ndarray]] = []
+    off = 0
+    for entry in meta["comp"]:
+        dt = np.dtype(entry["dtype"])
+        n = int(np.prod(entry["shape"], dtype=np.int64)) if entry["shape"] else 1
+        logical += n * dt.itemsize
+        idx = None
+        nnz = n
+        if "nnz" in entry:
+            nnz = int(entry["nnz"])
+            tok_n = int(entry["idx_tokens"])
+            tokens = np.frombuffer(payload, "<u2", count=tok_n, offset=off)
+            off += 2 * tok_n
+            idx = _decode_gaps(tokens)
+            if idx.size != nnz or (idx.size and int(idx[-1]) >= n):
+                raise ValueError(
+                    f"compressed frame: corrupt index sidecar for leaf "
+                    f"{entry['path']!r}"
+                )
+        bits = entry.get("bits")
+        if bits == 4:
+            packed_len = math.ceil(nnz / 2)
+            values = unpack_int4(
+                payload[off: off + packed_len], nnz
+            ).astype(np.float32)
+            off += packed_len
+        elif bits == 8:
+            values = np.frombuffer(
+                payload, np.int8, count=nnz, offset=off
+            ).astype(np.float32)
+            off += nnz
+        else:
+            values = np.frombuffer(
+                payload, "<f4", count=nnz, offset=off
+            ).astype(np.float32)
+            off += 4 * nnz
+        if bits is not None:
+            values = values * np.float32(entry["scale"])
+        dense = np.zeros((n,), np.float32)
+        if idx is None:
+            dense[:] = values
+        else:
+            dense[idx] = values
+        if np.issubdtype(dt, np.integer):
+            # round, don't truncate: astype's toward-zero cast would bias
+            # dequantized integer leaves (e.g. -2.976 -> -2, not -3)
+            dense = np.rint(dense)
+        items.append(
+            (entry["path"], dense.reshape(entry["shape"]).astype(dt))
+        )
+    _account("decoded", len(data), "compressed")
+    account_wire(logical, len(data), "decoded")
+    if like is None:
+        return _rebuild_nested(items)
+    by_path = dict(items)
+    template_paths, treedef = _match_template_paths(
+        [p for p, _ in items], like, "compressed wire frame"
+    )
+    return jax.tree_util.tree_unflatten(
+        treedef, [by_path[p] for p in template_paths]
     )

@@ -59,6 +59,22 @@ def _compile_native() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint16),
     ]
+    # nibble helpers are newer than the framing ABI: a stale cached .so
+    # (rebuilt lazily off mtime) may not export them — fall back per-symbol
+    try:
+        lib.fl4h_pack_nibbles.restype = ctypes.c_int64
+        lib.fl4h_pack_nibbles.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.fl4h_unpack_nibbles.restype = ctypes.c_int64
+        lib.fl4h_unpack_nibbles.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+    except AttributeError:
+        logger.info("native codec lacks nibble helpers; int4 packing uses "
+                    "the NumPy fallback")
     return lib
 
 
@@ -151,3 +167,61 @@ class PyFraming:
 def get_framing():
     lib = get_native()
     return NativeFraming(lib) if lib is not None else PyFraming()
+
+
+# ---------------------------------------------------------------------------
+# int4 nibble packing (compressed wire frames, codec.py encode_compressed)
+# ---------------------------------------------------------------------------
+
+def _pack_int4_py(vals) -> bytes:
+    import numpy as np
+
+    u = np.asarray(vals, np.int8).view(np.uint8) & 0xF
+    if u.size % 2:
+        u = np.concatenate([u, np.zeros((1,), np.uint8)])
+    return (u[0::2] | (u[1::2] << 4)).astype(np.uint8).tobytes()
+
+
+def _unpack_int4_py(packed: bytes, n: int):
+    import numpy as np
+
+    b = np.frombuffer(packed, np.uint8)
+    out = np.empty(2 * b.size, np.int16)
+    out[0::2] = b & 0xF
+    out[1::2] = b >> 4
+    return (((out[:n] ^ 0x8) - 0x8)).astype(np.int8)
+
+
+def pack_int4(vals) -> bytes:
+    """Pack signed int4 values (int8 array, each in [-8, 7]) two per byte,
+    low nibble first — native C++ when available, NumPy twin otherwise
+    (byte-identical; tests/transport/test_native.py pins the parity)."""
+    import numpy as np
+
+    v = np.ascontiguousarray(vals, np.int8)
+    lib = get_native()
+    if lib is None or not hasattr(lib, "fl4h_pack_nibbles"):
+        return _pack_int4_py(v)
+    out = ctypes.create_string_buffer((v.size + 1) // 2)
+    n = lib.fl4h_pack_nibbles(v.tobytes(), v.size, out, len(out))
+    if n < 0:
+        raise FrameError("int4 pack buffer sizing failed")
+    return out.raw[:n]
+
+
+def unpack_int4(packed: bytes, n: int):
+    """Inverse of :func:`pack_int4`: ``n`` sign-extended int8 values."""
+    import numpy as np
+
+    if len(packed) < (n + 1) // 2:
+        raise FrameError(
+            f"int4 payload too short: {len(packed)} bytes for {n} values"
+        )
+    lib = get_native()
+    if lib is None or not hasattr(lib, "fl4h_unpack_nibbles"):
+        return _unpack_int4_py(packed, n)
+    out = ctypes.create_string_buffer(max(n, 1))
+    rc = lib.fl4h_unpack_nibbles(packed, n, out, len(out))
+    if rc < 0:
+        raise FrameError("int4 unpack buffer sizing failed")
+    return np.frombuffer(out.raw[:n], np.int8).copy()

@@ -1,0 +1,347 @@
+"""CompressingStrategy + FederatedSimulation wiring: compression off is
+bit-identical, compressed trajectories agree across execution modes, the
+wrapper composes with robust/quarantining/SCAFFOLD strategies, and the
+channel is pure post-processing of the submitted packets (the DP
+composition check)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.compression import (
+    CompressedExchangeState,
+    CompressingStrategy,
+    CompressionConfig,
+)
+from fl4health_tpu.compression.codecs import compress_update
+from fl4health_tpu.exchange.exchanger import SparseExchanger
+from fl4health_tpu.exchange.packer import ControlVariatesPacket, SparseMaskPacket
+from fl4health_tpu.resilience import QuarantiningStrategy, RobustFedAvg
+from fl4health_tpu.strategies.base import FitResults
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.scaffold import Scaffold
+
+from tests.compression.conftest import N_CLIENTS, make_sim
+
+CFG = CompressionConfig(topk_fraction=0.25, quant_bits=8)
+
+
+class TestOffBitIdentity:
+    def test_no_compression_is_bit_identical_to_baseline(self):
+        """THE off-pin: compression=None == pre-PR trajectories, both
+        execution modes."""
+        for mode in ("pipelined", "chunked"):
+            base = make_sim(execution_mode=mode).fit(3)
+            off = make_sim(execution_mode=mode, compression=None).fit(3)
+            assert ([r.fit_losses["backward"] for r in base]
+                    == [r.fit_losses["backward"] for r in off]), mode
+
+    def test_disabled_config_raises_instead_of_identity_wrap(self):
+        with pytest.raises(ValueError, match="no lossy stage"):
+            CompressingStrategy(FedAvg(), CompressionConfig(), n_clients=4)
+
+
+class TestModeParity:
+    def test_compressed_chunked_matches_pipelined_bitwise(self):
+        losses = {}
+        for mode in ("pipelined", "chunked"):
+            hist = make_sim(execution_mode=mode, compression=CFG).fit(4)
+            losses[mode] = [r.fit_losses["backward"] for r in hist]
+        assert losses["pipelined"] == losses["chunked"]
+
+    def test_compression_actually_changes_the_trajectory(self):
+        base = [r.fit_losses["backward"] for r in make_sim().fit(3)]
+        comp = [r.fit_losses["backward"]
+                for r in make_sim(compression=CFG).fit(3)]
+        assert base != comp
+
+    def test_int8_trajectory_stays_close_to_dense(self):
+        base = [r.fit_losses["backward"] for r in make_sim().fit(5)]
+        comp = [r.fit_losses["backward"] for r in make_sim(
+            compression=CompressionConfig(quant_bits=8)).fit(5)]
+        assert abs(comp[-1] - base[-1]) < 0.05 * max(abs(base[-1]), 1e-6) + 0.02
+
+
+class TestComposition:
+    def test_with_robust_and_quarantining_inner(self):
+        strat = QuarantiningStrategy(RobustFedAvg("trimmed_mean"))
+        hist = make_sim(strategy=strat, compression=CFG).fit(3)
+        losses = [r.fit_losses["backward"] for r in hist]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_quarantine_mask_passthrough(self):
+        sim = make_sim(
+            strategy=QuarantiningStrategy(FedAvg()), compression=CFG
+        )
+        q = sim.strategy.quarantine_mask(sim.server_state)
+        np.testing.assert_array_equal(np.asarray(q), 0.0)
+
+    def test_scaffold_control_variates_are_compressed(self):
+        C = 4
+        params = {"w": jnp.zeros((6,))}
+        r = np.random.default_rng(0)
+        stack = {"w": jnp.asarray(r.normal(size=(C, 6)).astype(np.float32))}
+        pk = ControlVariatesPacket(
+            params=stack,
+            control_variates=jax.tree_util.tree_map(lambda x: 0.1 * x, stack),
+        )
+        s = CompressingStrategy(
+            Scaffold(), CompressionConfig(topk_fraction=0.5), n_clients=C
+        )
+        st = s.init(params)
+        res = FitResults(
+            packets=pk, sample_counts=jnp.ones((C,)),
+            train_losses={"backward": jnp.ones((C,))}, train_metrics={},
+            mask=jnp.ones((C,)),
+        )
+        st2 = jax.jit(s.aggregate)(st, res, jnp.asarray(1, jnp.int32))
+        assert np.isfinite(
+            np.asarray(s.global_params(st2)["w"])
+        ).all()
+
+    def test_masked_packet_layouts_rejected(self):
+        C = 4
+        stack = {"w": jnp.ones((C, 6))}
+        pk = SparseMaskPacket(params=stack, element_mask=stack)
+        s = CompressingStrategy(
+            FedAvg(), CompressionConfig(quant_bits=8), n_clients=C
+        )
+        st = s.init({"w": jnp.zeros((6,))})
+        res = FitResults(
+            packets=pk, sample_counts=jnp.ones((C,)),
+            train_losses={"backward": jnp.ones((C,))}, train_metrics={},
+            mask=jnp.ones((C,)),
+        )
+        with pytest.raises(ValueError, match="masked partial exchange"):
+            s.aggregate(st, res, jnp.asarray(1, jnp.int32))
+
+    def test_simulation_rejects_partial_exchangers(self):
+        with pytest.raises(ValueError, match="full-model exchange"):
+            make_sim(compression=CFG, exchanger=SparseExchanger())
+
+
+class TestChannelSemantics:
+    """The DP composition check (documented in
+    docs/module_guides/compression.md): compression is strictly packet
+    post-processing — aggregate consumes exactly
+    ``reference + decode(encode(packet - reference))``, so a DP mechanism
+    that ran inside local training is untouched (post-processing
+    invariance; sigma unchanged)."""
+
+    def test_aggregate_equals_inner_aggregate_of_channel_output(self):
+        C = N_CLIENTS
+        params = {"w": jnp.asarray(np.linspace(0, 1, 6).astype(np.float32))}
+        r = np.random.default_rng(1)
+        stack = {"w": jnp.asarray(r.normal(size=(C, 6)).astype(np.float32))}
+        cfg = CompressionConfig(topk_fraction=0.5, quant_bits=8, seed=3)
+        s = CompressingStrategy(FedAvg(), cfg, n_clients=C)
+        st = s.init(params)
+        mask = jnp.ones((C,))
+        res = FitResults(
+            packets=stack, sample_counts=jnp.ones((C,)),
+            train_losses={"backward": jnp.ones((C,))}, train_metrics={},
+            mask=mask,
+        )
+        round_idx = jnp.asarray(2, jnp.int32)
+        st2 = s.aggregate(st, res, round_idx)
+
+        # reconstruct the channel by hand: same keys, same reference
+        round_key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.seed), round_idx
+        )
+        lossy_rows = []
+        for i in range(C):
+            update = {"w": stack["w"][i] - params["w"]}
+            residual_i = jax.tree_util.tree_map(
+                lambda x: x[i], st.residual
+            )
+            dec, _ = compress_update(
+                update, residual_i, jax.random.fold_in(round_key, i), cfg
+            )
+            lossy_rows.append(params["w"] + dec["w"])
+        expected = FedAvg().aggregate(
+            FedAvg().init(params),
+            res.replace(packets={"w": jnp.stack(lossy_rows)}),
+            round_idx,
+        )
+        np.testing.assert_allclose(
+            np.asarray(s.global_params(st2)["w"]),
+            np.asarray(expected.params["w"]),
+            atol=1e-6,
+        )
+
+    def test_residual_updates_only_for_masked_in_clients(self):
+        C = 4
+        params = {"w": jnp.zeros((4,))}
+        stack = {"w": jnp.ones((C, 4))}
+        s = CompressingStrategy(
+            FedAvg(), CompressionConfig(topk_fraction=0.25), n_clients=C
+        )
+        st = s.init(params)
+        mask = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+        res = FitResults(
+            packets=stack, sample_counts=jnp.ones((C,)),
+            train_losses={"backward": jnp.ones((C,))}, train_metrics={},
+            mask=mask,
+        )
+        st2 = s.aggregate(st, res, jnp.asarray(1, jnp.int32))
+        r = np.asarray(st2.residual["w"])
+        assert (r[1] == 0).all() and (r[3] == 0).all()  # unsampled: untouched
+        assert (r[0] != 0).any()  # sampled: unsent mass accumulated
+
+    def test_state_is_wrapper_state(self):
+        sim = make_sim(compression=CFG)
+        assert isinstance(sim.server_state, CompressedExchangeState)
+        # residual is [C]-stacked like params
+        leaf = jax.tree_util.tree_leaves(sim.server_state.residual)[0]
+        assert leaf.shape[0] == N_CLIENTS
+
+    def test_dp_clip_fraction_telemetry_untouched_by_compression(self):
+        """Enabling compression must not reach into local training: the
+        packets the channel consumes already carry the DP-noised update.
+        Proxy check without a heavy DP run: local train outputs (per-round
+        per-client FIT losses) are identical with and without compression
+        in round 1 (the first broadcast is identical; only aggregation —
+        strictly after the packet exists — differs)."""
+        base = make_sim().fit(1)
+        comp = make_sim(compression=CFG).fit(1)
+        assert base[0].fit_losses["backward"] == comp[0].fit_losses["backward"]
+
+
+def test_simulation_rejects_duck_typed_compression_config():
+    """Review regression pin: a non-CompressionConfig compression argument
+    must raise, not silently train uncompressed."""
+    with pytest.raises(TypeError, match="CompressionConfig"):
+        make_sim(compression={"topk_fraction": 0.1, "quant_bits": 8})
+
+
+def test_integer_reference_leaves_round_not_truncate():
+    """Review regression pin: reconstructing reference + decoded delta for
+    an integer param leaf must round (astype alone truncates toward zero)."""
+    C = 2
+    params = {"q": jnp.arange(-4, 4, dtype=jnp.int32)}
+    # identical packets: with a lossless-enough channel the aggregate must
+    # reproduce them exactly, not a toward-zero-biased copy
+    stack = {"q": jnp.stack([params["q"] + 3] * C)}
+    s = CompressingStrategy(
+        FedAvg(), CompressionConfig(quant_bits=8, error_feedback=False),
+        n_clients=C,
+    )
+    st = s.init(params)
+    res = FitResults(
+        packets=stack, sample_counts=jnp.ones((C,)),
+        train_losses={"backward": jnp.ones((C,))}, train_metrics={},
+        mask=jnp.ones((C,)),
+    )
+    st2 = s.aggregate(st, res, jnp.asarray(1, jnp.int32))
+    out = np.asarray(s.global_params(st2)["q"])
+    assert out.dtype == np.int32
+    # stochastic int8 over a delta of constant 3: every reconstruction is
+    # within one grid step and must ROUND to the nearest int, landing
+    # within 1 of the true value with no systematic toward-zero collapse
+    np.testing.assert_allclose(out, np.asarray(params["q"]) + 3, atol=1)
+
+
+def test_scaffold_server_composes_with_compression():
+    """Review regression pin: the advertised SCAFFOLD composition must
+    survive the server wrapper — ScaffoldServer sees through the
+    CompressingStrategy wrap, warm start rolls wrapper bookkeeping back
+    and keeps the warmed variates, and training proceeds finite."""
+    import optax
+
+    from fl4health_tpu.clients import engine as eng
+    from fl4health_tpu.clients.scaffold import ScaffoldClientLogic
+    from fl4health_tpu.metrics.base import MetricManager
+    from fl4health_tpu.server.servers import ScaffoldServer
+    from fl4health_tpu.server.simulation import FederatedSimulation
+
+    from tests.compression.conftest import TinyNet, _dataset
+
+    logic = ScaffoldClientLogic(
+        eng.from_flax(TinyNet()), eng.masked_cross_entropy,
+        learning_rate=0.05,
+    )
+    sim = FederatedSimulation(
+        logic=logic, tx=optax.sgd(0.05), strategy=Scaffold(),
+        datasets=[_dataset(i) for i in range(4)], batch_size=8,
+        metrics=MetricManager(()), local_epochs=1, seed=2,
+        compression=CompressionConfig(quant_bits=8),
+    )
+    pre = np.asarray(
+        jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    )
+    server = ScaffoldServer(sim, warm_start=True)
+    from fl4health_tpu.server.servers import scaffold_warm_start  # noqa: F401
+    hist = server.fit(2)
+    assert len(hist) == 2
+    assert np.isfinite(hist[-1].fit_losses["backward"])
+    # wrapper state intact after warm start + rounds
+    assert isinstance(sim.server_state, CompressedExchangeState)
+    # variates warmed somewhere along the way
+    cv = np.asarray(jax.flatten_util.ravel_pytree(
+        sim.server_state.inner.control_variates)[0])
+    assert np.isfinite(cv).all()
+    assert pre.shape == np.asarray(
+        jax.flatten_util.ravel_pytree(sim.global_params)[0]).shape
+
+
+def test_evaluate_server_sets_params_through_wrappers():
+    from fl4health_tpu.server.servers import EvaluateServer
+
+    sim = make_sim(compression=CFG)
+    new_params = jax.tree_util.tree_map(
+        lambda x: x * 0.0, sim.global_params
+    )
+    srv = EvaluateServer(sim, params=new_params)
+    out = srv.fit()
+    assert np.isfinite(out["eval_losses"]["checkpoint"]) if isinstance(
+        out, dict) else True
+    flat = np.asarray(
+        jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    )
+    np.testing.assert_array_equal(flat, 0.0)
+
+
+def test_empty_leaf_in_update_tree_is_safe():
+    """Review regression pin: a zero-size leaf must not crash the traced
+    quantizer (jnp.max has no identity on empty arrays)."""
+    from fl4health_tpu.compression.codecs import compress_update
+
+    tree = {"w": jnp.ones((4,)), "empty": jnp.zeros((0,))}
+    res = jax.tree_util.tree_map(jnp.zeros_like, tree)
+    for cfg in (CompressionConfig(quant_bits=8),
+                CompressionConfig(topk_fraction=0.5, quant_bits=4)):
+        dec, new_res = compress_update(
+            tree, res, jax.random.PRNGKey(0), cfg
+        )
+        assert np.asarray(dec["empty"]).shape == (0,)
+        np.testing.assert_allclose(
+            np.asarray(dec["w"]) + np.asarray(new_res["w"]),
+            np.asarray(tree["w"]), atol=1e-4,
+        )
+
+
+def test_fixed_layer_exchangers_rejected_under_compression():
+    """Review regression pin: FixedLayerExchanger (FedBN) zeroes
+    non-exchanged leaves in push() — those would read as huge fake
+    -reference deltas through the channel, so the simulation must reject
+    it like the packet-shaped partial exchangers."""
+    from fl4health_tpu.exchange.exchanger import norm_exclusion_exchanger
+
+    with pytest.raises(ValueError, match="full-model exchange"):
+        make_sim(compression=CFG, exchanger=norm_exclusion_exchanger())
+
+
+def test_set_global_params_through_compression_wrapper():
+    """Review regression pin: the pretrained-checkpoint import path must
+    reach through CompressedExchangeState instead of TypeError-ing."""
+    sim = make_sim(compression=CFG)
+    zeros = jax.tree_util.tree_map(lambda x: x * 0.0, sim.global_params)
+    sim.set_global_params(zeros)
+    flat = np.asarray(
+        jax.flatten_util.ravel_pytree(sim.global_params)[0]
+    )
+    np.testing.assert_array_equal(flat, 0.0)
+    assert isinstance(sim.server_state, CompressedExchangeState)

@@ -220,3 +220,87 @@ def test_dynamic_exchange_topk_shares_selected_leaves():
     np.testing.assert_allclose(
         np.asarray(flat[0]), np.asarray(flat[1]), atol=1e-6
     )
+
+
+class TestSelectionDeterminism:
+    """Pinned determinism + tie-breaking of the top-k score selections
+    (the compressed-exchange PR satellite): the same params must produce
+    the same mask across repeated calls, eager vs jit (the two "backends"
+    a CPU box can exercise — the tie rule itself is jax.lax.top_k's
+    lowest-index contract on every backend), and under exact score ties."""
+
+    @staticmethod
+    def _drifted(seed=0):
+        r = np.random.default_rng(seed)
+        initial = {
+            "a": jnp.zeros((4, 4)),
+            "b": jnp.zeros((7,)),
+            "c": jnp.zeros((3, 3)),
+        }
+        moved = jax.tree_util.tree_map(
+            lambda x: x + jnp.asarray(
+                r.normal(size=x.shape).astype(np.float32)
+            ),
+            initial,
+        )
+        return moved, initial
+
+    def test_sparse_exchanger_same_mask_across_calls_and_jit(self):
+        moved, initial = self._drifted(1)
+        e = ex.SparseExchanger(sparsity_level=0.25)
+        masks = [
+            np.asarray(
+                jax.flatten_util.ravel_pytree(
+                    e.push(moved, initial).element_mask
+                )[0]
+            )
+            for _ in range(3)
+        ]
+        jit_push = jax.jit(lambda p, i: e.push(p, i).element_mask)
+        masks.append(
+            np.asarray(jax.flatten_util.ravel_pytree(
+                jit_push(moved, initial))[0])
+        )
+        for m in masks[1:]:
+            np.testing.assert_array_equal(m, masks[0])
+        assert masks[0].sum() == round(0.25 * masks[0].size)
+
+    def test_sparse_exchanger_ties_break_by_lowest_index(self):
+        # all-equal scores: exact top-k must pick the FIRST k flat indices,
+        # deterministically (a >=threshold rule would select everything)
+        params = {"w": jnp.ones((10,))}
+        e = ex.SparseExchanger(sparsity_level=0.3)
+        for _ in range(3):
+            mask = np.asarray(e.push(params).element_mask["w"])
+            np.testing.assert_array_equal(np.nonzero(mask)[0], [0, 1, 2])
+
+    def test_dynamic_layer_topk_same_mask_across_calls_and_jit(self):
+        moved, initial = self._drifted(2)
+        e = ex.DynamicLayerExchanger(mode="topk", exchange_fraction=0.5)
+        flat_masks = []
+        for _ in range(3):
+            packet = e.push(moved, initial)
+            flat_masks.append(
+                np.asarray([float(v) for v in
+                            jax.tree_util.tree_leaves(packet.leaf_mask)])
+            )
+        jit_push = jax.jit(lambda p, i: e.push(p, i).leaf_mask)
+        flat_masks.append(
+            np.asarray([float(v) for v in
+                        jax.tree_util.tree_leaves(jit_push(moved, initial))])
+        )
+        for m in flat_masks[1:]:
+            np.testing.assert_array_equal(m, flat_masks[0])
+
+    def test_dynamic_layer_topk_ties_break_by_leaf_order(self):
+        # identical drift norms on every leaf: argsort(-scores) is stable,
+        # so the selected leaves are the FIRST ceil(f * n) in tree order
+        initial = {"a": jnp.zeros((2,)), "b": jnp.zeros((2,)),
+                   "c": jnp.zeros((2,))}
+        moved = jax.tree_util.tree_map(lambda x: x + 1.0, initial)
+        e = ex.DynamicLayerExchanger(mode="topk", exchange_fraction=0.4)
+        for _ in range(3):
+            packet = e.push(moved, initial)
+            sel = [k for k in ("a", "b", "c")
+                   if float(packet.leaf_mask[k]) == 1.0]
+            assert sel == ["a", "b"], sel

@@ -310,3 +310,53 @@ def test_cli_output_byte_stable_without_resilience_events(tmp_path):
         capture_output=True, text=True, check=True,
     ).stdout)
     assert "faults" not in doc and "quarantine" not in doc
+
+
+def test_wire_columns_render_when_fields_present(tmp_path):
+    rounds = [_round(1, gather_bytes_wire=512,
+                     wire_compression_ratio=13.1),
+              _round(2, gather_bytes_wire=512,
+                     wire_compression_ratio=13.0)]
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    assert "wire_bytes" in header and "wire_ratio" in header
+    assert "13.1x" in table and "512" in table
+    summary = perf_report.summarize(rounds)
+    assert summary["gather_bytes_wire"] == 1024
+
+
+def test_wire_fields_absent_keeps_legacy_table_byte_stable(tmp_path):
+    """Logs from uncompressed runs must render the EXACT pre-compression
+    output — header set, alignment and summary keys unchanged."""
+    rounds = [_round(1), _round(2)]
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    assert "wire_bytes" not in header and "wire_ratio" not in header
+    assert header == [h for h, _, _ in perf_report.COLUMNS]
+    assert "gather_bytes_wire" not in perf_report.summarize(rounds)
+
+
+def test_cli_output_byte_stable_without_wire_fields(tmp_path):
+    """End-to-end CLI: a legacy log renders identically whether or not the
+    wire columns exist in the tool (snapshot vs a hand-stripped module is
+    overkill — pin the absence of the new markers instead)."""
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "wire" not in out
+    assert "gather_bytes_wire" not in out
+
+
+def test_cli_json_includes_wire_fields_when_present(tmp_path):
+    path = _log(tmp_path, [_round(1, gather_bytes_wire=256,
+                                  wire_compression_ratio=8.5)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    doc = json.loads(out)
+    assert doc["summary"]["gather_bytes_wire"] == 256
+    assert doc["rounds"][0]["wire_compression_ratio"] == 8.5

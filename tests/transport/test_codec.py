@@ -192,3 +192,280 @@ class TestFramingFuzz:
         if lib is None:
             pytest.skip("no C++ toolchain available")
         self._fuzz(NativeFraming(lib))
+
+
+class TestDtypePreservation:
+    """Non-f32 leaves must round-trip with their dtype intact through the
+    dense codec — on BOTH framing implementations (the framing only moves
+    bytes, but the satellite pins it end-to-end)."""
+
+    @staticmethod
+    def _mixed_tree():
+        return {
+            "q": jnp.arange(-4, 4, dtype=jnp.int8),
+            "counts": jnp.asarray([1, 2, 3], jnp.int32),
+            "w": jnp.asarray([0.5, -1.5, 2.0], jnp.bfloat16),
+            "f": jnp.ones((2, 2), jnp.float32),
+        }
+
+    @pytest.mark.parametrize("framing_cls", [PyFraming, None],
+                             ids=["python", "native"])
+    def test_dense_roundtrip_preserves_dtypes(self, monkeypatch, framing_cls):
+        from fl4health_tpu.transport import codec as codec_mod
+
+        if framing_cls is None:
+            lib = get_native()
+            if lib is None:
+                pytest.skip("no C++ toolchain available")
+            framing = NativeFraming(lib)
+        else:
+            framing = framing_cls()
+        monkeypatch.setattr(codec_mod, "get_framing", lambda: framing)
+        tree = self._mixed_tree()
+        out = codec_mod.decode(codec_mod.encode(tree), like=tree)
+        for key, leaf in tree.items():
+            got = out[key]
+            assert np.asarray(got).dtype == np.asarray(leaf).dtype, key
+            np.testing.assert_array_equal(
+                np.asarray(got, np.float32), np.asarray(leaf, np.float32)
+            )
+
+
+class TestTemplateMismatchErrors:
+    def test_decode_names_first_missing_template_leaf(self):
+        frame = encode({"a": jnp.ones((2,)), "b": jnp.ones((2,))})
+        template = {"a": jnp.ones((2,)), "c": jnp.ones((2,))}
+        with pytest.raises(ValueError, match=r"missing leaf 'c'"):
+            decode(frame, like=template)
+
+    def test_decode_names_first_extra_payload_leaf(self):
+        frame = encode({"a": jnp.ones((2,)), "b": jnp.ones((2,))})
+        with pytest.raises(ValueError, match=r"leaf 'b' does not exist"):
+            decode(frame, like={"a": jnp.ones((2,))})
+
+    def test_decode_sparse_names_mismatched_path(self):
+        packet = SparseMaskPacket(
+            params={"w": jnp.arange(4.0)},
+            element_mask={"w": jnp.asarray([1.0, 0.0, 1.0, 0.0])},
+        )
+        frame = encode_sparse(packet)
+        bad_template = SparseMaskPacket(
+            params={"v": jnp.zeros((4,))},
+            element_mask={"v": jnp.zeros((4,))},
+        )
+        with pytest.raises(ValueError, match=r"missing leaf 'v'"):
+            decode_sparse(frame, like=bad_template)
+
+
+class TestCompressedFrames:
+    @staticmethod
+    def _tree(n=400):
+        r = np.random.default_rng(7)
+        return {
+            "w": jnp.asarray(r.normal(size=(n, 10)).astype(np.float32)),
+            "b": jnp.asarray(r.normal(size=(64,)).astype(np.float32)),
+        }
+
+    def test_topk_int8_roundtrip_and_ratio(self):
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.transport.codec import (
+            decode_compressed,
+            encode_compressed,
+        )
+
+        tree = self._tree()
+        cfg = CompressionConfig(topk_fraction=0.1, quant_bits=8)
+        frame = encode_compressed(tree, cfg)
+        dense = encode(tree)
+        assert len(dense) / len(frame) >= 8.0
+        out = decode_compressed(frame, like=tree)
+        w = np.asarray(out["w"])
+        total = w.size + np.asarray(out["b"]).size
+        nnz = (w != 0).sum() + (np.asarray(out["b"]) != 0).sum()
+        assert nnz <= max(1, round(0.1 * total)) + 1
+        # kept coordinates within one quantization step
+        kept = w != 0
+        ref = np.asarray(tree["w"])
+        scale = np.abs(ref).max() / 127  # upper bound on the leaf scale
+        assert np.abs(w[kept] - ref[kept]).max() <= scale + 1e-6
+
+    def test_int4_roundtrip(self):
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.transport.codec import (
+            decode_compressed,
+            encode_compressed,
+        )
+
+        tree = self._tree(64)
+        cfg = CompressionConfig(quant_bits=4)
+        out = decode_compressed(encode_compressed(tree, cfg), like=tree)
+        ref = np.asarray(tree["w"])
+        scale = np.abs(ref).max() / 7
+        assert np.abs(np.asarray(out["w"]) - ref).max() <= 0.5 * scale + 1e-6
+
+    def test_grid_values_attaining_top_level_roundtrip_bit_exactly(self):
+        """Values on the int8 grid WHOSE MAX ATTAINS +-127 (what a fresh
+        in-graph per-leaf quantization produces — the scale re-derivation
+        then lands on the identical grid) survive byte-exactly."""
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.transport.codec import (
+            decode_compressed,
+            encode_compressed,
+        )
+
+        scale = np.float32(0.125)
+        q = np.random.default_rng(3).integers(-126, 127, size=50)
+        q[0] = 127  # pin the grid: max level attained by construction
+        tree = {"w": jnp.asarray((q * scale).astype(np.float32))}
+        cfg = CompressionConfig(quant_bits=8)
+        out = decode_compressed(encode_compressed(tree, cfg), like=tree)
+        np.testing.assert_array_equal(
+            np.asarray(out["w"]), np.asarray(tree["w"])
+        )
+
+    def test_codec_is_idempotent_after_one_round_trip(self):
+        """Arbitrary values: decode(encode(x)) may re-quantize onto the
+        re-derived grid, but a SECOND encode of the reconstruction is
+        bit-stable (the scale re-derivation is a fixed point)."""
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.transport.codec import (
+            decode_compressed,
+            encode_compressed,
+        )
+
+        tree = self._tree(32)
+        cfg = CompressionConfig(topk_fraction=0.3, quant_bits=8)
+        once = decode_compressed(encode_compressed(tree, cfg), like=tree)
+        twice = decode_compressed(encode_compressed(once, cfg), like=tree)
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(
+                np.asarray(once[k]), np.asarray(twice[k])
+            )
+
+    def test_nan_poison_stays_visible_through_the_wire(self):
+        """Review regression pin: a poisoned update must cross the wire
+        visibly poisoned — top-k selects the NaN coordinate (lax.top_k
+        sorts NaN past every finite value) and the NaN scale sidecar
+        poisons the decode, never laundering to zeros."""
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.transport.codec import (
+            decode_compressed,
+            encode_compressed,
+        )
+
+        w = np.ones((100,), np.float32)
+        w[7] = np.nan
+        tree = {"w": jnp.asarray(w)}
+        cfg = CompressionConfig(topk_fraction=0.1, quant_bits=8)
+        out = decode_compressed(encode_compressed(tree, cfg), like=tree)
+        assert np.isnan(np.asarray(out["w"])).any()
+
+    def test_mostly_zero_tree_selects_lowest_zero_indices(self):
+        """Review regression pin: fewer nonzeros than k (the kth-magnitude
+        == 0 plateau) must keep the candidate set bounded and fill with
+        the LOWEST zero indices — lax.top_k's tie order."""
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.transport.codec import (
+            _global_topk_indices,
+            decode_compressed,
+            encode_compressed,
+        )
+
+        a = np.zeros((100,), np.float32)
+        a[50] = 3.0
+        idx = _global_topk_indices(a, 5)
+        np.testing.assert_array_equal(idx, [0, 1, 2, 3, 50])
+        tree = {"w": jnp.asarray(a)}
+        out = decode_compressed(
+            encode_compressed(
+                tree, CompressionConfig(topk_fraction=0.05, quant_bits=8)
+            ),
+            like=tree,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["w"]), a, atol=3.0 / 127 + 1e-6
+        )
+
+    def test_corrupted_compressed_frame_raises(self):
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.transport.codec import encode_compressed
+
+        frame = bytearray(
+            encode_compressed(self._tree(16),
+                              CompressionConfig(quant_bits=8))
+        )
+        frame[-6] ^= 0xFF
+        with pytest.raises(FrameError, match="crc"):
+            from fl4health_tpu.transport.codec import decode_compressed
+
+            decode_compressed(bytes(frame))
+
+    def test_wrong_decoder_raises(self):
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.transport.codec import (
+            decode_compressed,
+            encode_compressed,
+        )
+
+        tree = self._tree(8)
+        comp = encode_compressed(tree, CompressionConfig(quant_bits=8))
+        with pytest.raises(ValueError, match="decode_compressed"):
+            decode(comp)
+        with pytest.raises(ValueError, match="not a compressed frame"):
+            decode_compressed(encode(tree))
+
+    def test_gap_encoding_handles_giant_gaps(self):
+        from fl4health_tpu.transport.codec import _decode_gaps, _encode_gaps
+
+        idx = np.asarray([0, 5, 70000, 200001, 200002], np.int64)
+        tokens = _encode_gaps(idx)
+        assert tokens.dtype == np.uint16
+        np.testing.assert_array_equal(_decode_gaps(tokens), idx)
+        # empty selection
+        np.testing.assert_array_equal(
+            _decode_gaps(_encode_gaps(np.zeros((0,), np.int64))),
+            np.zeros((0,), np.int64),
+        )
+
+    def test_wire_counters_account_logical_vs_compressed(self):
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.observability.registry import get_registry
+        from fl4health_tpu.transport.codec import encode_compressed
+
+        reg = get_registry()
+        before = reg.counter(
+            "fl_wire_bytes_compressed_total",
+            labels={"direction": "encoded"},
+        ).value
+        tree = self._tree(64)
+        frame = encode_compressed(
+            tree, CompressionConfig(topk_fraction=0.2, quant_bits=8)
+        )
+        after = reg.counter(
+            "fl_wire_bytes_compressed_total",
+            labels={"direction": "encoded"},
+        ).value
+        assert after - before == len(frame)
+        assert reg.gauge(
+            "fl_wire_compression_ratio", labels={"direction": "encoded"}
+        ).value > 1.0
+
+    def test_integer_leaves_round_instead_of_truncating(self):
+        """Review regression pin: dequantized values cast to integer leaf
+        dtypes must ROUND (astype alone truncates toward zero, biasing
+        e.g. -2.976 to -2 instead of -3)."""
+        from fl4health_tpu.compression import CompressionConfig
+        from fl4health_tpu.transport.codec import (
+            decode_compressed,
+            encode_compressed,
+        )
+
+        tree = {"q": jnp.arange(-4, 4, dtype=jnp.int8)}
+        out = decode_compressed(
+            encode_compressed(tree, CompressionConfig(quant_bits=8)),
+            like=tree,
+        )
+        assert np.asarray(out["q"]).dtype == np.int8
+        np.testing.assert_array_equal(
+            np.asarray(out["q"]), np.asarray(tree["q"])
+        )

@@ -186,3 +186,53 @@ class TestRpcAccountingOverNativeTransport:
         # not drag the percentiles of working silos) — the instrument is
         # registered up front but stays empty
         assert snap["transport_rpc_latency_seconds"][silo]["count"] == 0
+
+
+class TestInt4Packing:
+    """Nibble pack/unpack for compressed int4 wire frames: the native C++
+    helpers and the NumPy twin must agree byte-for-byte."""
+
+    def test_native_matches_python_bytes(self):
+        import numpy as np
+
+        from fl4health_tpu.transport.native import (
+            _pack_int4_py,
+            _unpack_int4_py,
+            get_native,
+        )
+
+        lib = get_native()
+        if lib is None or not hasattr(lib, "fl4h_pack_nibbles"):
+            pytest.skip("native nibble helpers unavailable")
+        from fl4health_tpu.transport import native
+
+        for n in (0, 1, 2, 7, 100, 101):
+            vals = np.random.default_rng(n).integers(
+                -8, 8, size=n
+            ).astype(np.int8)
+            assert native.pack_int4(vals) == _pack_int4_py(vals), n
+            np.testing.assert_array_equal(
+                native.unpack_int4(native.pack_int4(vals), n), vals
+            )
+            np.testing.assert_array_equal(
+                _unpack_int4_py(_pack_int4_py(vals), n), vals
+            )
+
+    def test_sign_extension_covers_full_range(self):
+        import numpy as np
+
+        from fl4health_tpu.transport.native import (
+            _pack_int4_py,
+            _unpack_int4_py,
+        )
+
+        vals = np.arange(-8, 8, dtype=np.int8)
+        np.testing.assert_array_equal(
+            _unpack_int4_py(_pack_int4_py(vals), 16), vals
+        )
+
+    def test_short_payload_raises(self):
+        from fl4health_tpu.transport.native import unpack_int4
+
+        with pytest.raises(FrameError, match="too short"):
+            unpack_int4(b"\x00", 5)

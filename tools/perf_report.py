@@ -46,6 +46,15 @@ TELEMETRY_COLUMNS = (
     ("diverg", "divergence_max", lambda v: f"{v:.3g}"),
 )
 
+# Compressed-exchange fields (fl4health_tpu/compression/): estimated wire
+# bytes of the round's gather under the active CompressionConfig and the
+# logical/wire ratio. Optional like the telemetry columns — logs from
+# uncompressed runs keep their exact old table shape (byte-stable, tested).
+WIRE_COLUMNS = (
+    ("wire_bytes", "gather_bytes_wire", lambda v: str(int(v))),
+    ("wire_ratio", "wire_compression_ratio", lambda v: f"{v:.1f}x"),
+)
+
 
 def load_events(path: str) -> dict[str, list[dict]]:
     """Parse the JSONL log into {event_kind: [records]}. Malformed lines
@@ -95,9 +104,10 @@ def load_program_events(path: str) -> list[dict]:
 
 
 def active_columns(rounds: list[dict]) -> tuple:
-    """Base columns plus any telemetry column present in >=1 round event."""
+    """Base columns plus any telemetry/wire column present in >=1 round
+    event."""
     extra = tuple(
-        col for col in TELEMETRY_COLUMNS
+        col for col in TELEMETRY_COLUMNS + WIRE_COLUMNS
         if any(col[1] in rec for rec in rounds)
     )
     return COLUMNS + extra
@@ -231,7 +241,7 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
         return {"rounds": 0}
     tot = lambda k: sum(float(r.get(k, 0.0)) for r in rounds)  # noqa: E731
     steady = [r for r in rounds[1:]] or rounds  # round 1 pays the compiles
-    return {
+    summary = {
         "rounds": len(rounds),
         "total_compiles": int(tot("compiles")),
         "compile_s": round(tot("compile_s"), 4),
@@ -247,6 +257,10 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
             sum(float(r.get("compiles", 0)) for r in rounds[1:])
         ),
     }
+    if any("gather_bytes_wire" in r for r in rounds):
+        # compressed-exchange runs only — legacy summaries stay byte-stable
+        summary["gather_bytes_wire"] = int(tot("gather_bytes_wire"))
+    return summary
 
 
 def main(argv: list[str] | None = None) -> int:
