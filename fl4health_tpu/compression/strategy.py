@@ -134,6 +134,25 @@ class CompressingStrategy(Strategy):
     def global_params(self, server_state: CompressedExchangeState):
         return self.inner.global_params(server_state.inner)
 
+    def state_sharding_spec(self, server_state: CompressedExchangeState,
+                            clients_axis: str):
+        """On a client mesh the per-client ``[C, ...]`` EF residual stack
+        shards over the clients axis (it is client-local state — replicating
+        it would multiply its footprint by the device count); the inner
+        strategy's state follows its own spec (replicated by default)."""
+        from jax.sharding import PartitionSpec as P
+
+        from fl4health_tpu.strategies.base import inner_state_sharding_spec
+
+        residual_spec = (P(clients_axis) if server_state.residual is not None
+                         else None)
+        return CompressedExchangeState(
+            inner=inner_state_sharding_spec(
+                self.inner, server_state.inner, clients_axis
+            ),
+            residual=residual_spec,
+        )
+
     def divergence_reference(self, server_state: CompressedExchangeState):
         return self.inner.divergence_reference(server_state.inner)
 

@@ -60,11 +60,16 @@ class ProgramReport:
     name: str
     backend: str
     device_kind: str
-    # cost_analysis
+    # cost_analysis — WHOLE-program logical work: XLA reports per-partition
+    # numbers for SPMD-partitioned (mesh) executables, so capture scales
+    # them by the partition count (collective traffic is not modeled; the
+    # scaled bytes are an approximation)
     flops: float | None = None
     transcendentals: float | None = None
     bytes_accessed: float | None = None
-    # memory_analysis (device-memory footprint components)
+    # memory_analysis (device-memory footprint components) — deliberately
+    # PER-PARTITION on a mesh: peak_hbm_bytes is each chip's footprint,
+    # which is what HBM-headroom accounting needs
     argument_bytes: int | None = None
     output_bytes: int | None = None
     temp_bytes: int | None = None
@@ -75,6 +80,11 @@ class ProgramReport:
     cache_misses: int = 0
     # a multi-round scan program executes this many rounds per dispatch
     rounds_per_dispatch: int = 1
+    # mesh/sharding descriptor (parallel.program.RoundProgramBuilder
+    # .descriptor()) when the program was built for a device mesh; None on
+    # single-chip builds (and omitted from as_dict/events, so legacy
+    # program records keep their exact shape)
+    mesh: dict | None = None
 
     @property
     def peak_hbm_bytes(self) -> int | None:
@@ -108,6 +118,8 @@ class ProgramReport:
 
     def as_dict(self) -> dict[str, Any]:
         d = {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
+        if d.get("mesh") is None:
+            del d["mesh"]
         d["peak_hbm_bytes"] = self.peak_hbm_bytes
         d["cache_hit"] = self.cache_hit
         roof = self.roofline()
@@ -116,10 +128,18 @@ class ProgramReport:
         return d
 
 
-def analyze_compiled(compiled: Any) -> dict[str, Any]:
+def analyze_compiled(compiled: Any, n_partitions: int = 1) -> dict[str, Any]:
     """Extract cost/memory analysis from a ``jax`` compiled executable,
     defensively: backends without a cost model yield ``None`` fields, never
-    an exception (the caller may be mid-``fit``)."""
+    an exception (the caller may be mid-``fit``).
+
+    ``n_partitions``: SPMD partition count of the executable (the mesh's
+    device count). XLA's ``cost_analysis()`` reports ONE partition's
+    flops/transcendentals/bytes for a partitioned program, so they are
+    scaled back up to whole-program numbers here — otherwise every
+    downstream per-chip division (MFU, tflops_per_chip) would divide by
+    the device count a second time. ``memory_analysis`` is left
+    per-partition on purpose (each chip's footprint)."""
     out: dict[str, Any] = {
         "flops": None, "transcendentals": None, "bytes_accessed": None,
         "argument_bytes": None, "output_bytes": None, "temp_bytes": None,
@@ -134,7 +154,7 @@ def analyze_compiled(compiled: Any) -> dict[str, Any]:
                                ("transcendentals", "transcendentals"),
                                ("bytes_accessed", "bytes accessed")):
                 if key in cost:
-                    out[field] = float(cost[key])
+                    out[field] = float(cost[key]) * max(n_partitions, 1)
     except Exception:
         logger.debug("cost_analysis unavailable", exc_info=True)
     try:
@@ -177,7 +197,8 @@ class ProgramIntrospector:
 
     # -- capture ---------------------------------------------------------
     def introspect_jit(self, name: str, jitted: Any, args: tuple,
-                       rounds_per_dispatch: int = 1) -> ProgramReport | None:
+                       rounds_per_dispatch: int = 1,
+                       mesh: dict | None = None) -> ProgramReport | None:
         """AOT-lower and compile ``jitted`` against (abstracted) ``args``
         and record the report. The compile goes through XLA's normal
         ``compile_or_get_cached`` path, so with the persistent compilation
@@ -203,7 +224,11 @@ class ProgramIntrospector:
                     self.registry.counter(_CACHE_MISSES).value - misses0
                 ),
                 rounds_per_dispatch=rounds_per_dispatch,
-                **analyze_compiled(compiled),
+                mesh=mesh,
+                **analyze_compiled(
+                    compiled,
+                    n_partitions=int((mesh or {}).get("n_devices", 1)),
+                ),
             )
         except Exception:
             logger.warning("program introspection failed for %r", name,

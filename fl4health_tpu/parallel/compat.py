@@ -1,0 +1,48 @@
+"""shard_map across jax versions — one call site for the API drift.
+
+``jax.shard_map`` (with ``check_vma=``) is the modern spelling; this
+jaxlib generation only ships ``jax.experimental.shard_map.shard_map``
+(with ``check_rep=``). Everything mesh-mapped in this repo
+(``parallel/zero.py``, ``parallel/ring_attention.py``) routes through
+:func:`shard_map` below so the version probe happens exactly once.
+"""
+
+from __future__ import annotations
+
+import jax
+
+def axis_size(axis_name: str):
+    """``jax.lax.axis_size`` where it exists; the ``psum(1, axis)`` idiom
+    (statically folded to the axis size) on older jax."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax, "shard_map"):
+    # Probe the SIGNATURE, not just the namespace: there is a jax window
+    # where the top-level export exists but still takes check_rep (the
+    # check_vma rename came later than the promotion out of experimental).
+    import inspect
+
+    _CHECK_KW = (
+        "check_vma"
+        if "check_vma" in inspect.signature(jax.shard_map).parameters
+        else "check_rep"
+    )
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            **{_CHECK_KW: check},
+        )
+
+else:  # jax<=0.4.x: experimental namespace, check_rep kwarg
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check,
+        )

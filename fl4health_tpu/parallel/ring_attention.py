@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fl4health_tpu.parallel.compat import axis_size, shard_map
+
 NEG_INF = -1e30
 
 
@@ -75,7 +77,7 @@ def _ring_body(q_blk, k_blk, v_blk, mask_blk, local_fn, axis_name: str):
     online-softmax algebra one level up), so the driver is the ONE copy of
     the rotation/merge logic for both the dense and the flash local block.
     """
-    ring = jax.lax.axis_size(axis_name)
+    ring = axis_size(axis_name)
     perm = [(j, (j + 1) % ring) for j in range(ring)]
 
     # local block first, then n-1 hops: rotate-THEN-compute so no transfer's
@@ -110,12 +112,12 @@ def _ring_body(q_blk, k_blk, v_blk, mask_blk, local_fn, axis_name: str):
 def _ring_shard_map(local_fn, mesh, axis_name, q, k, v, pad_mask):
     qkv_spec = P(None, axis_name, None, None)
     mask_spec = P(None, axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_body, local_fn=local_fn, axis_name=axis_name),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,
-        check_vma=False,
+        check=False,
     )
     return fn(q, k, v, pad_mask)
 

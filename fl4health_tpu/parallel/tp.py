@@ -73,17 +73,22 @@ def shard_transformer_params(
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 
-def shard_like_params(tree: PyTree, params_template: PyTree, mesh: Mesh,
-                      axis: str = "model", client_axis: str | None = None) -> PyTree:
-    """Shard a tree holding params-shaped sub-trees (optimizer momenta, drift
-    anchors) by the same TP rules.
+def spec_like_params(tree: PyTree, params_template: PyTree,
+                     axis: str = "model", client_axis: str | None = None,
+                     default: P = P()) -> PyTree:
+    """``PartitionSpec`` pytree for a tree holding params-shaped sub-trees
+    (optimizer momenta, drift anchors) under the TP rules — THE one
+    implementation of the inheritance rule, used by both the device_put
+    placer below and the round-program builder's in/out shardings
+    (``parallel/program.py``).
 
     Leaves are matched to template params by dotted-path SUFFIX — an adam
     ``mu`` leaf at ``0.mu.layer_0.attn.o_proj.kernel`` inherits the rule of
     ``layer_0.attn.o_proj.kernel``. Path matching (not shape matching) keeps
     same-shaped leaves with different rules distinct (q/k/v vs o_proj are all
     [d, d] but shard on opposite axes). Unmatched leaves (step counts, EMA
-    scalars) replicate.
+    scalars) get ``default`` (replicate, unless the caller's tree is
+    client-stacked and needs ``P(client_axis)``).
     """
     flat_t, _ = jax.tree_util.tree_flatten_with_path(params_template)
     param_specs: list[tuple[str, Any, P]] = []
@@ -96,15 +101,27 @@ def shard_like_params(tree: PyTree, params_template: PyTree, mesh: Mesh,
         param_specs.append((dotted, leaf.shape, spec))
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    placed = []
+    specs = []
     for key_path, leaf in flat:
         dotted = ".".join(str(getattr(k, "key", k)) for k in key_path)
-        spec = P()
+        spec = default
         for ppath, pshape, pspec in param_specs:
             if (dotted == ppath or dotted.endswith("." + ppath)) and (
                 getattr(leaf, "shape", ()) == pshape
             ):
                 spec = pspec
                 break
-        placed.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
-    return jax.tree_util.tree_unflatten(treedef, placed)
+        specs.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_like_params(tree: PyTree, params_template: PyTree, mesh: Mesh,
+                      axis: str = "model", client_axis: str | None = None) -> PyTree:
+    """``device_put`` a params-shaped tree by :func:`spec_like_params`'s
+    TP-inheritance rule (see its docstring for the matching semantics)."""
+    specs = spec_like_params(tree, params_template,
+                             axis=axis, client_axis=client_axis)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        tree, specs,
+    )

@@ -251,6 +251,22 @@ class QuarantiningStrategy(Strategy):
     def global_params(self, server_state: QuarantineServerState):
         return self.inner.global_params(server_state.inner)
 
+    def state_sharding_spec(self, server_state: QuarantineServerState,
+                            clients_axis: str):
+        """Quarantine bookkeeping is all ``[clients]``-shaped — shard it
+        over the clients mesh axis like every other per-client stack; the
+        inner strategy's state follows its own spec."""
+        from jax.sharding import PartitionSpec as P
+
+        from fl4health_tpu.strategies.base import inner_state_sharding_spec
+
+        return QuarantineServerState(
+            inner=inner_state_sharding_spec(
+                self.inner, server_state.inner, clients_axis
+            ),
+            quarantine=P(clients_axis),
+        )
+
     def divergence_reference(self, server_state: QuarantineServerState):
         return self.inner.divergence_reference(server_state.inner)
 

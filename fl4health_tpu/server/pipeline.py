@@ -64,6 +64,12 @@ class RoundPrefetcher:
     the simulation's train stacks were swapped (``set_train_data``) between
     staging and ``take``, the plan is re-gathered against the fresh stacks —
     correctness over reuse.
+
+    Under a device mesh the staged batch stack is ``device_put`` onto the
+    builder's clients-axis sharding as part of staging — the clients-axis
+    split of round *r+1*'s data overlaps round *r*'s execution instead of
+    riding the dispatch as an implicit reshard. Without a mesh, staging is
+    exactly the pre-mesh behavior.
     """
 
     def __init__(self, sim: Any):
@@ -72,6 +78,25 @@ class RoundPrefetcher:
             max_workers=1, thread_name_prefix="fl-round-prefetch"
         )
         self._pending: tuple[int, Future] | None = None
+
+    def _place(self, batches):
+        # one placement rule everywhere: the builder's put + the builder's
+        # own clients sharding (no-op when unsharded), so staging policy
+        # can't drift from the other device_put sites.
+        #
+        # Thread-safety note: this device_put runs on the worker thread
+        # while the main thread dispatches the current round's program.
+        # That is safe where eager multi-device COMPUTATIONS are not —
+        # an eager sharded gather here deadlocks against the concurrent
+        # dispatch (rendezvous-synchronized executable launches from two
+        # threads; see the train-bank comment in simulation.__init__) —
+        # because device_put issues independent per-device transfers, not
+        # a collective program. Pinned green on the 8-device virtual mesh
+        # that reproduces the gather deadlock; if a real multi-chip
+        # backend ever hangs here, fall back to placing in take() on the
+        # caller's thread at the cost of the staging overlap.
+        builder = self._sim._program_builder
+        return builder.put(batches, builder.client_sharding())
 
     def schedule(self, round_idx: int) -> None:
         sim = self._sim
@@ -83,7 +108,9 @@ class RoundPrefetcher:
             from fl4health_tpu.clients import engine
 
             plan = sim._round_plan(round_idx)
-            batches = engine.gather_batches(x_stack, y_stack, *plan)
+            batches = self._place(
+                engine.gather_batches(x_stack, y_stack, *plan)
+            )
             return (x_stack, y_stack), plan, batches
 
         self._pending = (round_idx, self._pool.submit(build))
@@ -92,16 +119,16 @@ class RoundPrefetcher:
         sim = self._sim
         pending, self._pending = self._pending, None
         if pending is None or pending[0] != round_idx:
-            return sim._round_batches(round_idx)
+            return self._place(sim._round_batches(round_idx))
         (x_stack, y_stack), plan, batches = pending[1].result()
         if x_stack is sim._x_train_stack and y_stack is sim._y_train_stack:
             return batches
         # data refreshed after staging: same plan, fresh gather
         from fl4health_tpu.clients import engine
 
-        return engine.gather_batches(
+        return self._place(engine.gather_batches(
             sim._x_train_stack, sim._y_train_stack, *plan
-        )
+        ))
 
     def close(self) -> None:
         self._pending = None

@@ -133,8 +133,13 @@ def scaffold_warm_start(sim: FederatedSimulation) -> None:
     # the pre-round states (rolled back below) and the warmed outputs, so
     # sim._fit_round — which donates its state arguments and invalidates
     # the passed-in buffers — cannot be used here. One extra compile,
-    # one-time cost at warm start.
-    fit_once = jax.jit(sim._fit_round_fn)
+    # one-time cost at warm start. Constructed by the sim's program
+    # builder so a mesh run's warm start keeps the client axis sharded
+    # (same in/out shardings as the round program, donation off).
+    fit_once = sim._program_builder.jit(
+        sim._fit_round_fn,
+        in_shardings=sim._fit_in_sh, out_shardings=sim._fit_out_sh,
+    )
     server_state, client_states, _, _, _ = fit_once(
         sim.server_state, sim.client_states, batches, mask,
         jnp.asarray(0, jnp.int32), val_batches,

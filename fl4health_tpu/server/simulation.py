@@ -43,6 +43,11 @@ from fl4health_tpu.core import pytree as ptu
 from fl4health_tpu.exchange.exchanger import FullExchanger
 from fl4health_tpu.metrics.aggregation import aggregate_metrics
 from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.parallel.program import (
+    CLIENTS_AXIS,
+    MeshConfig,
+    RoundProgramBuilder,
+)
 from fl4health_tpu.server.client_manager import ClientManager, FullParticipationManager
 from fl4health_tpu.server.pipeline import RoundConsumer, RoundPrefetcher
 from fl4health_tpu.strategies.base import FitResults, Strategy
@@ -71,8 +76,9 @@ def _donate_argnums(*argnums: int) -> tuple[int, ...]:
     Donation on CPU saves nothing we need — the in-place client-stack
     update is a device-memory lever — so CPU runs plain and TPU/GPU get
     the donation. Re-evaluate when the jaxlib cache serializes aliasing
-    correctly."""
-    return argnums if jax.default_backend() != "cpu" else ()
+    correctly. (One implementation: RoundProgramBuilder.donate — the
+    sharded programs route through the same gate.)"""
+    return RoundProgramBuilder.donate(*argnums)
 
 
 def _dedupe_donated(*trees):
@@ -220,6 +226,7 @@ class FederatedSimulation:
         pipeline_depth: int = 2,
         fault_plan: Any = None,
         compression: Any = None,
+        mesh: MeshConfig | None = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -281,6 +288,21 @@ class FederatedSimulation:
             strategy = self.strategy = CompressingStrategy(
                 strategy, compression
             )
+        # Device-mesh placement (parallel/program.py): mesh=None keeps the
+        # single-chip programs (and trajectories) bit-identical; a
+        # MeshConfig shards the [C, ...] client axes over the "clients"
+        # mesh axis in every compiled round program, replicates (or
+        # ZeRO-1-shards) the server state, and stages per-round data with
+        # sharded device_put — massive cohorts across data-parallel chips.
+        if mesh is not None and not isinstance(mesh, MeshConfig):
+            raise TypeError(
+                "mesh must be a MeshConfig (or None); got "
+                f"{type(mesh).__name__} — pass parallel.program.MeshConfig"
+            )
+        self.mesh_config = mesh
+        self._program_builder = RoundProgramBuilder(
+            mesh, n_clients=self.n_clients
+        )
         self.client_manager = client_manager or FullParticipationManager(self.n_clients)
         # setup-time strategy <-> sampling-scheme validation (e.g. the DP
         # strategies derive/check fraction_fit against the manager's sampling
@@ -356,6 +378,9 @@ class FederatedSimulation:
         # (observability/introspect.py); None until a fit() captures it.
         # Feeds the measured-MFU numbers in _record_round_metrics.
         self._round_program_flops: float | None = None
+        # per-client scheduled local-step counts (from the fixed round
+        # plan), computed lazily for the per-chip steps/s round metric
+        self._steps_per_client_cache: np.ndarray | None = None
         self.rng = jax.random.PRNGKey(seed)
         self._device_kind = getattr(jax.devices()[0], "device_kind", None)
         self.sample_counts = jnp.asarray(
@@ -396,8 +421,16 @@ class FederatedSimulation:
 
         # Pre-stacked per-client data (one-time, device-resident) feeding the
         # per-round single-gather batch construction (engine.gather_batches).
+        # The banks deliberately stay UNSHARDED here: the pipelined
+        # prefetcher's worker thread gathers batches from them eagerly, and
+        # an eager multi-device gather racing the main thread's round
+        # dispatch deadlocks (two threads enqueueing multi-device launches
+        # in different per-device orders). The chunked dispatches — the only
+        # programs that take the banks as jit inputs — stage a sharded copy
+        # once via _sharded_train_banks() instead.
         self._x_train_stack = engine.pad_and_stack_data([d.x_train for d in self.datasets], "x_train")
         self._y_train_stack = engine.pad_and_stack_data([d.y_train for d in self.datasets], "y_train")
+        self._sharded_banks_cache: tuple | None = None
         self._x_val_stack = engine.pad_and_stack_data([d.x_val for d in self.datasets], "x_val")
         self._y_val_stack = engine.pad_and_stack_data([d.y_val for d in self.datasets], "y_val")
         self._base_entropy = engine._entropy_from_key(self.rng)
@@ -410,6 +443,12 @@ class FederatedSimulation:
             lambda a: a[:1], self.datasets[0].x_train
         )
         proto = engine.create_train_state(logic, tx, init_rng, sample_x)
+        if self._program_builder.mesh is not None and mesh.zero1:
+            # ZeRO-1 server optimizer (parallel/zero.py) over the SAME mesh
+            # the round programs dispatch on — each replica owns 1/N of the
+            # server momenta; the construction-time parity probe therefore
+            # validates the deployed sharding, not a throwaway mesh.
+            self._wire_zero1_server_optimizer(proto.params)
         per_client = []
         for i in range(self.n_clients):
             # All clients share the server's initial params (the reference's
@@ -419,7 +458,9 @@ class FederatedSimulation:
             st = proto.replace(rng=jax.random.fold_in(init_rng, i + 1))
             per_client.append(st)
         self.client_states: TrainState = ptu.stack_clients(per_client)
-        self.server_state = strategy.init(proto.params)
+        # self.strategy, not the local: zero1 wiring may have rebuilt the
+        # chain around a ZeRO-sharded server optimizer
+        self.server_state = self.strategy.init(proto.params)
 
         self._build_compiled()
 
@@ -460,7 +501,82 @@ class FederatedSimulation:
                         f"{b.shape}/{b.dtype} (per-round refresh may not "
                         "change the data layout)"
                     )
-        self._x_train_stack, self._y_train_stack = new_x, new_y
+        self._x_train_stack = new_x
+        self._y_train_stack = new_y
+        # the swapped banks invalidate any staged sharded copy (identity
+        # check in _sharded_train_banks)
+        self._sharded_banks_cache = None
+
+    # ------------------------------------------------------------------
+    def _wire_zero1_server_optimizer(self, params_template) -> None:
+        """Wire ``parallel/zero.py`` into the server optimizer
+        (``MeshConfig(zero1=True)``): the innermost strategy must be
+        FedOpt-family (it OWNS a server optax transform); its ``tx`` is
+        wrapped so the flat server-momenta vector is partitioned over the
+        clients (replica) axis — Xu et al.'s cross-replica sharding of the
+        weight update. The one-step sharded-vs-unsharded parity probe runs
+        against THIS mesh (the one ``fit()`` dispatches on).
+
+        The caller's strategy object is never mutated: the wrapper chain is
+        rebuilt around shallow copies and ``self.strategy`` reassigned, so a
+        strategy instance reused by another simulation (the natural
+        sharded-vs-unsharded comparison) keeps its plain ``tx``."""
+        import copy
+
+        from fl4health_tpu.parallel.zero import (
+            Zero2ShardedOptimizer,
+            ZeroShardedOptimizer,
+            _validate_elementwise,
+            zero_sharded_optimizer,
+        )
+        from fl4health_tpu.strategies.fedopt import FedOpt
+
+        chain = [self.strategy]
+        while hasattr(chain[-1], "inner"):
+            chain.append(chain[-1].inner)
+        inner = chain[-1]
+        if not isinstance(inner, FedOpt):
+            raise ValueError(
+                "MeshConfig(zero1=True) shards a SERVER optimizer: the "
+                "(innermost) strategy must be FedOpt-family (fed_adam/"
+                "fed_yogi/fed_adagrad/fed_avg_m/FedOpt); got "
+                f"{type(inner).__name__}, which has no server optax "
+                "transform to shard"
+            )
+        mesh = self._program_builder.mesh
+        if isinstance(inner.tx, (ZeroShardedOptimizer, Zero2ShardedOptimizer)):
+            # Already sharded by the caller: the probe must still reflect
+            # the DEPLOYED mesh — a wrapper validated on a different mesh
+            # certifies nothing about this run's sharding.
+            if inner.tx.mesh != mesh or inner.tx.axis_name != CLIENTS_AXIS:
+                raise ValueError(
+                    "the server optimizer was ZeRO-sharded against a "
+                    f"different mesh/axis ({inner.tx.axis_name!r} on "
+                    f"{dict(inner.tx.mesh.shape)}) than the round programs "
+                    f"dispatch on ({CLIENTS_AXIS!r} on {dict(mesh.shape)}); "
+                    "let MeshConfig(zero1=True) do the wiring (pass the "
+                    "plain optax transform) so validation reflects the "
+                    "deployed sharding"
+                )
+            if self.mesh_config.validate_zero1:
+                n_local = (inner.tx.n_shards
+                           if isinstance(inner.tx, Zero2ShardedOptimizer)
+                           else None)
+                _validate_elementwise(
+                    inner.tx, inner.tx.tx, params_template, n_local=n_local
+                )
+            return
+        new_inner = copy.copy(inner)
+        new_inner.tx = zero_sharded_optimizer(
+            inner.tx, mesh, params_template, axis_name=CLIENTS_AXIS,
+            validate=self.mesh_config.validate_zero1,
+        )
+        rebuilt = new_inner
+        for wrapper in reversed(chain[:-1]):
+            wrapper = copy.copy(wrapper)
+            wrapper.inner = rebuilt
+            rebuilt = wrapper
+        self.strategy = rebuilt
 
     # ------------------------------------------------------------------
     def _build_compiled(self):
@@ -472,6 +588,33 @@ class FederatedSimulation:
         # extra output is the RoundTelemetry pytree.
         self._telemetry_enabled = self.observability.telemetry_enabled
         self._fit_round_fn, self._eval_round_fn = self._build_round_fns(False)
+        # Every compiled round program is constructed by the
+        # RoundProgramBuilder (parallel/program.py) — placement policy in
+        # one place. mesh=None: b.jit IS jax.jit(fn, donate_argnums=...),
+        # the pre-mesh program. With a mesh, the [C, ...] inputs/outputs
+        # get NamedSharding(P("clients")) and the server state replicates
+        # (or ZeRO-1-shards) via in_shardings/out_shardings.
+        b = self._program_builder
+        cs = b.client_sharding()
+        rep = b.replicated()
+        if b.mesh is not None:
+            sh_clients = b.client_state_shardings(self.client_states)
+            sh_server = b.server_state_shardings(
+                self.strategy, self.server_state
+            )
+            # fit_round(server_state, client_states, batches, mask,
+            #           round_idx, val_batches)
+            self._fit_in_sh = (sh_server, sh_clients, cs, cs, rep, cs)
+            self._fit_out_sh = (sh_server, sh_clients, None, None, None)
+            # eval_round(server_state, client_states, batches, eval_counts)
+            self._eval_in_sh = (sh_server, sh_clients, cs, cs)
+            self._eval_out_sh = (sh_clients, None, None, None, None)
+        else:
+            sh_clients = sh_server = None
+            self._fit_in_sh = self._fit_out_sh = None
+            self._eval_in_sh = self._eval_out_sh = None
+        self._sh_client_states = sh_clients
+        self._sh_server_state = sh_server
         # Donation (mirroring fit_chunk's donate_argnums=(0,1), per
         # arXiv:2004.13336's reuse-the-replica-buffers rule): the full
         # client-weight stack and server state are updated IN PLACE each
@@ -480,14 +623,17 @@ class FederatedSimulation:
         # CONTRACT for every caller: treat the passed-in states as INVALID
         # after the call — always replace them with the returned ones.
         # (Donation is gated off the CPU backend — see _donate_argnums —
-        # but call sites must stay donation-safe for the TPU path.) eval
-        # donates only the client stack: its server_state flows on to
+        # but call sites must stay donation-safe for the TPU path; the
+        # sharded builds route through the SAME gating.) eval donates only
+        # the client stack: its server_state flows on to
         # update_after_eval/test-eval on the caller side.
-        self._fit_round = jax.jit(
-            self._fit_round_fn, donate_argnums=_donate_argnums(0, 1)
+        self._fit_round = b.jit(
+            self._fit_round_fn, donate=(0, 1),
+            in_shardings=self._fit_in_sh, out_shardings=self._fit_out_sh,
         )
-        self._eval_round = jax.jit(
-            self._eval_round_fn, donate_argnums=_donate_argnums(1)
+        self._eval_round = b.jit(
+            self._eval_round_fn, donate=(1,),
+            in_shardings=self._eval_in_sh, out_shardings=self._eval_out_sh,
         )
         self._fit_round_fn_t = self._eval_round_fn_t = None
         self._fit_round_t = self._eval_round_t = None
@@ -495,11 +641,19 @@ class FederatedSimulation:
             self._fit_round_fn_t, self._eval_round_fn_t = (
                 self._build_round_fns(True)
             )
-            self._fit_round_t = jax.jit(
-                self._fit_round_fn_t, donate_argnums=_donate_argnums(0, 1)
+            # telemetry variants append ONE output (RoundTelemetry / the
+            # per-client non-finite eval count) — unconstrained placement
+            fit_out_t = (self._fit_out_sh + (None,)
+                         if self._fit_out_sh is not None else None)
+            eval_out_t = (self._eval_out_sh + (None,)
+                          if self._eval_out_sh is not None else None)
+            self._fit_round_t = b.jit(
+                self._fit_round_fn_t, donate=(0, 1),
+                in_shardings=self._fit_in_sh, out_shardings=fit_out_t,
             )
-            self._eval_round_t = jax.jit(
-                self._eval_round_fn_t, donate_argnums=_donate_argnums(1)
+            self._eval_round_t = b.jit(
+                self._eval_round_fn_t, donate=(1,),
+                in_shardings=self._eval_in_sh, out_shardings=eval_out_t,
             )
         self._chunked_fit = None  # compiled lazily by make_chunked_fit
         self._chunked_fit_eval = None  # compiled lazily (fit()'s chunked route)
@@ -733,6 +887,30 @@ class FederatedSimulation:
             local_epochs=self.local_epochs,
         )
 
+    def _sharded_train_banks(self):
+        """The [C, ...] train banks staged onto the clients axis, cached
+        until ``set_train_data`` swaps them. The chunked programs take the
+        banks as jit inputs with ``in_shardings`` pinned to P("clients"),
+        so passing the unsharded construction-time banks would reshard the
+        FULL per-client data bank — a cross-device copy of every client's
+        whole dataset — on every chunk dispatch. Without a mesh this
+        returns the banks untouched. (The banks themselves must stay
+        unsharded for the pipelined prefetcher — see the construction-time
+        comment.)"""
+        sh = self._program_builder.client_sharding()
+        if sh is None:
+            return self._x_train_stack, self._y_train_stack
+        cached = self._sharded_banks_cache
+        if (cached is not None and cached[0] is self._x_train_stack
+                and cached[1] is self._y_train_stack):
+            return cached[2], cached[3]
+        xs = self._program_builder.put(self._x_train_stack, sh)
+        ys = self._program_builder.put(self._y_train_stack, sh)
+        self._sharded_banks_cache = (
+            self._x_train_stack, self._y_train_stack, xs, ys
+        )
+        return xs, ys
+
     def _round_batches(self, round_idx: int) -> Batch:
         idx, em, sm = self._round_plan(round_idx)
         return engine.gather_batches(
@@ -791,7 +969,18 @@ class FederatedSimulation:
         # buffers in place instead of allocating a second copy — on a 16GB
         # chip that halves the peak footprint of the big-cohort configs.
         # (No-op on CPU; data stacks are NOT donated.)
-        self._chunked_fit = jax.jit(chunk, donate_argnums=_donate_argnums(0, 1))
+        b = self._program_builder
+        in_sh = out_sh = None
+        if b.mesh is not None:
+            cs = b.client_sharding()
+            scs = b.stacked_client_sharding()
+            in_sh = (self._sh_server_state, self._sh_client_states, cs, cs,
+                     scs, scs, scs, scs, b.replicated(), cs)
+            out_sh = (self._sh_server_state, self._sh_client_states,
+                      None, None)
+        self._chunked_fit = b.jit(
+            chunk, donate=(0, 1), in_shardings=in_sh, out_shardings=out_sh
+        )
         return self._chunked_fit
 
     def fit_chunk(self, start_round: int, k: int, mask=None):
@@ -841,9 +1030,10 @@ class FederatedSimulation:
         self.server_state, self.client_states = _dedupe_donated(
             self.server_state, self.client_states
         )
+        x_bank, y_bank = self._sharded_train_banks()
         self.server_state, self.client_states, losses, metrics = chunked(
             self.server_state, self.client_states,
-            self._x_train_stack, self._y_train_stack, idx, em, sm, masks,
+            x_bank, y_bank, idx, em, sm, masks,
             jnp.asarray(start_round, jnp.int32), val_batches,
         )
         return losses, metrics
@@ -925,7 +1115,20 @@ class FederatedSimulation:
             )
             return server_state, client_states, outs
 
-        self._chunked_fit_eval = jax.jit(chunk, donate_argnums=_donate_argnums(0, 1))
+        b = self._program_builder
+        in_sh = out_sh = None
+        if b.mesh is not None:
+            cs = b.client_sharding()
+            scs = b.stacked_client_sharding()
+            in_sh = (self._sh_server_state, self._sh_client_states, cs, cs,
+                     scs, scs, scs, scs, b.replicated(), cs, cs)
+            if self._test_batches() is not None:
+                # arity must match the dispatch: test args ride along
+                in_sh = in_sh + (cs, cs)
+            out_sh = (self._sh_server_state, self._sh_client_states, None)
+        self._chunked_fit_eval = b.jit(
+            chunk, donate=(0, 1), in_shardings=in_sh, out_shardings=out_sh
+        )
         return self._chunked_fit_eval
 
     def _eval_split_batches(self, x_stack, y_stack, ns) -> tuple[Batch, jax.Array]:
@@ -939,10 +1142,17 @@ class FederatedSimulation:
 
     def _val_batches(self) -> tuple[Batch, jax.Array]:
         if self._val_cache is None:
-            self._val_cache = self._eval_split_batches(
+            batches, counts = self._eval_split_batches(
                 self._x_val_stack, self._y_val_stack,
                 [engine.data_rows(d.x_val) for d in self.datasets],
             )
+            # sharded staging (no-op without a mesh): the cache is reused
+            # every round, so the clients-axis split is paid once here
+            # instead of on each dispatch's implicit reshard
+            batches = self._program_builder.put(
+                batches, self._program_builder.client_sharding()
+            )
+            self._val_cache = (batches, counts)
         return self._val_cache
 
     def _test_batches(self) -> tuple[Batch, jax.Array] | None:
@@ -959,9 +1169,13 @@ class FederatedSimulation:
             y_stack = engine.pad_and_stack_data(
                 [d.y_test for d in self.datasets], "y_test"
             )
-            self._test_cache = self._eval_split_batches(
+            batches, counts = self._eval_split_batches(
                 x_stack, y_stack, [engine.data_rows(d.x_test) for d in self.datasets]
             )
+            batches = self._program_builder.put(
+                batches, self._program_builder.client_sharding()
+            )
+            self._test_cache = (batches, counts)
         return self._test_cache
 
     # ------------------------------------------------------------------
@@ -1047,6 +1261,22 @@ class FederatedSimulation:
             )
         if obs.enabled:
             obs.log_event("execution_mode", mode=mode, reason=mode_reason)
+            if self._program_builder.mesh is not None:
+                # one-time mesh gauges: a scraped metrics page can divide
+                # any aggregate number down to per-chip without the manifest
+                mesh_shape = dict(self._program_builder.mesh.shape)
+                obs.registry.gauge(
+                    "fl_mesh_devices",
+                    help="devices backing the round-program mesh",
+                ).set(float(self._program_builder.n_devices))
+                obs.registry.gauge(
+                    "fl_mesh_client_axis",
+                    help="size of the 'clients' (data-parallel) mesh axis",
+                ).set(float(self._program_builder.client_axis_size))
+                obs.registry.gauge(
+                    "fl_mesh_model_axis",
+                    help="size of the 'model' (tensor-parallel) mesh axis",
+                ).set(float(mesh_shape.get("model", 1)))
             # run manifest (served live at /manifest when http_port is set,
             # exported as manifest.json): provenance that makes a scraped
             # metrics page interpretable — versions, chip, mode, config hash
@@ -1055,6 +1285,7 @@ class FederatedSimulation:
                     execution_mode=mode,
                     execution_mode_reason=mode_reason,
                     donation=bool(_donate_argnums(0, 1)),
+                    mesh=self._program_builder.descriptor(),
                     config=self._manifest_config(n_rounds),
                 ))
             except Exception:
@@ -1094,7 +1325,7 @@ class FederatedSimulation:
     def _manifest_config(self, n_rounds: int) -> dict:
         """JSON-able run-config facts for the manifest's ``config_hash`` —
         the experiment identity two scrapes can be matched on."""
-        return {
+        config = {
             "n_clients": self.n_clients,
             "batch_size": self.batch_size,
             "local_epochs": self.local_epochs,
@@ -1108,6 +1339,12 @@ class FederatedSimulation:
             "compression": (self.compression.describe()
                             if self._compression_active else None),
         }
+        if self._program_builder.mesh is not None:
+            # mesh identity belongs in the config hash (a sharded and an
+            # unsharded run of the same recipe are different experiments);
+            # key absent on single-chip builds so legacy hashes are stable
+            config["mesh"] = self._program_builder.descriptor()
+        return config
 
     def _introspect_programs(self, mode: str, n_rounds: int) -> None:
         """Capture XLA cost/memory analysis for the round programs this
@@ -1123,6 +1360,7 @@ class FederatedSimulation:
         not take down a run."""
         obs = self.observability
         intro = obs.introspector
+        mesh_desc = self._program_builder.descriptor()
         try:
             val_batches, val_counts = self._val_batches()
             mask = self.client_manager.sample(
@@ -1149,6 +1387,7 @@ class FederatedSimulation:
                 intro.introspect_jit(
                     "fit_chunk_eval", self._make_chunked_fit_with_eval(),
                     tuple(args), rounds_per_dispatch=n_rounds,
+                    mesh=mesh_desc,
                 )
                 names: tuple[str, ...] = ("fit_chunk_eval",)
             else:
@@ -1166,11 +1405,13 @@ class FederatedSimulation:
                     fit_name, fit_fn,
                     (self.server_state, self.client_states, batches, mask,
                      r, val_batches),
+                    mesh=mesh_desc,
                 )
                 intro.introspect_jit(
                     eval_name, eval_fn,
                     (self.server_state, self.client_states, val_batches,
                      val_counts),
+                    mesh=mesh_desc,
                 )
                 names = (fit_name, eval_name)
                 if test is not None:
@@ -1181,6 +1422,7 @@ class FederatedSimulation:
                         test_name, eval_fn,
                         (self.server_state, self.client_states,
                          test[0], test[1]),
+                        mesh=mesh_desc,
                     )
                     names = names + (test_name,)
             self._round_program_flops = intro.round_flops(names)
@@ -1213,6 +1455,9 @@ class FederatedSimulation:
             self.server_state, self.client_states
         )
         consumer = self._consumer = RoundConsumer(maxsize=self.pipeline_depth)
+        # per-round data staging is SHARDED under a mesh: the prefetcher's
+        # device_put splits the gathered [C, ...] batch stack over the
+        # clients axis while the previous round still runs
         prefetcher = self._prefetcher = RoundPrefetcher(self)
         writer = None
         if self.model_checkpointers or self.state_checkpointer is not None:
@@ -1643,8 +1888,9 @@ class FederatedSimulation:
             for r in range(1, n_rounds + 1)
         ])
         masks_np = np.asarray(mask_stack)
+        x_bank, y_bank = self._sharded_train_banks()
         args = [self.server_state, self.client_states,
-                self._x_train_stack, self._y_train_stack, idx, em, sm,
+                x_bank, y_bank, idx, em, sm,
                 mask_stack, jnp.asarray(1, jnp.int32), val_batches, val_counts]
         if test is not None:
             args.extend(test)
@@ -1969,11 +2215,35 @@ class FederatedSimulation:
         wall = rec.fit_elapsed_s + rec.eval_elapsed_s
         exec_s = (device_wait_s if device_wait_s > 0
                   else wall - summary["compile_s"])
+        n_mesh = self._program_builder.n_devices
+        if self._program_builder.mesh is not None:
+            # mesh-run extras (absent on single-chip logs, so legacy
+            # perf_report tables stay byte-stable): devices/axis facts plus
+            # the per-chip local-step throughput over device-execution time
+            summary["mesh_devices"] = n_mesh
+            summary["mesh_client_axis"] = self._program_builder.client_axis_size
+            if self._steps_per_client_cache is None:
+                self._steps_per_client_cache = np.asarray(
+                    self._round_plan(1)[2]
+                ).sum(axis=1)
+            steps = float(
+                (self._steps_per_client_cache * (mask_np > 0)).sum()
+            )
+            if steps > 0 and exec_s > 0:
+                summary["steps_per_s_per_chip"] = steps / exec_s / n_mesh
+                reg.gauge(
+                    "fl_round_steps_per_s_per_chip",
+                    help="participating clients' local steps per second "
+                         "per mesh device (device-execution time)",
+                ).set(summary["steps_per_s_per_chip"])
         if self._round_program_flops and exec_s > 0:
             # build-time cost_analysis FLOPs over device-execution time —
             # hardware-grounded, unlike bench.py's old analytic formula.
             # mfu_pct only where the chip's peak is known (device_specs);
-            # never a made-up percentage.
+            # never a made-up percentage. On a mesh the denominator is the
+            # whole mesh's wall, so MFU/tflops divide down to PER-CHIP —
+            # the honest utilization of each device, comparable across
+            # mesh sizes.
             achieved = self._round_program_flops / exec_s
             summary["program_flops_round"] = self._round_program_flops
             summary["program_exec_s"] = exec_s
@@ -1981,15 +2251,21 @@ class FederatedSimulation:
             reg.gauge(
                 "fl_round_tflops_measured",
                 help="measured TFLOP/s this round (cost-model FLOPs / "
-                     "device-execution time)",
+                     "device-execution time, whole mesh)",
             ).set(achieved / 1e12)
-            mfu = device_specs.mfu_pct(achieved, self._device_kind)
+            if self._program_builder.mesh is not None:
+                summary["tflops_per_chip"] = achieved / n_mesh / 1e12
+                reg.gauge(
+                    "fl_round_tflops_per_chip",
+                    help="measured TFLOP/s per mesh device this round",
+                ).set(summary["tflops_per_chip"])
+            mfu = device_specs.mfu_pct(achieved / n_mesh, self._device_kind)
             if mfu is not None:
                 summary["mfu_pct"] = mfu
                 reg.gauge(
                     "fl_round_mfu_pct",
                     help="measured model FLOPs utilization vs the chip's "
-                         "bf16 peak",
+                         "bf16 peak (per chip on a mesh)",
                 ).set(mfu)
         if self._fault_plan is not None:
             # host mirror of the round's seeded in-graph fault draws — the

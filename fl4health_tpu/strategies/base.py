@@ -55,6 +55,21 @@ def replace_global_params(strategy: "Strategy", server_state: Any, params) -> An
     return server_state.replace(params=params)
 
 
+def inner_state_sharding_spec(inner: "Strategy", server_state: Any,
+                              clients_axis: str):
+    """Delegate ``state_sharding_spec`` to a wrapped strategy for use
+    inside a wrapper's own spec pytree. A wrapper state embeds the inner
+    SPEC tree, so the inner strategy's "no preference" (no hook, or the
+    hook returning None) must become an explicit replicate-everything
+    ``P()`` leaf rather than None — None would read as "no spec for this
+    subtree" and mis-shard the wrapper state."""
+    from jax.sharding import PartitionSpec as P
+
+    hook = getattr(inner, "state_sharding_spec", None)
+    spec = hook(server_state, clients_axis) if hook else None
+    return P() if spec is None else spec
+
+
 class Strategy:
     """Base protocol. Subclasses override any of the four methods.
 
@@ -73,6 +88,18 @@ class Strategy:
     def init(self, params: Params) -> Any:
         """Build initial server state from initial model params."""
         raise NotImplementedError
+
+    def state_sharding_spec(self, server_state: Any, clients_axis: str):
+        """Optional per-leaf ``PartitionSpec`` pytree (prefix) for the
+        server state on a client mesh; ``None`` = fully replicated.
+
+        Strategies whose state carries per-client ``[C, ...]`` leaves
+        (wrapper bookkeeping, EF residuals) or replica-sharded optimizer
+        vectors (the ZeRO-1 server optimizer) override this so the round
+        program's ``in_shardings``/``out_shardings`` keep those leaves
+        split instead of replicating the whole state
+        (``parallel/program.py RoundProgramBuilder``)."""
+        return None
 
     def global_params(self, server_state: Any) -> Params:
         """The current global model params (for checkpointing/eval)."""

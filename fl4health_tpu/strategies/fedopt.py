@@ -37,6 +37,32 @@ class FedOpt(Strategy):
     def init(self, params: Params) -> FedOptState:
         return FedOptState(params=params, opt_state=self.tx.init(params))
 
+    def state_sharding_spec(self, server_state: FedOptState, clients_axis: str):
+        """With a ZeRO-1/2 sharded server optimizer (``parallel/zero.py``,
+        wired by ``MeshConfig(zero1=True)``) the optimizer's flat-vector
+        state leaves are partitioned over the replica (clients) axis —
+        cross-replica sharding of the weight update (Xu et al.): each
+        replica owns 1/N of the momenta and the update all-gathers once.
+        Params (and scalar counts) replicate. Without a sharded optimizer
+        the whole state replicates (None)."""
+        from fl4health_tpu.parallel.zero import (
+            Zero2ShardedOptimizer,
+            ZeroShardedOptimizer,
+        )
+
+        if not isinstance(self.tx, (ZeroShardedOptimizer, Zero2ShardedOptimizer)):
+            return None
+        from jax.sharding import PartitionSpec as P
+
+        opt_spec = jax.tree_util.tree_map(
+            lambda leaf: (P(self.tx.axis_name)
+                          if getattr(leaf, "ndim", 0) >= 1 else P()),
+            server_state.opt_state,
+        )
+        return FedOptState(
+            params=P(), opt_state=opt_spec
+        )
+
     def aggregate(self, server_state: FedOptState, results: FitResults, round_idx) -> FedOptState:
         avg = agg.aggregate(
             results.packets, results.sample_counts, results.mask,

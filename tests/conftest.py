@@ -52,6 +52,14 @@ def pytest_configure(config):
         "subsystem). The smoke subset is tier-1-safe and runs by default; "
         "heavier scenarios also carry 'slow'. Select with -m chaos.",
     )
+    config.addinivalue_line(
+        "markers",
+        "multichip: mesh-sharded round-program lanes (parallel/program.py "
+        "MeshConfig). Tier-1-safe under this conftest's forced 8-device "
+        "virtual CPU platform; select with -m multichip. Tests skip "
+        "themselves when fewer than 8 devices are visible "
+        "(eight_devices fixture).",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

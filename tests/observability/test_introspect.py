@@ -31,6 +31,41 @@ class TestAnalyzeCompiled:
         assert out["argument_bytes"] == 2 * 64 * 64 * 4
         assert out["temp_bytes"] is not None
 
+    @pytest.mark.multichip
+    def test_partitioned_flops_scaled_to_whole_program(self):
+        """XLA's cost_analysis reports ONE partition's FLOPs for an SPMD
+        executable; capture must scale them back to whole-program numbers
+        or every downstream per-chip division (MFU, tflops_per_chip)
+        divides by the device count twice."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices")
+        fn = lambda a, b: a @ b  # noqa: E731
+        sds = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+        plain = analyze_compiled(jax.jit(fn).lower(sds, sds).compile())
+        mesh = Mesh(devs[:8], ("clients",))
+        sh = NamedSharding(mesh, P("clients"))
+        sharded_exe = jax.jit(
+            fn, in_shardings=(sh, None), out_shardings=sh
+        ).lower(sds, sds).compile()
+        raw = analyze_compiled(sharded_exe)
+        scaled = analyze_compiled(sharded_exe, n_partitions=8)
+        # this jaxlib reports per-partition numbers; the scaled capture
+        # must land back on the whole-program count
+        assert raw["flops"] == pytest.approx(plain["flops"] / 8)
+        assert scaled["flops"] == pytest.approx(plain["flops"])
+
+        # introspect_jit applies the scaling from the mesh descriptor
+        intro = ProgramIntrospector(MetricsRegistry())
+        rep = intro.introspect_jit(
+            "sharded_mm",
+            jax.jit(fn, in_shardings=(sh, None), out_shardings=sh),
+            (sds, sds), mesh={"n_devices": 8, "axes": {"clients": 8}},
+        )
+        assert rep.flops == pytest.approx(plain["flops"])
+
     def test_broken_executable_degrades_to_none(self):
         class Broken:
             def cost_analysis(self):

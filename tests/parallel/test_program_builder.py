@@ -1,0 +1,151 @@
+"""RoundProgramBuilder / MeshConfig units (parallel/program.py).
+
+The mesh=None contract — the builder constructs EXACTLY the pre-mesh plain
+jit — is the bit-identical-trajectory guarantee's foundation, so it gets
+pinned here at the unit level (the integration half lives in
+tests/server/test_mesh_fit.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fl4health_tpu.clients.engine import TrainState
+from fl4health_tpu.parallel.program import MeshConfig, RoundProgramBuilder
+
+pytestmark = pytest.mark.multichip
+
+
+class TestMeshConfigValidation:
+    def test_model_axis_must_be_positive(self):
+        with pytest.raises(ValueError, match="model"):
+            MeshConfig(model=0)
+
+    def test_clients_must_be_positive(self):
+        with pytest.raises(ValueError, match="clients"):
+            MeshConfig(clients=0)
+
+    def test_tp_rules_require_model_axis(self):
+        with pytest.raises(ValueError, match="tp_rules"):
+            MeshConfig(tp_rules=True)
+
+    def test_too_many_devices_requested(self, eight_devices):
+        with pytest.raises(ValueError, match="devices"):
+            MeshConfig(clients=16, model=2).build(eight_devices)
+
+    def test_cohort_divisibility_checked(self, eight_devices):
+        with pytest.raises(ValueError, match="divisible"):
+            RoundProgramBuilder(MeshConfig(clients=8), n_clients=12)
+
+    def test_default_axes(self, eight_devices):
+        mesh = MeshConfig().build(eight_devices)
+        assert dict(mesh.shape) == {"clients": 8}
+        hybrid = MeshConfig(model=2).build(eight_devices)
+        assert dict(hybrid.shape) == {"clients": 4, "model": 2}
+
+
+class TestBuilderNoMesh:
+    def test_helpers_return_none(self):
+        b = RoundProgramBuilder(None)
+        assert b.mesh is None
+        assert b.n_devices == 1
+        assert b.client_axis_size == 1
+        assert b.client_sharding() is None
+        assert b.replicated() is None
+        assert b.descriptor() is None
+
+    def test_put_is_identity(self):
+        b = RoundProgramBuilder(None)
+        tree = {"a": jnp.arange(3.0)}
+        assert b.put(tree, b.client_sharding()) is tree
+
+    def test_jit_is_plain(self):
+        """mesh=None must construct the exact pre-mesh program: a plain
+        jax.jit with the donation gating and NO sharding constraints."""
+        b = RoundProgramBuilder(None)
+        jitted = b.jit(lambda x: x * 2, donate=(0,))
+        out = jitted(jnp.arange(4.0))
+        assert out.tolist() == [0.0, 2.0, 4.0, 6.0]
+        lowered = jitted.lower(jnp.arange(4.0))
+        assert "sharding" not in lowered.as_text().lower()
+
+    def test_donate_gated_off_cpu(self):
+        gated = RoundProgramBuilder.donate(0, 1)
+        if jax.default_backend() == "cpu":
+            assert gated == ()
+        else:
+            assert gated == (0, 1)
+
+
+class TestBuilderWithMesh:
+    def test_descriptor(self, eight_devices):
+        b = RoundProgramBuilder(MeshConfig(), n_clients=8)
+        d = b.descriptor()
+        assert d["axes"] == {"clients": 8}
+        assert d["n_devices"] == 8
+        assert d["zero1"] is False and d["tp_rules"] is False
+
+    def test_jit_shards_client_axis(self, eight_devices):
+        b = RoundProgramBuilder(MeshConfig(), n_clients=8)
+        cs = b.client_sharding()
+        jitted = b.jit(lambda x: x + 1, in_shardings=(cs,),
+                       out_shardings=(cs))
+        out = jitted(jnp.zeros((8, 4)))
+        assert out.sharding.spec == P("clients")
+        assert len(out.sharding.device_set) == 8
+
+    def test_stacked_client_sharding(self, eight_devices):
+        b = RoundProgramBuilder(MeshConfig(), n_clients=8)
+        placed = b.put(jnp.zeros((3, 8, 2)), b.stacked_client_sharding())
+        assert placed.sharding.spec == P(None, "clients")
+
+    def test_client_state_shardings_default_prefix(self, eight_devices):
+        b = RoundProgramBuilder(MeshConfig(), n_clients=8)
+        template = TrainState(
+            params={"w": jnp.zeros((8, 3))}, opt_state=(),
+            model_state={}, rng=jnp.zeros((8, 2), jnp.uint32),
+            step=jnp.zeros((8,), jnp.int32),
+        )
+        sh = b.client_state_shardings(template)
+        assert isinstance(sh, NamedSharding)
+        assert sh.spec == P("clients")
+
+    def test_client_state_shardings_tp_rules(self, eight_devices):
+        """Megatron pairing through the builder: column-parallel kernels
+        shard their OUTPUT features over 'model', row-parallel their input
+        features; optimizer momenta inherit by dotted-path suffix."""
+        params = {
+            "attn": {
+                "q_proj": {"kernel": jnp.zeros((4, 6, 6))},
+                "o_proj": {"kernel": jnp.zeros((4, 6, 6))},
+            },
+            "norm": {"scale": jnp.zeros((4, 6))},
+        }
+        momenta = jax.tree_util.tree_map(jnp.zeros_like, params)
+        template = TrainState(
+            params=params, opt_state=(momenta,), model_state={},
+            rng=jnp.zeros((4, 2), jnp.uint32),
+            step=jnp.zeros((4,), jnp.int32),
+        )
+        b = RoundProgramBuilder(MeshConfig(clients=4, model=2,
+                                           tp_rules=True), n_clients=4)
+        sh = b.client_state_shardings(template)
+        assert sh.params["attn"]["q_proj"]["kernel"].spec == P(
+            "clients", None, "model")
+        assert sh.params["attn"]["o_proj"]["kernel"].spec == P(
+            "clients", "model", None)
+        assert sh.params["norm"]["scale"].spec == P("clients", None)
+        # momenta inherit their param's rule by path suffix
+        assert sh.opt_state[0]["attn"]["q_proj"]["kernel"].spec == P(
+            "clients", None, "model")
+
+    def test_server_state_replicated_by_default(self, eight_devices):
+        from fl4health_tpu.strategies.fedavg import FedAvg
+
+        strat = FedAvg()
+        state = strat.init({"w": jnp.zeros((3,))})
+        b = RoundProgramBuilder(MeshConfig(), n_clients=8)
+        sh = b.server_state_shardings(strat, state)
+        assert isinstance(sh, NamedSharding)
+        assert sh.spec == P()

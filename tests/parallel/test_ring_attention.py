@@ -13,6 +13,8 @@ from fl4health_tpu.parallel.ring_attention import (
     ring_self_attention,
 )
 
+pytestmark = pytest.mark.multichip
+
 
 def _mesh(devices, n):
     from jax.experimental import mesh_utils
@@ -34,6 +36,7 @@ class TestRingAttention:
         ref = _dense_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    @pytest.mark.slow
     def test_pad_mask_respected_across_ring_hops(self, eight_devices):
         """Padding that lives entirely on ANOTHER device's shard must still be
         excluded — the mask rotates with its K/V block."""
@@ -139,6 +142,7 @@ class TestRingFlashAttention:
         ref = _dense_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    @pytest.mark.slow
     def test_pad_mask_rotates_with_kv(self, eight_devices):
         mesh = _mesh(eight_devices, 8)
         q, k, v = _qkv(t=32)
@@ -157,6 +161,7 @@ class TestRingFlashAttention:
         out = self._ring_flash(q, k, v, mesh, pad_mask=pad_mask)
         assert bool(jnp.all(jnp.isfinite(out)))
 
+    @pytest.mark.slow
     def test_gradients_match_dense(self, eight_devices):
         """The lse cotangent path (delta - dlse in the flash backward) must
         make the MERGED program's gradients agree with dense attention for
@@ -178,6 +183,7 @@ class TestRingFlashAttention:
                 err_msg=f"grad d{name} diverged",
             )
 
+    @pytest.mark.slow
     def test_gradients_match_dense_with_pad_mask(self, eight_devices):
         """The dlse backward path UNDER MASKING: p=0 rows/keys must zero the
         (delta - dlse) term, with padding spanning whole ring shards."""

@@ -30,6 +30,8 @@ from fl4health_tpu.strategies.client_dp_fedavgm import ClientLevelDPFedAvgM
 from fl4health_tpu.strategies.fedavg import FedAvg
 from fl4health_tpu.strategies.scaffold import Scaffold
 
+pytestmark = pytest.mark.multichip
+
 N_CLIENTS = 8
 
 

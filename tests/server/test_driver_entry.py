@@ -30,8 +30,11 @@ def test_entry_forward_jits():
     assert out.shape == (8, 10)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_two_devices(eight_devices):
     # In-process: conftest provides 8 virtual CPU devices, so no re-exec.
+    # slow lane: ~20s of whole-stack compile; the MeshConfig machinery it
+    # drives is covered in tier-1 by tests/server/test_mesh_fit.py.
     mod = _graft_entry()
     mod.dryrun_multichip(2)
 

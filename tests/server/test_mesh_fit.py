@@ -1,0 +1,404 @@
+"""fit() over a device mesh (parallel/program.py wired into the server).
+
+The massive-cohort contract, CI-tested on the forced 8-host-device CPU
+platform (tests/conftest.py):
+
+- ``mesh=None`` (default) keeps both execution modes bit-identical to each
+  other (the pre-mesh guarantee);
+- with ``FederatedSimulation(mesh=MeshConfig(...))`` every compiled round
+  program shards the [C, ...] client axis across all devices (asserted via
+  sharding introspection on the live state) and the trajectories agree
+  with the unsharded run within a pinned tolerance, on BOTH execution
+  modes;
+- donation routes through the same CPU gating (warm persistent-cache runs
+  match cold runs bit-for-bit);
+- wrapper strategies (quarantine + compression) compose without silently
+  gathering the cohort onto one chip.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import (
+    synthetic_classification,
+    synthetic_text_classification,
+)
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.parallel.program import MeshConfig
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.fedopt import fed_adam
+
+pytestmark = pytest.mark.multichip
+
+N_CLIENTS = 8
+# Sharded vs unsharded reorders the cross-client reductions; the pinned
+# tolerance for trajectory agreement (same ballpark as the sharded-mesh
+# round tests' atol).
+TRAJ_ATOL = 1e-5
+
+
+def _datasets(n=40, dim=6, n_classes=3, seed=0):
+    out = []
+    for i in range(N_CLIENTS):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(seed + i), n, (dim,), n_classes
+        )
+        out.append(ClientDataset(x[:24], y[:24], x[24:], y[24:]))
+    return out
+
+
+def _make(mesh=None, execution_mode="auto", strategy=None, compression=None,
+          observability=None, seed=11):
+    return FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(12,), n_outputs=3)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05),
+        strategy=strategy or FedAvg(),
+        datasets=_datasets(),
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=3,
+        seed=seed,
+        execution_mode=execution_mode,
+        mesh=mesh,
+        compression=compression,
+        observability=observability,
+    )
+
+
+def _losses(history):
+    return [r.fit_losses["backward"] for r in history]
+
+
+def _assert_client_stack_sharded(sim, n_devices=8):
+    for leaf in jax.tree_util.tree_leaves(sim.client_states.params):
+        assert leaf.sharding.spec == P("clients"), leaf.sharding
+        assert len(leaf.sharding.device_set) == n_devices
+
+
+class TestMeshNoneUnchanged:
+    def test_modes_bit_identical(self):
+        """The pre-mesh guarantee: with mesh=None (default) the chunked and
+        pipelined trajectories are bit-identical — the builder constructed
+        the plain programs."""
+        l_pipe = _losses(_make(execution_mode="pipelined").fit(3))
+        l_chunk = _losses(_make(execution_mode="chunked").fit(3))
+        assert l_pipe == l_chunk
+
+    def test_no_sharding_constraints_compiled_in(self):
+        sim = _make()
+        lowered = sim._fit_round.lower(
+            sim.server_state, sim.client_states, sim._round_batches(1),
+            sim.client_manager.sample_all(), jnp.asarray(1, jnp.int32),
+            sim._val_batches()[0],
+        )
+        assert "sharding" not in lowered.as_text().lower()
+
+    def test_mesh_type_checked(self):
+        with pytest.raises(TypeError, match="MeshConfig"):
+            _make(mesh={"clients": 8})
+
+
+class TestMeshFit:
+    def test_pipelined_shards_and_matches_unsharded(self, eight_devices):
+        base = _losses(_make(execution_mode="pipelined").fit(3))
+        sim = _make(mesh=MeshConfig(), execution_mode="pipelined")
+        got = _losses(sim.fit(3))
+        np.testing.assert_allclose(base, got, atol=TRAJ_ATOL, rtol=1e-5)
+        _assert_client_stack_sharded(sim)
+        # server state replicates — every device holds the full globals
+        srv = jax.tree_util.tree_leaves(sim.server_state.params)[0]
+        assert srv.sharding.spec == P()
+
+    def test_chunked_shards_and_matches_unsharded(self, eight_devices):
+        base = _losses(_make(execution_mode="chunked").fit(3))
+        sim = _make(mesh=MeshConfig(), execution_mode="chunked")
+        got = _losses(sim.fit(3))
+        np.testing.assert_allclose(base, got, atol=TRAJ_ATOL, rtol=1e-5)
+        _assert_client_stack_sharded(sim)
+
+    def test_sharded_modes_agree(self, eight_devices):
+        lp = _losses(_make(mesh=MeshConfig(),
+                           execution_mode="pipelined").fit(3))
+        lc = _losses(_make(mesh=MeshConfig(),
+                           execution_mode="chunked").fit(3))
+        np.testing.assert_allclose(lp, lc, atol=TRAJ_ATOL, rtol=1e-5)
+
+    def test_fit_chunk_direct_sharded(self, eight_devices):
+        base_sim = _make()
+        base, _ = base_sim.fit_chunk(start_round=1, k=3)
+        sim = _make(mesh=MeshConfig())
+        got, _ = sim.fit_chunk(start_round=1, k=3)
+        np.testing.assert_allclose(
+            np.asarray(base["backward"]), np.asarray(got["backward"]),
+            atol=TRAJ_ATOL, rtol=1e-5,
+        )
+        _assert_client_stack_sharded(sim)
+
+    def test_cohort_not_divisible_raises(self, eight_devices):
+        ds = _datasets()[:6]
+        with pytest.raises(ValueError, match="divisible"):
+            FederatedSimulation(
+                logic=engine.ClientLogic(
+                    engine.from_flax(Mlp(features=(12,), n_outputs=3)),
+                    engine.masked_cross_entropy,
+                ),
+                tx=optax.sgd(0.05), strategy=FedAvg(), datasets=ds,
+                batch_size=8, metrics=MetricManager((efficient.accuracy(),)),
+                local_steps=3, mesh=MeshConfig(clients=8),
+            )
+
+    def test_prefetcher_stages_sharded(self, eight_devices):
+        from fl4health_tpu.server.pipeline import RoundPrefetcher
+
+        sim = _make(mesh=MeshConfig())
+        pf = RoundPrefetcher(sim)
+        pf.schedule(1)
+        batches = pf.take(1)
+        leaf = jax.tree_util.tree_leaves(batches)[0]
+        assert leaf.sharding.spec == P("clients")
+        assert len(leaf.sharding.device_set) == 8
+        pf.close()
+
+
+class TestZero1ServerOptimizer:
+    def test_wired_into_fedopt_and_matches_unsharded(self, eight_devices):
+        base = _losses(_make(strategy=fed_adam(0.1),
+                             execution_mode="chunked").fit(3))
+        sim = _make(strategy=fed_adam(0.1), mesh=MeshConfig(zero1=True),
+                    execution_mode="chunked")
+        got = _losses(sim.fit(3))
+        np.testing.assert_allclose(base, got, atol=TRAJ_ATOL, rtol=1e-4)
+        # each replica owns 1/N of the server momenta (ZeRO-1)
+        vec_leaves = [
+            x for x in jax.tree_util.tree_leaves(sim.server_state.opt_state)
+            if getattr(x, "ndim", 0) >= 1
+        ]
+        assert vec_leaves
+        for leaf in vec_leaves:
+            assert leaf.sharding.spec == P("clients"), leaf.sharding
+
+    def test_requires_fedopt_family(self, eight_devices):
+        with pytest.raises(ValueError, match="FedOpt"):
+            _make(strategy=FedAvg(), mesh=MeshConfig(zero1=True))
+
+    def test_caller_strategy_not_mutated(self, eight_devices):
+        """zero1 wiring rebuilds the strategy chain around copies: a
+        strategy instance reused by an unsharded simulation (the natural
+        sharded-vs-unsharded comparison) must keep its plain optax tx."""
+        from fl4health_tpu.parallel.zero import ZeroShardedOptimizer
+
+        strat = fed_adam(0.1)
+        plain_tx = strat.tx
+        sim = _make(strategy=strat, mesh=MeshConfig(zero1=True),
+                    execution_mode="chunked")
+        assert strat.tx is plain_tx
+        assert isinstance(sim.strategy.tx, ZeroShardedOptimizer)
+        # the untouched instance still drives an unsharded simulation
+        _make(strategy=strat, execution_mode="chunked").fit(1)
+
+    def test_foreign_mesh_prewrap_rejected(self, eight_devices):
+        """A server optimizer ZeRO-sharded against a throwaway mesh must be
+        rejected: its construction-time parity probe certified nothing
+        about the mesh fit() actually dispatches on."""
+        import numpy as onp
+
+        from fl4health_tpu.parallel import mesh as meshlib
+        from fl4health_tpu.parallel.zero import zero_sharded_optimizer
+
+        proto_params = {"w": jnp.zeros((16,))}
+        throwaway = meshlib.Mesh(onp.array(eight_devices[:2]), ("model",))
+        tx = zero_sharded_optimizer(
+            optax.adam(0.1), throwaway, proto_params, axis_name="model"
+        )
+        from fl4health_tpu.strategies.fedopt import FedOpt
+
+        with pytest.raises(ValueError, match="different mesh"):
+            _make(strategy=FedOpt(tx), mesh=MeshConfig(zero1=True))
+
+
+class TestTensorParallelHybrid:
+    def test_transformer_tp_matches_unsharded(self, eight_devices):
+        from fl4health_tpu.models.transformer import TransformerClassifier
+
+        def make(mesh=None):
+            ds = []
+            for i in range(4):
+                x, y = synthetic_text_classification(
+                    jax.random.PRNGKey(i), 12, 64, 8, 4
+                )
+                ds.append(ClientDataset(x[:8], y[:8], x[8:], y[8:]))
+            return FederatedSimulation(
+                logic=engine.ClientLogic(
+                    engine.from_flax(TransformerClassifier(
+                        vocab_size=64, n_classes=4, d_model=16, n_heads=2,
+                        n_layers=1, d_ff=32, max_len=8,
+                    )),
+                    engine.masked_cross_entropy,
+                ),
+                tx=optax.sgd(0.05), strategy=FedAvg(), datasets=ds,
+                batch_size=4, metrics=MetricManager((efficient.accuracy(),)),
+                local_steps=2, seed=1, execution_mode="pipelined", mesh=mesh,
+            )
+
+        base = _losses(make().fit(2))
+        sim = make(mesh=MeshConfig(clients=4, model=2, tp_rules=True))
+        got = _losses(sim.fit(2))
+        np.testing.assert_allclose(base, got, atol=TRAJ_ATOL, rtol=1e-5)
+        # Megatron pairing on the live state: q_proj column-parallel,
+        # o_proj row-parallel, both split over clients on the leading axis
+        flat = jax.tree_util.tree_flatten_with_path(sim.client_states.params)[0]
+        specs = {
+            ".".join(str(getattr(k, "key", k)) for k in kp): leaf.sharding.spec
+            for kp, leaf in flat
+        }
+        q = [v for k, v in specs.items() if k.endswith("q_proj.kernel")]
+        o = [v for k, v in specs.items() if k.endswith("o_proj.kernel")]
+        assert q and all(s == P("clients", None, "model") for s in q)
+        assert o and all(s == P("clients", "model", None) for s in o)
+
+
+class TestWrapperStrategiesUnderMesh:
+    def test_quarantine_plus_compression_no_silent_gather(self, eight_devices):
+        from fl4health_tpu.compression.config import CompressionConfig
+        from fl4health_tpu.resilience.quarantine import (
+            QuarantinePolicy,
+            QuarantiningStrategy,
+        )
+
+        cfg = CompressionConfig(topk_fraction=0.5, quant_bits=8,
+                                error_feedback=True, seed=3)
+
+        def make(mesh=None, mode="chunked"):
+            return _make(
+                mesh=mesh, execution_mode=mode,
+                strategy=QuarantiningStrategy(
+                    FedAvg(), QuarantinePolicy(), n_clients=N_CLIENTS
+                ),
+                compression=cfg,
+            )
+
+        base = _losses(make().fit(3))
+        sim = make(mesh=MeshConfig())
+        got = _losses(sim.fit(3))
+        np.testing.assert_allclose(base, got, atol=TRAJ_ATOL, rtol=1e-4)
+        _assert_client_stack_sharded(sim)
+        # wrapper per-client bookkeeping shards over clients too: the EF
+        # residual stack and the quarantine [C] vectors never gather
+        res_leaf = jax.tree_util.tree_leaves(sim.server_state.residual)[0]
+        assert res_leaf.sharding.spec == P("clients")
+        q = sim.server_state.inner.quarantine.quarantined
+        assert q.sharding.spec == P("clients")
+
+
+class TestMeshObservability:
+    def test_round_events_manifest_and_gauges(self, eight_devices, tmp_path):
+        from fl4health_tpu.observability import Observability
+        from fl4health_tpu.observability.registry import MetricsRegistry
+        from fl4health_tpu.observability.spans import Tracer
+
+        reg = MetricsRegistry()
+        obs = Observability(enabled=True, tracer=Tracer(), registry=reg,
+                            introspection=True, output_dir=str(tmp_path))
+        sim = _make(mesh=MeshConfig(), observability=obs,
+                    execution_mode="chunked")
+        sim.fit(2)
+        # shutdown dumped (and dropped) the event log — read the artifact
+        events = [json.loads(line) for line in
+                  (tmp_path / "metrics.jsonl").read_text().splitlines()]
+        rounds = [e for e in events if e.get("event") == "round"]
+        assert rounds, "no round events logged"
+        for rec in rounds:
+            assert rec["mesh_devices"] == 8
+            assert rec["mesh_client_axis"] == 8
+            assert rec.get("steps_per_s_per_chip", 0) > 0
+        programs = [e for e in events if e.get("event") == "program"]
+        assert programs
+        assert all(p["mesh"]["axes"] == {"clients": 8} for p in programs)
+        assert reg.gauge("fl_mesh_devices").value == 8.0
+        assert reg.gauge("fl_mesh_client_axis").value == 8.0
+        assert reg.gauge("fl_mesh_model_axis").value == 1.0
+        # manifest carries the mesh descriptor (served at /manifest)
+        assert obs.manifest["mesh"]["axes"] == {"clients": 8}
+        assert obs.manifest["config"]["mesh"]["n_devices"] == 8
+
+    def test_single_chip_round_events_unchanged(self, tmp_path):
+        """mesh=None runs must not grow mesh fields — legacy perf_report
+        tables depend on their absence."""
+        from fl4health_tpu.observability import Observability
+        from fl4health_tpu.observability.registry import MetricsRegistry
+        from fl4health_tpu.observability.spans import Tracer
+
+        reg = MetricsRegistry()
+        obs = Observability(enabled=True, tracer=Tracer(), registry=reg,
+                            output_dir=str(tmp_path))
+        _make(observability=obs, execution_mode="chunked").fit(2)
+        events = [json.loads(line) for line in
+                  (tmp_path / "metrics.jsonl").read_text().splitlines()]
+        rounds = [e for e in events if e.get("event") == "round"]
+        assert rounds
+        for rec in rounds:
+            assert "mesh_devices" not in rec
+            assert "steps_per_s_per_chip" not in rec
+            assert "tflops_per_chip" not in rec
+
+
+class TestDonationSafetyAudit:
+    def test_warm_persistent_cache_mesh_run_matches_cold(self, eight_devices):
+        """The PR-2 persistent-cache hazard, audited for the SHARDED jits:
+        an executable compiled with input-output aliasing mis-restores from
+        a warm .jax_test_cache on XLA:CPU (wrong numerics). The sharded
+        programs route through the same _donate_argnums CPU gating, so a
+        warm-cache mesh run must reproduce the cold run bit-for-bit. If
+        someone ever lifts the gating on CPU this test goes red."""
+        cold = _losses(_make(mesh=MeshConfig(),
+                             execution_mode="chunked").fit(3))
+        # drop every in-memory executable: the rebuild below recompiles and
+        # — with the persistent cache enabled by tests/conftest.py — loads
+        # the just-persisted executables from disk (the warm path)
+        jax.clear_caches()
+        warm = _losses(_make(mesh=MeshConfig(),
+                             execution_mode="chunked").fit(3))
+        assert cold == warm
+
+    def test_scaffold_warm_start_sharded(self, eight_devices):
+        """servers.scaffold_warm_start builds its jit through the program
+        builder: under a mesh the warmed variates come back without
+        gathering the client stack."""
+        from fl4health_tpu.clients.scaffold import ScaffoldClientLogic
+        from fl4health_tpu.server.servers import ScaffoldServer
+        from fl4health_tpu.strategies.scaffold import Scaffold
+
+        def make(mesh=None):
+            return FederatedSimulation(
+                logic=ScaffoldClientLogic(
+                    engine.from_flax(Mlp(features=(12,), n_outputs=3)),
+                    engine.masked_cross_entropy, learning_rate=0.05,
+                ),
+                tx=optax.sgd(0.05), strategy=Scaffold(learning_rate=1.0),
+                datasets=_datasets(), batch_size=8,
+                metrics=MetricManager((efficient.accuracy(),)),
+                local_steps=3, seed=11, execution_mode="pipelined",
+                mesh=mesh,
+            )
+
+        base_sim = make()
+        ScaffoldServer(base_sim, warm_start=True).fit(2)
+        base = _losses(base_sim.history)
+        sim = make(mesh=MeshConfig())
+        ScaffoldServer(sim, warm_start=True).fit(2)
+        got = _losses(sim.history)
+        np.testing.assert_allclose(base, got, atol=TRAJ_ATOL, rtol=1e-4)
+        _assert_client_stack_sharded(sim)

@@ -360,3 +360,62 @@ def test_cli_json_includes_wire_fields_when_present(tmp_path):
     doc = json.loads(out)
     assert doc["summary"]["gather_bytes_wire"] == 256
     assert doc["rounds"][0]["wire_compression_ratio"] == 8.5
+
+
+# -- mesh / per-chip columns (mesh-sharded round programs PR) ---------------
+
+def test_mesh_columns_render_when_fields_present():
+    rounds = [_round(1, mesh_devices=8, mesh_client_axis=8,
+                     steps_per_s_per_chip=12.5, tflops_per_chip=0.031),
+              _round(2, mesh_devices=8, mesh_client_axis=8,
+                     steps_per_s_per_chip=13.5, tflops_per_chip=0.033)]
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    assert "chips" in header and "steps/s/chip" in header
+    assert "tflops/chip" in header
+    assert "12.5" in table
+    summary = perf_report.summarize(rounds)
+    assert summary["mesh_devices"] == 8
+    assert summary["steps_per_s_per_chip"] == 13.0
+
+
+def test_mesh_fields_absent_keeps_legacy_table_byte_stable():
+    rounds = [_round(1), _round(2)]
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    assert "chips" not in header and "steps/s/chip" not in header
+    assert header == [h for h, _, _ in perf_report.COLUMNS]
+    summary = perf_report.summarize(rounds)
+    assert "mesh_devices" not in summary
+    assert "steps_per_s_per_chip" not in summary
+
+
+def test_program_table_mesh_column_only_when_present():
+    programs = [
+        {"name": "fit_round", "flops": 1e9, "bytes_accessed": 1e6,
+         "peak_hbm_bytes": 1024, "compile_seconds": 0.5, "cache_hit": True},
+    ]
+    table = perf_report.render_program_table(programs)
+    assert "mesh" not in table.splitlines()[0]
+    programs_mesh = [
+        {**programs[0],
+         "mesh": {"axes": {"clients": 8}, "n_devices": 8}},
+        {"name": "eval_round", "flops": 1e8},
+    ]
+    table = perf_report.render_program_table(programs_mesh)
+    header = table.splitlines()[0].split()
+    assert header[-1] == "mesh"
+    assert "clients=8" in table
+    # a mesh-less record in a mesh table renders '-'
+    assert table.splitlines()[-1].split()[-1] == "-"
+
+
+def test_cli_output_has_no_mesh_markers_for_legacy_log(tmp_path):
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "chips" not in out
+    assert "steps/s/chip" not in out
+    assert "mesh" not in out

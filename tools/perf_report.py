@@ -55,6 +55,16 @@ WIRE_COLUMNS = (
     ("wire_ratio", "wire_compression_ratio", lambda v: f"{v:.1f}x"),
 )
 
+# Mesh-run fields (parallel/program.py RoundProgramBuilder): device count,
+# clients-axis width and the per-chip throughput numbers. Optional like the
+# telemetry columns — single-chip logs keep their exact old table shape
+# (byte-stable, tested).
+MESH_COLUMNS = (
+    ("chips", "mesh_devices", lambda v: str(int(v))),
+    ("steps/s/chip", "steps_per_s_per_chip", lambda v: f"{v:.3g}"),
+    ("tflops/chip", "tflops_per_chip", lambda v: f"{v:.3g}"),
+)
+
 
 def load_events(path: str) -> dict[str, list[dict]]:
     """Parse the JSONL log into {event_kind: [records]}. Malformed lines
@@ -107,7 +117,7 @@ def active_columns(rounds: list[dict]) -> tuple:
     """Base columns plus any telemetry/wire column present in >=1 round
     event."""
     extra = tuple(
-        col for col in TELEMETRY_COLUMNS + WIRE_COLUMNS
+        col for col in TELEMETRY_COLUMNS + WIRE_COLUMNS + MESH_COLUMNS
         if any(col[1] in rec for rec in rounds)
     )
     return COLUMNS + extra
@@ -143,6 +153,13 @@ def _fmt_program_cell(field: str, rec: dict) -> str:
         return "-"
     if field == "cache_hit":
         return "hit" if v else "miss"
+    if field == "mesh":
+        # mesh/sharding descriptor -> compact axis summary ("clients=8" /
+        # "clients=4,model=2")
+        axes = (v or {}).get("axes") or {}
+        if not axes:
+            return "-"
+        return ",".join(f"{a}={int(n)}" for a, n in axes.items())
     if field == "name":
         return str(v)
     if field == "compile_seconds":
@@ -223,6 +240,11 @@ def render_program_table(programs: list[dict]) -> str:
     fields = ("name", "flops", "bytes_accessed", "peak_hbm_bytes",
               "compile_seconds", "cache_hit")
     headers = ("program", "flops", "bytes", "hbm_peak", "compile_ms", "cache")
+    if any(rec.get("mesh") for rec in programs):
+        # mesh-built programs only (parallel/program.py descriptor) —
+        # single-chip logs keep the exact legacy table shape
+        fields = fields + ("mesh",)
+        headers = headers + ("mesh",)
     rows = [list(headers)]
     for rec in programs:
         rows.append([_fmt_program_cell(f, rec) for f in fields])
@@ -260,6 +282,16 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
     if any("gather_bytes_wire" in r for r in rounds):
         # compressed-exchange runs only — legacy summaries stay byte-stable
         summary["gather_bytes_wire"] = int(tot("gather_bytes_wire"))
+    if any("mesh_devices" in r for r in rounds):
+        # mesh runs only — device count plus the mean per-chip throughput
+        # over the rounds that measured one
+        summary["mesh_devices"] = int(max(
+            float(r.get("mesh_devices", 0)) for r in rounds
+        ))
+        sps = [float(r["steps_per_s_per_chip"]) for r in rounds
+               if "steps_per_s_per_chip" in r]
+        if sps:
+            summary["steps_per_s_per_chip"] = round(sum(sps) / len(sps), 4)
     return summary
 
 
