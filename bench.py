@@ -138,16 +138,27 @@ def analytic_transformer_round_flops(
     return 3.0 * per_tok_fwd * tokens_per_round
 
 
+def _headline_conv_impl() -> str:
+    """The resolved conv impl of the (unsharded) headline config — what the
+    artifact's ``conv_impl`` field must name (the env may say "auto")."""
+    from fl4health_tpu.models.cnn import resolve_conv_impl
+
+    return resolve_conv_impl(os.environ.get("FL4HEALTH_BENCH_CONV", "auto"))
+
+
 def make_sim(model_kind: str = "cifar_cnn", conv_impl: str | None = None,
              n_clients_override: int | None = None, mesh=None,
-             observability=None):
+             observability=None, precision=None, model_dtype=None):
     """``conv_impl``/``n_clients_override``/``mesh``/``observability`` are
     overrides for the mesh block (timed_mesh_rounds) and the multichip
     artifact: a sharded clients axis requires the im2col MxuConv lowering
     (XLA's partitioner rejects the grouped-conv one) and a cohort divisible
     by the device count; observability must be present at construction so
     the round programs are built against it (post-construction assignment
-    would leave the telemetry/introspection variants unbuilt)."""
+    would leave the telemetry/introspection variants unbuilt).
+    ``precision``/``model_dtype`` serve the precision block
+    (timed_precision_block): the A/B pins the MODEL dtype to f32 so the
+    engine-level PrecisionConfig is the only difference between arms."""
     import jax
     import optax
 
@@ -163,7 +174,7 @@ def make_sim(model_kind: str = "cifar_cnn", conv_impl: str | None = None,
     from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
     from fl4health_tpu.strategies.fedavg import FedAvg
 
-    dtype = _bench_dtype()
+    dtype = model_dtype if model_dtype is not None else _bench_dtype()
     datasets = []
     analytic_flops = None  # set where the XLA cost model undercounts
 
@@ -174,15 +185,20 @@ def make_sim(model_kind: str = "cifar_cnn", conv_impl: str | None = None,
                              x_val=x[n:], y_val=y[n:])
 
     if model_kind == "cifar_cnn":
-        # "mxu" lowers the per-client vmapped convs as im2col + batched
-        # matmul instead of grouped convolutions (models/cnn.py MxuConv) —
-        # the grouped-conv lowering is the suspected TPU MFU limiter
-        # (BENCH_r03 note). Measured on XLA:CPU the im2col TRAIN step is
-        # ~3.4x SLOWER (the patches backward lowers to scatter-add), so the
-        # default stays "lax" until a TPU measurement decides; flip with
-        # FL4HEALTH_BENCH_CONV=mxu and compare conv_impl fields.
+        # Conv impl selection (models/cnn.py resolve_conv_impl): the
+        # default "auto" resolves per backend/mesh — "lax" (grouped conv)
+        # everywhere the partitioner accepts it (the real-TPU A/B in the
+        # MxuConv docstring: grouped 3186 vs im2col 606 steps/s on a v5e),
+        # "mxu" only under a clients-sharded mesh, where XLA's grouped-conv
+        # partitioner rejects the vmapped nn.Conv outright. Pin with
+        # FL4HEALTH_BENCH_CONV=lax|mxu and compare conv_impl fields.
+        from fl4health_tpu.models.cnn import resolve_conv_impl
+
         if conv_impl is None:
-            conv_impl = os.environ.get("FL4HEALTH_BENCH_CONV", "lax")
+            conv_impl = os.environ.get("FL4HEALTH_BENCH_CONV", "auto")
+        conv_impl = resolve_conv_impl(
+            conv_impl, sharded_clients=mesh is not None
+        )
         module = CifarNet(dtype=dtype, conv_impl=conv_impl)
         n_clients = n_clients_override or N_CLIENTS
         for i in range(n_clients):
@@ -276,6 +292,7 @@ def make_sim(model_kind: str = "cifar_cnn", conv_impl: str | None = None,
         seed=0,
         mesh=mesh,
         observability=observability,
+        precision=precision,
     )
 
 
@@ -588,6 +605,70 @@ def timed_compression_overhead(sim, timing: bool = True) -> dict:
     }
 
 
+def timed_precision_block(timing: bool = True) -> dict:
+    """Mixed-precision block (the roofline-path PR acceptance metric):
+    engine-level bf16 compute with f32 master weights
+    (``FederatedSimulation(precision=PrecisionConfig("bfloat16"))``) vs the
+    plain f32 build, on the benched CIFAR config with the MODEL dtype
+    pinned to f32 so the PrecisionConfig is the ONLY difference between
+    arms.
+
+    ``loss_delta`` (final-round training-loss gap between the arms over
+    TIMED_ROUNDS identical-seed rounds) is always measured — it is the
+    cheap half and the accuracy side of the claim survives the CPU
+    fallback. ``timing=False`` skips only the round-time arms (round_s_*/
+    mfu_pct_* come back null, the standard CPU-fallback annotation): bf16
+    is EMULATED on XLA:CPU, so a fallback timing would report the emulation
+    tax, not the MXU speedup. Per-arm ``mfu_pct`` uses each arm's own
+    compiled cost-model FLOPs over its measured round time against the
+    chip's bf16 peak — null (never 0.0) where either is unknown."""
+    from fl4health_tpu.precision import PrecisionConfig
+
+    import jax.numpy as jnp
+
+    dtype_name = os.environ.get("FL4HEALTH_BENCH_PRECISION_DTYPE", "bfloat16")
+    _, device_kind = _provenance()
+    peak = device_specs.peak_bf16_flops(device_kind)
+
+    def arm(precision):
+        round_s = flops = None
+        if timing:
+            _, sim = make_sim("cifar_cnn", precision=precision,
+                              model_dtype=jnp.float32)
+            compiled, prog = compile_fit_round(sim)
+            flops = prog.flops
+            round_s = timed_compiled_rounds(sim, compiled)
+            del sim
+        # loss trajectory on a FRESH sim (the timed dispatches donated the
+        # first sim's state buffers); identical seeds across arms
+        _, sim = make_sim("cifar_cnn", precision=precision,
+                          model_dtype=jnp.float32)
+        loss = float(sim.fit(TIMED_ROUNDS)[-1].fit_losses["backward"])
+        return round_s, flops, loss
+
+    def mfu(flops, round_s):
+        if not (peak and flops and round_s):
+            return None
+        return round(100.0 * flops / round_s / peak, 2)
+
+    f32_s, f32_flops, f32_loss = arm(None)
+    lp_s, lp_flops, lp_loss = arm(PrecisionConfig(dtype_name))
+    return {
+        "compute_dtype": dtype_name,
+        "round_s_f32": round(f32_s, 5) if f32_s is not None else None,
+        "round_s_bf16": round(lp_s, 5) if lp_s is not None else None,
+        "speedup": (round(f32_s / lp_s, 3) if f32_s and lp_s else None),
+        # per-arm MFU, attributed to the dtype that produced the wall time
+        # (both against the chip's bf16 peak — the roofline of record)
+        "mfu_pct_f32": mfu(f32_flops, f32_s),
+        "mfu_pct_bf16": mfu(lp_flops, lp_s),
+        "loss_f32": round(f32_loss, 5),
+        "loss_bf16": round(lp_loss, 5),
+        "loss_delta": round(abs(lp_loss - f32_loss), 5),
+        "rounds": TIMED_ROUNDS,
+    }
+
+
 def mesh_cohort_size(n_dev: int) -> int:
     """Cohort for the mesh arms: the nearest device-count multiple of
     ``N_CLIENTS`` — rounded DOWN when the configured cohort exceeds the
@@ -832,6 +913,25 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
             and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
         )
         out["compression"] = timed_compression_overhead(sim, timing=timing)
+    # Mixed-precision arms (the roofline-path PR metric: bf16 engine policy
+    # vs f32, {round_s_f32, round_s_bf16, speedup, mfu_pct per arm,
+    # loss_delta}). Same gating shape as telemetry/resilience:
+    # FL4HEALTH_BENCH_PRECISION=1 forces the full block, =0 disables it,
+    # "auto" runs it on the headline config but skips the CPU fallback
+    # entirely — the arms each compile + fit a fresh sim, which the
+    # fallback's tight budget cannot absorb, and a fallback bf16 timing
+    # would report the XLA:CPU emulation tax, not the MXU speedup. The
+    # standalone ``python bench.py --precision`` artifact covers the
+    # fallback (loss_delta measured, timing arms null-annotated).
+    want_p = os.environ.get("FL4HEALTH_BENCH_PRECISION", "auto")
+    if want_p == "1" or (
+        want_p == "auto" and with_eager
+        and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+    ):
+        out["precision"] = timed_precision_block(
+            timing=not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+            or want_p == "1"
+        )
     # Mesh-sharded rounds (the massive-cohort PR metric): opt-in only —
     # FL4HEALTH_BENCH_MESH=1 — because it compiles two extra chunked scans
     # and needs a multi-device backend (single-device runs report skipped).
@@ -871,7 +971,7 @@ def run_measurement() -> None:
         # Alt-config child (e.g. the mxu-conv comparison): compiled
         # measurement only, no eager baseline.
         out = _measure_config("cifar_cnn", with_eager=False)
-        out["conv_impl"] = os.environ.get("FL4HEALTH_BENCH_CONV", "lax")
+        out["conv_impl"] = _headline_conv_impl()
         print(json.dumps(out))
         return
 
@@ -918,7 +1018,7 @@ def run_measurement() -> None:
         # Assumption-based bridge to BASELINE.json's >=10x-vs-A100-Flower
         # north star (see modeled_vs_a100_flower); null off-TPU.
         "vs_a100_flower_modeled": cifar.get("vs_a100_flower_modeled"),
-        "conv_impl": os.environ.get("FL4HEALTH_BENCH_CONV", "lax"),
+        "conv_impl": _headline_conv_impl(),
         "execution_mode": cifar["execution_mode"],
         "rounds_per_dispatch": cifar["rounds_per_dispatch"],
         "steps_per_sec_single_dispatch": cifar["steps_per_sec_single_dispatch"],
@@ -936,6 +1036,10 @@ def run_measurement() -> None:
         # bytes_wire, ratio, round_s_plain, round_s_compressed}) measured
         # on real wire frames — the communication-efficiency PR metric
         "compression": cifar.get("compression"),
+        # engine-level mixed-precision arms ({round_s_f32, round_s_bf16,
+        # speedup, mfu_pct per arm, loss_delta}) — the roofline-path PR
+        # metric; timing arms null on the CPU fallback
+        "precision": cifar.get("precision"),
     }
     if fallback_note:
         record["note"] = fallback_note
@@ -1055,6 +1159,49 @@ def run_multichip_artifact() -> None:
         json.dump(record, f, indent=1)
     print(json.dumps({"written": out_path, "value": record["value"],
                       "unit": record["unit"]}))
+
+
+def run_precision_artifact() -> None:
+    """``python bench.py --precision``: the mixed-precision A/B as its own
+    artifact, landed as ``BENCH_precision_<label>_<ts>.json``. On a real
+    accelerator the timing arms measure the bf16-vs-f32 round walls and
+    per-arm MFU; on CPU the timing arms are skipped with the standard
+    fallback annotation (bf16 is emulated on XLA:CPU) and the artifact
+    still carries the measured ``loss_delta`` — the harness-health
+    variant. FL4HEALTH_BENCH_PRECISION=1 forces the timing arms anywhere
+    (e.g. to record the emulation tax explicitly)."""
+    platform, device_kind = _provenance()
+    fallback = platform == "cpu"
+    timing = (os.environ.get("FL4HEALTH_BENCH_PRECISION") == "1"
+              or not fallback)
+    block = timed_precision_block(timing=timing)
+    label = f"{platform}_fallback" if fallback else platform
+    record = {
+        "metric": (f"fedavg_cifar_cnn_{N_CLIENTS}clients_precision"
+                   f"{'_cpu_fallback' if fallback else ''}"),
+        "platform": platform,
+        "device_kind": device_kind,
+        "data_provenance": "synthetic",
+        "model_dtype": "float32",
+        "precision": block,
+    }
+    if fallback and not timing:
+        record["note"] = (
+            "CPU-fallback context: bf16 is emulated on XLA:CPU, so the "
+            "round_s/mfu timing arms are skipped (null) — loss_delta is "
+            "the measured half here. This artifact certifies the harness "
+            "runs, not the speed claim; re-run on TPU for the speedup."
+        )
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"BENCH_precision_{label}_{stamp}.json",
+    )
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({"written": out_path,
+                      "loss_delta": block["loss_delta"],
+                      "speedup": block["speedup"]}))
 
 
 def main() -> None:
@@ -1246,5 +1393,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--multichip" in sys.argv:
         run_multichip_artifact()
+    elif "--precision" in sys.argv:
+        run_precision_artifact()
     else:
         main()

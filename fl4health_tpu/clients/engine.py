@@ -31,6 +31,7 @@ from flax import struct
 from fl4health_tpu.core.pytree import tree_nbytes
 from fl4health_tpu.core.types import Params, PRNGKey, PyTree
 from fl4health_tpu.losses.containers import LossMeter
+from fl4health_tpu.precision import policy as precision_policy
 from fl4health_tpu.metrics.base import MetricManager
 from fl4health_tpu.observability.registry import get_registry
 from fl4health_tpu.observability.spans import get_tracer
@@ -64,6 +65,10 @@ class TrainState:
     rng: PRNGKey
     step: jax.Array
     extra: Any = None  # algorithm-specific persistent state
+    # dynamic loss-scale state ({"scale", "growth", "skipped"}) when the
+    # precision policy scales (fp16); None otherwise — an empty pytree
+    # node, so precision-off states keep their legacy structure exactly
+    loss_scale: Any = None
 
 
 @struct.dataclass
@@ -217,12 +222,13 @@ class ClientLogic:
         expensive work on the mask to avoid wasted compute."""
         return state
 
-    def value_and_grads(self, state: TrainState, ctx: Any, batch: Batch, step_rng: PRNGKey):
-        """Compute ((backward, (preds, additional, new_model_state)), grads).
-
-        Default: whole-batch ``value_and_grad``. DP logics override this with
-        vmapped per-example gradients + clip + noise (the Opacus hook point,
-        instance_level_dp_client.py:85-114 in the reference)."""
+    def _loss_fn(self, state: TrainState, ctx: Any, batch: Batch,
+                 step_rng: PRNGKey):
+        """The differentiated closure params -> (backward, (preds,
+        additional, new_model_state)). ONE definition shared by the default
+        ``value_and_grads`` below and the engine's fp16 loss-scaling path
+        (which seeds its backward via ``jax.vjp``), so the scaled and
+        unscaled gradient paths cannot silently drift apart."""
 
         def loss_fn(params):
             (preds, features), new_model_state = self.predict(
@@ -234,7 +240,17 @@ class ClientLogic:
             )
             return backward, (preds, additional, new_model_state)
 
-        return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        return loss_fn
+
+    def value_and_grads(self, state: TrainState, ctx: Any, batch: Batch, step_rng: PRNGKey):
+        """Compute ((backward, (preds, additional, new_model_state)), grads).
+
+        Default: whole-batch ``value_and_grad``. DP logics override this with
+        vmapped per-example gradients + clip + noise (the Opacus hook point,
+        instance_level_dp_client.py:85-114 in the reference)."""
+        return jax.value_and_grad(
+            self._loss_fn(state, ctx, batch, step_rng), has_aux=True
+        )(state.params)
 
     def update_after_step(self, state: TrainState, ctx: Any, batch: Batch,
                           preds: dict | None = None) -> TrainState:
@@ -288,7 +304,11 @@ def masked_bce_with_logits(logits: jax.Array, targets: jax.Array, mask: jax.Arra
 def create_train_state(
     logic: ClientLogic, tx: optax.GradientTransformation, rng: PRNGKey,
     sample_x: jax.Array,
+    precision: Any = None,
 ) -> TrainState:
+    """``precision`` (a PrecisionConfig, optional): params/opt state are
+    ALWAYS created f32 master (init runs in the model's native dtypes); a
+    scaling policy additionally seeds the carried loss-scale state."""
     params, model_state = logic.model.init(rng, sample_x)
     return TrainState(
         params=params,
@@ -297,6 +317,7 @@ def create_train_state(
         rng=rng,
         step=jnp.zeros((), jnp.int32),
         extra=logic.init_extra(params),
+        loss_scale=precision_policy.loss_scale_init(precision),
     )
 
 
@@ -374,7 +395,7 @@ def _microbatched_value_and_grads(logic, tx, state, ctx, batch, step_rng):
 
 
 def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation,
-                    collect_telemetry: bool = False):
+                    collect_telemetry: bool = False, precision: Any = None):
     """Returns step(state, ctx, batch) -> (state, StepOutput) — jit/scan-safe.
 
     ``collect_telemetry`` additionally populates ``StepOutput.grad_norm``
@@ -382,8 +403,50 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation,
     the optimizer actually consumes — SCAFFOLD correction, DP noise etc.
     included). A pure extra output: the parameter update math is untouched,
     so telemetry-on trajectories stay bit-identical to telemetry-off
-    (tests/observability/test_telemetry.py)."""
+    (tests/observability/test_telemetry.py).
+
+    ``precision`` (a :class:`~fl4health_tpu.precision.PrecisionConfig`, or
+    None): the engine-level mixed-precision policy. With a low-precision
+    compute dtype the logic's model apply is wrapped so float params AND
+    float inputs are cast at apply time — the forward/backward runs in
+    bf16/fp16 for EVERY logic routing through ``logic.model`` (the default
+    path, DP per-example gradients, dual forwards) while gradients come
+    back f32 at the parameter boundary (the cast's VJP) and optax applies
+    them to the f32 master weights. fp16 adds in-graph loss scaling: the
+    backward is seeded with the scale as the loss cotangent, gradients are
+    unscaled in f32, a non-finite gradient skips the step (params,
+    optimizer and model_state untouched) and the scale/growth/skip state
+    evolves in ``TrainState.loss_scale``. ``None`` (or an inactive config)
+    builds the exact legacy step — bit-identical, pinned by
+    tests/precision/."""
+    precision = precision_policy.resolve(precision)
+    if precision is not None and precision.casts_compute:
+        logic = precision_policy.wrap_logic_compute(
+            logic, precision.compute_jnp_dtype
+        )
+    scaling = precision is not None and precision.scaling_active
     unreduced = getattr(tx, "expects_unreduced_grads", False)
+    if scaling:
+        if unreduced:
+            raise ValueError(
+                "loss scaling cannot compose with the ZeRO-2 microbatched "
+                "gradient path (expects_unreduced_grads): the per-microbatch "
+                "finite screen would skip shards independently and the "
+                "pre-scaled recombination no longer holds — use bf16 (no "
+                "scaling) with ZeRO-2"
+            )
+        if type(logic).value_and_grads is not ClientLogic.value_and_grads:
+            # A logic that owns its gradient computation (DP per-example
+            # clip+noise) would see SCALED gradients inside its mechanism —
+            # the clip bound and noise sigma would silently mis-calibrate.
+            # bf16 (range of f32, no scaling needed) composes fine.
+            raise TypeError(
+                f"in-graph loss scaling wraps the engine's default gradient "
+                f"path only: {type(logic).__name__} overrides "
+                "value_and_grads (e.g. DP per-example gradients), whose "
+                "clip/noise calibration breaks under a scaled backward — "
+                "use compute_dtype='bfloat16' with loss_scale='none'"
+            )
     if unreduced:
         # The microbatch pre-scaling assumes the optimizer's uniform MEAN
         # reduction; a reduce="sum" ZeRO-2 would silently apply n_shards x
@@ -411,12 +474,41 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation,
         )
         rng, step_rng = jax.random.split(state.rng)
         batch = logic.augment(batch, jax.random.fold_in(step_rng, 0xA6), ctx)
+        finite = None
         if unreduced:
             backward, preds, additional, new_model_state, grads = (
                 _microbatched_value_and_grads(
                     logic, tx, state, ctx, batch, step_rng
                 )
             )
+        elif scaling:
+            ls = state.loss_scale
+            if ls is None:
+                raise ValueError(
+                    "loss scaling needs the carried scaler state: build the "
+                    "TrainState with create_train_state(..., precision=...) "
+                    "(FederatedSimulation(precision=...) does this)"
+                )
+
+            # THE default-path loss closure (logic._loss_fn — one shared
+            # definition), driven through jax.vjp so the backward can be
+            # SEEDED with the scale as the loss cotangent — mathematically
+            # identical to scaling the loss (gradients are linear in the
+            # cotangent) but it reaches every intermediate fp16 cotangent,
+            # which is where the underflow lives. The primal loss stays
+            # unscaled, so meters/telemetry report true values.
+            backward, vjp_fn, (preds, additional, new_model_state) = jax.vjp(
+                logic._loss_fn(state, ctx, batch, step_rng),
+                state.params, has_aux=True,
+            )
+            grads = vjp_fn(ls["scale"].astype(backward.dtype))[0]
+            # unscale in f32 (grads are f32 at the master-param boundary);
+            # the finite screen runs on the UNSCALED gradient so a huge
+            # scale can't masquerade as overflow
+            inv = 1.0 / ls["scale"]
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            finite = precision_policy.tree_all_finite(grads)
+            grads = logic.transform_gradients(grads, state, ctx)
         else:
             (backward, (preds, additional, new_model_state)), grads = (
                 logic.value_and_grads(state, ctx, batch, step_rng)
@@ -426,13 +518,28 @@ def make_train_step(logic: ClientLogic, tx: optax.GradientTransformation,
         new_params = optax.apply_updates(state.params, updates)
 
         keep = batch.step_mask  # padding steps must not move anything
+        # a non-finite scaled gradient additionally skips the optimizer
+        # step (master weights, optimizer state and batch stats untouched)
+        keep_update = keep if finite is None else keep * finite
         new_state = state.replace(
-            params=_mask_tree(new_params, state.params, keep),
-            opt_state=_mask_tree(new_opt_state, state.opt_state, keep),
-            model_state=_mask_tree(new_model_state, state.model_state, keep),
+            params=_mask_tree(new_params, state.params, keep_update),
+            opt_state=_mask_tree(new_opt_state, state.opt_state, keep_update),
+            model_state=_mask_tree(
+                new_model_state, state.model_state, keep_update
+            ),
             rng=rng,
-            step=state.step + keep.astype(jnp.int32),
+            step=state.step + keep_update.astype(jnp.int32),
         )
+        if scaling:
+            # scaler state advances on REAL steps only (padding steps are
+            # full no-ops); it advances on skipped steps too — that is how
+            # the scale backs off and recovers
+            new_ls = precision_policy.loss_scale_step(
+                state.loss_scale, finite, precision
+            )
+            new_state = new_state.replace(
+                loss_scale=_mask_tree(new_ls, state.loss_scale, keep)
+            )
         new_state = logic.update_after_step(new_state, ctx, batch, preds=preds)
         grad_norm = None
         if collect_telemetry:
@@ -502,6 +609,7 @@ def make_local_train(
     metric_manager: MetricManager,
     loss_keys: tuple[str, ...] = ("backward",),
     collect_telemetry: bool = False,
+    precision: Any = None,
 ):
     """Compiled local-training phase: scan the train step over stacked batches.
 
@@ -510,8 +618,12 @@ def make_local_train(
     With ``collect_telemetry`` a fifth output is appended: the engine's
     telemetry dict (loss min/max, grad-norm mean/max over executed steps) —
     extra scan outputs only; the training math is byte-for-byte the same.
+    ``precision`` threads the mixed-precision policy into every step (see
+    :func:`make_train_step`); telemetry stats are computed from the f32
+    boundary values (unscaled grads, f32 losses) either way.
     """
-    step_fn = make_train_step(logic, tx, collect_telemetry=collect_telemetry)
+    step_fn = make_train_step(logic, tx, collect_telemetry=collect_telemetry,
+                              precision=precision)
     meter_proto = LossMeter.create(loss_keys)
 
     def train(state: TrainState, ctx: Any, batches: Batch):
@@ -593,6 +705,7 @@ def make_local_train_with_early_stopping(
     config: EarlyStoppingConfig,
     loss_keys: tuple[str, ...] = ("backward",),
     collect_telemetry: bool = False,
+    precision: Any = None,
 ):
     """Early-stopped local training as ONE compiled program.
 
@@ -607,9 +720,12 @@ def make_local_train_with_early_stopping(
     ``make_local_train`` (including the telemetry dict when
     ``collect_telemetry``; stats cover executed steps only — batches after
     the stop flag have their step_mask zeroed and never touch the
-    accumulator).
+    accumulator). ``precision`` applies to the TRAIN steps only: the
+    in-scan validation (and the best-snapshot selection it drives) scores
+    the f32 master weights, matching ``fit()``'s eval rounds.
     """
-    step_fn = make_train_step(logic, tx, collect_telemetry=collect_telemetry)
+    step_fn = make_train_step(logic, tx, collect_telemetry=collect_telemetry,
+                              precision=precision)
     evaluate = make_local_eval(logic, metric_manager)
     meter_proto = LossMeter.create(loss_keys)
     interval = config.interval_steps

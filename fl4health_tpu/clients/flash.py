@@ -47,10 +47,14 @@ def make_flash_local_train(
     metric_manager: MetricManager,
     config: FlashEarlyStopConfig,
     loss_keys: tuple[str, ...] = ("backward",),
+    precision=None,
 ):
     """Returns train(state, ctx, batches, val_batches) with the engine's
-    standard outputs (state, loss_dict, metric_dict, n_steps)."""
-    step_fn = engine.make_train_step(logic, tx)
+    standard outputs (state, loss_dict, metric_dict, n_steps).
+    ``precision`` threads the engine's mixed-precision policy into the
+    train steps (the per-epoch gamma-rule validation scores f32 master
+    weights, like the other early-stop paths)."""
+    step_fn = engine.make_train_step(logic, tx, precision=precision)
     evaluate = engine.make_local_eval(logic, metric_manager)
     meter_proto = LossMeter.create(loss_keys)
     n_epochs = config.n_epochs

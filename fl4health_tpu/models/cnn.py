@@ -15,6 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from fl4health_tpu.precision.policy import conv_compute_dtype
+
 
 class MnistNet(nn.Module):
     """Small MNIST CNN (examples/models/cnn_model.py MnistNet equivalent):
@@ -82,9 +84,14 @@ class MxuConv(nn.Module):
     features: int
     kernel_size: tuple[int, ...] = (3, 3)
     padding: str = "SAME"
-    # None = nn.Conv's dtype=None semantics: promote input AND params via
-    # result_type (f32 params + bf16 input -> f32 compute), keeping the
-    # lax/mxu impls numerically interchangeable
+    # None = nn.Conv's dtype=None semantics: ONE promotion rule —
+    # precision.policy.conv_compute_dtype, result_type over input + kernel
+    # + bias (flax's promote_dtype includes the bias; an earlier version
+    # here omitted it, which could diverge from nn.Conv under mixed-dtype
+    # params). Under the engine-level precision cast every operand is
+    # already the policy dtype, so the rule degenerates to it — keeping the
+    # lax/mxu impls numerically interchangeable at bf16 (parity pinned by
+    # tests/models/test_mxu_conv.py).
     dtype: jnp.dtype | None = None
     strides: tuple[int, ...] | None = None
 
@@ -99,7 +106,7 @@ class MxuConv(nn.Module):
         )
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
         dtype = (self.dtype if self.dtype is not None
-                 else jnp.result_type(x.dtype, kernel.dtype))
+                 else conv_compute_dtype(x.dtype, kernel.dtype, bias.dtype))
         patches = jax.lax.conv_general_dilated_patches(
             x.astype(dtype), ks,
             tuple(self.strides) if self.strides else (1,) * rank,
@@ -115,6 +122,26 @@ class MxuConv(nn.Module):
         return y + bias.astype(dtype)
 
 
+def resolve_conv_impl(impl: str, *, sharded_clients: bool = False) -> str:
+    """Resolve ``"auto"`` to a concrete conv impl per the measured policy:
+
+    ``"lax"`` (grouped-conv ``nn.Conv``) everywhere XLA accepts it — the
+    real-TPU A/B in the :class:`MxuConv` docstring measured grouped conv
+    3186 vs im2col's 606 steps/s on a v5e, so im2col is never a speed play;
+    ``"mxu"`` only where the grouped-conv partitioner REJECTS the vmapped
+    ``nn.Conv``: clients-axis-sharded meshes (``sharded_clients=True`` —
+    the ``tests/parallel/test_sharded_mesh.py`` segmentation case), where
+    the weight-independent patch extraction is the lowering that compiles
+    at all. Concrete impls pass through unchanged."""
+    if impl == "auto":
+        return "mxu" if sharded_clients else "lax"
+    if impl not in ("lax", "mxu"):
+        raise ValueError(
+            f"conv impl must be 'lax', 'mxu' or 'auto', got {impl!r}"
+        )
+    return impl
+
+
 def make_conv(
     impl: str,
     features: int,
@@ -127,17 +154,21 @@ def make_conv(
 ) -> nn.Module:
     """The ONE conv-impl switch ("lax" = nn.Conv, "mxu" = MxuConv) shared by
     every model that offers the knob (CifarNet, the U-Net blocks/heads).
+    ``"auto"`` resolves via :func:`resolve_conv_impl`; a module cannot know
+    at trace time whether its clients axis is mesh-sharded, so ``"auto"``
+    here assumes unsharded ("lax") — callers building for a
+    clients-sharded mesh resolve with ``sharded_clients=True`` first (the
+    bench's ``make_sim`` does).
 
     Callers must pass ``name`` matching nn.Conv's auto-name for that call
     site ("Conv_0", "Conv_1", ...): both impls then produce identical param
     paths, hence identical RNG-keyed initial values, so checkpoints and
     exchanger path filters are impl-agnostic.
     """
+    impl = resolve_conv_impl(impl)
     if impl == "mxu":
         return MxuConv(features, tuple(kernel_size), strides=strides,
                        padding=padding, dtype=dtype, name=name)
-    if impl != "lax":
-        raise ValueError(f"conv impl must be 'lax' or 'mxu', got {impl!r}")
     return nn.Conv(features, tuple(kernel_size), strides=strides,
                    padding=padding, dtype=dtype, use_bias=True, name=name)
 

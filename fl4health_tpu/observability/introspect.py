@@ -85,6 +85,11 @@ class ProgramReport:
     # single-chip builds (and omitted from as_dict/events, so legacy
     # program records keep their exact shape)
     mesh: dict | None = None
+    # precision-policy descriptor (precision.PrecisionConfig.describe())
+    # when the program was compiled under an active mixed-precision policy;
+    # None on f32 builds (omitted from as_dict/events like ``mesh``) — the
+    # dtype a program's flops/MFU numbers are attributable to
+    precision: dict | None = None
 
     @property
     def peak_hbm_bytes(self) -> int | None:
@@ -120,6 +125,8 @@ class ProgramReport:
         d = {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
         if d.get("mesh") is None:
             del d["mesh"]
+        if d.get("precision") is None:
+            del d["precision"]
         d["peak_hbm_bytes"] = self.peak_hbm_bytes
         d["cache_hit"] = self.cache_hit
         roof = self.roofline()
@@ -198,7 +205,8 @@ class ProgramIntrospector:
     # -- capture ---------------------------------------------------------
     def introspect_jit(self, name: str, jitted: Any, args: tuple,
                        rounds_per_dispatch: int = 1,
-                       mesh: dict | None = None) -> ProgramReport | None:
+                       mesh: dict | None = None,
+                       precision: dict | None = None) -> ProgramReport | None:
         """AOT-lower and compile ``jitted`` against (abstracted) ``args``
         and record the report. The compile goes through XLA's normal
         ``compile_or_get_cached`` path, so with the persistent compilation
@@ -225,6 +233,7 @@ class ProgramIntrospector:
                 ),
                 rounds_per_dispatch=rounds_per_dispatch,
                 mesh=mesh,
+                precision=precision,
                 **analyze_compiled(
                     compiled,
                     n_partitions=int((mesh or {}).get("n_devices", 1)),

@@ -96,9 +96,16 @@ class RoundTelemetry:
     nonfinite_loss: jax.Array
     divergence: jax.Array
     nonfinite_eval_loss: jax.Array
+    # [C] cumulative loss-scale skipped-step counts — present only when the
+    # precision policy scales (fp16 dynamic/static); None is an empty
+    # pytree node, so legacy telemetry records keep their exact structure
+    loss_scale_skips: Any = None
 
     def as_dict(self) -> dict[str, Any]:
-        return {k: getattr(self, k) for k in TELEMETRY_FIELDS}
+        d = {k: getattr(self, k) for k in TELEMETRY_FIELDS}
+        if self.loss_scale_skips is not None:
+            d["loss_scale_skips"] = self.loss_scale_skips
+        return d
 
 
 # ---------------------------------------------------------------------------
@@ -194,7 +201,7 @@ def summarize_host(telemetry: Mapping[str, np.ndarray], mask) -> dict[str, float
         + float(np.sum(t["nonfinite_loss"]))
         + float(np.sum(t["nonfinite_eval_loss"]))
     )
-    return {
+    out = {
         "train_loss_min": _nan_stat(np.min, t["train_loss_min"]),
         "train_loss_max": _nan_stat(np.max, t["train_loss_max"]),
         "grad_norm_mean": _nan_stat(np.mean, t["grad_norm_mean"]),
@@ -206,3 +213,14 @@ def summarize_host(telemetry: Mapping[str, np.ndarray], mask) -> dict[str, float
         "divergence_mean": _nan_stat(np.mean, t["divergence"]),
         "divergence_max": _nan_stat(np.max, t["divergence"]),
     }
+    if "loss_scale_skips" in telemetry:
+        # fp16 loss-scaling runs only (key absent otherwise, so legacy
+        # round events keep their exact shape). Summed over ALL clients,
+        # NOT the participating filter: the per-client counters are
+        # cumulative, so the all-client sum is monotone and its last value
+        # IS the run-wide skipped-step total — a participant-filtered sum
+        # would re-count or drop history as the sampled cohort changes.
+        out["loss_scale_skips"] = float(np.sum(
+            np.asarray(telemetry["loss_scale_skips"], np.float64)
+        ))
+    return out

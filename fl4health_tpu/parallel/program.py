@@ -201,6 +201,13 @@ class RoundProgramBuilder:
             rng=cs,
             step=cs,
             extra=cs if jax.tree_util.tree_leaves(template.extra) else None,
+            # fp16 scaler state: [C]-leading scalars, clients-axis like the
+            # other per-client bookkeeping (None when precision is off /
+            # not scaling, matching the template's empty node)
+            loss_scale=(
+                cs if jax.tree_util.tree_leaves(template.loss_scale)
+                else None
+            ),
         )
 
     def server_state_shardings(self, strategy: Any, template: Any) -> Any:

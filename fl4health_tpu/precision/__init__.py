@@ -1,0 +1,32 @@
+"""Engine-level mixed-precision policy (Micikevicius et al., arXiv:1710.03740).
+
+One :class:`~fl4health_tpu.precision.policy.PrecisionConfig` describes how
+every client algorithm trains: the forward/backward runs in a low-precision
+compute dtype (bf16 on the MXU, fp16 with in-graph loss scaling), gradients
+come back f32 at the parameter boundary, and optimizer updates apply to f32
+master weights — so the trajectory-critical state (params, optimizer
+momenta, DP clip/noise, telemetry norms, compression deltas, ZeRO-1 server
+shards) never leaves f32. Threaded through the cohort engine
+(``clients/engine.py``) at model *apply* time, so it works for every model
+and every client logic without a per-model ``dtype`` knob.
+"""
+
+from fl4health_tpu.precision.policy import (
+    PrecisionConfig,
+    cast_floats,
+    conv_compute_dtype,
+    loss_scale_init,
+    loss_scale_step,
+    tree_all_finite,
+    wrap_logic_compute,
+)
+
+__all__ = [
+    "PrecisionConfig",
+    "cast_floats",
+    "conv_compute_dtype",
+    "loss_scale_init",
+    "loss_scale_step",
+    "tree_all_finite",
+    "wrap_logic_compute",
+]

@@ -227,6 +227,7 @@ class FederatedSimulation:
         fault_plan: Any = None,
         compression: Any = None,
         mesh: MeshConfig | None = None,
+        precision: Any = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -302,6 +303,31 @@ class FederatedSimulation:
         self.mesh_config = mesh
         self._program_builder = RoundProgramBuilder(
             mesh, n_clients=self.n_clients
+        )
+        # Engine-level mixed precision (precision/: PrecisionConfig): the
+        # compute-dtype cast and fp16 loss scaling compile INTO the round
+        # programs at model-apply time — every client algorithm trains
+        # bf16/fp16 against the f32 master weights this simulation carries,
+        # and everything pinned on those masters (DP clip->noise, telemetry
+        # norms, compression deltas, robust aggregation, ZeRO-1 server
+        # shards) stays f32. None (or an inactive f32 config) builds the
+        # exact pre-precision programs — trajectories bit-identical on both
+        # execution modes (tests/precision/).
+        if precision is not None:
+            from fl4health_tpu.precision import PrecisionConfig
+
+            if not isinstance(precision, PrecisionConfig):
+                raise TypeError(
+                    "precision must be a PrecisionConfig (or None); got "
+                    f"{type(precision).__name__} — a duck-typed config "
+                    "would silently train in f32"
+                )
+        self.precision = precision
+        self._precision_active = bool(
+            precision is not None and precision.active
+        )
+        self._precision_scaling = bool(
+            precision is not None and precision.scaling_active
         )
         self.client_manager = client_manager or FullParticipationManager(self.n_clients)
         # setup-time strategy <-> sampling-scheme validation (e.g. the DP
@@ -442,7 +468,9 @@ class FederatedSimulation:
         sample_x = jax.tree_util.tree_map(
             lambda a: a[:1], self.datasets[0].x_train
         )
-        proto = engine.create_train_state(logic, tx, init_rng, sample_x)
+        proto = engine.create_train_state(
+            logic, tx, init_rng, sample_x, precision=self.precision
+        )
         if self._program_builder.mesh is not None and mesh.zero1:
             # ZeRO-1 server optimizer (parallel/zero.py) over the SAME mesh
             # the round programs dispatch on — each replica owns 1/N of the
@@ -678,6 +706,7 @@ class FederatedSimulation:
             es_train = engine.make_local_train_with_early_stopping(
                 logic, tx, self.metrics, self.early_stopping, loss_keys,
                 collect_telemetry=collect_telemetry,
+                precision=self.precision,
             )
             train = None
         elif self.flash_early_stopping is not None:
@@ -687,7 +716,8 @@ class FederatedSimulation:
             # stats come back NaN (update_norm/divergence/nonfinite still
             # measure — they are computed outside the train scan)
             es_train = make_flash_local_train(
-                logic, tx, self.metrics, self.flash_early_stopping, loss_keys
+                logic, tx, self.metrics, self.flash_early_stopping, loss_keys,
+                precision=self.precision,
             )
             train = None
         else:
@@ -695,12 +725,14 @@ class FederatedSimulation:
             train = engine.make_local_train(
                 logic, tx, self.metrics, loss_keys,
                 collect_telemetry=collect_telemetry,
+                precision=self.precision,
             )
         evaluate = engine.make_local_eval(logic, self.metrics, ("checkpoint", *self._eval_keys()))
 
         evaluate_after_fit = getattr(strategy, "evaluate_after_fit", False)
 
         wants_packet = getattr(exchanger, "wants_packet_payload", False)
+        scaling_active = self._precision_scaling
 
         def client_fit(state: TrainState, payload, batches: Batch, participate,
                        val_batches: Batch):
@@ -743,6 +775,13 @@ class FederatedSimulation:
             new_state = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(participate > 0, n, o), new_state, orig
             )
+            if collect_telemetry and scaling_active:
+                # cumulative skipped-optimizer-step count from the carried
+                # scaler state, AFTER participation masking (a
+                # non-participant reports its carried value, not garbage)
+                client_telem["loss_scale_skips"] = new_state.loss_scale[
+                    "skipped"
+                ]
             pushed = exchanger.push(new_state.params, pulled)
             packet = logic.pack(new_state, pushed, losses)
             if collect_telemetry:
@@ -830,6 +869,10 @@ class FederatedSimulation:
                     strategy.divergence_reference(new_server_state),
                 ),
                 nonfinite_eval_loss=jnp.zeros_like(nan_row),
+                # fp16 scaler visibility: cumulative skipped-step count per
+                # client; None (an empty pytree node) without loss scaling,
+                # so legacy telemetry records keep their exact shape
+                loss_scale_skips=client_telem.get("loss_scale_skips"),
             )
             return (new_server_state, new_states, agg_losses, agg_metrics,
                     losses, round_telemetry)
@@ -1338,6 +1381,11 @@ class FederatedSimulation:
             "telemetry": self._telemetry_enabled,
             "compression": (self.compression.describe()
                             if self._compression_active else None),
+            # precision identity: an f32 and a bf16 run of the same recipe
+            # are different experiments — and the dtype the manifest names
+            # is the one the fl_program_*/MFU numbers were produced under
+            "precision": (self.precision.describe()
+                          if self._precision_active else None),
         }
         if self._program_builder.mesh is not None:
             # mesh identity belongs in the config hash (a sharded and an
@@ -1361,6 +1409,8 @@ class FederatedSimulation:
         obs = self.observability
         intro = obs.introspector
         mesh_desc = self._program_builder.descriptor()
+        prec_desc = (self.precision.describe() if self._precision_active
+                     else None)
         try:
             val_batches, val_counts = self._val_batches()
             mask = self.client_manager.sample(
@@ -1387,7 +1437,7 @@ class FederatedSimulation:
                 intro.introspect_jit(
                     "fit_chunk_eval", self._make_chunked_fit_with_eval(),
                     tuple(args), rounds_per_dispatch=n_rounds,
-                    mesh=mesh_desc,
+                    mesh=mesh_desc, precision=prec_desc,
                 )
                 names: tuple[str, ...] = ("fit_chunk_eval",)
             else:
@@ -1405,13 +1455,13 @@ class FederatedSimulation:
                     fit_name, fit_fn,
                     (self.server_state, self.client_states, batches, mask,
                      r, val_batches),
-                    mesh=mesh_desc,
+                    mesh=mesh_desc, precision=prec_desc,
                 )
                 intro.introspect_jit(
                     eval_name, eval_fn,
                     (self.server_state, self.client_states, val_batches,
                      val_counts),
-                    mesh=mesh_desc,
+                    mesh=mesh_desc, precision=prec_desc,
                 )
                 names = (fit_name, eval_name)
                 if test is not None:
@@ -1422,7 +1472,7 @@ class FederatedSimulation:
                         test_name, eval_fn,
                         (self.server_state, self.client_states,
                          test[0], test[1]),
-                        mesh=mesh_desc,
+                        mesh=mesh_desc, precision=prec_desc,
                     )
                     names = names + (test_name,)
             self._round_program_flops = intro.round_flops(names)
@@ -2173,6 +2223,15 @@ class FederatedSimulation:
             summary["wire_compression_ratio"] = (
                 gather / gather_wire if gather_wire > 0 else None
             )
+        if self._precision_active:
+            # precision attribution (absent on f32 logs, so legacy
+            # perf_report tables stay byte-stable): the dtype that produced
+            # this round's device time — and thus its MFU/tflops numbers
+            summary["compute_dtype"] = self.precision.compute_dtype_name
+            if self._precision_scaling:
+                summary["loss_scale_mode"] = (
+                    self.precision.resolved_loss_scale
+                )
         if telemetry is not None:
             t_summary = telem.summarize_host(telemetry, mask_np)
             summary.update(t_summary)

@@ -134,3 +134,59 @@ class TestUnetConvImpl:
             np.asarray(mxu.apply(params, x)),
             np.asarray(ref.apply(params, x)), rtol=1e-5, atol=1e-5,
         )
+
+
+class TestPromotionRuleAndAuto:
+    def test_bf16_parity_under_engine_cast_rule(self):
+        """The engine-side precision cast hands BOTH impls bf16 inputs and
+        bf16 params; the shared promotion rule (precision.policy
+        .conv_compute_dtype) must then make lax and mxu compute — and
+        emit — the same bf16 values."""
+        from fl4health_tpu.precision.policy import cast_floats
+
+        x = _inputs(b=2, hw=8).astype(jnp.bfloat16)
+        ref = nn.Conv(4, (3, 3))
+        mxu = MxuConv(4, (3, 3))
+        params = cast_floats(
+            ref.init(jax.random.PRNGKey(1), _inputs(b=2, hw=8)), jnp.bfloat16
+        )
+        out_ref = ref.apply(params, x)
+        out_mxu = mxu.apply(params, x)
+        assert out_ref.dtype == out_mxu.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out_mxu, np.float32), np.asarray(out_ref, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+    def test_dtype_none_promotion_includes_bias(self):
+        """dtype=None follows flax's promote_dtype over input AND params
+        (bias included) — bf16 input against f32 params promotes to f32 in
+        BOTH impls, so they stay interchangeable under partial casts."""
+        x = _inputs(b=2, hw=8).astype(jnp.bfloat16)
+        ref = nn.Conv(4, (3, 3))
+        mxu = MxuConv(4, (3, 3))
+        params = ref.init(jax.random.PRNGKey(1), _inputs(b=2, hw=8))  # f32
+        out_ref = ref.apply(params, x)
+        out_mxu = mxu.apply(params, x)
+        assert out_ref.dtype == out_mxu.dtype == jnp.float32
+        np.testing.assert_allclose(
+            np.asarray(out_mxu), np.asarray(out_ref), rtol=1e-3, atol=1e-3
+        )
+
+    def test_resolve_conv_impl_auto(self):
+        from fl4health_tpu.models.cnn import make_conv, resolve_conv_impl
+
+        # "mxu" only where the grouped-conv partitioner rejects the
+        # vmapped nn.Conv: clients-sharded meshes. "lax" everywhere else
+        # (the measured TPU A/B in the MxuConv docstring).
+        assert resolve_conv_impl("auto") == "lax"
+        assert resolve_conv_impl("auto", sharded_clients=True) == "mxu"
+        assert resolve_conv_impl("lax", sharded_clients=True) == "lax"
+        assert resolve_conv_impl("mxu") == "mxu"
+        try:
+            resolve_conv_impl("im2col")
+            raise AssertionError("unknown impl must raise")
+        except ValueError:
+            pass
+        # make_conv accepts "auto" (module-level default: unsharded)
+        assert isinstance(make_conv("auto", 4, (3, 3)), nn.Conv)

@@ -1,0 +1,97 @@
+"""Shared tiny-FL fixtures for the precision suite.
+
+Same discipline as tests/compression/conftest.py: one small Dense model +
+fixed synthetic shards so every test traces the same program shapes, plus
+the 4-client CIFAR-shaped conv config for the pinned bf16-vs-f32 claim.
+"""
+
+import flax.linen as nn
+import numpy as np
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+
+N_CLIENTS = 4
+
+
+class TinyNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Dense(8)(x)
+        x = nn.relu(x)
+        return nn.Dense(2)(x)
+
+
+def _dataset(i: int, scale: float = 1.0) -> ClientDataset:
+    r = np.random.default_rng(300 + i)
+    x = (scale * r.normal(size=(32, 4))).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    return ClientDataset(x_train=x, y_train=y, x_val=x[:8], y_val=y[:8])
+
+
+def make_sim(logic=None, execution_mode="auto", seed=13, data_scale=1.0,
+             n_clients=N_CLIENTS, **kwargs) -> FederatedSimulation:
+    from fl4health_tpu.strategies.fedavg import FedAvg
+
+    args = dict(
+        logic=logic or engine.ClientLogic(
+            engine.from_flax(TinyNet()), engine.masked_cross_entropy
+        ),
+        tx=optax.sgd(0.1),
+        strategy=FedAvg(),
+        datasets=[_dataset(i, data_scale) for i in range(n_clients)],
+        batch_size=8,
+        metrics=MetricManager(()),
+        local_steps=2,
+        seed=seed,
+        execution_mode=execution_mode,
+    )
+    args.update(kwargs)
+    return FederatedSimulation(**args)
+
+
+class TinyCifarNet(nn.Module):
+    """Scaled-down CIFAR-shaped CNN (32x32x3 in, 10 classes): the claim
+    config's geometry without the bench model's compile/step cost."""
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(4, (3, 3), strides=2)(x)
+        x = nn.relu(x)
+        x = nn.Conv(8, (3, 3), strides=2)(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(32)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def _cifar_dataset(i: int) -> ClientDataset:
+    r = np.random.default_rng(400 + i)
+    x = r.normal(size=(24, 32, 32, 3)).astype(np.float32)
+    w = np.random.default_rng(9).normal(size=(32 * 32 * 3, 10))
+    y = (x.reshape(24, -1) @ w).argmax(axis=1).astype(np.int32)
+    return ClientDataset(x_train=x[:16], y_train=y[:16],
+                         x_val=x[16:], y_val=y[16:])
+
+
+def make_cifar_sim(seed=11, **kwargs) -> FederatedSimulation:
+    """The 4-client CIFAR config of the pinned bf16-vs-f32 loss claim."""
+    from fl4health_tpu.strategies.fedavg import FedAvg
+
+    args = dict(
+        logic=engine.ClientLogic(
+            engine.from_flax(TinyCifarNet()), engine.masked_cross_entropy
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=[_cifar_dataset(i) for i in range(4)],
+        batch_size=8,
+        metrics=MetricManager(()),
+        local_steps=2,
+        seed=seed,
+    )
+    args.update(kwargs)
+    return FederatedSimulation(**args)

@@ -1,0 +1,248 @@
+"""PrecisionConfig validation + the in-graph mechanics: cast helpers, the
+shared promotion rule, loss-scale state updates, and the engine step's
+skip semantics (a forced-overflow step must leave the master weights
+untouched)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.precision import PrecisionConfig
+from fl4health_tpu.precision import policy as px
+
+from tests.precision.conftest import TinyNet
+
+
+class TestConfigValidation:
+    def test_dtype_aliases_canonicalize(self):
+        assert PrecisionConfig("bf16").compute_dtype_name == "bfloat16"
+        assert PrecisionConfig("fp16").compute_dtype_name == "float16"
+        assert PrecisionConfig(jnp.bfloat16).compute_dtype_name == "bfloat16"
+        assert PrecisionConfig("f32").compute_dtype_name == "float32"
+
+    def test_unknown_dtype_rejected(self):
+        with pytest.raises(ValueError, match="compute_dtype"):
+            PrecisionConfig("int8")
+        with pytest.raises(ValueError, match="compute_dtype"):
+            PrecisionConfig(jnp.float64)
+
+    def test_loss_scale_auto_resolution(self):
+        assert PrecisionConfig("fp16").resolved_loss_scale == "dynamic"
+        assert PrecisionConfig("bf16").resolved_loss_scale == "none"
+        assert PrecisionConfig("f32").resolved_loss_scale == "none"
+        assert PrecisionConfig(
+            "bf16", loss_scale="static"
+        ).resolved_loss_scale == "static"
+
+    def test_f32_with_scaling_rejected(self):
+        with pytest.raises(ValueError, match="no-op"):
+            PrecisionConfig("f32", loss_scale="dynamic")
+
+    def test_master_f32_contract_enforced(self):
+        with pytest.raises(ValueError, match="keep_master_f32"):
+            PrecisionConfig("bf16", keep_master_f32=False)
+        # the no-op f32 config tolerates the knob (nothing is cast)
+        PrecisionConfig("f32", keep_master_f32=False)
+
+    def test_active_and_resolve(self):
+        assert not PrecisionConfig("f32").active
+        assert px.resolve(PrecisionConfig("f32")) is None
+        assert px.resolve(None) is None
+        assert px.resolve(PrecisionConfig("bf16")) is not None
+
+    def test_scaler_knob_validation(self):
+        with pytest.raises(ValueError, match="growth_factor"):
+            PrecisionConfig("fp16", growth_factor=1.0)
+        with pytest.raises(ValueError, match="growth_interval"):
+            PrecisionConfig("fp16", growth_interval=0)
+
+    def test_describe_is_json_able(self):
+        import json
+
+        d = PrecisionConfig("fp16").describe()
+        assert json.loads(json.dumps(d)) == d
+        assert d["compute_dtype"] == "float16"
+        assert d["loss_scale"] == "dynamic"
+
+
+class TestCastHelpers:
+    def test_cast_floats_leaves_integers_alone(self):
+        tree = {"w": jnp.ones((2,), jnp.float32),
+                "ids": jnp.ones((2,), jnp.int32),
+                "flag": jnp.ones((2,), jnp.bool_)}
+        out = px.cast_floats(tree, jnp.bfloat16)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["ids"].dtype == jnp.int32
+        assert out["flag"].dtype == jnp.bool_
+
+    def test_conv_compute_dtype_rule(self):
+        assert px.conv_compute_dtype(jnp.bfloat16, jnp.bfloat16,
+                                     jnp.bfloat16) == jnp.bfloat16
+        # a single f32 operand promotes the whole op (flax promote_dtype)
+        assert px.conv_compute_dtype(jnp.bfloat16, jnp.float32,
+                                     jnp.float32) == jnp.float32
+
+    def test_wrapped_model_casts_train_only(self):
+        """Apply-time cast: train forwards run in the compute dtype, eval
+        forwards stay on the f32 master."""
+        logic = engine.ClientLogic(engine.from_flax(TinyNet()),
+                                   engine.masked_cross_entropy)
+        wrapped = px.wrap_logic_compute(logic, jnp.bfloat16)
+        assert type(wrapped) is type(logic)
+        x = jnp.ones((2, 4), jnp.float32)
+        params, mstate = wrapped.model.init(jax.random.PRNGKey(0), x)
+        # master params come back f32 from init
+        assert all(l.dtype == jnp.float32
+                   for l in jax.tree_util.tree_leaves(params))
+        (preds, _), _ = wrapped.model.apply(params, mstate, x, train=True,
+                                            rng=jax.random.PRNGKey(1))
+        assert preds["prediction"].dtype == jnp.bfloat16
+        (preds, _), _ = wrapped.model.apply(params, mstate, x, train=False,
+                                            rng=jax.random.PRNGKey(1))
+        assert preds["prediction"].dtype == jnp.float32
+
+    def test_grads_return_f32_at_master_boundary(self):
+        """The cast's VJP promotes cotangents back to f32 — gradients wrt
+        the master weights are f32 even though the forward ran bf16."""
+        logic = engine.ClientLogic(engine.from_flax(TinyNet()),
+                                   engine.masked_cross_entropy)
+        wrapped = px.wrap_logic_compute(logic, jnp.bfloat16)
+        st = engine.create_train_state(
+            wrapped, optax.sgd(0.1), jax.random.PRNGKey(0),
+            jnp.zeros((1, 4), jnp.float32),
+        )
+        b = engine.Batch(x=jnp.ones((4, 4)), y=jnp.zeros((4,), jnp.int32),
+                         example_mask=jnp.ones((4,)), step_mask=jnp.ones(()))
+        _, grads = wrapped.value_and_grads(st, None, b, jax.random.PRNGKey(2))
+        assert {str(l.dtype) for l in jax.tree_util.tree_leaves(grads)} == \
+            {"float32"}
+
+
+class TestLossScaleState:
+    CFG = PrecisionConfig("fp16", init_scale=2.0 ** 10, growth_interval=2)
+
+    def test_init_structure(self):
+        ls = px.loss_scale_init(self.CFG)
+        assert float(ls["scale"]) == 2.0 ** 10
+        assert int(ls["growth"]) == 0 and float(ls["skipped"]) == 0.0
+        assert px.loss_scale_init(PrecisionConfig("bf16")) is None
+        assert px.loss_scale_init(None) is None
+
+    def test_backoff_on_nonfinite(self):
+        ls = px.loss_scale_init(self.CFG)
+        ls2 = px.loss_scale_step(ls, jnp.zeros(()), self.CFG)
+        assert float(ls2["scale"]) == 2.0 ** 9
+        assert int(ls2["growth"]) == 0
+        assert float(ls2["skipped"]) == 1.0
+
+    def test_growth_after_interval(self):
+        ls = px.loss_scale_init(self.CFG)
+        ls = px.loss_scale_step(ls, jnp.ones(()), self.CFG)
+        assert float(ls["scale"]) == 2.0 ** 10 and int(ls["growth"]) == 1
+        ls = px.loss_scale_step(ls, jnp.ones(()), self.CFG)
+        assert float(ls["scale"]) == 2.0 ** 11 and int(ls["growth"]) == 0
+
+    def test_scale_clamped(self):
+        cfg = PrecisionConfig("fp16", init_scale=2.0, min_scale=1.0,
+                              growth_interval=1, max_scale=4.0)
+        ls = px.loss_scale_init(cfg)
+        for _ in range(5):
+            ls = px.loss_scale_step(ls, jnp.ones(()), cfg)
+        assert float(ls["scale"]) == 4.0
+        for _ in range(5):
+            ls = px.loss_scale_step(ls, jnp.zeros(()), cfg)
+        assert float(ls["scale"]) == 1.0
+
+    def test_static_never_moves_but_counts_skips(self):
+        cfg = PrecisionConfig("fp16", loss_scale="static", init_scale=8.0)
+        ls = px.loss_scale_init(cfg)
+        ls = px.loss_scale_step(ls, jnp.zeros(()), cfg)
+        ls = px.loss_scale_step(ls, jnp.ones(()), cfg)
+        assert float(ls["scale"]) == 8.0
+        assert float(ls["skipped"]) == 1.0
+
+
+class _OverflowLogic(engine.ClientLogic):
+    """Training loss whose gradient is non-finite on demand (via ctx) —
+    the forced-overflow probe for the skip semantics."""
+
+    def training_loss(self, preds, features, batch, params, state, ctx):
+        loss, extra = super().training_loss(
+            preds, features, batch, params, state, ctx
+        )
+        # ctx > 0 poisons the gradient (inf * differentiable term)
+        return loss * jnp.where(ctx > 0, jnp.inf, 1.0), extra
+
+
+class TestStepSkipSemantics:
+    def _state_and_batch(self, precision):
+        logic = _OverflowLogic(engine.from_flax(TinyNet()),
+                               engine.masked_cross_entropy)
+        st = engine.create_train_state(
+            logic, optax.sgd(0.1), jax.random.PRNGKey(0),
+            jnp.zeros((1, 4), jnp.float32), precision=precision,
+        )
+        b = engine.Batch(x=jnp.ones((4, 4)), y=jnp.zeros((4,), jnp.int32),
+                         example_mask=jnp.ones((4,)), step_mask=jnp.ones(()))
+        step = engine.make_train_step(logic, optax.sgd(0.1),
+                                      precision=precision)
+        return st, b, step
+
+    def test_overflow_step_leaves_master_untouched(self):
+        cfg = PrecisionConfig("fp16", init_scale=4.0)
+        st, b, step = self._state_and_batch(cfg)
+        st2, _ = step(st, jnp.ones(()), b)  # ctx>0 -> non-finite grads
+        for a, before in zip(jax.tree_util.tree_leaves(st2.params),
+                             jax.tree_util.tree_leaves(st.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(before))
+        for a, before in zip(jax.tree_util.tree_leaves(st2.opt_state),
+                             jax.tree_util.tree_leaves(st.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(before))
+        assert float(st2.loss_scale["scale"]) == 2.0  # backed off
+        assert float(st2.loss_scale["skipped"]) == 1.0
+        assert int(st2.step) == 0  # a skipped step is not an optimizer step
+
+    def test_finite_step_moves_params_and_grows(self):
+        cfg = PrecisionConfig("fp16", init_scale=4.0, growth_interval=1)
+        st, b, step = self._state_and_batch(cfg)
+        st2, out = step(st, jnp.zeros(()), b)  # ctx=0 -> clean step
+        moved = any(
+            not np.array_equal(np.asarray(a), np.asarray(bfr))
+            for a, bfr in zip(jax.tree_util.tree_leaves(st2.params),
+                              jax.tree_util.tree_leaves(st.params))
+        )
+        assert moved
+        assert float(st2.loss_scale["scale"]) == 8.0
+        assert int(st2.step) == 1
+        # the reported loss is the TRUE (unscaled) loss
+        assert float(out.losses["backward"]) < 10.0
+
+    def test_scaling_without_state_raises(self):
+        logic = engine.ClientLogic(engine.from_flax(TinyNet()),
+                                   engine.masked_cross_entropy)
+        st = engine.create_train_state(
+            logic, optax.sgd(0.1), jax.random.PRNGKey(0),
+            jnp.zeros((1, 4), jnp.float32),  # no precision -> no ls state
+        )
+        b = engine.Batch(x=jnp.ones((4, 4)), y=jnp.zeros((4,), jnp.int32),
+                         example_mask=jnp.ones((4,)), step_mask=jnp.ones(()))
+        step = engine.make_train_step(logic, optax.sgd(0.1),
+                                      precision=PrecisionConfig("fp16"))
+        with pytest.raises(ValueError, match="loss scaling needs"):
+            step(st, None, b)
+
+    def test_dp_logic_rejected_under_scaling(self):
+        from fl4health_tpu.clients.instance_level_dp import (
+            InstanceLevelDpClientLogic,
+        )
+
+        logic = InstanceLevelDpClientLogic(
+            engine.from_flax(TinyNet()), engine.masked_cross_entropy,
+            clipping_bound=1.0, noise_multiplier=0.5,
+        )
+        with pytest.raises(TypeError, match="loss scaling"):
+            engine.make_train_step(logic, optax.sgd(0.1),
+                                   precision=PrecisionConfig("fp16"))

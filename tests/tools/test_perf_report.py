@@ -419,3 +419,59 @@ def test_cli_output_has_no_mesh_markers_for_legacy_log(tmp_path):
     assert "chips" not in out
     assert "steps/s/chip" not in out
     assert "mesh" not in out
+
+
+def test_precision_columns_render_when_fields_present():
+    rounds = [_round(1, compute_dtype="bfloat16"),
+              _round(2, compute_dtype="bfloat16")]
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    assert "dtype" in header
+    assert "bfloat16" in table
+    summary = perf_report.summarize(rounds)
+    assert summary["compute_dtype"] == "bfloat16"
+
+
+def test_loss_scale_skips_column_and_cumulative_summary():
+    rounds = [_round(1, compute_dtype="float16", loss_scale_skips=1.0),
+              _round(2, compute_dtype="float16", loss_scale_skips=3.0)]
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    assert "ls_skips" in header
+    assert table.splitlines()[2].split()[-1] == "1"
+    # cumulative counter: the run total is the max, not the sum
+    assert perf_report.summarize(rounds)["loss_scale_skips"] == 3
+
+
+def test_precision_fields_absent_keeps_legacy_table_byte_stable():
+    rounds = [_round(1), _round(2)]
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    assert "dtype" not in header and "ls_skips" not in header
+    assert header == [h for h, _, _ in perf_report.COLUMNS]
+    summary = perf_report.summarize(rounds)
+    assert "compute_dtype" not in summary
+    assert "loss_scale_skips" not in summary
+
+
+def test_cli_output_has_no_precision_markers_for_legacy_log(tmp_path):
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "dtype" not in out
+    assert "ls_skips" not in out
+
+
+def test_program_table_unaffected_by_precision_descriptor():
+    """A ``precision`` key on program events must not disturb the program
+    table (it is a manifest-style descriptor, not a column)."""
+    programs = [
+        {"name": "fit_round", "flops": 1e9, "bytes_accessed": 1e6,
+         "peak_hbm_bytes": 1024, "compile_seconds": 0.5, "cache_hit": True,
+         "precision": {"compute_dtype": "bfloat16", "loss_scale": "none"}},
+    ]
+    table = perf_report.render_program_table(programs)
+    assert "fit_round" in table
+    assert "bfloat16" not in table

@@ -65,6 +65,16 @@ MESH_COLUMNS = (
     ("tflops/chip", "tflops_per_chip", lambda v: f"{v:.3g}"),
 )
 
+# Mixed-precision fields (fl4health_tpu/precision/): the compute dtype the
+# round's device time (and thus its MFU/tflops columns) is attributable to,
+# and the cumulative fp16 loss-scale skipped-step count across participating
+# clients. Optional like the telemetry columns — f32 logs keep their exact
+# old table shape (byte-stable, tested).
+PRECISION_COLUMNS = (
+    ("dtype", "compute_dtype", str),
+    ("ls_skips", "loss_scale_skips", lambda v: str(int(v))),
+)
+
 
 def load_events(path: str) -> dict[str, list[dict]]:
     """Parse the JSONL log into {event_kind: [records]}. Malformed lines
@@ -117,7 +127,8 @@ def active_columns(rounds: list[dict]) -> tuple:
     """Base columns plus any telemetry/wire column present in >=1 round
     event."""
     extra = tuple(
-        col for col in TELEMETRY_COLUMNS + WIRE_COLUMNS + MESH_COLUMNS
+        col for col in (TELEMETRY_COLUMNS + WIRE_COLUMNS + MESH_COLUMNS
+                        + PRECISION_COLUMNS)
         if any(col[1] in rec for rec in rounds)
     )
     return COLUMNS + extra
@@ -135,6 +146,9 @@ def render_table(rounds: Iterable[dict]) -> str:
             v = rec.get(field)
             if v is None or (isinstance(v, float) and v != v):
                 row.append("-")
+            elif isinstance(v, str):
+                # non-numeric fields (compute_dtype) skip the float coercion
+                row.append(fmt(v))
             else:
                 row.append(fmt(float(v)))
         rows.append(row)
@@ -282,6 +296,17 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
     if any("gather_bytes_wire" in r for r in rounds):
         # compressed-exchange runs only — legacy summaries stay byte-stable
         summary["gather_bytes_wire"] = int(tot("gather_bytes_wire"))
+    if any("compute_dtype" in r for r in rounds):
+        # precision runs only — the dtype the run's timing/MFU numbers are
+        # attributable to (a list if a log mixes runs of different dtypes)
+        dtypes = sorted({str(r["compute_dtype"]) for r in rounds
+                         if "compute_dtype" in r})
+        summary["compute_dtype"] = dtypes[0] if len(dtypes) == 1 else dtypes
+    if any("loss_scale_skips" in r for r in rounds):
+        # cumulative counter: the last round's value IS the run total
+        summary["loss_scale_skips"] = int(max(
+            float(r.get("loss_scale_skips", 0.0)) for r in rounds
+        ))
     if any("mesh_devices" in r for r in rounds):
         # mesh runs only — device count plus the mean per-chip throughput
         # over the rounds that measured one
