@@ -669,6 +669,133 @@ def timed_precision_block(timing: bool = True) -> dict:
     }
 
 
+def timed_async_block(timing: bool = True) -> dict:
+    """Buffered-async block (the tail-independence PR acceptance metric):
+    sync-vs-async round CADENCE and final loss under one fixed straggler
+    ``FaultPlan`` — 2 of 8 clients at 5x compute time.
+
+    The cadence side reads off the VIRTUAL clock (the same deterministic
+    compute-time model both modes schedule from, ``server/async_schedule``)
+    so it is exact, free, and backend-independent: a synchronous round
+    costs ``max_c T_c`` (the tail), an async round costs the gap between
+    buffer fills. The headline claim: async cadence stays within 1.5x of
+    the STRAGGLER-FREE sync cadence while sync degrades toward the tail
+    (>= 3x slower), at a final loss within a small delta of sync.
+
+    ``timing=False`` (the CPU-fallback annotation) skips only the real
+    fit() loss/wall arms; the virtual-cadence numbers always land."""
+    import numpy as np
+
+    from fl4health_tpu.resilience.faults import ClientFault, FaultPlan
+    from fl4health_tpu.server.async_schedule import (
+        AsyncConfig,
+        build_event_plan,
+        sync_round_times,
+    )
+
+    n_clients = int(os.environ.get("FL4HEALTH_BENCH_ASYNC_CLIENTS", 8))
+    if n_clients < 2:
+        raise ValueError(
+            "FL4HEALTH_BENCH_ASYNC_CLIENTS must be >= 2 (the block needs "
+            "at least one straggler AND one fast client)"
+        )
+    slow_scale = float(os.environ.get("FL4HEALTH_BENCH_ASYNC_SLOW", 5.0))
+    k = int(os.environ.get("FL4HEALTH_BENCH_ASYNC_BUFFER", n_clients // 2))
+    events = 24  # virtual horizon for the cadence statistics
+    acfg = AsyncConfig(buffer_size=k, compute_jitter=0.05)
+    # straggler set derived from the cohort (2 of 8 in the claim config):
+    # never the whole cohort, so the arrival rate has a fast side to win on
+    slow_clients = tuple(range(min(2, n_clients - 1)))
+    plan_faults = FaultPlan(client_faults=(
+        ClientFault(clients=slow_clients, kind="slow", scale=slow_scale),
+    ))
+    sync_clean = float(np.mean(sync_round_times(
+        acfg, events, n_clients, None
+    )))
+    sync_straggler = float(np.mean(sync_round_times(
+        acfg, events, n_clients, plan_faults
+    )))
+    plan = build_event_plan(acfg, events, n_clients, plan_faults)
+    async_cadence = float(np.mean(plan.cadences()))
+    stal = plan.staleness[plan.arrivals > 0]
+    out = {
+        "n_clients": n_clients,
+        "buffer_size": k,
+        "slow_clients": len(slow_clients),
+        "slow_scale": slow_scale,
+        "virtual_events": events,
+        # the three cadence numbers the claim is made of (virtual seconds)
+        "sync_round_vs_clean": round(sync_clean, 4),
+        "sync_round_vs_straggler": round(sync_straggler, 4),
+        "async_cadence_vs": round(async_cadence, 4),
+        "sync_degradation": round(sync_straggler / sync_clean, 3),
+        "async_vs_clean_ratio": round(async_cadence / sync_clean, 3),
+        "staleness_mean": round(float(stal.mean()), 3),
+        "staleness_max": float(stal.max()),
+    }
+    if not timing:
+        out.update({"final_loss_sync": None, "final_loss_async": None,
+                    "loss_delta": None, "round_s_sync": None,
+                    "round_s_async": None, "rounds": 0})
+        return out
+
+    # loss arms: identical seeds + the SAME FaultPlan; slow faults change
+    # no math, so the sync arm is the straggler run's exact trajectory
+    import jax
+    import optax
+
+    from fl4health_tpu.clients import engine as _engine
+    from fl4health_tpu.datasets.synthetic import synthetic_classification
+    from fl4health_tpu.metrics import efficient
+    from fl4health_tpu.metrics.base import MetricManager
+    from fl4health_tpu.models.cnn import Mlp
+    from fl4health_tpu.server.simulation import (
+        ClientDataset,
+        FederatedSimulation,
+    )
+    from fl4health_tpu.strategies.fedavg import FedAvg
+
+    rounds = max(TIMED_ROUNDS * 2, 6)
+
+    def make(async_config):
+        datasets = []
+        for i in range(n_clients):
+            x, y = synthetic_classification(
+                jax.random.PRNGKey(i), 48, (8,), 3, class_sep=1.5
+            )
+            datasets.append(ClientDataset(x[:40], y[:40], x[40:], y[40:]))
+        model = _engine.from_flax(Mlp(features=(16,), n_outputs=3))
+        logic = _engine.ClientLogic(model, _engine.masked_cross_entropy)
+        return FederatedSimulation(
+            logic=logic, tx=optax.sgd(0.05), strategy=FedAvg(),
+            datasets=datasets, batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_steps=LOCAL_STEPS, seed=7, fault_plan=plan_faults,
+            async_config=async_config,
+        )
+
+    t0 = time.perf_counter()
+    sync_hist = make(None).fit(rounds)
+    sync_wall = (time.perf_counter() - t0) / rounds
+    t0 = time.perf_counter()
+    async_hist = make(acfg).fit(rounds)
+    async_wall = (time.perf_counter() - t0) / rounds
+    loss_sync = float(sync_hist[-1].eval_losses["checkpoint"])
+    loss_async = float(async_hist[-1].eval_losses["checkpoint"])
+    out.update({
+        "final_loss_sync": round(loss_sync, 5),
+        "final_loss_async": round(loss_async, 5),
+        "loss_delta": round(abs(loss_async - loss_sync), 5),
+        # chip wall per server update (both modes run every client's
+        # compute in simulation, so this measures program cost, not the
+        # virtual-clock story above)
+        "round_s_sync": round(sync_wall, 5),
+        "round_s_async": round(async_wall, 5),
+        "rounds": rounds,
+    })
+    return out
+
+
 def mesh_cohort_size(n_dev: int) -> int:
     """Cohort for the mesh arms: the nearest device-count multiple of
     ``N_CLIENTS`` — rounded DOWN when the configured cohort exceeds the
@@ -932,6 +1059,18 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
             timing=not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
             or want_p == "1"
         )
+    # Buffered-async cadence + loss arms (the tail-independence PR
+    # metric). Same gating shape as telemetry/resilience:
+    # FL4HEALTH_BENCH_ASYNC=1 forces the full block, =0 disables it,
+    # "auto" runs it but skips the loss/wall fit arms on the CPU fallback
+    # (the virtual-clock cadence numbers are free and always land).
+    want_a = os.environ.get("FL4HEALTH_BENCH_ASYNC", "auto")
+    if want_a != "0":
+        a_timing = want_a == "1" or (
+            want_a == "auto"
+            and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+        )
+        out["async"] = timed_async_block(timing=a_timing)
     # Mesh-sharded rounds (the massive-cohort PR metric): opt-in only —
     # FL4HEALTH_BENCH_MESH=1 — because it compiles two extra chunked scans
     # and needs a multi-device backend (single-device runs report skipped).
@@ -1040,6 +1179,12 @@ def run_measurement() -> None:
         # speedup, mfu_pct per arm, loss_delta}) — the roofline-path PR
         # metric; timing arms null on the CPU fallback
         "precision": cifar.get("precision"),
+        # buffered-async cadence arms ({sync_round_vs_straggler,
+        # async_cadence_vs, async_vs_clean_ratio, loss_delta, ...}) under
+        # a fixed 2-of-8-clients-at-5x straggler FaultPlan — the
+        # tail-independence PR metric (virtual-clock cadences always
+        # measured; fit arms null on the CPU fallback)
+        "async": cifar.get("async"),
     }
     if fallback_note:
         record["note"] = fallback_note
@@ -1202,6 +1347,47 @@ def run_precision_artifact() -> None:
     print(json.dumps({"written": out_path,
                       "loss_delta": block["loss_delta"],
                       "speedup": block["speedup"]}))
+
+
+def run_async_artifact() -> None:
+    """``python bench.py --async``: the buffered-async sync-vs-async
+    comparison as its own artifact, landed as
+    ``BENCH_async_<label>_<ts>.json``. The virtual-clock cadence numbers
+    (the headline: tail-independent round cadence) are exact on any
+    backend; the fit loss/wall arms run everywhere too — they are small
+    8-client MLP fits — unless FL4HEALTH_BENCH_ASYNC=0cpu-style gating is
+    wanted, in which case use the in-record block instead."""
+    platform, device_kind = _provenance()
+    fallback = platform == "cpu"
+    block = timed_async_block(timing=True)
+    label = f"{platform}_fallback" if fallback else platform
+    record = {
+        "metric": (f"fedbuff_async_vs_sync_cadence"
+                   f"{'_cpu_fallback' if fallback else ''}"),
+        "platform": platform,
+        "device_kind": device_kind,
+        "data_provenance": "synthetic",
+        "async": block,
+    }
+    if fallback:
+        record["note"] = (
+            "Cadence numbers are VIRTUAL-clock (deterministic compute-time "
+            "model) and exact on any backend; the round_s_* chip walls are "
+            "CPU-fallback harness health, not speed claims."
+        )
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"BENCH_async_{label}_{stamp}.json",
+    )
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({
+        "written": out_path,
+        "sync_degradation": block["sync_degradation"],
+        "async_vs_clean_ratio": block["async_vs_clean_ratio"],
+        "loss_delta": block["loss_delta"],
+    }))
 
 
 def main() -> None:
@@ -1395,5 +1581,7 @@ if __name__ == "__main__":
         run_multichip_artifact()
     elif "--precision" in sys.argv:
         run_precision_artifact()
+    elif "--async" in sys.argv:
+        run_async_artifact()
     else:
         main()

@@ -1,0 +1,175 @@
+"""Buffered-async cross-silo rounds with COMPRESSED wire frames.
+
+Run:  python examples/cross_silo_buffered_async_example/run.py
+Tiny: FL4HEALTH_EXAMPLE_ROUNDS=1 python examples/cross_silo_buffered_async_example/run.py
+
+The two wire features PR 6 and PR 9 added, composed over the REAL
+coordinator path:
+
+- every silo ships its update as a COMPRESSED frame
+  (``encode_compressed``: global top-k + int8 quantization, CRC-checked
+  framing) and the coordinator decodes it with ``decode_compressed``
+  through ``SiloUpdateBuffer``'s pluggable decoder — the same
+  retry/metrics machinery dense frames ride;
+- the coordinator does NOT barrier on the slowest silo: a
+  ``SiloUpdateBuffer`` collects replies as they arrive and the server
+  aggregates as soon as ``buffer_size`` updates are in (FedBuff-style),
+  staleness-discounting updates that trained from an older server
+  version (``1/sqrt(1+staleness)``, the same rule the in-process async
+  mode uses). One silo is made a straggler with ``chaos_handler``'s
+  deterministic delay, so slow updates genuinely arrive stale.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import optax  # noqa: E402
+
+import _lib as lib  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from fl4health_tpu.clients import engine  # noqa: E402
+from fl4health_tpu.compression.config import CompressionConfig  # noqa: E402
+from fl4health_tpu.datasets.synthetic import synthetic_classification  # noqa: E402
+from fl4health_tpu.models.cnn import Mlp  # noqa: E402
+from fl4health_tpu.resilience.faults import (  # noqa: E402
+    TransportFaultPolicy,
+    chaos_handler,
+)
+from fl4health_tpu.server.async_schedule import staleness_discount  # noqa: E402
+from fl4health_tpu.transport import (  # noqa: E402
+    LoopbackServer,
+    SiloUpdateBuffer,
+    decode,
+    encode,
+)
+from fl4health_tpu.transport.codec import (  # noqa: E402
+    decode_compressed,
+    encode_compressed,
+)
+
+cfg = lib.example_config(Path(__file__).parent)
+N_SILOS = 4
+K = int(cfg.get("buffer_size", 2))
+COMP = CompressionConfig(topk_fraction=0.25, quant_bits=8)
+
+module = Mlp(features=(16,), n_outputs=3)
+model = engine.from_flax(module)
+criterion = engine.masked_cross_entropy
+logic = engine.ClientLogic(model, criterion)
+tx = optax.sgd(cfg["learning_rate"])
+init_params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 6)))[0]
+
+
+def make_silo(seed: int, slow: bool):
+    """One remote hospital: private data, local training, COMPRESSED
+    update frames. ``slow`` silos straggle behind a deterministic
+    chaos delay — their updates arrive stale at the buffer."""
+    x, y = synthetic_classification(
+        jax.random.PRNGKey(seed), 48, (6,), 3, class_sep=2.0
+    )
+    state = engine.create_train_state(logic, tx, jax.random.PRNGKey(seed), x[:1])
+    train = jax.jit(engine.make_local_train(logic, tx, lib.accuracy_metrics()))
+    n = 40
+
+    def handler(frame: bytes) -> bytes:
+        nonlocal state
+        global_params = decode(frame, like=state.params)
+        state = state.replace(params=global_params)
+        batches = engine.epoch_batches(
+            state.rng, x[:n], y[:n], cfg["batch_size"],
+            n_steps=cfg["local_steps"],
+        )
+        state, _losses, _metrics, _ = train(state, None, batches)
+        delta = jax.tree_util.tree_map(
+            lambda t, g: np.asarray(t - g, np.float32),
+            state.params, global_params,
+        )
+        return encode_compressed(delta, COMP)
+
+    if slow:
+        handler = chaos_handler(
+            handler,
+            TransportFaultPolicy(delay_s=0.2, delay_probability=1.0),
+            seed=0, silo_idx=seed,
+        )
+    return LoopbackServer(handler), n
+
+
+silos = [make_silo(s, slow=(s == N_SILOS - 1)) for s in range(N_SILOS)]
+addrs = [(srv.host, srv.port) for srv, _ in silos]
+counts = {f"{h}:{p}": float(n) for (h, p), (_, n) in zip(addrs, silos)}
+
+# coordinator-held validation set (public split) to score the global model
+val_x, val_y = synthetic_classification(
+    jax.random.PRNGKey(99), 64, (6,), 3, class_sep=2.0
+)
+
+
+def float_loss(params):
+    (preds, _features), _state = model.apply(params, None, val_x, train=False)
+    logits = preds["prediction"]
+    one_hot = jax.nn.one_hot(val_y, 3)
+    return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
+
+
+buffer = SiloUpdateBuffer(
+    reply_template=init_params,
+    decoder=lambda raw: decode_compressed(raw, like=init_params),
+)
+global_params = init_params
+version = 0
+silo_version = {a: 0 for a in addrs}
+try:
+    buffer.dispatch(addrs, global_params, version)
+    dense_bytes = len(encode(init_params))
+    for event in range(1, int(cfg["n_server_rounds"]) + 1):
+        arrivals = buffer.take(K, timeout=60.0)
+        stal = [float(version - a.version) for a in arrivals]
+        disc = staleness_discount(np.asarray(stal))
+        w = np.asarray(
+            [counts[a.result.silo] for a in arrivals]
+        ) * np.asarray(disc)
+        w = w / w.sum()
+        merged_delta = jax.tree_util.tree_map(
+            lambda *leaves: sum(wi * leaf for wi, leaf in zip(w, leaves)),
+            *[a.reply for a in arrivals],
+        )
+        global_params = jax.tree_util.tree_map(
+            lambda g, d: g + d, global_params, merged_delta
+        )
+        version += 1
+        # consumed silos pull the fresh version and train again
+        consumed = [
+            next(a for a in addrs if f"{a[0]}:{a[1]}" == r.result.silo)
+            for r in arrivals
+        ]
+        buffer.dispatch(consumed, global_params, version)
+        print(json.dumps({
+            "event": event,
+            "arrived": [a.result.silo.split(":")[-1] for a in arrivals],
+            "staleness": stal,
+            "val_loss": round(float(float_loss(global_params)), 5),
+        }))
+finally:
+    buffer.close()
+    for srv, _ in silos:
+        srv.close()
+
+comp_bytes = len(encode_compressed(
+    jax.tree_util.tree_map(lambda a: np.asarray(a, np.float32), init_params),
+    COMP,
+))
+print(json.dumps({
+    "final": True,
+    "events": int(cfg["n_server_rounds"]),
+    "buffer_size": K,
+    "wire_bytes_dense": dense_bytes,
+    "wire_bytes_compressed": comp_bytes,
+    "wire_ratio": round(dense_bytes / comp_bytes, 2),
+}))

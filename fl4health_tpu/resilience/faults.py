@@ -16,6 +16,16 @@ Two fault surfaces, one plan:
   (:func:`chaos_handler`) — deterministic per ``(seed, silo, request
   counter)`` via ``random.Random``. This is what the retry/quorum path
   (``transport/retry.py``, ``broadcast_round``) is exercised against.
+  The sleep is injectable (mirroring ``retry.py``'s ``rng``/``sleep``)
+  so delay-path tests never wall-clock sleep.
+- **Compute-time faults** (virtual clock): ``kind="slow"`` specs model
+  stragglers as a per-(client, round) compute-time MULTIPLIER instead of
+  a wire delay. They never enter the round programs — the buffered-async
+  scheduler (``server/async_schedule.py``) reads them host-side via
+  :meth:`FaultPlan.compute_time_factors` to build its deterministic
+  arrival plan, and the bench derives sync-round virtual wall times from
+  the same draws. A plan with only ``slow`` faults leaves the compiled
+  programs (and thus any synchronous trajectory) bit-identical.
 
 Corruption semantics: a corrupted packet is ``payload + s * (packet -
 payload)`` relative to the round's broadcast payload — ``s = -1`` is the
@@ -36,7 +46,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-CLIENT_FAULT_KINDS = ("dropout", "nan", "scale", "sign_flip")
+CLIENT_FAULT_KINDS = ("dropout", "nan", "scale", "sign_flip", "slow")
+
+# kinds that transform the wire packet (everything except mask math and
+# the host-side virtual-clock straggler model)
+_CORRUPTION_KINDS = ("nan", "scale", "sign_flip")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +73,11 @@ class ClientFault:
             raise ValueError(
                 f"ClientFault.kind must be one of {CLIENT_FAULT_KINDS}; "
                 f"got {self.kind!r}"
+            )
+        if self.kind == "slow" and not self.scale > 0:
+            raise ValueError(
+                "ClientFault(kind='slow') uses scale as a compute-time "
+                f"multiplier; it must be > 0 (got {self.scale})"
             )
         if not 0.0 <= self.probability <= 1.0:
             raise ValueError("probability must be in [0, 1]")
@@ -103,7 +122,13 @@ class FaultPlan:
 
     @property
     def corruption_faults(self) -> tuple[ClientFault, ...]:
-        return tuple(f for f in self.client_faults if f.kind != "dropout")
+        return tuple(
+            f for f in self.client_faults if f.kind in _CORRUPTION_KINDS
+        )
+
+    @property
+    def slow_faults(self) -> tuple[ClientFault, ...]:
+        return tuple(f for f in self.client_faults if f.kind == "slow")
 
     @property
     def has_client_faults(self) -> bool:
@@ -164,7 +189,7 @@ class FaultPlan:
         self._check_clients(n_clients)
         factors = jnp.ones((n_clients,), jnp.float32)
         for i, f in enumerate(self.client_faults):
-            if f.kind == "dropout":
+            if f.kind not in _CORRUPTION_KINDS:
                 continue
             value = {
                 "nan": jnp.nan,
@@ -204,6 +229,27 @@ class FaultPlan:
             packets,
         )
 
+    # -- virtual-clock straggler model (host-side) ----------------------
+    def compute_time_factors(self, round_idx: int, n_clients: int) -> np.ndarray:
+        """[C] per-client compute-time MULTIPLIER for the training attempt
+        whose data plan index is ``round_idx`` (1.0 = nominal speed) — the
+        ``kind="slow"`` specs' contribution to the virtual clock.
+
+        Host-side numpy (the async scheduler builds its static event plan
+        before dispatch), but the draws come from the SAME seeded
+        ``_fired`` streams as the in-graph faults, so a plan mixing slow +
+        corruption faults stays one reproducible experiment. Overlapping
+        slow specs compound multiplicatively (a client named by two 2x
+        specs runs 4x slower)."""
+        self._check_clients(n_clients)
+        factors = np.ones((n_clients,), np.float64)
+        for i, f in enumerate(self.client_faults):
+            if f.kind != "slow":
+                continue
+            fired = np.asarray(self._fired(f, i, round_idx, n_clients))
+            factors = np.where(fired > 0, factors * float(f.scale), factors)
+        return factors
+
     # -- host mirror (observability) ------------------------------------
     def summarize_round(self, round_idx: int, n_clients: int) -> dict | None:
         """Host-side mirror of the round's draws for the ``fault`` JSONL
@@ -224,7 +270,15 @@ class FaultPlan:
             elif f != 1.0:
                 kinds.setdefault("scale", []).append(c)
         corrupted = sorted({c for cs in kinds.values() for c in cs})
-        if not dropped and not corrupted:
+        slow: list[int] = []
+        if self.slow_faults:
+            # virtual-clock stragglers are facts about the round too — the
+            # log should name them even though no packet was touched
+            ct = self.compute_time_factors(round_idx, n_clients)
+            slow = [int(c) for c in np.nonzero(ct != 1.0)[0]]
+            if slow:
+                kinds["slow"] = slow
+        if not dropped and not corrupted and not slow:
             return None
         return {
             "round": int(round_idx),
@@ -245,19 +299,25 @@ def chaos_handler(
     policy: TransportFaultPolicy,
     seed: int = 0,
     silo_idx: int = 0,
+    sleep: Callable[[float], None] = time.sleep,
 ) -> Callable[[bytes], bytes]:
     """Wrap a silo request handler with deterministic wire chaos.
 
     Draws come from ``random.Random(f"{seed}:{silo_idx}")`` in a fixed order
     per request (delay, drop, corrupt), so a given plan produces the same
     fault sequence every run — tests assert against it. Thread-safe enough
-    for the one-connection-at-a-time loopback server."""
+    for the one-connection-at-a-time loopback server.
+
+    ``sleep`` is injectable (mirroring ``retry.py``'s ``call_with_retry``)
+    so straggler-delay tests record the delays instead of paying them —
+    the draw ORDER is identical either way, keeping recorded and
+    real-sleep runs the same fault sequence."""
     rng = _pyrandom.Random(f"{seed}:{silo_idx}")
 
     def wrapped(frame: bytes) -> bytes:
         r_delay, r_drop, r_corrupt = rng.random(), rng.random(), rng.random()
         if policy.delay_s > 0 and r_delay < policy.delay_probability:
-            time.sleep(policy.delay_s)
+            sleep(policy.delay_s)
         if r_drop < policy.drop_probability:
             raise _InjectedDrop(
                 f"chaos: dropped request at silo {silo_idx}"

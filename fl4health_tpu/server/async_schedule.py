@@ -1,0 +1,248 @@
+"""Buffered-async scheduling — round cadence set by arrival rate, not the tail.
+
+PR 5's quorum/circuit-breaker work still ran SYNCHRONOUS rounds: wall time
+per round is ``max_c T_c``, the compute time of the slowest surviving
+client — exactly the tail cost CLIP (arXiv:2510.16694) identifies as
+dominant in secure FL deployments, and the barrier FedBuff (Nguyen et al.,
+arXiv:2106.06639) removes. This module is the host half of the repo's
+FedBuff-style mode: clients draw deterministic, seeded compute times on a
+VIRTUAL clock, the server aggregates as soon as a buffer of ``K`` updates
+has arrived, and stale updates are staleness-discounted against the server
+version they trained from.
+
+The critical design decision: the async schedule is resolved to a STATIC
+EVENT PLAN here, at dispatch time. Arrival order, staleness and cadence
+are a pure function of ``(AsyncConfig.seed, FaultPlan, cohort, K)`` — a
+priority-queue simulation over the virtual clock, no wall-clock sleeps, no
+threads. The resulting ``[events, clients]`` arrival/staleness arrays feed
+the compiled async round programs (``server/simulation.py``) as plain jit
+inputs, so the whole buffered-async run still executes as compiled round
+programs — an in-graph scan over buffer-fill events on the chunked path,
+one dispatch per event on the pipelined path — and the same plan replays
+bit-identically on both.
+
+Process semantics (one client = one row of the stacked cohort):
+
+- At virtual t=0 every client pulls server version 0 and starts training;
+  client ``c``'s attempt on data-plan ``p`` takes
+  ``base_compute_s * jitter(seed, c, p) * slow_factor(fault_plan, c, p)``
+  virtual seconds (``kind="slow"`` faults, resilience/faults.py).
+- Finished updates queue in the server buffer; when the ``K``-th arrives
+  the server aggregates those ``K`` (event ``e``, producing version
+  ``e``), each discounted by ``1/(1+staleness)^exponent`` where staleness
+  counts server versions since that client pulled.
+- Consumed clients immediately pull the fresh version and restart; clients
+  still training run straight through the event (no barrier).
+
+With ``K = cohort`` and no slow faults every event consumes the whole
+cohort at staleness 0 — the plan degenerates to the synchronous schedule,
+which is how the simulation pins ``async == sync`` bit-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+
+import numpy as np
+
+__all__ = [
+    "AsyncConfig",
+    "AsyncEventPlan",
+    "build_event_plan",
+    "staleness_discount",
+    "sync_round_times",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncConfig:
+    """Static recipe for the buffered-async mode.
+
+    buffer_size:        K — updates the server buffers before aggregating.
+    staleness_exponent: discount ``1/(1+s)^exponent`` (0.5 = the FedBuff
+                        paper's ``1/sqrt(1+s)``; 0.0 disables discounting).
+    max_staleness:      updates staler than this aggregate with weight 0
+                        (still counted/arrived — their client restarts);
+                        None = no cap.
+    base_compute_s:     nominal virtual compute time of one local-training
+                        attempt (the unit every cadence number is in).
+    compute_jitter:     per-(client, attempt) multiplicative jitter drawn
+                        uniformly from ``[1-j, 1+j]`` — breaks arrival
+                        ties so buffer fills are not degenerate lockstep;
+                        0.0 keeps every honest client identical.
+    seed:               stream for the jitter draws (independent of the
+                        FaultPlan seed).
+    """
+
+    buffer_size: int
+    staleness_exponent: float = 0.5
+    max_staleness: int | None = None
+    base_compute_s: float = 1.0
+    compute_jitter: float = 0.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.buffer_size < 1:
+            raise ValueError(
+                f"buffer_size must be >= 1; got {self.buffer_size}"
+            )
+        if self.staleness_exponent < 0:
+            raise ValueError("staleness_exponent must be >= 0")
+        if self.max_staleness is not None and self.max_staleness < 0:
+            raise ValueError("max_staleness must be >= 0 (or None)")
+        if not self.base_compute_s > 0:
+            raise ValueError("base_compute_s must be > 0")
+        if not 0.0 <= self.compute_jitter < 1.0:
+            raise ValueError("compute_jitter must be in [0, 1)")
+
+    def describe(self) -> dict:
+        """JSON-able identity for the run manifest's config hash."""
+        return {
+            "buffer_size": self.buffer_size,
+            "staleness_exponent": self.staleness_exponent,
+            "max_staleness": self.max_staleness,
+            "base_compute_s": self.base_compute_s,
+            "compute_jitter": self.compute_jitter,
+            "seed": self.seed,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncEventPlan:
+    """The resolved static schedule of one buffered-async run.
+
+    arrivals:    [E, C] float32 — 1.0 where client c's update is consumed
+                 at event e (exactly ``buffer_size`` ones per row).
+    staleness:   [E, C] float32 — server versions elapsed since the
+                 arriving client pulled (0 where not arriving).
+    event_times: [E] float64 — virtual wall time of each aggregation; the
+                 successive differences ARE the async round cadence.
+    """
+
+    arrivals: np.ndarray
+    staleness: np.ndarray
+    event_times: np.ndarray
+
+    @property
+    def n_events(self) -> int:
+        return int(self.arrivals.shape[0])
+
+    @property
+    def n_clients(self) -> int:
+        return int(self.arrivals.shape[1])
+
+    def cadences(self) -> np.ndarray:
+        """[E] virtual seconds between consecutive aggregations (event 0
+        measured from t=0)."""
+        return np.diff(self.event_times, prepend=0.0)
+
+    def summarize_event(self, e: int) -> dict:
+        """Host facts about one event for the ``round`` JSONL record."""
+        arr = self.arrivals[e] > 0
+        stal = self.staleness[e][arr]
+        return {
+            "async_buffer": int(arr.sum()),
+            "staleness_mean": float(stal.mean()) if stal.size else 0.0,
+            "staleness_max": float(stal.max()) if stal.size else 0.0,
+            "async_virtual_time_s": float(self.event_times[e]),
+            "async_cadence_vs": float(self.cadences()[e]),
+        }
+
+
+def staleness_discount(staleness, exponent: float = 0.5,
+                       max_staleness: int | None = None):
+    """Aggregation weight for an update ``staleness`` versions old:
+    ``1/(1+s)^exponent``, hard-zeroed past ``max_staleness``. Works on
+    numpy arrays and traced jax arrays alike (pure arithmetic)."""
+    w = (1.0 + staleness) ** (-float(exponent))
+    if max_staleness is not None:
+        w = w * (staleness <= max_staleness)
+    return w
+
+
+def _attempt_times(config: AsyncConfig, n_clients: int, n_plans: int,
+                   fault_plan=None) -> np.ndarray:
+    """[n_plans, C] virtual compute time of each (data-plan, client)
+    training attempt — base x jitter x slow-fault factor. Plan indices are
+    1-based (plan p is row p-1), matching the simulation's round plans."""
+    times = np.full((n_plans, n_clients), float(config.base_compute_s))
+    if config.compute_jitter > 0:
+        j = config.compute_jitter
+        for p in range(1, n_plans + 1):
+            # seeded per (seed, plan), one [C] vector per plan:
+            # deterministic across runs/platforms (PCG64) and O(plans)
+            # generator constructions — a per-(client, plan) generator
+            # would cost seconds of host time at thousands of clients
+            rng = np.random.default_rng([config.seed, p])
+            times[p - 1] *= rng.uniform(1.0 - j, 1.0 + j, size=n_clients)
+    if fault_plan is not None and getattr(fault_plan, "slow_faults", ()):
+        for p in range(1, n_plans + 1):
+            times[p - 1] *= fault_plan.compute_time_factors(p, n_clients)
+    return times
+
+
+def build_event_plan(
+    config: AsyncConfig,
+    n_events: int,
+    n_clients: int,
+    fault_plan=None,
+) -> AsyncEventPlan:
+    """Simulate the buffered-async process on the virtual clock and return
+    the static event plan the compiled round programs consume.
+
+    Priority-queue over (finish_time, client_id) — ties resolve by client
+    id, so the plan is exactly reproducible. Clients consumed at event
+    ``e`` restart at the event's time on data plan ``e+1`` (the plan their
+    NEXT update trains on), which is what makes the ``K = cohort`` plan
+    collapse to the synchronous round schedule."""
+    if n_events < 1:
+        raise ValueError(f"n_events must be >= 1; got {n_events}")
+    if n_clients < 1:
+        raise ValueError(f"n_clients must be >= 1; got {n_clients}")
+    k = config.buffer_size
+    if k > n_clients:
+        raise ValueError(
+            f"buffer_size={k} exceeds the cohort ({n_clients} clients): "
+            "the buffer could never fill"
+        )
+    # plan indices in play: the prologue trains on plan 1; a restart at
+    # event e trains on plan e+1 — so at most n_events+1 plans are drawn
+    times = _attempt_times(config, n_clients, n_events + 1, fault_plan)
+
+    arrivals = np.zeros((n_events, n_clients), np.float32)
+    staleness = np.zeros((n_events, n_clients), np.float32)
+    event_times = np.zeros((n_events,), np.float64)
+    pulled = np.zeros((n_clients,), np.int64)  # server version each holds
+    heap: list[tuple[float, int]] = [
+        (times[0, c], c) for c in range(n_clients)
+    ]
+    heapq.heapify(heap)
+    for e in range(n_events):
+        batch = [heapq.heappop(heap) for _ in range(k)]
+        t_event = max(t for t, _ in batch)
+        event_times[e] = t_event
+        for _, c in batch:
+            arrivals[e, c] = 1.0
+            staleness[e, c] = float(e - pulled[c])
+            pulled[c] = e + 1
+            heapq.heappush(heap, (t_event + times[e + 1, c], c))
+    return AsyncEventPlan(
+        arrivals=arrivals, staleness=staleness, event_times=event_times
+    )
+
+
+def sync_round_times(
+    config: AsyncConfig,
+    n_rounds: int,
+    n_clients: int,
+    fault_plan=None,
+) -> np.ndarray:
+    """[n_rounds] virtual wall time of each SYNCHRONOUS round under the
+    same compute-time model — ``max_c T_c(round)``, the barrier cost. The
+    bench's sync-vs-async cadence comparison reads both sides from one
+    model, so the headline ratio is apples-to-apples by construction."""
+    if n_rounds < 1:
+        raise ValueError(f"n_rounds must be >= 1; got {n_rounds}")
+    times = _attempt_times(config, n_clients, n_rounds, fault_plan)
+    return times.max(axis=1)

@@ -31,6 +31,14 @@ consumer receives *result* arrays (fresh outputs, never donated back into a
 later round) or device-side snapshot copies; the prefetcher reads only the
 immutable per-round plan inputs and the data stacks it re-validates by
 identity.
+
+Buffered-async runs (``server/async_schedule.py``) reuse both helpers with
+shifted indices: buffer-fill event *e* restarts its consumed clients on
+data plan ``e+1``, so the async producer schedules/takes plan index
+``e+1`` while event *e* executes (the prologue takes plan 1). The plan
+index IS the prefetcher's contract — it never assumes indices are round
+numbers, only that ``take(i)`` follows ``schedule(i)`` — which is what
+lets one prefetcher serve both cadences.
 """
 
 from __future__ import annotations

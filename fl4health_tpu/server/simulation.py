@@ -193,6 +193,10 @@ class _RoundWork:
     compile_s_before: float
     compiles_after: float | None
     compile_s_after: float | None
+    # buffered-async runs only: host facts of the consumed buffer-fill
+    # event (staleness stats, virtual cadence) from the static plan —
+    # merged into the round record/metrics by the consumer
+    async_info: dict | None = None
 
 
 class FederatedSimulation:
@@ -228,6 +232,7 @@ class FederatedSimulation:
         compression: Any = None,
         mesh: MeshConfig | None = None,
         precision: Any = None,
+        async_config: Any = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -289,6 +294,62 @@ class FederatedSimulation:
             strategy = self.strategy = CompressingStrategy(
                 strategy, compression
             )
+        # Buffered-async federation (server/async_schedule.py AsyncConfig):
+        # the FedBuff-style mode where the server aggregates as soon as a
+        # buffer of K updates arrives, staleness-discounting stale ones.
+        # The schedule resolves to a STATIC event plan at fit() time, so
+        # async runs still execute as compiled round programs on both
+        # execution paths. None (the default) builds the exact synchronous
+        # programs — trajectories bit-identical to pre-async builds.
+        self.async_config = async_config
+        if async_config is not None:
+            from fl4health_tpu.server.async_schedule import AsyncConfig
+
+            if not isinstance(async_config, AsyncConfig):
+                raise TypeError(
+                    "async_config must be an AsyncConfig (or None); got "
+                    f"{type(async_config).__name__} — a duck-typed config "
+                    "would silently train synchronously"
+                )
+            if async_config.buffer_size > len(datasets):
+                raise ValueError(
+                    f"async_config.buffer_size={async_config.buffer_size} "
+                    f"exceeds the cohort ({len(datasets)} clients): the "
+                    "buffer could never fill"
+                )
+            from fl4health_tpu.strategies.fedbuff import FedBuff
+
+            if isinstance(strategy, FedBuff):
+                # A pre-wrapped FedBuff must AGREE with the AsyncConfig:
+                # the manifest hashes the config's staleness parameters,
+                # so a wrapper silently discounting with different ones
+                # would misattribute the experiment.
+                if (strategy.staleness_exponent
+                        != float(async_config.staleness_exponent)
+                        or strategy.max_staleness
+                        != async_config.max_staleness):
+                    raise ValueError(
+                        "the provided FedBuff wrapper's staleness "
+                        f"parameters (exponent={strategy.staleness_exponent}"
+                        f", max_staleness={strategy.max_staleness}) differ "
+                        "from async_config's "
+                        f"(exponent={async_config.staleness_exponent}, "
+                        f"max_staleness={async_config.max_staleness}) — "
+                        "the manifest records the config's values, so "
+                        "they must match (simplest: pass the bare inner "
+                        "strategy and let async_config do the wrapping)"
+                    )
+            else:
+                # FedBuff must be the OUTERMOST wrapper: the async round
+                # programs call its async_aggregation_mask hook, and inner
+                # wrappers (compression/quarantine) see the discounted
+                # fractional mask exactly like a sampled one
+                strategy = self.strategy = FedBuff(
+                    strategy,
+                    staleness_exponent=async_config.staleness_exponent,
+                    max_staleness=async_config.max_staleness,
+                )
+        self._async_active = async_config is not None
         # Device-mesh placement (parallel/program.py): mesh=None keeps the
         # single-chip programs (and trajectories) bit-identical; a
         # MeshConfig shards the [C, ...] client axes over the "clients"
@@ -376,6 +437,46 @@ class FederatedSimulation:
         # fresh patch extraction per round (nnunet.data.make_patch_resampler);
         # fit_chunk bakes its data at dispatch time and bypasses it.
         self.train_data_provider = train_data_provider
+        if self._async_active:
+            # The async event programs are FUSED (aggregate -> eval ->
+            # retrain in one dispatch), so hooks that need the host mid-
+            # round cannot compose; and participation is DERIVED from the
+            # arrival schedule, so a sampling manager would be silently
+            # ignored. Reject loudly instead.
+            if not isinstance(self.client_manager, FullParticipationManager):
+                raise ValueError(
+                    "async_config derives participation from the buffer's "
+                    "arrival schedule; a sampling client manager "
+                    f"({type(self.client_manager).__name__}) is not "
+                    "composable with buffered-async mode"
+                )
+            overrides = getattr(
+                self.strategy, "overrides_update_after_eval", None
+            )
+            if overrides is None:
+                overrides = (type(self.strategy).update_after_eval
+                             is not Strategy.update_after_eval)
+            if overrides:
+                raise ValueError(
+                    "async_config is not composable with strategies that "
+                    "consume per-round eval results on the host "
+                    "(update_after_eval override): the async event "
+                    "program fuses aggregate+eval+retrain in one dispatch"
+                )
+            if self.train_data_provider is not None:
+                raise ValueError(
+                    "async_config is not composable with "
+                    "train_data_provider: the async event programs bake "
+                    "their data at dispatch time"
+                )
+            if self.model_checkpointers or self.state_checkpointer is not None:
+                raise ValueError(
+                    "async_config is not composable with per-round "
+                    "checkpointing yet: there is no synchronous "
+                    "post-fit/pre-aggregation moment inside a fused "
+                    "buffer-fill event (checkpoint manually between "
+                    "fit() calls instead)"
+                )
         # fit() dispatch strategy: "auto" routes through the on-device
         # multi-round chunked scan whenever the configuration permits (see
         # _chunk_ineligibility) and falls back to the pipelined per-round
@@ -685,13 +786,22 @@ class FederatedSimulation:
             )
         self._chunked_fit = None  # compiled lazily by make_chunked_fit
         self._chunked_fit_eval = None  # compiled lazily (fit()'s chunked route)
+        # Buffered-async programs (compiled lazily by _make_async_programs /
+        # _make_async_chunked — only ever built when async_config is set,
+        # so a synchronous simulation compiles exactly the pre-async set)
+        self._async_prologue_jit = None
+        self._async_event_jit = None
+        self._async_chunked_jit = None
+        self._async_plan = None  # the run's static event plan (host numpy)
+        self._async_pending = None  # in-flight update buffer (device tree)
 
-    def _build_round_fns(self, collect_telemetry: bool):
-        """Build (fit_round, eval_round) closures. With ``collect_telemetry``
-        each appends one extra output — fit_round a :class:`RoundTelemetry`
-        pytree, eval_round the per-client non-finite eval-loss count — all
-        derived from values the program already computes, so the training
-        math (and thus the loss trajectory) is bit-identical either way."""
+    def _build_client_fns(self, collect_telemetry: bool):
+        """Build the client-level (client_fit, client_eval) closures —
+        pull -> local train -> push, and pull -> eval. ONE definition
+        shared by the synchronous round programs (:meth:`_build_round_fns`)
+        and the buffered-async event programs (:meth:`_build_async_fns`),
+        so async and sync rounds run bit-identical client math by
+        construction."""
         logic, tx, strategy, exchanger = self.logic, self.tx, self.strategy, self.exchanger
         loss_keys = ("backward", *self._extra_keys())
         if collect_telemetry:
@@ -788,6 +898,26 @@ class FederatedSimulation:
                 return new_state, packet, losses, metrics, client_telem
             return new_state, packet, losses, metrics
 
+        def client_eval(state: TrainState, payload, batches: Batch):
+            payload_params = payload.params if hasattr(payload, "params") else payload
+            pull_src = payload if wants_packet else payload_params
+            pulled = exchanger.pull(pull_src, state.params)
+            st = state.replace(params=pulled)
+            ctx = logic.init_round_context(st, payload)
+            losses, metrics = evaluate(st, ctx, batches)
+            return st, losses, metrics
+
+        return client_fit, client_eval
+
+    def _build_round_fns(self, collect_telemetry: bool):
+        """Build (fit_round, eval_round) closures. With ``collect_telemetry``
+        each appends one extra output — fit_round a :class:`RoundTelemetry`
+        pytree, eval_round the per-client non-finite eval-loss count — all
+        derived from values the program already computes, so the training
+        math (and thus the loss trajectory) is bit-identical either way."""
+        client_fit, client_eval = self._build_client_fns(collect_telemetry)
+        strategy = self.strategy
+
         # Chaos layer (resilience/faults.py): compiled into the round
         # program so the same seeded plan injects identical faults on both
         # execution modes. With no plan (or an empty one) neither branch
@@ -876,15 +1006,6 @@ class FederatedSimulation:
             )
             return (new_server_state, new_states, agg_losses, agg_metrics,
                     losses, round_telemetry)
-
-        def client_eval(state: TrainState, payload, batches: Batch):
-            payload_params = payload.params if hasattr(payload, "params") else payload
-            pull_src = payload if wants_packet else payload_params
-            pulled = exchanger.pull(pull_src, state.params)
-            st = state.replace(params=pulled)
-            ctx = logic.init_round_context(st, payload)
-            losses, metrics = evaluate(st, ctx, batches)
-            return st, losses, metrics
 
         def eval_round(server_state, client_states, batches, eval_counts):
             gp = strategy.client_payload(server_state, jnp.zeros((), jnp.int32))
@@ -1174,6 +1295,281 @@ class FederatedSimulation:
         )
         return self._chunked_fit_eval
 
+    # -- buffered-async programs (server/async_schedule.py) -------------
+    def _build_async_fns(self, collect_telemetry: bool):
+        """Build the (async_prologue, async_event) closures of the
+        buffered-async mode (FedBuff-style, arXiv:2106.06639).
+
+        One buffer-fill EVENT replaces one synchronous round:
+
+            consume  — the K arrived updates (a row of the static event
+                       plan) aggregate under the staleness-discounted
+                       fractional mask (``FedBuff.async_aggregation_mask``);
+            eval     — the post-aggregation global evaluates exactly like
+                       a synchronous round's eval;
+            restart  — the consumed clients pull the fresh global and run
+                       their next local training, whose packet sits in the
+                       carried ``pending`` buffer until a later event
+                       consumes it.
+
+        The prologue is event 0's missing half: every client trains from
+        the initial global on data plan 1, filling ``pending``. Ordering
+        (aggregate -> eval -> restart-on-post-eval-states) deliberately
+        mirrors the synchronous round sequence, which is what makes the
+        ``K = cohort, no stragglers`` plan bit-identical to sync fit() —
+        same client math (shared ``_build_client_fns`` closures), same
+        aggregation arithmetic, same round indices."""
+        client_fit, _ = self._build_client_fns(collect_telemetry)
+        _, eval_round = self._build_round_fns(collect_telemetry)
+        strategy = self.strategy
+        fault_plan = self._fault_plan
+        inject_dropout = (fault_plan is not None
+                          and bool(getattr(fault_plan, "dropout_faults", ())))
+        inject_corruption = (
+            fault_plan is not None
+            and bool(getattr(fault_plan, "corruption_faults", ()))
+        )
+        n_clients = self.n_clients
+        sample_counts = self.sample_counts
+        async_mask = getattr(strategy, "async_aggregation_mask", None)
+        quarantine_fn = (getattr(strategy, "quarantine_mask", None)
+                         if self.observability.enabled else None)
+
+        def train_wave(server_state, client_states, batches, train_mask,
+                       round_idx, val_batches):
+            """One training wave on data plan ``round_idx``: pull the
+            current payload, locally train the masked clients, corrupt the
+            wire packets with the SAME seeded round draws the sync path
+            uses. Returns (new client stack, this wave's pending pieces)."""
+            payload = strategy.client_payload(server_state, round_idx)
+            vmapped = jax.vmap(client_fit, in_axes=(0, None, 0, 0, 0))(
+                client_states, payload, batches, train_mask, val_batches
+            )
+            if collect_telemetry:
+                new_states, packets, losses, metrics, client_telem = vmapped
+            else:
+                new_states, packets, losses, metrics = vmapped
+                client_telem = None
+            if inject_corruption:
+                payload_params = (payload.params
+                                  if hasattr(payload, "params") else payload)
+                packets = fault_plan.corrupt_packets(
+                    packets, payload_params, round_idx, n_clients
+                )
+            pending = {"packets": packets, "losses": losses,
+                       "metrics": metrics}
+            if collect_telemetry:
+                pending["telem"] = client_telem
+            return new_states, pending
+
+        def merge_pending(old, new, arrivals):
+            """Per-leaf arrival-masked merge: an arrived client's slot
+            takes its fresh wave output; everyone else's in-flight update
+            stays buffered untouched."""
+            def sel(n, o):
+                a = arrivals.reshape((-1,) + (1,) * (n.ndim - 1))
+                return jnp.where(a > 0, n, o)
+
+            return jax.tree_util.tree_map(sel, new, old)
+
+        def async_prologue(server_state, client_states, batches, val_batches):
+            ones = jnp.ones((n_clients,), jnp.float32)
+            return train_wave(
+                server_state, client_states, batches, ones,
+                jnp.asarray(1, jnp.int32), val_batches,
+            )
+
+        def async_event(server_state, client_states, pending, batches_next,
+                        arrivals, staleness, event_idx, val_batches,
+                        val_counts, test_batches=None, test_counts=None):
+            # -- consume: staleness-discounted aggregation of the buffer --
+            arr = arrivals
+            if inject_dropout:
+                # a dropped update is lost on the wire: it fills its buffer
+                # slot but aggregates with weight 0 (the client restarts
+                # normally, keeping the static plan's bookkeeping exact)
+                arr = arr * fault_plan.participation_factor(
+                    event_idx, n_clients
+                )
+            disc_mask = (async_mask(arr, staleness) if async_mask is not None
+                         else arr)
+            finite = jnp.isfinite(
+                pending["losses"].get("backward", jnp.zeros_like(arr))
+            )
+            agg_mask = disc_mask * finite.astype(disc_mask.dtype)
+            results = FitResults(
+                packets=pending["packets"],
+                sample_counts=sample_counts,
+                train_losses=pending["losses"],
+                train_metrics=pending["metrics"],
+                mask=agg_mask,
+            )
+            new_server = strategy.aggregate(server_state, results, event_idx)
+            w = results.mask * sample_counts
+            agg_losses = {
+                k: jnp.sum(jnp.where(results.mask > 0, v, 0.0) * w)
+                / jnp.maximum(jnp.sum(w), 1.0)
+                for k, v in pending["losses"].items()
+            }
+            agg_metrics = aggregate_metrics(
+                pending["metrics"], sample_counts, results.mask
+            )
+            round_telemetry = None
+            if collect_telemetry:
+                # telemetry describes the CONSUMED updates (like the loss
+                # record): engine stats ride the pending buffer from train
+                # time; divergence/nonfinite measure the live stack against
+                # the fresh aggregate, exactly the sync definitions
+                pt = pending["telem"]
+                nan_row = jnp.full_like(
+                    jnp.asarray(pending["losses"]["backward"], jnp.float32),
+                    jnp.nan,
+                )
+                round_telemetry = RoundTelemetry(
+                    train_loss=jnp.asarray(
+                        pending["losses"]["backward"], jnp.float32
+                    ),
+                    train_loss_min=pt["train_loss_min"],
+                    train_loss_max=pt["train_loss_max"],
+                    grad_norm_mean=pt["grad_norm_mean"],
+                    grad_norm_max=pt["grad_norm_max"],
+                    update_norm=pt["update_norm"],
+                    clip_fraction=pending["losses"].get(
+                        "clip_fraction", nan_row
+                    ),
+                    nonfinite_params=telem.per_client_nonfinite(
+                        client_states.params
+                    ),
+                    nonfinite_loss=telem.nonfinite_in_losses(
+                        pending["losses"]
+                    ),
+                    divergence=telem.per_client_divergence(
+                        client_states.params,
+                        strategy.divergence_reference(new_server),
+                    ),
+                    nonfinite_eval_loss=jnp.zeros_like(nan_row),
+                    loss_scale_skips=pt.get("loss_scale_skips"),
+                )
+            # -- eval: the fresh global, synchronous-round semantics ------
+            ev_outs = eval_round(
+                new_server, client_states, val_batches, val_counts
+            )
+            if collect_telemetry:
+                (client_states, ev_losses, ev_metrics, _pl, _pm,
+                 ev_nonfinite) = ev_outs
+                round_telemetry = round_telemetry.replace(
+                    nonfinite_eval_loss=ev_nonfinite
+                )
+            else:
+                client_states, ev_losses, ev_metrics, _pl, _pm = ev_outs
+            out = {
+                "fit_losses": agg_losses,
+                "fit_metrics": agg_metrics,
+                "per_client_fit_losses": pending["losses"],
+                "eval_losses": ev_losses,
+                "eval_metrics": ev_metrics,
+            }
+            if round_telemetry is not None:
+                out["telemetry"] = round_telemetry
+            if quarantine_fn is not None:
+                out["quarantine"] = quarantine_fn(new_server)
+            if test_batches is not None:
+                t_outs = eval_round(
+                    new_server, client_states, test_batches, test_counts
+                )
+                client_states = t_outs[0]
+                out["test_losses"] = t_outs[1]
+                out["test_metrics"] = t_outs[2]
+            # -- restart: consumed clients train for a later event --------
+            # data plan event_idx+1 and the matching fault draws — the
+            # index stream a synchronous round event_idx+1 would use
+            client_states, fresh = train_wave(
+                new_server, client_states, batches_next, arrivals,
+                event_idx + 1, val_batches,
+            )
+            pending = merge_pending(pending, fresh, arrivals)
+            return new_server, client_states, pending, out
+
+        return async_prologue, async_event
+
+    def _make_async_programs(self):
+        """Jit the per-event async programs (the pipelined path). The
+        prologue keeps its server-state input alive (event 1 consumes it);
+        the event program donates all three carried trees."""
+        if self._async_event_jit is not None:
+            return self._async_prologue_jit, self._async_event_jit
+        prologue, event = self._build_async_fns(self._telemetry_enabled)
+        b = self._program_builder
+        pro_in = ev_in = ev_out = None
+        if b.mesh is not None:
+            cs = b.client_sharding()
+            rep = b.replicated()
+            sh_c, sh_s = self._sh_client_states, self._sh_server_state
+            pro_in = (sh_s, sh_c, cs, cs)
+            ev_in = (sh_s, sh_c, cs, cs, cs, cs, rep, cs, cs)
+            if self._test_batches() is not None:
+                ev_in = ev_in + (cs, cs)
+            ev_out = (sh_s, sh_c, cs, None)
+        self._async_prologue_jit = b.jit(
+            prologue, donate=(1,),
+            in_shardings=pro_in,
+            out_shardings=(self._sh_client_states, b.client_sharding())
+            if b.mesh is not None else None,
+        )
+        self._async_event_jit = b.jit(
+            event, donate=(0, 1, 2), in_shardings=ev_in, out_shardings=ev_out,
+        )
+        return self._async_prologue_jit, self._async_event_jit
+
+    def _make_async_chunked(self):
+        """Compile the async chunked route: ONE lax.scan dispatch walks the
+        whole static event plan — per-event arrivals/staleness rows and
+        data plans scan over the carried (server, clients, pending) trees,
+        so a buffered-async run costs two dispatches total (prologue +
+        scan) exactly like the synchronous chunked path costs one."""
+        if self._async_chunked_jit is not None:
+            return self._async_chunked_jit
+        _, event = self._build_async_fns(self._telemetry_enabled)
+
+        def chunk(server_state, client_states, pending, x_stack, y_stack,
+                  idx, em, sm, arrivals, staleness, val_batches, val_counts,
+                  test_batches=None, test_counts=None):
+            def body(carry, per_event):
+                server_state, client_states, pending, e = carry
+                idx_r, em_r, sm_r, arr_r, stal_r = per_event
+                batches_next = engine.gather_batches(
+                    x_stack, y_stack, idx_r, em_r, sm_r
+                )
+                server_state, client_states, pending, out = event(
+                    server_state, client_states, pending, batches_next,
+                    arr_r, stal_r, e, val_batches, val_counts,
+                    test_batches, test_counts,
+                )
+                return (server_state, client_states, pending, e + 1), out
+
+            (server_state, client_states, _p, _e), outs = jax.lax.scan(
+                body,
+                (server_state, client_states, pending,
+                 jnp.asarray(1, jnp.int32)),
+                (idx, em, sm, arrivals, staleness),
+            )
+            return server_state, client_states, outs
+
+        b = self._program_builder
+        in_sh = out_sh = None
+        if b.mesh is not None:
+            cs = b.client_sharding()
+            scs = b.stacked_client_sharding()
+            in_sh = (self._sh_server_state, self._sh_client_states, cs,
+                     cs, cs, scs, scs, scs, scs, scs, cs, cs)
+            if self._test_batches() is not None:
+                in_sh = in_sh + (cs, cs)
+            out_sh = (self._sh_server_state, self._sh_client_states, None)
+        self._async_chunked_jit = b.jit(
+            chunk, donate=(0, 1, 2), in_shardings=in_sh, out_shardings=out_sh
+        )
+        return self._async_chunked_jit
+
     def _eval_split_batches(self, x_stack, y_stack, ns) -> tuple[Batch, jax.Array]:
         """Shared val/test eval batching: fixed-order full pass + counts —
         one implementation so both splits always score under the same rules."""
@@ -1335,10 +1731,14 @@ class FederatedSimulation:
                 logging.getLogger(__name__).warning(
                     "run manifest construction failed", exc_info=True
                 )
-            if obs.introspection and n_rounds >= 1:
+            if obs.introspection and n_rounds >= 1 and not self._async_active:
                 # compiled-program introspection at BUILD time: XLA
                 # cost/memory analysis, compile wall, cache attribution —
-                # zero per-round cost, measured MFU for every round record
+                # zero per-round cost, measured MFU for every round record.
+                # (Async runs skip it: the event programs' work varies with
+                # the consumed buffer, so a single per-round FLOP number
+                # would be dishonest — staleness/cadence metrics carry the
+                # async story instead.)
                 with obs.span("introspect", cat="fit"):
                     self._introspect_programs(mode, n_rounds)
         for r in self.reporters:
@@ -1346,7 +1746,9 @@ class FederatedSimulation:
                       "num_rounds": n_rounds, "execution_mode": mode,
                       "execution_mode_reason": mode_reason})
         try:
-            if mode == EXEC_CHUNKED:
+            if self._async_active and n_rounds >= 1:
+                self._fit_async(n_rounds, mode)
+            elif mode == EXEC_CHUNKED:
                 self._fit_chunked(n_rounds)
             else:
                 self._fit_pipelined(n_rounds)
@@ -1387,6 +1789,12 @@ class FederatedSimulation:
             "precision": (self.precision.describe()
                           if self._precision_active else None),
         }
+        if self._async_active:
+            # async identity belongs in the config hash (a buffered-async
+            # and a synchronous run of the same recipe are different
+            # experiments); key absent on sync builds so legacy hashes
+            # stay stable
+            config["async"] = self.async_config.describe()
         if self._program_builder.mesh is not None:
             # mesh identity belongs in the config hash (a sharded and an
             # unsharded run of the same recipe are different experiments);
@@ -1867,6 +2275,7 @@ class FederatedSimulation:
                 compiles_after=work.compiles_after,
                 compile_s_after=work.compile_s_after,
                 telemetry=telemetry_host,
+                async_info=work.async_info,
             )
         if quarantine_mask is not None:
             self._emit_quarantine_metrics(rnd, np.asarray(quarantine_mask))
@@ -1960,6 +2369,24 @@ class FederatedSimulation:
                 "jax_backend_compiles_seconds_total").value
         per_round_s = (time.time() - t_start) / max(n_rounds, 1)
         device_wait_round = device_wait_total / max(n_rounds, 1)
+        self._chunked_epilogue(
+            n_rounds, stacked, masks_np, compiles_before, compile_s_before,
+            compiles_after, compile_s_after, per_round_s, device_wait_round,
+        )
+
+    def _chunked_epilogue(
+        self, n_rounds: int, stacked: dict, masks_np: np.ndarray,
+        compiles_before: float, compile_s_before: float,
+        compiles_after: float | None, compile_s_after: float | None,
+        per_round_s: float, device_wait_round: float,
+        async_plan=None,
+    ) -> None:
+        """Per-round host epilogue over a chunked dispatch's stacked
+        outputs: failure screen, RoundRecords, metrics/reports, watchdog —
+        shared by the synchronous chunked route and the buffered-async
+        chunked route (``async_plan`` adds per-event staleness/cadence
+        facts to each round's metrics)."""
+        obs = self.observability
         telemetry_stack = stacked.get("telemetry")
         quarantine_stack = stacked.get("quarantine")
         for i in range(n_rounds):
@@ -2019,6 +2446,8 @@ class FederatedSimulation:
                     compile_s_after=(compile_s_after if i == 0
                                      else compile_s_before),
                     telemetry=telemetry_i,
+                    async_info=(self._async_event_info(async_plan, i)
+                                if async_plan is not None else None),
                 )
             if quarantine_stack is not None:
                 self._emit_quarantine_metrics(
@@ -2044,6 +2473,228 @@ class FederatedSimulation:
                     obs=obs, reporters=self.reporters,
                 )
 
+    # -- buffered-async path (server/async_schedule.py) -----------------
+    @staticmethod
+    def _async_event_info(plan, i: int) -> dict:
+        """One event's host facts for the round record, plus the raw
+        per-update staleness row (popped by ``_record_round_metrics``
+        into the staleness histogram)."""
+        info = plan.summarize_event(i)
+        arr = plan.arrivals[i] > 0
+        info["_staleness_values"] = [
+            float(s) for s in plan.staleness[i][arr]
+        ]
+        return info
+
+    def _fit_async(self, n_rounds: int, mode: str) -> None:
+        """fit()'s buffered-async route: resolve the virtual-clock arrival
+        schedule to a static event plan (pure function of the async
+        config's seed, the FaultPlan and the cohort — identical across
+        execution modes, resumes and processes), then run ``n_rounds``
+        buffer-fill EVENTS as compiled programs. Each event is one
+        RoundRecord: cadence is set by arrival rate, not the tail."""
+        from fl4health_tpu.server.async_schedule import build_event_plan
+
+        plan = build_event_plan(
+            self.async_config, n_rounds, self.n_clients, self._fault_plan
+        )
+        self._async_plan = plan
+        obs = self.observability
+        if obs.enabled:
+            obs.log_event(
+                "async_plan", events=n_rounds,
+                buffer_size=self.async_config.buffer_size,
+                staleness_mean=float(
+                    plan.staleness[plan.arrivals > 0].mean()
+                ) if n_rounds else 0.0,
+                virtual_wall_s=float(plan.event_times[-1]),
+                mean_cadence_vs=float(plan.cadences().mean()),
+            )
+        if mode == EXEC_CHUNKED:
+            self._fit_async_chunked(n_rounds, plan)
+        else:
+            self._fit_async_pipelined(n_rounds, plan)
+
+    def _stage_prologue_batches(self):
+        """Data-plan-1 batches for the async prologue, staged with the
+        builder's clients sharding (no-op unsharded)."""
+        return self._program_builder.put(
+            self._round_batches(1), self._program_builder.client_sharding()
+        )
+
+    def _fit_async_pipelined(self, n_rounds: int, plan) -> None:
+        """Per-event async path: prologue dispatch fills the pending
+        buffer, then each buffer-fill event dispatches one fused
+        aggregate->eval->restart program while the RoundConsumer runs the
+        previous event's host epilogue and the prefetcher stages the next
+        event's restart batches (data plan e+1)."""
+        obs = self.observability
+        prologue_jit, _ = self._make_async_programs()
+        with obs.span("setup", cat="fit"):
+            val_batches, val_counts = self._val_batches()
+        self._fit_n_rounds = n_rounds
+        self.server_state, self.client_states = _dedupe_donated(
+            self.server_state, self.client_states
+        )
+        consumer = self._consumer = RoundConsumer(maxsize=self.pipeline_depth)
+        prefetcher = self._prefetcher = RoundPrefetcher(self)
+        try:
+            with obs.span("async_prologue", cat="fit"):
+                batches1 = self._stage_prologue_batches()
+                self.client_states, self._async_pending = prologue_jit(
+                    self.server_state, self.client_states, batches1,
+                    val_batches,
+                )
+            # event e restarts its clients on data plan e+1
+            prefetcher.schedule(2)
+            for e in range(1, n_rounds + 1):
+                consumer.raise_pending()
+                with obs.maybe_profile(e):
+                    self._run_async_event(e, plan, val_batches, val_counts)
+            consumer.flush()
+        finally:
+            consumer.close()
+            prefetcher.close()
+            self._consumer = None
+            self._prefetcher = None
+            self._async_pending = None
+
+    def _run_async_event(self, e: int, plan, val_batches, val_counts) -> None:
+        """Producer half of one buffer-fill event (mirrors ``_run_round``):
+        one fused dispatch consumes the event's arrivals, evaluates the
+        fresh global and restarts the consumed clients; the host epilogue
+        (failure screen, records, metrics, reports, watchdog) runs on the
+        RoundConsumer thread."""
+        obs = self.observability
+        consumer = self._consumer
+        prefetcher = self._prefetcher
+        _, event_jit = self._make_async_programs()
+        compiles_before = compile_s_before = 0.0
+        if obs.enabled:
+            compiles_before = obs.registry.counter(
+                "jax_backend_compiles_total").value
+            compile_s_before = obs.registry.counter(
+                "jax_backend_compiles_seconds_total").value
+        t0 = time.time()
+        with obs.span("round", round=e, kind="async_event"):
+            arrivals = jnp.asarray(plan.arrivals[e - 1])
+            staleness = jnp.asarray(plan.staleness[e - 1])
+            batches_next = (prefetcher.take(e + 1) if prefetcher is not None
+                            else self._round_batches(e + 1))
+            if prefetcher is not None and e < self._fit_n_rounds:
+                prefetcher.schedule(e + 2)
+            args = [self.server_state, self.client_states,
+                    self._async_pending, batches_next, arrivals, staleness,
+                    jnp.asarray(e, jnp.int32), val_batches, val_counts]
+            test = self._test_batches()
+            if test is not None:
+                args.extend(test)
+            with obs.span("async_event", round=e) as ev_span:
+                (self.server_state, self.client_states, self._async_pending,
+                 out) = event_jit(*args)
+                _, device_wait_s = obs.fence(
+                    (out["fit_losses"], out["eval_losses"])
+                )
+                ev_span.set(device_wait_s=device_wait_s)
+            compiles_after = compile_s_after = None
+            if obs.enabled:
+                compiles_after = obs.registry.counter(
+                    "jax_backend_compiles_total").value
+                compile_s_after = obs.registry.counter(
+                    "jax_backend_compiles_seconds_total").value
+            device_results = {
+                "mask": plan.arrivals[e - 1],
+                "fit_losses": out["fit_losses"],
+                "fit_metrics": out["fit_metrics"],
+                "per_client_fit_losses": out["per_client_fit_losses"],
+                "eval_losses": out["eval_losses"],
+                "eval_metrics": out["eval_metrics"],
+            }
+            if "telemetry" in out:
+                device_results["telemetry"] = out["telemetry"]
+            if "quarantine" in out:
+                device_results["_quarantine"] = out["quarantine"]
+            if "test_losses" in out:
+                device_results["test_losses"] = out["test_losses"]
+                device_results["test_metrics"] = out["test_metrics"]
+            work = _RoundWork(
+                round=e,
+                device_results=device_results,
+                fit_elapsed_s=time.time() - t0,
+                eval_elapsed_s=0.0,  # eval is fused into the event program
+                device_wait_s=device_wait_s,
+                compiles_before=compiles_before,
+                compile_s_before=compile_s_before,
+                compiles_after=compiles_after,
+                compile_s_after=compile_s_after,
+                async_info=self._async_event_info(plan, e - 1),
+            )
+            if consumer is not None:
+                consumer.submit(functools.partial(self._finish_round, work))
+                if not self.failure_policy.accept_failures:
+                    # the failure screen must be able to terminate BEFORE
+                    # the next event mutates state — same rule as sync
+                    consumer.flush()
+            else:
+                self._finish_round(work)
+
+    def _fit_async_chunked(self, n_rounds: int, plan) -> None:
+        """Async chunked route: prologue dispatch + ONE lax.scan dispatch
+        over every buffer-fill event, then the shared chunked epilogue
+        reconstructs per-event records (with staleness/cadence facts) from
+        the stacked pull."""
+        obs = self.observability
+        compiles_before = compile_s_before = 0.0
+        if obs.enabled:
+            compiles_before = obs.registry.counter(
+                "jax_backend_compiles_total").value
+            compile_s_before = obs.registry.counter(
+                "jax_backend_compiles_seconds_total").value
+        t_start = time.time()
+        val_batches, val_counts = self._val_batches()
+        test = self._test_batches()
+        prologue_jit, _ = self._make_async_programs()
+        chunked = self._make_async_chunked()
+        self.server_state, self.client_states = _dedupe_donated(
+            self.server_state, self.client_states
+        )
+        with obs.span("async_prologue", cat="fit"):
+            batches1 = self._stage_prologue_batches()
+            self.client_states, pending = prologue_jit(
+                self.server_state, self.client_states, batches1, val_batches
+            )
+        # event e restarts on data plan e+1: stack plans 2..E+1
+        plans = [self._round_plan(e + 1) for e in range(1, n_rounds + 1)]
+        idx = jnp.asarray(np.stack([p[0] for p in plans]))
+        em = jnp.asarray(np.stack([p[1] for p in plans]))
+        sm = jnp.asarray(np.stack([p[2] for p in plans]))
+        x_bank, y_bank = self._sharded_train_banks()
+        args = [self.server_state, self.client_states, pending,
+                x_bank, y_bank, idx, em, sm,
+                jnp.asarray(plan.arrivals), jnp.asarray(plan.staleness),
+                val_batches, val_counts]
+        if test is not None:
+            args.extend(test)
+        with obs.span("fit_async_chunk", cat="fit",
+                      rounds=n_rounds) as chunk_span:
+            self.server_state, self.client_states, outs = chunked(*args)
+            _, device_wait_total = obs.fence(outs)
+            stacked = jax.device_get(outs)
+            if obs.enabled:
+                chunk_span.set(device_wait_s=device_wait_total)
+        compiles_after = compile_s_after = None
+        if obs.enabled:
+            compiles_after = obs.registry.counter(
+                "jax_backend_compiles_total").value
+            compile_s_after = obs.registry.counter(
+                "jax_backend_compiles_seconds_total").value
+        per_round_s = (time.time() - t_start) / max(n_rounds, 1)
+        device_wait_round = device_wait_total / max(n_rounds, 1)
+        self._chunked_epilogue(
+            n_rounds, stacked, plan.arrivals, compiles_before,
+            compile_s_before, compiles_after, compile_s_after, per_round_s,
+            device_wait_round, async_plan=plan,
+        )
 
     def _emit_quarantine_metrics(self, rnd: int, q_np: np.ndarray) -> None:
         """``fl_quarantine_*`` gauges/counters + one ``quarantine`` JSONL
@@ -2132,6 +2783,7 @@ class FederatedSimulation:
         *, compiles_after: float | None = None,
         compile_s_after: float | None = None,
         telemetry: dict | None = None,
+        async_info: dict | None = None,
     ) -> dict:
         """Per-round gauges/counters + one JSONL ``round`` event; returns the
         summary dict bridged into every reporter. Runs identically on the
@@ -2223,6 +2875,30 @@ class FederatedSimulation:
             summary["wire_compression_ratio"] = (
                 gather / gather_wire if gather_wire > 0 else None
             )
+        if async_info is not None:
+            # buffered-async attribution (absent on sync logs, so legacy
+            # perf_report tables stay byte-stable): buffer occupancy,
+            # per-update staleness and the virtual arrival-driven cadence
+            # of this event — the "round cadence set by arrival rate"
+            # numbers the async mode exists for
+            stal_values = async_info.pop("_staleness_values", [])
+            summary.update(async_info)
+            reg.gauge(
+                "fl_async_buffer_occupancy",
+                help="updates consumed by the current buffer-fill event",
+            ).set(float(async_info.get("async_buffer", 0)))
+            reg.gauge(
+                "fl_async_round_cadence_vs",
+                help="virtual seconds between consecutive aggregation "
+                     "events (arrival-driven round cadence)",
+            ).set(float(async_info.get("async_cadence_vs", 0.0)))
+            hist = reg.histogram(
+                "fl_async_staleness",
+                help="staleness (server versions) of consumed updates",
+                buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+            )
+            for s in stal_values:
+                hist.observe(float(s))
         if self._precision_active:
             # precision attribution (absent on f32 logs, so legacy
             # perf_report tables stay byte-stable): the dtype that produced

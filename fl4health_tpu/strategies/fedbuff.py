@@ -1,0 +1,136 @@
+"""FedBuff — staleness-discounted buffered-async aggregation as a wrapper.
+
+Reference point: Nguyen et al., "Federated Learning with Buffered
+Asynchronous Aggregation" (FedBuff, arXiv:2106.06639) — the server
+aggregates a buffer of K client updates as they arrive, each discounted by
+a function of its staleness (server versions elapsed since that client
+pulled). In this repo the asynchrony itself is resolved to a static event
+plan (``server/async_schedule.py``), so the strategy layer's job reduces
+to one pure function: turn an event's ``(arrivals, staleness)`` row into
+the aggregation mask the inner strategy consumes.
+
+That folding is exact for every strategy in the repo because aggregation
+weights already flow through ``FitResults.mask`` as FLOATS: the core
+``effective_weights`` computes ``w_i = n_i * mask_i / sum`` — a fractional
+mask entry IS a per-client weight multiplier. So ``FedBuff(inner)`` keeps
+the inner strategy's state and math untouched (its state IS the inner
+state, like ``RobustFedAvg``) and composes with ``RobustFedAvg``,
+``QuarantiningStrategy``, ``CompressingStrategy``, FedOpt-family server
+optimizers, SCAFFOLD — anything whose ``aggregate`` honors the mask.
+
+With every arrival at staleness 0 the discount is exactly 1.0 and the mask
+is bit-identical to the synchronous one — the simulation's
+``async == sync`` pin (K = cohort, no stragglers) holds through this
+wrapper by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.server.async_schedule import staleness_discount
+from fl4health_tpu.strategies.base import (
+    FitResults,
+    Strategy,
+    inner_state_sharding_spec,
+)
+
+
+class FedBuff(Strategy):
+    """Wrap any strategy with staleness-discounted async aggregation.
+
+    ``async_aggregation_mask(arrivals, staleness)`` is the one async-only
+    hook — the simulation's async round programs call it to build the
+    event's mask; everything else delegates, so a FedBuff-wrapped strategy
+    run synchronously (``async_config=None``) is bit-identical to the bare
+    inner strategy.
+
+    staleness_exponent: discount ``1/(1+s)^exponent`` (0.5 = FedBuff's
+        ``1/sqrt(1+s)``).
+    max_staleness: updates staler than this get weight 0 (dropped from
+        the aggregate; their client still restarts). None = no cap.
+    """
+
+    def __init__(
+        self,
+        inner: Strategy,
+        staleness_exponent: float = 0.5,
+        max_staleness: int | None = None,
+    ):
+        if staleness_exponent < 0:
+            raise ValueError("staleness_exponent must be >= 0")
+        if max_staleness is not None and max_staleness < 0:
+            raise ValueError("max_staleness must be >= 0 (or None)")
+        self.inner = inner
+        self.staleness_exponent = float(staleness_exponent)
+        self.max_staleness = max_staleness
+        self.weighted_aggregation = inner.weighted_aggregation
+        self.weighted_eval_aggregation = inner.weighted_eval_aggregation
+        # chunk-eligibility passthrough (server/simulation.py consults this
+        # before the type-level check) — same contract as the other
+        # wrapper strategies
+        inner_overrides = getattr(inner, "overrides_update_after_eval", None)
+        if inner_overrides is None:
+            inner_overrides = (type(inner).update_after_eval
+                               is not Strategy.update_after_eval)
+        self.overrides_update_after_eval = inner_overrides
+        inner_qmask = getattr(inner, "quarantine_mask", None)
+        if inner_qmask is not None:
+            # state passthrough: FedBuff's state IS the inner state
+            self.quarantine_mask = inner_qmask
+
+    # -- the async hook -------------------------------------------------
+    def async_aggregation_mask(self, arrivals: jax.Array,
+                               staleness: jax.Array) -> jax.Array:
+        """[C] fractional aggregation mask for one buffer-fill event:
+        ``arrivals * 1/(1+staleness)^exponent`` (0 past ``max_staleness``).
+        Jit-traceable; a staleness-0 arrival row returns ``arrivals``
+        bit-identically (the discount is exactly 1.0)."""
+        disc = staleness_discount(
+            jnp.asarray(staleness, jnp.float32),
+            self.staleness_exponent,
+            self.max_staleness,
+        )
+        return jnp.asarray(arrivals, jnp.float32) * disc.astype(jnp.float32)
+
+    # -- pure delegation (state passthrough) ----------------------------
+    @property
+    def evaluate_after_fit(self) -> bool:
+        return bool(getattr(self.inner, "evaluate_after_fit", False))
+
+    def bind_client_manager(self, client_manager: Any) -> None:
+        bind = getattr(self.inner, "bind_client_manager", None)
+        if bind is not None:
+            bind(client_manager)
+
+    def init(self, params) -> Any:
+        return self.inner.init(params)
+
+    def state_sharding_spec(self, server_state: Any, clients_axis: str):
+        return inner_state_sharding_spec(
+            self.inner, server_state, clients_axis
+        )
+
+    def global_params(self, server_state: Any):
+        return self.inner.global_params(server_state)
+
+    def divergence_reference(self, server_state: Any):
+        return self.inner.divergence_reference(server_state)
+
+    def client_payload(self, server_state: Any, round_idx):
+        return self.inner.client_payload(server_state, round_idx)
+
+    def aggregate(self, server_state: Any, results: FitResults, round_idx):
+        # the event's staleness discount is already folded into
+        # results.mask by the async round program (or absent entirely on a
+        # synchronous run) — the inner strategy sees plain weighted masks
+        return self.inner.aggregate(server_state, results, round_idx)
+
+    def update_after_eval(self, server_state, eval_losses, eval_metrics,
+                          mask):
+        return self.inner.update_after_eval(
+            server_state, eval_losses, eval_metrics, mask
+        )

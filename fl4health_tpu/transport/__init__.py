@@ -13,9 +13,11 @@ from fl4health_tpu.transport.codec import (
     encode_sparse,
 )
 from fl4health_tpu.transport.coordinator import (
+    AsyncReply,
     BroadcastReport,
     QuorumError,
     SiloResult,
+    SiloUpdateBuffer,
     broadcast_round,
     broadcast_round_detailed,
     weighted_merge,
@@ -28,4 +30,5 @@ __all__ = [
     "LoopbackServer", "call", "FrameError", "get_framing",
     "broadcast_round", "broadcast_round_detailed", "weighted_merge",
     "BroadcastReport", "QuorumError", "SiloResult",
+    "SiloUpdateBuffer", "AsyncReply",
 ]

@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import queue
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Any, Mapping, Sequence
@@ -128,8 +130,14 @@ def _silo_round_trip(
     timeout: float | None,
     retry: RetryPolicy | None,
     breaker: CircuitBreaker | None,
+    decoder: Any = None,
 ) -> SiloResult:
-    """One silo's full round trip (runs on a fan-out worker thread)."""
+    """One silo's full round trip (runs on a fan-out worker thread).
+
+    ``decoder`` overrides the default dense-template decode — e.g.
+    ``lambda raw: decode_compressed(raw, like=template)`` when silos reply
+    with COMPRESSED frames (transport/codec.py), so compressed exchange
+    rides the same retry/breaker/metrics machinery as dense frames."""
     reg, tracer = get_registry(), get_tracer()
     silo = f"{host}:{port}"
     hist = reg.histogram(
@@ -147,6 +155,8 @@ def _silo_round_trip(
     def do_call():
         result.attempts += 1
         raw = call(host, port, frame, **kwargs)
+        if decoder is not None:
+            return decoder(raw), len(raw)
         return decode(raw, like=reply_template), len(raw)
 
     def on_failure(exc: BaseException, attempt: int, will_retry: bool):
@@ -195,6 +205,7 @@ def broadcast_round_detailed(
     breakers: Mapping[str, CircuitBreaker] | None = None,
     max_workers: int | None = None,
     fail_fast: bool = False,
+    decoder: Any = None,
 ) -> BroadcastReport:
     """Concurrent fan-out: encode ONCE (the frame is identical for every
     silo), dial every silo in parallel, decode each reply against
@@ -216,7 +227,8 @@ def broadcast_round_detailed(
     def task(i: int, host: str, port: int) -> SiloResult:
         breaker = (breakers or {}).get(f"{host}:{port}")
         return _silo_round_trip(
-            i, host, port, frame, reply_template, timeout, retry, breaker
+            i, host, port, frame, reply_template, timeout, retry, breaker,
+            decoder=decoder,
         )
 
     pool = ThreadPoolExecutor(max_workers=workers)
@@ -289,6 +301,181 @@ def broadcast_round(
             failures=[(f.silo, f.reason or "unknown") for f in failures],
         )
     return replies
+
+
+@dataclasses.dataclass
+class AsyncReply:
+    """One silo update pulled from the :class:`SiloUpdateBuffer`.
+
+    ``version`` is the server version the silo trained from (stamped at
+    dispatch); the caller computes staleness as ``current_version -
+    reply.version`` — the same accounting the simulation's static event
+    plan uses (``server/async_schedule.py``)."""
+
+    result: SiloResult
+    version: int
+
+    @property
+    def reply(self) -> dict[str, Any]:
+        return self.result.reply
+
+
+class SiloUpdateBuffer:
+    """Non-blocking silo round trips feeding a FedBuff-style buffer.
+
+    ``broadcast_round`` is a BARRIER: the round returns when every (or a
+    quorum of) silo replied, so wall time tracks the slowest survivor.
+    This class is the wire-side counterpart of the simulation's
+    buffered-async mode: ``dispatch`` fans requests out WITHOUT waiting —
+    each silo's reply (decoded, CRC-checked, retry/breaker-wrapped by the
+    same ``_silo_round_trip`` the synchronous path uses) lands in an
+    internal arrival queue as it completes — and ``take(k)`` blocks only
+    until ``k`` successful updates have arrived. Slow silos keep training
+    through an aggregation; their updates arrive later, tagged with the
+    (now stale) ``version`` they were dispatched under, and the caller
+    discounts them exactly like the in-graph path discounts its event
+    plan's staleness.
+
+    Failures never fill the buffer: a failed round trip bumps the same
+    reason-labeled ``transport_rpc_failures_total`` counters and is
+    dropped from the arrival queue (``failures`` keeps them inspectable).
+    ``take`` raises :class:`QuorumError` when fewer in-flight requests
+    remain than the buffer still needs — a dead cohort cannot block the
+    coordinator forever."""
+
+    def __init__(
+        self,
+        reply_template: Mapping[str, Any],
+        *,
+        timeout: float | None = None,
+        retry: RetryPolicy | None = None,
+        breakers: Mapping[str, CircuitBreaker] | None = None,
+        max_workers: int = 32,
+        decoder: Any = None,
+    ):
+        self._template = reply_template
+        self._decoder = decoder
+        self._timeout = timeout
+        self._retry = retry
+        self._breakers = breakers or {}
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="fl-silo-buffer"
+        )
+        self._arrived: queue.Queue[AsyncReply] = queue.Queue()
+        self._lock = threading.Lock()
+        self._in_flight = 0
+        self._failures: list[AsyncReply] = []
+        self._closed = False
+
+    @property
+    def failures(self) -> list[AsyncReply]:
+        """Completed-but-failed round trips (reason on ``result.reason``)."""
+        with self._lock:
+            return list(self._failures)
+
+    def in_flight(self) -> int:
+        """Requests dispatched but not yet completed (success or failure)."""
+        with self._lock:
+            return self._in_flight
+
+    def pending(self) -> int:
+        """Successful updates sitting in the buffer right now."""
+        return self._arrived.qsize()
+
+    def dispatch(
+        self,
+        silos: Sequence[tuple[str, int]],
+        global_params: Any,
+        version: int,
+    ) -> None:
+        """Ship ``global_params`` (encoded ONCE) to ``silos`` without
+        waiting; each reply joins the arrival queue tagged ``version``."""
+        if self._closed:
+            raise RuntimeError("SiloUpdateBuffer is closed")
+        if not silos:
+            return
+        frame = encode(global_params)
+        with self._lock:
+            self._in_flight += len(silos)
+        for i, (host, port) in enumerate(silos):
+            self._pool.submit(self._one, i, host, port, frame, version)
+
+    def _one(self, index: int, host: str, port: int, frame: bytes,
+             version: int) -> None:
+        breaker = self._breakers.get(f"{host}:{port}")
+        try:
+            result = _silo_round_trip(
+                index, host, port, frame, self._template, self._timeout,
+                self._retry, breaker, decoder=self._decoder,
+            )
+        except BaseException as e:  # noqa: BLE001 — a worker must never die silently
+            result = SiloResult(silo=f"{host}:{port}", index=index, error=e,
+                                reason=classify_failure(e))
+        reply = AsyncReply(result=result, version=version)
+        if not result.ok:
+            with self._lock:
+                self._in_flight -= 1
+                self._failures.append(reply)
+            return
+        # success: enqueue BEFORE decrementing — take()'s reachability
+        # check (in_flight + qsize) may transiently double-count this
+        # reply, which is harmless, but must never see it in NEITHER
+        # count (a spurious QuorumError on an update that was about to
+        # land)
+        self._arrived.put(reply)
+        with self._lock:
+            self._in_flight -= 1
+
+    def take(self, k: int, timeout: float | None = None) -> list[AsyncReply]:
+        """Block until ``k`` successful updates have arrived; returns them
+        in ARRIVAL order (the buffer semantics — not silo order).
+
+        Raises :class:`QuorumError` if the buffer can no longer fill
+        (fewer in-flight requests remain than updates still needed) and
+        ``TimeoutError`` if ``timeout`` elapses first. Either raise
+        RE-QUEUES any updates this call had already dequeued — arrived,
+        CRC-checked updates are never lost to a failed take (a retrying
+        caller still receives them, re-queued behind any updates that
+        landed in the meantime)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: list[AsyncReply] = []
+
+        def bail(exc: BaseException) -> BaseException:
+            for r in out:
+                self._arrived.put(r)
+            return exc
+
+        while len(out) < k:
+            with self._lock:
+                reachable = self._in_flight + self._arrived.qsize()
+            if reachable < k - len(out):
+                failures = [
+                    (f.result.silo, f.result.reason or "unknown")
+                    for f in self.failures
+                ]
+                raise bail(QuorumError(
+                    f"SiloUpdateBuffer: buffer needs {k - len(out)} more "
+                    f"updates but only {reachable} round trips remain in "
+                    f"flight (failed: {failures})",
+                    required=k, succeeded=len(out), failures=failures,
+                ))
+            wait = 0.1
+            if deadline is not None:
+                wait = min(wait, deadline - time.monotonic())
+                if wait <= 0:
+                    raise bail(TimeoutError(
+                        f"SiloUpdateBuffer.take({k}): only {len(out)} "
+                        f"updates arrived within {timeout}s"
+                    ))
+            try:
+                out.append(self._arrived.get(timeout=wait))
+            except queue.Empty:
+                continue
+        return out
+
+    def close(self, wait: bool = False) -> None:
+        self._closed = True
+        self._pool.shutdown(wait=wait, cancel_futures=not wait)
 
 
 def weighted_merge(

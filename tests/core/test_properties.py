@@ -1,36 +1,40 @@
-"""Property-based invariants (hypothesis) for the wire codec, the
-aggregation kernel, and the batch index plans — contracts that unit cases
-alone under-sample."""
+"""Property-based invariants for the wire codec, the aggregation kernel,
+and the batch index plans — contracts that unit cases alone under-sample.
+
+Originally written against ``hypothesis``, which this box does not ship
+(zero-egress, no pip installs); the draws now come from seeded
+``random.Random`` sweeps instead — the SAME invariants over a comparable
+sample of the input space, fully deterministic run-to-run (a failure
+reproduces from the case's seed alone, no shrinking database needed)."""
+
+import random
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
 
 from fl4health_tpu.clients.engine import epoch_index_plan
 from fl4health_tpu.core.aggregate import aggregate, effective_weights
 from fl4health_tpu.transport.codec import decode, encode
 
-SETTINGS = dict(max_examples=25, deadline=None)
+N_EXAMPLES = 25
 
-# -- codec ------------------------------------------------------------------
-
-_dtypes = st.sampled_from([np.float32, np.float64, np.int32, np.int64, np.uint8])
+_DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8]
 
 
-@st.composite
-def pytrees(draw):
-    """Nested dict pytrees with 1-6 array leaves of assorted shapes/dtypes."""
-    n_leaves = draw(st.integers(1, 6))
+def random_pytree(rng: random.Random):
+    """Nested dict pytrees with 1-6 array leaves of assorted shapes/dtypes
+    (the shape of the old hypothesis strategy, seeded)."""
     tree = {}
-    for i in range(n_leaves):
-        depth = draw(st.integers(0, 2))
-        shape = tuple(draw(st.lists(st.integers(1, 5), min_size=0, max_size=3)))
-        dtype = draw(_dtypes)
+    for i in range(rng.randint(1, 6)):
+        depth = rng.randint(0, 2)
+        shape = tuple(rng.randint(1, 5) for _ in range(rng.randint(0, 3)))
+        dtype = rng.choice(_DTYPES)
         if np.issubdtype(dtype, np.floating):
-            arr = draw(st.integers(-1000, 1000)) * np.ones(shape, dtype) * 0.37
+            arr = rng.randint(-1000, 1000) * np.ones(shape, dtype) * 0.37
         else:
-            arr = (draw(st.integers(-100, 100)) * np.ones(shape, np.int64)).astype(dtype)
+            arr = (rng.randint(-100, 100) * np.ones(shape, np.int64)).astype(dtype)
         node = tree
         for d in range(depth):
             node = node.setdefault(f"level{d}", {})
@@ -38,9 +42,11 @@ def pytrees(draw):
     return tree
 
 
-@given(tree=pytrees())
-@settings(**SETTINGS)
-def test_codec_roundtrip_identity(tree):
+# -- codec ------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(N_EXAMPLES))
+def test_codec_roundtrip_identity(seed):
+    tree = random_pytree(random.Random(1000 + seed))
     out = decode(encode(tree))
     flat_a, def_a = jax.tree_util.tree_flatten_with_path(tree)
     flat_b, def_b = jax.tree_util.tree_flatten_with_path(out)
@@ -51,9 +57,9 @@ def test_codec_roundtrip_identity(tree):
         np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
 
 
-@given(tree=pytrees())
-@settings(**SETTINGS)
-def test_codec_roundtrip_with_template(tree):
+@pytest.mark.parametrize("seed", range(N_EXAMPLES))
+def test_codec_roundtrip_with_template(seed):
+    tree = random_pytree(random.Random(2000 + seed))
     out = decode(encode(tree), like=tree)
     to64 = lambda t: jax.tree_util.tree_map(  # noqa: E731
         lambda x: np.asarray(x, np.float64), t
@@ -66,18 +72,17 @@ def test_codec_roundtrip_with_template(tree):
 
 # -- aggregation ------------------------------------------------------------
 
-@given(
-    values=st.lists(st.floats(-100, 100), min_size=2, max_size=8),
-    counts=st.lists(st.integers(1, 50), min_size=2, max_size=8),
-    mask_bits=st.lists(st.booleans(), min_size=2, max_size=8),
-    weighted=st.booleans(),
-)
-@settings(**SETTINGS)
-def test_aggregate_is_convex_combination(values, counts, mask_bits, weighted):
-    n = min(len(values), len(counts), len(mask_bits))
-    v = jnp.asarray(values[:n], jnp.float32)[:, None]
-    c = jnp.asarray(counts[:n], jnp.float32)
-    m = jnp.asarray([1.0 if b else 0.0 for b in mask_bits[:n]])
+@pytest.mark.parametrize("seed", range(N_EXAMPLES))
+def test_aggregate_is_convex_combination(seed):
+    rng = random.Random(3000 + seed)
+    n = rng.randint(2, 8)
+    values = [rng.uniform(-100, 100) for _ in range(n)]
+    counts = [rng.randint(1, 50) for _ in range(n)]
+    mask_bits = [rng.random() < 0.5 for _ in range(n)]
+    weighted = rng.random() < 0.5
+    v = jnp.asarray(values, jnp.float32)[:, None]
+    c = jnp.asarray(counts, jnp.float32)
+    m = jnp.asarray([1.0 if b else 0.0 for b in mask_bits])
     w = effective_weights(c, m, weighted)
     # weights: nonnegative, sum to 1 (or all-zero for an empty cohort)
     assert float(jnp.min(w)) >= 0.0
@@ -97,14 +102,13 @@ def test_aggregate_is_convex_combination(values, counts, mask_bits, weighted):
 
 # -- index plans ------------------------------------------------------------
 
-@given(
-    n=st.integers(1, 40),
-    batch_size=st.integers(1, 16),
-    seed=st.integers(0, 10_000),
-)
-@settings(**SETTINGS)
-def test_epoch_plan_covers_each_example_once(n, batch_size, seed):
-    idx, em, sm = epoch_index_plan([seed], n, batch_size)
+@pytest.mark.parametrize("seed", range(N_EXAMPLES))
+def test_epoch_plan_covers_each_example_once(seed):
+    rng = random.Random(4000 + seed)
+    n = rng.randint(1, 40)
+    batch_size = rng.randint(1, 16)
+    plan_seed = rng.randint(0, 10_000)
+    idx, em, sm = epoch_index_plan([plan_seed], n, batch_size)
     # every step is real in a plain epoch plan
     assert np.all(sm == 1.0)
     valid = idx[em > 0]
@@ -114,15 +118,14 @@ def test_epoch_plan_covers_each_example_once(n, batch_size, seed):
     assert idx.min() >= 0 and idx.max() < n
 
 
-@given(
-    n=st.integers(2, 30),
-    batch_size=st.integers(1, 8),
-    n_steps=st.integers(1, 20),
-    seed=st.integers(0, 10_000),
-)
-@settings(**SETTINGS)
-def test_step_plan_has_exact_step_count_and_valid_indices(n, batch_size, n_steps, seed):
-    idx, em, sm = epoch_index_plan([seed], n, batch_size, n_steps=n_steps)
+@pytest.mark.parametrize("seed", range(N_EXAMPLES))
+def test_step_plan_has_exact_step_count_and_valid_indices(seed):
+    rng = random.Random(5000 + seed)
+    n = rng.randint(2, 30)
+    batch_size = rng.randint(1, 8)
+    n_steps = rng.randint(1, 20)
+    plan_seed = rng.randint(0, 10_000)
+    idx, em, sm = epoch_index_plan([plan_seed], n, batch_size, n_steps=n_steps)
     assert idx.shape[0] == n_steps
     assert np.all((idx >= 0) & (idx < n))
     # each step has at least one valid example

@@ -230,3 +230,104 @@ class TestTransportChaos:
         assert corrupted != frame
         with pytest.raises(FrameError):
             get_framing().unframe(corrupted)
+
+
+class TestSlowFaults:
+    """kind="slow": the virtual-clock straggler model (PR 9) — host-side
+    compute-time multipliers that never touch the compiled programs."""
+
+    def test_compute_time_factors(self):
+        plan = FaultPlan(client_faults=(
+            ClientFault(clients=(0, 2), kind="slow", scale=5.0),
+        ))
+        f = plan.compute_time_factors(1, 4)
+        np.testing.assert_allclose(f, [5.0, 1.0, 5.0, 1.0])
+
+    def test_windowed_and_compounding(self):
+        plan = FaultPlan(client_faults=(
+            ClientFault(clients=(1,), kind="slow", scale=2.0),
+            ClientFault(clients=(1,), kind="slow", scale=3.0,
+                        start_round=3),
+        ))
+        np.testing.assert_allclose(
+            plan.compute_time_factors(1, 3), [1.0, 2.0, 1.0]
+        )
+        # overlapping specs compound multiplicatively
+        np.testing.assert_allclose(
+            plan.compute_time_factors(3, 3), [1.0, 6.0, 1.0]
+        )
+
+    def test_slow_is_not_a_corruption_and_not_a_dropout(self):
+        plan = FaultPlan(client_faults=(
+            ClientFault(clients=(0,), kind="slow", scale=5.0),
+        ))
+        assert plan.corruption_faults == ()
+        assert plan.dropout_faults == ()
+        assert len(plan.slow_faults) == 1
+        # in-graph draws stay identity: a slow-only plan compiles the
+        # exact pre-resilience round programs
+        np.testing.assert_allclose(
+            np.asarray(plan.participation_factor(1, 4)), np.ones(4)
+        )
+        np.testing.assert_allclose(
+            np.asarray(plan.corruption_factors(1, 4)), np.ones(4)
+        )
+
+    def test_scale_must_be_positive(self):
+        with pytest.raises(ValueError, match="multiplier"):
+            ClientFault(clients=(0,), kind="slow", scale=0.0)
+
+    def test_summarize_round_names_slow_clients(self):
+        plan = FaultPlan(client_faults=(
+            ClientFault(clients=(2,), kind="slow", scale=4.0),
+        ))
+        summary = plan.summarize_round(1, 4)
+        assert summary["kinds"]["slow"] == [2]
+        assert summary["corrupted"] == [] and summary["dropped"] == []
+
+    def test_legacy_plans_summarize_unchanged(self):
+        plan = FaultPlan(client_faults=(
+            ClientFault(clients=(1,), kind="sign_flip"),
+        ))
+        summary = plan.summarize_round(1, 4)
+        assert "slow" not in summary["kinds"]
+
+
+class TestInjectableSleep:
+    """chaos_handler's straggler delay is testable without wall-clock
+    sleeping (the satellite mirroring retry.py's injectable rng/sleep)."""
+
+    def test_delays_recorded_not_slept(self):
+        slept: list[float] = []
+        policy = TransportFaultPolicy(delay_s=7.5, delay_probability=1.0)
+        wrapped = chaos_handler(
+            lambda b: b + b"!", policy, seed=3, silo_idx=1,
+            sleep=slept.append,
+        )
+        for i in range(5):
+            assert wrapped(b"x%d" % i) == b"x%d!" % i
+        assert slept == [7.5] * 5
+
+    def test_injected_sleep_preserves_draw_order(self):
+        """The recorded-sleep run and the real-sleep run must observe the
+        SAME fault sequence: the delay draw is consumed either way."""
+        policy = TransportFaultPolicy(
+            delay_s=0.001, delay_probability=0.5, drop_probability=0.3,
+        )
+
+        def outcomes(sleep):
+            wrapped = chaos_handler(
+                lambda b: b, policy, seed=11, silo_idx=0, sleep=sleep,
+            )
+            seq = []
+            for i in range(32):
+                try:
+                    wrapped(b"r%d" % i)
+                    seq.append("ok")
+                except RuntimeError:
+                    seq.append("dropped")
+            return seq
+
+        recorded: list[float] = []
+        assert outcomes(recorded.append) == outcomes(lambda s: None)
+        assert recorded  # the delay path actually fired

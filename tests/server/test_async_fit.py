@@ -1,0 +1,374 @@
+"""Buffered-async fit() (FedBuff-style, server/async_schedule.py +
+strategies/fedbuff.py): determinism, the sync-equivalence pin, and
+composition with the rest of the stack.
+
+THE pinned claims of the async PR:
+
+- same seed + FaultPlan => identical arrival order, staleness weights and
+  loss trajectory on the pipelined and chunked paths;
+- K = cohort size with no stragglers => bit-identical to synchronous
+  FedAvg on BOTH execution modes (the async machinery degenerates to the
+  sync schedule exactly);
+- async disabled (default) compiles the exact synchronous programs —
+  nothing in this file touches the sync suites' pins.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.observability import Observability
+from fl4health_tpu.observability.registry import MetricsRegistry
+from fl4health_tpu.observability.spans import Tracer
+from fl4health_tpu.resilience.faults import ClientFault, FaultPlan
+from fl4health_tpu.server.async_schedule import AsyncConfig
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.fedbuff import FedBuff
+
+N_CLASSES = 3
+N_CLIENTS = 4
+
+STRAGGLER_PLAN = FaultPlan(client_faults=(
+    ClientFault(clients=(0,), kind="slow", scale=5.0),
+))
+
+
+def make_sim(async_config=None, execution_mode="auto", fault_plan=None,
+             strategy=None, observability=None, compression=None,
+             n_clients=N_CLIENTS, **kwargs):
+    datasets = []
+    for i in range(n_clients):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(i), 40, (6,), N_CLASSES
+        )
+        datasets.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+    model = engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES))
+    logic = engine.ClientLogic(model, engine.masked_cross_entropy)
+    return FederatedSimulation(
+        logic=logic,
+        tx=optax.sgd(0.05),
+        strategy=strategy or FedAvg(),
+        datasets=datasets,
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1,
+        seed=5,
+        async_config=async_config,
+        execution_mode=execution_mode,
+        fault_plan=fault_plan,
+        observability=observability,
+        compression=compression,
+        **kwargs,
+    )
+
+
+def losses_of(history):
+    return [r.eval_losses["checkpoint"] for r in history]
+
+
+def fit_losses_of(history):
+    return [r.fit_losses["backward"] for r in history]
+
+
+def flat_params(sim):
+    return np.asarray(jax.flatten_util.ravel_pytree(
+        jax.device_get(sim.strategy.global_params(sim.server_state))
+    )[0])
+
+
+class TestSyncEquivalence:
+    """K = cohort, no stragglers: the buffered-async machinery must be
+    bit-identical to synchronous FedAvg — not close, IDENTICAL."""
+
+    @pytest.mark.parametrize("mode", ["pipelined", "chunked"])
+    def test_bit_identical_to_sync(self, mode):
+        rounds = 3
+        sync = make_sim(execution_mode=mode)
+        async_ = make_sim(
+            async_config=AsyncConfig(buffer_size=N_CLIENTS),
+            execution_mode=mode,
+        )
+        hs = sync.fit(rounds)
+        ha = async_.fit(rounds)
+        assert losses_of(hs) == losses_of(ha)
+        assert fit_losses_of(hs) == fit_losses_of(ha)
+        np.testing.assert_array_equal(flat_params(sync), flat_params(async_))
+
+    def test_bit_identical_with_corruption_faults(self):
+        """Packet-corruption draws use the same (seed, round) streams in
+        both schedules, so the equivalence survives a byzantine plan."""
+        fp = FaultPlan(client_faults=(
+            ClientFault(clients=(2,), kind="scale", scale=3.0),
+        ))
+        rounds = 3
+        sync = make_sim(execution_mode="chunked", fault_plan=fp)
+        async_ = make_sim(
+            async_config=AsyncConfig(buffer_size=N_CLIENTS),
+            execution_mode="chunked", fault_plan=fp,
+        )
+        assert losses_of(sync.fit(rounds)) == losses_of(async_.fit(rounds))
+
+
+class TestAsyncDeterminism:
+    """Same seed + FaultPlan => same arrival order, staleness and loss
+    trajectory, on either execution path."""
+
+    def _cfg(self):
+        return AsyncConfig(buffer_size=2, compute_jitter=0.05, seed=3)
+
+    def test_pipelined_matches_chunked(self):
+        rounds = 4
+        a = make_sim(async_config=self._cfg(), execution_mode="pipelined",
+                     fault_plan=STRAGGLER_PLAN)
+        b = make_sim(async_config=self._cfg(), execution_mode="chunked",
+                     fault_plan=STRAGGLER_PLAN)
+        la, lb = losses_of(a.fit(rounds)), losses_of(b.fit(rounds))
+        assert la == lb
+        np.testing.assert_array_equal(flat_params(a), flat_params(b))
+        # the resolved plans are the same object content-wise
+        np.testing.assert_array_equal(
+            a._async_plan.arrivals, b._async_plan.arrivals
+        )
+        np.testing.assert_array_equal(
+            a._async_plan.staleness, b._async_plan.staleness
+        )
+
+    def test_rerun_reproduces_exactly(self):
+        rounds = 3
+        runs = [
+            losses_of(make_sim(
+                async_config=self._cfg(), execution_mode="chunked",
+                fault_plan=STRAGGLER_PLAN,
+            ).fit(rounds))
+            for _ in range(2)
+        ]
+        assert runs[0] == runs[1]
+
+    def test_dropout_fault_parity_across_modes(self):
+        """In-graph dropout (arrival discarded at aggregation) must draw
+        identically inside the per-event programs and the event scan."""
+        fp = FaultPlan(client_faults=(
+            ClientFault(clients=(1,), kind="dropout", probability=0.5),
+            ClientFault(clients=(0,), kind="slow", scale=4.0),
+        ))
+        cfg = AsyncConfig(buffer_size=2, compute_jitter=0.05)
+        a = make_sim(async_config=cfg, execution_mode="pipelined",
+                     fault_plan=fp)
+        b = make_sim(async_config=cfg, execution_mode="chunked",
+                     fault_plan=fp)
+        assert losses_of(a.fit(4)) == losses_of(b.fit(4))
+
+
+class TestStalenessDiscounting:
+    def test_fedbuff_mask_rule(self):
+        fb = FedBuff(FedAvg())
+        arr = jnp.asarray([1.0, 1.0, 0.0, 1.0])
+        stal = jnp.asarray([0.0, 3.0, 5.0, 1.0])
+        m = np.asarray(fb.async_aggregation_mask(arr, stal))
+        np.testing.assert_allclose(
+            m, [1.0, 0.5, 0.0, 1.0 / np.sqrt(2.0)], rtol=1e-6
+        )
+
+    def test_max_staleness_cap(self):
+        fb = FedBuff(FedAvg(), max_staleness=2)
+        m = np.asarray(fb.async_aggregation_mask(
+            jnp.ones((3,)), jnp.asarray([0.0, 2.0, 3.0])
+        ))
+        assert m[0] == 1.0 and m[1] > 0.0 and m[2] == 0.0
+
+    def test_straggler_run_actually_consumes_stale_updates(self):
+        sim = make_sim(
+            async_config=AsyncConfig(buffer_size=2, compute_jitter=0.05),
+            execution_mode="chunked", fault_plan=STRAGGLER_PLAN,
+        )
+        sim.fit(5)
+        plan = sim._async_plan
+        assert plan.staleness[plan.arrivals > 0].max() >= 1.0
+
+    def test_losses_stay_finite_under_stragglers(self):
+        sim = make_sim(
+            async_config=AsyncConfig(buffer_size=2, compute_jitter=0.05),
+            execution_mode="pipelined", fault_plan=STRAGGLER_PLAN,
+        )
+        hist = sim.fit(5)
+        assert all(np.isfinite(v) for v in losses_of(hist))
+        assert len(hist) == 5
+
+
+class TestComposition:
+    def test_with_compression(self):
+        from fl4health_tpu.compression.config import CompressionConfig
+
+        cfg = AsyncConfig(buffer_size=2, compute_jitter=0.05)
+        a = make_sim(async_config=cfg, execution_mode="pipelined",
+                     compression=CompressionConfig(quant_bits=8),
+                     fault_plan=STRAGGLER_PLAN)
+        b = make_sim(async_config=cfg, execution_mode="chunked",
+                     compression=CompressionConfig(quant_bits=8),
+                     fault_plan=STRAGGLER_PLAN)
+        la, lb = losses_of(a.fit(3)), losses_of(b.fit(3))
+        assert la == lb
+        assert all(np.isfinite(v) for v in la)
+
+    def test_with_robust_aggregation(self):
+        from fl4health_tpu.resilience.aggregators import RobustFedAvg
+
+        sim = make_sim(
+            async_config=AsyncConfig(buffer_size=3, compute_jitter=0.05),
+            execution_mode="chunked",
+            strategy=RobustFedAvg(method="trimmed_mean", trim_fraction=0.2),
+            fault_plan=STRAGGLER_PLAN,
+        )
+        hist = sim.fit(3)
+        assert all(np.isfinite(v) for v in losses_of(hist))
+
+    def test_fedbuff_wrapper_delegation(self):
+        """set_global_params / global_params must thread through the
+        FedBuff wrapper (state passthrough)."""
+        sim = make_sim(async_config=AsyncConfig(buffer_size=2))
+        assert isinstance(sim.strategy, FedBuff)
+        gp = sim.global_params
+        new = jax.tree_util.tree_map(lambda a: a + 1.0, gp)
+        sim.set_global_params(new)
+        np.testing.assert_allclose(
+            np.asarray(jax.flatten_util.ravel_pytree(sim.global_params)[0]),
+            np.asarray(jax.flatten_util.ravel_pytree(
+                jax.device_get(new))[0]),
+        )
+
+    @pytest.mark.parametrize("mode", ["pipelined", "chunked"])
+    def test_observability_round_events_carry_async_fields(self, mode):
+        reg = MetricsRegistry()
+        # no output_dir: shutdown() would export + clear the event log the
+        # assertions below read
+        obs = Observability(
+            enabled=True, registry=reg, tracer=Tracer(enabled=True),
+            telemetry=True,
+        )
+        sim = make_sim(
+            async_config=AsyncConfig(buffer_size=2, compute_jitter=0.05),
+            execution_mode=mode, fault_plan=STRAGGLER_PLAN,
+            observability=obs,
+        )
+        sim.fit(3)
+        rounds = [e for e in reg.events if e.get("event") == "round"]
+        assert len(rounds) == 3
+        for e in rounds:
+            assert e["async_buffer"] == 2
+            assert "staleness_mean" in e and "async_cadence_vs" in e
+            assert e["participants"] == 2
+        # plan-level event + staleness histogram + occupancy gauge landed
+        assert any(e.get("event") == "async_plan" for e in reg.events)
+        exposition = reg.to_prometheus()
+        assert "fl_async_staleness" in exposition
+        assert "fl_async_buffer_occupancy" in exposition
+        assert "fl_async_round_cadence_vs" in exposition
+        # telemetry rode the async programs: one telemetry event per event
+        assert sum(
+            1 for e in reg.events if e.get("event") == "telemetry"
+        ) == 3
+
+    def test_telemetry_does_not_change_async_trajectory(self):
+        """Telemetry on/off: the PARAMETER trajectory is bit-identical
+        (verified on the flattened globals). The reported eval-loss
+        scalars are pinned to tolerance only: the async event program
+        fuses aggregate+eval+restart into ONE jit, and the extra telemetry
+        outputs shift XLA's fusion of the eval reduction by ~1 ulp — the
+        sync paths dispatch eval separately, which is why their stronger
+        bit pin (tests/observability/test_telemetry.py) doesn't carry
+        over verbatim."""
+        cfg = AsyncConfig(buffer_size=2, compute_jitter=0.05)
+        plain = make_sim(async_config=cfg, execution_mode="chunked",
+                         fault_plan=STRAGGLER_PLAN)
+        reg = MetricsRegistry()
+        obs = Observability(enabled=True, registry=reg,
+                            tracer=Tracer(enabled=True))
+        instrumented = make_sim(async_config=cfg, execution_mode="chunked",
+                                fault_plan=STRAGGLER_PLAN, observability=obs)
+        lp = losses_of(plain.fit(3))
+        li = losses_of(instrumented.fit(3))
+        np.testing.assert_array_equal(
+            flat_params(plain), flat_params(instrumented)
+        )
+        np.testing.assert_allclose(lp, li, rtol=1e-5)
+
+
+class TestValidation:
+    def test_rejects_duck_typed_config(self):
+        with pytest.raises(TypeError, match="AsyncConfig"):
+            make_sim(async_config={"buffer_size": 2})
+
+    def test_rejects_oversized_buffer(self):
+        with pytest.raises(ValueError, match="exceeds the cohort"):
+            make_sim(async_config=AsyncConfig(buffer_size=N_CLIENTS + 1))
+
+    def test_rejects_sampling_manager(self):
+        from fl4health_tpu.server.client_manager import FixedFractionManager
+
+        with pytest.raises(ValueError, match="arrival schedule"):
+            make_sim(
+                async_config=AsyncConfig(buffer_size=2),
+                client_manager=FixedFractionManager(N_CLIENTS, 0.5),
+            )
+
+    def test_rejects_host_eval_strategies(self):
+        from fl4health_tpu.strategies.feddg_ga import FedDgGa
+
+        with pytest.raises(ValueError, match="update_after_eval"):
+            make_sim(async_config=AsyncConfig(buffer_size=2),
+                     strategy=FedDgGa(n_clients=N_CLIENTS, num_rounds=3))
+
+    def test_rejects_train_data_provider(self):
+        with pytest.raises(ValueError, match="train_data_provider"):
+            make_sim(async_config=AsyncConfig(buffer_size=2),
+                     train_data_provider=lambda r: None)
+
+    def test_rejects_checkpointers(self):
+        class Ckpt:
+            def exists(self):
+                return False
+
+        with pytest.raises(ValueError, match="checkpointing"):
+            make_sim(async_config=AsyncConfig(buffer_size=2),
+                     state_checkpointer=Ckpt())
+
+    def test_fit_zero_rounds_is_noop(self):
+        sim = make_sim(async_config=AsyncConfig(buffer_size=2))
+        assert sim.fit(0) == []
+
+    def test_manifest_config_carries_async_identity(self):
+        sim = make_sim(async_config=AsyncConfig(buffer_size=2))
+        cfg = sim._manifest_config(3)
+        assert cfg["async"]["buffer_size"] == 2
+        sync = make_sim()
+        assert "async" not in sync._manifest_config(3)
+
+
+class TestPrewrappedFedBuff:
+    def test_matching_wrapper_accepted(self):
+        sim = make_sim(
+            strategy=FedBuff(FedAvg(), staleness_exponent=1.0,
+                             max_staleness=4),
+            async_config=AsyncConfig(buffer_size=2, staleness_exponent=1.0,
+                                     max_staleness=4),
+        )
+        assert isinstance(sim.strategy, FedBuff)
+        assert sim.strategy.staleness_exponent == 1.0
+
+    def test_mismatched_wrapper_rejected(self):
+        """A pre-wrapped FedBuff whose staleness parameters disagree with
+        the AsyncConfig would discount with values the manifest doesn't
+        record — rejected loudly."""
+        with pytest.raises(ValueError, match="staleness"):
+            make_sim(
+                strategy=FedBuff(FedAvg(), staleness_exponent=1.0),
+                async_config=AsyncConfig(buffer_size=2),  # exponent 0.5
+            )

@@ -1,0 +1,148 @@
+"""Virtual-clock scheduler units (server/async_schedule.py): the static
+event plan is the load-bearing artifact of the buffered-async mode —
+arrival order, staleness and cadence must be exact, deterministic
+functions of (seed, FaultPlan, cohort, K)."""
+
+import numpy as np
+import pytest
+
+from fl4health_tpu.resilience.faults import ClientFault, FaultPlan
+from fl4health_tpu.server.async_schedule import (
+    AsyncConfig,
+    build_event_plan,
+    staleness_discount,
+    sync_round_times,
+)
+
+
+class TestAsyncConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="buffer_size"):
+            AsyncConfig(buffer_size=0)
+        with pytest.raises(ValueError, match="staleness_exponent"):
+            AsyncConfig(buffer_size=1, staleness_exponent=-0.1)
+        with pytest.raises(ValueError, match="max_staleness"):
+            AsyncConfig(buffer_size=1, max_staleness=-1)
+        with pytest.raises(ValueError, match="base_compute_s"):
+            AsyncConfig(buffer_size=1, base_compute_s=0.0)
+        with pytest.raises(ValueError, match="compute_jitter"):
+            AsyncConfig(buffer_size=1, compute_jitter=1.0)
+
+    def test_describe_is_jsonable(self):
+        import json
+
+        d = AsyncConfig(buffer_size=3, max_staleness=4).describe()
+        assert json.loads(json.dumps(d))["buffer_size"] == 3
+
+
+class TestDiscount:
+    def test_fedbuff_rule(self):
+        s = np.asarray([0.0, 1.0, 3.0])
+        np.testing.assert_allclose(
+            staleness_discount(s), [1.0, 1.0 / np.sqrt(2.0), 0.5]
+        )
+
+    def test_zero_staleness_is_exactly_one(self):
+        assert float(staleness_discount(np.asarray(0.0))) == 1.0
+
+    def test_max_staleness_zeroes(self):
+        s = np.asarray([0.0, 2.0, 5.0])
+        w = staleness_discount(s, max_staleness=2)
+        assert w[0] == 1.0 and w[1] > 0 and w[2] == 0.0
+
+
+class TestEventPlan:
+    def test_exactly_k_arrivals_per_event(self):
+        plan = build_event_plan(
+            AsyncConfig(buffer_size=3, compute_jitter=0.2), 10, 8
+        )
+        np.testing.assert_array_equal(plan.arrivals.sum(axis=1), [3.0] * 10)
+
+    def test_deterministic(self):
+        cfg = AsyncConfig(buffer_size=2, compute_jitter=0.3, seed=5)
+        fp = FaultPlan(client_faults=(
+            ClientFault(clients=(1,), kind="slow", scale=4.0),
+        ))
+        a = build_event_plan(cfg, 8, 5, fp)
+        b = build_event_plan(cfg, 8, 5, fp)
+        np.testing.assert_array_equal(a.arrivals, b.arrivals)
+        np.testing.assert_array_equal(a.staleness, b.staleness)
+        np.testing.assert_array_equal(a.event_times, b.event_times)
+
+    def test_full_buffer_no_jitter_degenerates_to_sync(self):
+        """K = cohort, identical compute times: every event consumes the
+        whole cohort at staleness 0 on the synchronous cadence."""
+        plan = build_event_plan(AsyncConfig(buffer_size=4), 6, 4)
+        np.testing.assert_array_equal(plan.arrivals, np.ones((6, 4)))
+        np.testing.assert_array_equal(plan.staleness, np.zeros((6, 4)))
+        np.testing.assert_allclose(plan.cadences(), np.ones(6))
+
+    def test_straggler_does_not_set_the_cadence(self):
+        """The tail-independence claim in miniature: with 1/4 clients at
+        10x, async cadence stays near the fast clients' pace while the
+        sync barrier pays the tail every round."""
+        cfg = AsyncConfig(buffer_size=2, compute_jitter=0.05)
+        fp = FaultPlan(client_faults=(
+            ClientFault(clients=(0,), kind="slow", scale=10.0),
+        ))
+        plan = build_event_plan(cfg, 12, 4, fp)
+        async_cadence = float(plan.cadences().mean())
+        sync_cadence = float(sync_round_times(cfg, 12, 4, fp).mean())
+        assert sync_cadence > 9.0  # the barrier pays the 10x tail
+        assert async_cadence < 1.5  # the buffer fills from the fast three
+
+    def test_straggler_updates_arrive_stale(self):
+        cfg = AsyncConfig(buffer_size=2, compute_jitter=0.05)
+        fp = FaultPlan(client_faults=(
+            ClientFault(clients=(0,), kind="slow", scale=10.0),
+        ))
+        plan = build_event_plan(cfg, 12, 4, fp)
+        # the slow client's arrivals (when they finally land) are stale
+        slow_events = plan.arrivals[:, 0] > 0
+        assert slow_events.any()
+        assert plan.staleness[slow_events, 0].max() >= 2.0
+        # fast clients' staleness stays bounded by the events a slow
+        # arrival displaces
+        assert plan.staleness[:, 1:].max() <= 2.0
+
+    def test_event_times_monotone(self):
+        plan = build_event_plan(
+            AsyncConfig(buffer_size=2, compute_jitter=0.4, seed=3), 20, 6
+        )
+        assert (np.diff(plan.event_times) >= 0).all()
+        assert (plan.cadences() >= 0).all()
+
+    def test_summarize_event(self):
+        plan = build_event_plan(AsyncConfig(buffer_size=2), 3, 4)
+        info = plan.summarize_event(0)
+        assert info["async_buffer"] == 2
+        assert info["staleness_mean"] >= 0.0
+        assert info["async_virtual_time_s"] == plan.event_times[0]
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="exceeds the cohort"):
+            build_event_plan(AsyncConfig(buffer_size=5), 3, 4)
+        with pytest.raises(ValueError, match="n_events"):
+            build_event_plan(AsyncConfig(buffer_size=2), 0, 4)
+
+
+class TestSyncRoundTimes:
+    def test_plain_is_base(self):
+        t = sync_round_times(AsyncConfig(buffer_size=1, base_compute_s=2.0),
+                             5, 4)
+        np.testing.assert_allclose(t, np.full(5, 2.0))
+
+    def test_slow_fault_sets_the_tail(self):
+        fp = FaultPlan(client_faults=(
+            ClientFault(clients=(2,), kind="slow", scale=7.0),
+        ))
+        t = sync_round_times(AsyncConfig(buffer_size=1), 5, 4, fp)
+        np.testing.assert_allclose(t, np.full(5, 7.0))
+
+    def test_windowed_slow_fault(self):
+        fp = FaultPlan(client_faults=(
+            ClientFault(clients=(0,), kind="slow", scale=3.0,
+                        start_round=2, end_round=3),
+        ))
+        t = sync_round_times(AsyncConfig(buffer_size=1), 5, 4, fp)
+        np.testing.assert_allclose(t, [1.0, 3.0, 3.0, 1.0, 1.0])

@@ -58,7 +58,7 @@ def _datasets(n=40, dim=6, n_classes=3, seed=0):
 
 
 def _make(mesh=None, execution_mode="auto", strategy=None, compression=None,
-          observability=None, seed=11):
+          observability=None, seed=11, async_config=None, fault_plan=None):
     return FederatedSimulation(
         logic=engine.ClientLogic(
             engine.from_flax(Mlp(features=(12,), n_outputs=3)),
@@ -75,6 +75,8 @@ def _make(mesh=None, execution_mode="auto", strategy=None, compression=None,
         mesh=mesh,
         compression=compression,
         observability=observability,
+        async_config=async_config,
+        fault_plan=fault_plan,
     )
 
 
@@ -402,3 +404,39 @@ class TestDonationSafetyAudit:
         got = _losses(sim.history)
         np.testing.assert_allclose(base, got, atol=TRAJ_ATOL, rtol=1e-4)
         _assert_client_stack_sharded(sim)
+
+
+class TestAsyncUnderMesh:
+    """Buffered-async composes with clients-axis sharding: the async event
+    programs (prologue, per-event, event scan) build through the same
+    RoundProgramBuilder, so arrivals/staleness/pending shard like every
+    other [C, ...] tree."""
+
+    def _async_cfg(self):
+        from fl4health_tpu.server.async_schedule import AsyncConfig
+
+        return AsyncConfig(buffer_size=4, compute_jitter=0.05)
+
+    def _straggler_plan(self):
+        from fl4health_tpu.resilience.faults import ClientFault, FaultPlan
+
+        return FaultPlan(client_faults=(
+            ClientFault(clients=(0,), kind="slow", scale=5.0),
+        ))
+
+    def test_sharded_async_matches_unsharded(self, eight_devices):
+        kw = dict(async_config=self._async_cfg(),
+                  fault_plan=self._straggler_plan(),
+                  execution_mode="chunked")
+        ref = _losses(_make(**kw).fit(3))
+        sim = _make(mesh=MeshConfig(), **kw)
+        ls = _losses(sim.fit(3))
+        _assert_client_stack_sharded(sim)
+        np.testing.assert_allclose(ls, ref, atol=TRAJ_ATOL)
+
+    def test_sharded_async_modes_agree(self, eight_devices):
+        kw = dict(async_config=self._async_cfg(),
+                  fault_plan=self._straggler_plan(), mesh=MeshConfig())
+        lp = _losses(_make(execution_mode="pipelined", **kw).fit(3))
+        lc = _losses(_make(execution_mode="chunked", **kw).fit(3))
+        np.testing.assert_allclose(lp, lc, atol=TRAJ_ATOL)

@@ -475,3 +475,59 @@ def test_program_table_unaffected_by_precision_descriptor():
     table = perf_report.render_program_table(programs)
     assert "fit_round" in table
     assert "bfloat16" not in table
+
+
+def test_async_columns_render_when_fields_present():
+    rounds = [
+        _round(1, async_buffer=4, staleness_mean=0.5, staleness_max=2.0,
+               async_cadence_vs=0.67, async_virtual_time_s=0.67),
+        _round(2, async_buffer=4, staleness_mean=0.0, staleness_max=0.0,
+               async_cadence_vs=0.71, async_virtual_time_s=1.38),
+    ]
+    table = perf_report.render_table(rounds)
+    head = table.splitlines()[0]
+    assert "buffer" in head and "stale_avg" in head
+    assert "stale_max" in head and "cadence_vs" in head
+    assert "0.50" in table and "0.67" in table
+
+
+def test_async_summary_keys():
+    rounds = [
+        _round(1, async_buffer=2, staleness_mean=0.5, staleness_max=3.0,
+               async_cadence_vs=0.6),
+        _round(2, async_buffer=2, staleness_mean=0.0, staleness_max=1.0,
+               async_cadence_vs=0.8),
+    ]
+    s = perf_report.summarize(rounds)
+    assert s["async_cadence_vs"] == 0.7
+    assert s["staleness_max"] == 3
+
+
+def test_async_fields_absent_keeps_legacy_table_byte_stable():
+    rounds = [_round(1), _round(2)]
+    with_async = rounds + [
+        _round(3, async_buffer=2, staleness_mean=0.1, staleness_max=1.0,
+               async_cadence_vs=0.9),
+    ]
+    legacy = perf_report.render_table(rounds)
+    assert "buffer" not in legacy.splitlines()[0]
+    assert "cadence_vs" not in legacy.splitlines()[0]
+    # summary too: no async keys sneak into sync logs
+    s = perf_report.summarize(rounds)
+    assert "async_cadence_vs" not in s and "staleness_max" not in s
+    # ...and a mixed log renders the columns
+    assert "cadence_vs" in perf_report.render_table(with_async)
+
+
+def test_cli_output_byte_stable_without_async_fields(tmp_path):
+    """End-to-end: a legacy (sync) log's CLI output must not change at
+    all because async columns exist in the tool."""
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "buffer" not in out
+    assert "stale" not in out
+    assert "cadence" not in out
+    assert "async" not in out

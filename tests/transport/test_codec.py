@@ -156,23 +156,31 @@ class TestLoopback:
 class TestFramingFuzz:
     """Property fuzz: any single-byte corruption of a frame must raise
     FrameError (CRC/magic/length checks) — never decode silently-wrong
-    bytes. Both framing implementations, same contract."""
+    bytes. Both framing implementations, same contract.
+
+    Deterministic seeded draws (no hypothesis on this box): every byte
+    position is hit at least once across the sweep, plus a seeded spread
+    of (position, delta) pairs — strictly more positions than the old
+    60-example hypothesis run sampled."""
 
     def _fuzz(self, framing):
-        from hypothesis import given, settings, strategies as st
+        import random
 
         header, payload = b'{"fuzz":true}', bytes(range(251)) * 2
         frame = framing.frame(header, payload, flags=1)
-
-        @given(pos=st.integers(0, len(frame) - 1), delta=st.integers(1, 255))
-        @settings(max_examples=60, deadline=None)
-        def check(pos, delta):
+        rng = random.Random(0xF8A)
+        cases = [(pos, rng.randint(1, 255)) for pos in range(len(frame))]
+        cases += [
+            (rng.randrange(len(frame)), rng.randint(1, 255))
+            for _ in range(200)
+        ]
+        for pos, delta in cases:
             corrupted = bytearray(frame)
             corrupted[pos] = (corrupted[pos] + delta) % 256
             try:
                 h, p, fl = framing.unframe(bytes(corrupted))
             except FrameError:
-                return  # detected — the contract
+                continue  # detected — the contract
             # A flipped byte that still unframes must mean the corruption
             # landed somewhere the checks can't see — there is no such place:
             # magic, lengths, flags, header, payload are all covered by
@@ -181,8 +189,6 @@ class TestFramingFuzz:
                 f"corruption at byte {pos} (+{delta}) decoded silently: "
                 f"h={h!r} fl={fl}"
             )
-
-        check()
 
     def test_python_framing_rejects_all_single_byte_corruption(self):
         self._fuzz(PyFraming())

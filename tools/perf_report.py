@@ -75,6 +75,17 @@ PRECISION_COLUMNS = (
     ("ls_skips", "loss_scale_skips", lambda v: str(int(v))),
 )
 
+# Buffered-async fields (server/async_schedule.py): buffer occupancy at the
+# aggregation event, consumed-update staleness and the virtual
+# arrival-driven cadence. Optional like the telemetry columns — synchronous
+# logs keep their exact old table shape (byte-stable, tested).
+ASYNC_COLUMNS = (
+    ("buffer", "async_buffer", lambda v: str(int(v))),
+    ("stale_avg", "staleness_mean", lambda v: f"{v:.2f}"),
+    ("stale_max", "staleness_max", lambda v: str(int(v))),
+    ("cadence_vs", "async_cadence_vs", lambda v: f"{v:.3g}"),
+)
+
 
 def load_events(path: str) -> dict[str, list[dict]]:
     """Parse the JSONL log into {event_kind: [records]}. Malformed lines
@@ -128,7 +139,7 @@ def active_columns(rounds: list[dict]) -> tuple:
     event."""
     extra = tuple(
         col for col in (TELEMETRY_COLUMNS + WIRE_COLUMNS + MESH_COLUMNS
-                        + PRECISION_COLUMNS)
+                        + PRECISION_COLUMNS + ASYNC_COLUMNS)
         if any(col[1] in rec for rec in rounds)
     )
     return COLUMNS + extra
@@ -306,6 +317,15 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
         # cumulative counter: the last round's value IS the run total
         summary["loss_scale_skips"] = int(max(
             float(r.get("loss_scale_skips", 0.0)) for r in rounds
+        ))
+    if any("async_cadence_vs" in r for r in rounds):
+        # buffered-async runs only — mean arrival-driven cadence (virtual
+        # seconds) and worst consumed-update staleness over the run
+        cad = [float(r["async_cadence_vs"]) for r in rounds
+               if "async_cadence_vs" in r]
+        summary["async_cadence_vs"] = round(sum(cad) / len(cad), 4)
+        summary["staleness_max"] = int(max(
+            float(r.get("staleness_max", 0.0)) for r in rounds
         ))
     if any("mesh_devices" in r for r in rounds):
         # mesh runs only — device count plus the mean per-chip throughput
