@@ -669,6 +669,88 @@ def timed_precision_block(timing: bool = True) -> dict:
     }
 
 
+def timed_sweep_block(timing: bool = True) -> dict:
+    """Sweep block (the shared-compilation PR acceptance metric): run a
+    24-cell {2 strategies x 2 client algorithms x 2 partitioners x 2
+    seeds x 2 server-lr values} grid through ``fl4health_tpu/sweep/`` and
+    record the compile-amortization numbers — {cells, buckets,
+    programs_compiled, compile_s_total, cells_per_compile, wall_s}. The
+    acceptance bar is ``programs_compiled <= cells / 3``; here the grid
+    dispatches through 4 program groups (strategy x client), so a healthy
+    run reports 24 cells over ~4 compiled programs.
+
+    Counts/compile facts are exact on any backend and always land;
+    ``timing=False`` (the CPU-fallback annotation) nulls only the
+    throughput fields (steps_per_s_median, cells_per_s) — XLA:CPU walls
+    are harness health, not speed claims."""
+    import jax
+    import numpy as np
+    import optax
+
+    from fl4health_tpu.clients import engine as client_engine
+    from fl4health_tpu.clients.ditto import MrMtlClientLogic
+    from fl4health_tpu.datasets.synthetic import synthetic_classification
+    from fl4health_tpu.models.cnn import Mlp
+    from fl4health_tpu.server.simulation import ClientDataset
+    from fl4health_tpu.strategies.fedavg import FedAvg
+    from fl4health_tpu.strategies.fedopt import fed_adam
+    from fl4health_tpu.sweep import SweepSpec, run_sweep
+
+    n_classes = 3
+
+    def model():
+        return client_engine.from_flax(Mlp(features=(16,),
+                                           n_outputs=n_classes))
+
+    def partitioner(salt):
+        def build(cohort):
+            out = []
+            for i in range(cohort):
+                x, y = synthetic_classification(
+                    jax.random.PRNGKey(1000 * salt + i), 48, (8,), n_classes
+                )
+                n = 28 + 4 * ((i + salt) % 3)  # unequal non-IID-ish sizes
+                out.append(ClientDataset(
+                    np.asarray(x[:n]), np.asarray(y[:n]),
+                    np.asarray(x[40:]), np.asarray(y[40:]),
+                ))
+            return out
+        return build
+
+    rounds = int(os.environ.get("FL4HEALTH_BENCH_SWEEP_ROUNDS", 3))
+    spec = SweepSpec(
+        strategies={"fedavg": FedAvg, "fedadam": lambda: fed_adam(0.1)},
+        clients={
+            "sgd": lambda: client_engine.ClientLogic(
+                model(), client_engine.masked_cross_entropy
+            ),
+            "mrmtl": lambda: MrMtlClientLogic(
+                model(), client_engine.masked_cross_entropy, lam=0.5
+            ),
+        },
+        partitioners={"dir0": partitioner(0), "dir1": partitioner(1)},
+        rounds=rounds, batch_size=8, local_steps=2,
+        tx=lambda: optax.sgd(0.05),
+        seeds=(5, 7), cohort_sizes=(3,),
+        scalars={"server_lr": (0.1, 0.3)},
+    )
+    result = run_sweep(spec)
+    block = result.bench_block()
+    steps = [r.steps_per_s for r in result.cells]
+    block["steps_per_s_median"] = (
+        round(float(np.median(steps)), 3) if timing else None
+    )
+    block["cells_per_s"] = (
+        round(len(result.cells) / result.wall_s, 3)
+        if timing and result.wall_s > 0 else None
+    )
+    best = result.leaderboard()[0]
+    block["best_cell"] = best.cell.label()
+    block["best_final_eval_loss"] = round(best.final_eval_loss, 5)
+    block["rounds"] = rounds
+    return block
+
+
 def timed_async_block(timing: bool = True) -> dict:
     """Buffered-async block (the tail-independence PR acceptance metric):
     sync-vs-async round CADENCE and final loss under one fixed straggler
@@ -1071,6 +1153,18 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
             and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
         )
         out["async"] = timed_async_block(timing=a_timing)
+    # Shared-compilation sweep (the scenario-grid PR metric). Same gating
+    # shape as telemetry/resilience: FL4HEALTH_BENCH_SWEEP=1 forces the
+    # full block, =0 disables it, "auto" runs it but nulls the throughput
+    # fields on the CPU fallback (the compile-amortization counts are
+    # exact and always land).
+    want_s = os.environ.get("FL4HEALTH_BENCH_SWEEP", "auto")
+    if want_s != "0":
+        s_timing = want_s == "1" or (
+            want_s == "auto"
+            and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+        )
+        out["sweep"] = timed_sweep_block(timing=s_timing)
     # Mesh-sharded rounds (the massive-cohort PR metric): opt-in only —
     # FL4HEALTH_BENCH_MESH=1 — because it compiles two extra chunked scans
     # and needs a multi-device backend (single-device runs report skipped).
@@ -1390,6 +1484,50 @@ def run_async_artifact() -> None:
     }))
 
 
+def run_sweep_artifact() -> None:
+    """``python bench.py --sweep``: the shared-compilation scenario-grid
+    measurement as its own artifact, landed as
+    ``BENCH_sweep_<label>_<ts>.json``. The compile-amortization numbers
+    ({cells, programs_compiled, cells_per_compile, compile_s_total}) are
+    exact on any backend and are THE claim; on the CPU fallback the
+    throughput fields are nulled with the standard annotation.
+    FL4HEALTH_BENCH_SWEEP=1 forces the timing fields anywhere."""
+    platform, device_kind = _provenance()
+    fallback = platform == "cpu"
+    timing = (os.environ.get("FL4HEALTH_BENCH_SWEEP") == "1"
+              or not fallback)
+    block = timed_sweep_block(timing=timing)
+    label = f"{platform}_fallback" if fallback else platform
+    record = {
+        "metric": (f"scenario_sweep_shared_compilation"
+                   f"{'_cpu_fallback' if fallback else ''}"),
+        "platform": platform,
+        "device_kind": device_kind,
+        "data_provenance": "synthetic",
+        "sweep": block,
+    }
+    if fallback and not timing:
+        record["note"] = (
+            "Compile-amortization counts (cells, programs_compiled, "
+            "cells_per_compile) are exact on any backend and are the "
+            "measured claim; XLA:CPU throughput fields are nulled — "
+            "harness health, not speed. Re-run on TPU for steps/s."
+        )
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"BENCH_sweep_{label}_{stamp}.json",
+    )
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({
+        "written": out_path,
+        "cells": block["cells"],
+        "programs_compiled": block["programs_compiled"],
+        "cells_per_compile": block["cells_per_compile"],
+    }))
+
+
 def main() -> None:
     """Parent orchestrator: run the measurement in a child; on TPU-init
     failure or stall, retry with the CPU platform forced so the driver always
@@ -1583,5 +1721,7 @@ if __name__ == "__main__":
         run_precision_artifact()
     elif "--async" in sys.argv:
         run_async_artifact()
+    elif "--sweep" in sys.argv:
+        run_sweep_artifact()
     else:
         main()

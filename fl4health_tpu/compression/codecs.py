@@ -100,14 +100,25 @@ def topk_count(n_total: int, fraction: float) -> int:
     return max(1, min(n_total, int(round(fraction * n_total))))
 
 
-def topk_mask(flat: jax.Array, k: int) -> jax.Array:
+def topk_mask(flat: jax.Array, k: int,
+              k_effective: jax.Array | None = None) -> jax.Array:
     """0/1 mask keeping the ``k`` largest-magnitude coordinates.
 
     ``jax.lax.top_k`` is deterministic (ties broken by lowest index), so
     the same values always produce the same mask — across calls, backends
-    and execution modes (pinned by tests/exchange + tests/compression)."""
+    and execution modes (pinned by tests/exchange + tests/compression).
+
+    ``k_effective`` (optional TRACED i32 scalar in ``[1, k]``) keeps only
+    the first ``k_effective`` of the ``k`` selected slots — the adaptive
+    per-round fraction of ``CompressionConfig.topk_schedule``. The
+    selection SHAPE stays ``k`` (static), only rank weights change, so an
+    adaptive-fraction run never recompiles. ``None`` is bit-identical to
+    the historical constant-``k`` mask."""
     _, idx = jax.lax.top_k(jnp.abs(flat), k)
-    return jnp.zeros_like(flat, jnp.float32).at[idx].set(1.0)
+    if k_effective is None:
+        return jnp.zeros_like(flat, jnp.float32).at[idx].set(1.0)
+    keep = (jnp.arange(k) < k_effective).astype(jnp.float32)
+    return jnp.zeros_like(flat, jnp.float32).at[idx].set(keep)
 
 
 # ---------------------------------------------------------------------------
@@ -157,6 +168,7 @@ def compress_update(
     residual: PyTree | None,
     key: jax.Array,
     config: CompressionConfig,
+    topk_fraction_eff: jax.Array | None = None,
 ) -> tuple[PyTree, PyTree | None]:
     """Lossy-channel round trip for ONE client's update pytree.
 
@@ -164,6 +176,12 @@ def compress_update(
     what the server-side decoder reconstructs and ``new_residual`` the
     error-feedback memory (``None`` in == ``None`` out). Pure and
     jit/vmap-compatible; with no lossy stage enabled it is the identity.
+
+    ``topk_fraction_eff`` (optional TRACED f32 scalar) is the round's
+    effective kept fraction under ``config.topk_schedule`` — clamped into
+    ``[1/n, config.topk_fraction]`` and applied as rank weights over the
+    static top-``k`` selection, so the compiled shape never changes.
+    ``None`` keeps the constant ``config.topk_fraction`` bit-identically.
     """
     if not config.enabled:
         return update, residual
@@ -199,7 +217,15 @@ def compress_update(
     if config.topk_fraction is not None:
         n_sel = sum(v.shape[0] for v in flats)  # padded sizes under rotation
         k = topk_count(n_total, config.topk_fraction)
-        mask = topk_mask(jnp.concatenate(flats), min(k, n_sel))
+        k_eff = None
+        if topk_fraction_eff is not None:
+            # same arithmetic as the static topk_count, in-graph: round()
+            # matches Python round's half-to-even, clamps keep >=1 slot
+            k_eff = jnp.clip(
+                jnp.round(topk_fraction_eff * n_total).astype(jnp.int32),
+                1, min(k, n_sel),
+            )
+        mask = topk_mask(jnp.concatenate(flats), min(k, n_sel), k_eff)
         out, off = [], 0
         for v in flats:
             out.append(v * mask[off: off + v.shape[0]])

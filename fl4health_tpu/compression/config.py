@@ -50,6 +50,19 @@ class CompressionConfig:
     quant_bits: int | None = None
     rotation: bool = False
     seed: int = 0
+    #: Optional per-round adaptive kept-fraction schedule
+    #: ``("linear", f_start, f_end, over_rounds)``: the EFFECTIVE kept
+    #: fraction interpolates f_start -> f_end over the first
+    #: ``over_rounds`` rounds (then holds f_end), as a TRACED function of
+    #: the round index inside the compiled round programs — zero
+    #: recompiles across rounds, and the schedule endpoints are hoistable
+    #: sweep scalars (fl4health_tpu/sweep/). ``topk_fraction`` stays the
+    #: STATIC ceiling: it fixes the selection shape (k = top-k slots, the
+    #: wire sidecar size), so both endpoints must be <= it; coordinates
+    #: ranked past the effective fraction are zeroed (their mass lands in
+    #: the EF residual like any unsent mass). ``None`` = constant
+    #: ``topk_fraction``, bit-identical to the pre-schedule codec.
+    topk_schedule: tuple | None = None
 
     def __post_init__(self):
         if self.topk_fraction is not None and not (
@@ -58,6 +71,31 @@ class CompressionConfig:
             raise ValueError(
                 f"topk_fraction must be in (0, 1]; got {self.topk_fraction}"
             )
+        if self.topk_schedule is not None:
+            if self.topk_fraction is None:
+                raise ValueError(
+                    "topk_schedule needs topk_fraction as its static "
+                    "ceiling (the selection shape and wire sidecar are "
+                    "sized by it)"
+                )
+            s = self.topk_schedule
+            if (len(s) != 4 or s[0] != "linear"):
+                raise ValueError(
+                    "topk_schedule must be ('linear', f_start, f_end, "
+                    f"over_rounds); got {s!r}"
+                )
+            _, f0, f1, over = s
+            for name, f in (("f_start", f0), ("f_end", f1)):
+                if not 0.0 < float(f) <= self.topk_fraction:
+                    raise ValueError(
+                        f"topk_schedule {name}={f} must be in (0, "
+                        f"topk_fraction={self.topk_fraction}] — the static "
+                        "ceiling fixes the compiled selection shape"
+                    )
+            if int(over) < 1:
+                raise ValueError(
+                    f"topk_schedule over_rounds must be >= 1; got {over}"
+                )
         if self.quant_bits is not None and self.quant_bits not in QUANT_LEVELS:
             raise ValueError(
                 f"quant_bits must be one of {sorted(QUANT_LEVELS)}; "
@@ -81,10 +119,15 @@ class CompressionConfig:
 
     def describe(self) -> dict:
         """JSON-able config facts (run manifest / bench artifacts)."""
-        return {
+        out = {
             "topk_fraction": self.topk_fraction,
             "error_feedback": self.uses_error_feedback,
             "quant_bits": self.quant_bits,
             "rotation": self.rotation,
             "seed": self.seed,
         }
+        if self.topk_schedule is not None:
+            # absent on constant-fraction configs so legacy manifest
+            # config hashes stay stable
+            out["topk_schedule"] = list(self.topk_schedule)
+        return out

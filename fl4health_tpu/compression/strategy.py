@@ -86,6 +86,21 @@ class CompressingStrategy(Strategy):
         self.inner = inner
         self.config = config
         self._n_clients = n_clients
+        # Adaptive top-k schedule endpoints as PLAIN ATTRS (not the frozen
+        # config): they are read at trace time inside aggregate(), which
+        # makes them hoistable sweep scalars — the sweep engine rebinds
+        # them to traced program inputs so a schedule sweep shares one
+        # compiled round program (fl4health_tpu/sweep/hoisting.py). The
+        # static ceiling config.topk_fraction is NOT hoistable: it sizes
+        # the top-k selection shape.
+        if config.topk_schedule is not None:
+            _, f0, f1, over = config.topk_schedule
+            self.topk_f_start = float(f0)
+            self.topk_f_end = float(f1)
+            self.topk_over_rounds = int(over)
+        else:
+            self.topk_f_start = self.topk_f_end = None
+            self.topk_over_rounds = None
         self.weighted_aggregation = inner.weighted_aggregation
         self.weighted_eval_aggregation = inner.weighted_eval_aggregation
         # chunk-eligibility passthrough (server/simulation.py consults this
@@ -166,8 +181,30 @@ class CompressingStrategy(Strategy):
             jax.random.PRNGKey(self.config.seed), round_idx
         )
 
+    def effective_topk_fraction(self, round_idx):
+        """The round's kept fraction under ``config.topk_schedule`` — a
+        traced linear interpolation ``f_start -> f_end`` over the first
+        ``over_rounds`` rounds (1-based; holds ``f_end`` after), clamped
+        into ``(0, topk_fraction]``. ``None`` without a schedule (the
+        constant-fraction codec path, bit-identical to pre-schedule)."""
+        if self.topk_f_start is None:
+            return None
+        if self.topk_over_rounds <= 1:
+            # a 1-round ramp IS f_end from round 1 (the generic formula's
+            # (r-1)/(T-1) denominator would silently make it a 2-round one)
+            t = jnp.ones((), jnp.float32)
+        else:
+            t = jnp.clip(
+                (jnp.asarray(round_idx, jnp.float32) - 1.0)
+                / (float(self.topk_over_rounds) - 1.0),
+                0.0, 1.0,
+            )
+        f = self.topk_f_start + (self.topk_f_end - self.topk_f_start) * t
+        return jnp.clip(f, 1e-9, float(self.config.topk_fraction))
+
     def _compress_stacked(
-        self, stacked, reference, residuals, round_key, mask
+        self, stacked, reference, residuals, round_key, mask,
+        topk_fraction_eff=None,
     ):
         """vmap the per-client channel over the ``[C, ...]`` packet stack.
 
@@ -184,7 +221,8 @@ class CompressingStrategy(Strategy):
                 packet_c, reference,
             )
             decoded, new_res = compress_update(
-                update, residual_c, key_c, self.config
+                update, residual_c, key_c, self.config,
+                topk_fraction_eff=topk_fraction_eff,
             )
             def cast_back(r, d):
                 v = r.astype(jnp.float32) + d
@@ -236,7 +274,8 @@ class CompressingStrategy(Strategy):
             )
         round_key = self._round_key(round_idx)
         lossy_main, new_residual = self._compress_stacked(
-            main, reference, server_state.residual, round_key, results.mask
+            main, reference, server_state.residual, round_key, results.mask,
+            topk_fraction_eff=self.effective_topk_fraction(round_idx),
         )
         if hasattr(packets, "params"):
             new_packets = packets.replace(params=lossy_main)
@@ -252,6 +291,7 @@ class CompressingStrategy(Strategy):
             lossy_cv, _ = self._compress_stacked(
                 cv, cv_ref, None,
                 jax.random.fold_in(round_key, 0x5CAF), results.mask,
+                topk_fraction_eff=self.effective_topk_fraction(round_idx),
             )
             new_packets = new_packets.replace(control_variates=lossy_cv)
         new_inner = self.inner.aggregate(

@@ -91,15 +91,28 @@ def trimmed_mean(
 ) -> PyTree:
     """Masked coordinate-wise trimmed mean: drop ``floor(trim_fraction*k)``
     values from EACH end of the sorted participating values, average the
-    middle. ``trim_fraction`` is static config; the realized trim count is
-    clamped so at least the median survives tiny cohorts. Non-finite
-    submissions sort to the top end and are removed whenever the trim
-    budget covers the attacker count — the estimator's usual guarantee."""
-    if not 0.0 <= trim_fraction < 0.5:
-        raise ValueError(
-            f"trim_fraction must be in [0, 0.5); got {trim_fraction} "
-            "(trimming half or more from each end leaves nothing)"
+    middle. ``trim_fraction`` may be static config OR a traced f32 scalar
+    (the sweep engine hoists it so a trim-fraction sweep shares one
+    compiled program — it only ever enters rank comparisons, never a
+    shape); the realized trim count is clamped so at least the median
+    survives tiny cohorts. Non-finite submissions sort to the top end and
+    are removed whenever the trim budget covers the attacker count — the
+    estimator's usual guarantee."""
+    if isinstance(trim_fraction, jax.core.Tracer):
+        # traced (the sweep's hoisted hvec input): validation becomes an
+        # in-graph clamp of the [0, 0.5) rule — the host-side binding
+        # validators reject bad values before they reach a trace
+        trim_fraction = jnp.clip(
+            jnp.asarray(trim_fraction, jnp.float32), 0.0, 0.4999
         )
+    else:
+        # concrete scalar (Python / numpy / jnp): validate loudly, as always
+        trim_fraction = float(trim_fraction)
+        if not 0.0 <= trim_fraction < 0.5:
+            raise ValueError(
+                f"trim_fraction must be in [0, 0.5); got {trim_fraction} "
+                "(trimming half or more from each end leaves nothing)"
+            )
     m = jnp.asarray(mask)
     k = jnp.sum(m > 0).astype(jnp.int32)
     t = jnp.clip(

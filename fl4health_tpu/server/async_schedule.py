@@ -150,12 +150,17 @@ class AsyncEventPlan:
         }
 
 
-def staleness_discount(staleness, exponent: float = 0.5,
+def staleness_discount(staleness, exponent=0.5,
                        max_staleness: int | None = None):
     """Aggregation weight for an update ``staleness`` versions old:
     ``1/(1+s)^exponent``, hard-zeroed past ``max_staleness``. Works on
-    numpy arrays and traced jax arrays alike (pure arithmetic)."""
-    w = (1.0 + staleness) ** (-float(exponent))
+    numpy arrays and traced jax arrays alike (pure arithmetic), and
+    ``exponent`` itself may be a traced f32 scalar — the async round
+    programs feed it as a program INPUT so an exponent sweep never
+    recompiles (fl4health_tpu/sweep/ hoisting)."""
+    if isinstance(exponent, (int, float)):
+        exponent = float(exponent)
+    w = (1.0 + staleness) ** (-exponent)
     if max_staleness is not None:
         w = w * (staleness <= max_staleness)
     return w

@@ -565,14 +565,34 @@ class FederatedSimulation:
         self._test_cache: tuple[Batch, jax.Array] | None = None
 
         # --- init client + server state -----------------------------------
+        self._init_states(_wire_zero1=True)
+
+        self._build_compiled()
+
+    # ------------------------------------------------------------------
+    def _init_states(self, _wire_zero1: bool = False) -> None:
+        """(Re)initialize the client-stacked ``TrainState`` and the server
+        state from ``self.rng`` — exactly the constructor's derivation,
+        factored out so the sweep engine (``fl4health_tpu/sweep/``) can
+        re-seed a template simulation per grid cell without rebuilding its
+        closures/compiled programs::
+
+            sim.rng = jax.random.PRNGKey(seed)
+            sim._base_entropy = engine._entropy_from_key(sim.rng)
+            sim._init_states()
+
+        reproduces bit-identically the states a fresh construction with
+        that seed would build. ``_wire_zero1`` runs the one-time ZeRO-1
+        server-optimizer wiring and is only passed by ``__init__``."""
         init_rng = jax.random.fold_in(self.rng, 0)
         sample_x = jax.tree_util.tree_map(
             lambda a: a[:1], self.datasets[0].x_train
         )
         proto = engine.create_train_state(
-            logic, tx, init_rng, sample_x, precision=self.precision
+            self.logic, self.tx, init_rng, sample_x, precision=self.precision
         )
-        if self._program_builder.mesh is not None and mesh.zero1:
+        if (_wire_zero1 and self._program_builder.mesh is not None
+                and self.mesh_config.zero1):
             # ZeRO-1 server optimizer (parallel/zero.py) over the SAME mesh
             # the round programs dispatch on — each replica owns 1/N of the
             # server momenta; the construction-time parity probe therefore
@@ -587,11 +607,9 @@ class FederatedSimulation:
             st = proto.replace(rng=jax.random.fold_in(init_rng, i + 1))
             per_client.append(st)
         self.client_states: TrainState = ptu.stack_clients(per_client)
-        # self.strategy, not the local: zero1 wiring may have rebuilt the
+        # self.strategy, not a local: zero1 wiring may have rebuilt the
         # chain around a ZeRO-sharded server optimizer
         self.server_state = self.strategy.init(proto.params)
-
-        self._build_compiled()
 
     # ------------------------------------------------------------------
     def set_train_data(self, xs: Sequence[Any], ys: Sequence[Any]) -> None:
@@ -914,9 +932,17 @@ class FederatedSimulation:
         each appends one extra output — fit_round a :class:`RoundTelemetry`
         pytree, eval_round the per-client non-finite eval-loss count — all
         derived from values the program already computes, so the training
-        math (and thus the loss trajectory) is bit-identical either way."""
+        math (and thus the loss trajectory) is bit-identical either way.
+
+        ``fit_round`` carries one OPTIONAL trailing ``sample_counts``
+        parameter: every historical caller omits it (the closure bakes
+        ``self.sample_counts`` exactly as before), while the sweep engine's
+        cell programs (``fl4health_tpu/sweep/``) pass it as a TRACED input
+        so cells whose data partitions (and thus per-client train-set
+        sizes) differ still share one compiled program."""
         client_fit, client_eval = self._build_client_fns(collect_telemetry)
         strategy = self.strategy
+        baked_sample_counts = self.sample_counts
 
         # Chaos layer (resilience/faults.py): compiled into the round
         # program so the same seeded plan injects identical faults on both
@@ -932,7 +958,9 @@ class FederatedSimulation:
         n_clients = self.n_clients
 
         def fit_round(server_state, client_states, batches, mask, round_idx,
-                      val_batches):
+                      val_batches, sample_counts=None):
+            if sample_counts is None:
+                sample_counts = baked_sample_counts
             payload = strategy.client_payload(server_state, round_idx)
             if inject_dropout:
                 # a dropped client is exactly an unsampled one: mask math,
@@ -964,13 +992,13 @@ class FederatedSimulation:
             agg_mask = mask * finite.astype(mask.dtype)
             results = FitResults(
                 packets=packets,
-                sample_counts=self.sample_counts,
+                sample_counts=sample_counts,
                 train_losses=losses,
                 train_metrics=metrics,
                 mask=agg_mask,
             )
             new_server_state = strategy.aggregate(server_state, results, round_idx)
-            w = results.mask * self.sample_counts
+            w = results.mask * sample_counts
             agg_losses = {
                 # where() not multiply: an excluded client's NaN loss must not
                 # poison the weighted mean (NaN * 0 == NaN).
@@ -978,7 +1006,7 @@ class FederatedSimulation:
                 / jnp.maximum(jnp.sum(w), 1.0)
                 for k, v in losses.items()
             }
-            agg_metrics = aggregate_metrics(metrics, self.sample_counts, results.mask)
+            agg_metrics = aggregate_metrics(metrics, sample_counts, results.mask)
             if not collect_telemetry:
                 return new_server_state, new_states, agg_losses, agg_metrics, losses
             nan_row = jnp.full_like(
@@ -1332,6 +1360,36 @@ class FederatedSimulation:
         n_clients = self.n_clients
         sample_counts = self.sample_counts
         async_mask = getattr(strategy, "async_aggregation_mask", None)
+        if async_mask is not None:
+            import inspect
+
+            # duck-typed hooks with the pre-hoisting 2-arg signature keep
+            # working: only pass the traced exponent where it is accepted.
+            # The exponent is passed POSITIONALLY, so only positional-
+            # capable parameters count (**kwargs can never absorb it).
+            _params = inspect.signature(async_mask).parameters.values()
+            _positional = sum(
+                1 for p in _params
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            )
+            _takes_exponent = (_positional >= 3 or any(
+                p.kind == p.VAR_POSITIONAL for p in _params
+            ))
+            if not _takes_exponent:
+                raw_mask = async_mask
+                async_mask = lambda arr, stal, _exp: raw_mask(arr, stal)  # noqa: E731
+            elif not hasattr(strategy, "staleness_exponent"):
+                # an exponent-taking hook on a strategy WITHOUT the
+                # attribute would receive the 0.0 dispatch fallback —
+                # (1+s)^0 = 1, silently no discounting; fail loudly
+                raise ValueError(
+                    f"{type(strategy).__name__}.async_aggregation_mask "
+                    "accepts an exponent argument but the strategy exposes "
+                    "no 'staleness_exponent' attribute for the async round "
+                    "programs to feed it from; expose the attribute (as "
+                    "FedBuff does), or drop the parameter to use internal "
+                    "defaults"
+                )
         quarantine_fn = (getattr(strategy, "quarantine_mask", None)
                          if self.observability.enabled else None)
 
@@ -1381,8 +1439,13 @@ class FederatedSimulation:
 
         def async_event(server_state, client_states, pending, batches_next,
                         arrivals, staleness, event_idx, val_batches,
-                        val_counts, test_batches=None, test_counts=None):
+                        val_counts, staleness_exponent,
+                        test_batches=None, test_counts=None):
             # -- consume: staleness-discounted aggregation of the buffer --
+            # staleness_exponent is a TRACED scalar input (fed from the
+            # live strategy attribute at each dispatch), so an exponent
+            # sweep/rebind reuses this compiled program — the sweep
+            # engine's scalar-hoisting contract
             arr = arrivals
             if inject_dropout:
                 # a dropped update is lost on the wire: it fills its buffer
@@ -1391,8 +1454,8 @@ class FederatedSimulation:
                 arr = arr * fault_plan.participation_factor(
                     event_idx, n_clients
                 )
-            disc_mask = (async_mask(arr, staleness) if async_mask is not None
-                         else arr)
+            disc_mask = (async_mask(arr, staleness, staleness_exponent)
+                         if async_mask is not None else arr)
             finite = jnp.isfinite(
                 pending["losses"].get("backward", jnp.zeros_like(arr))
             )
@@ -1506,7 +1569,7 @@ class FederatedSimulation:
             rep = b.replicated()
             sh_c, sh_s = self._sh_client_states, self._sh_server_state
             pro_in = (sh_s, sh_c, cs, cs)
-            ev_in = (sh_s, sh_c, cs, cs, cs, cs, rep, cs, cs)
+            ev_in = (sh_s, sh_c, cs, cs, cs, cs, rep, cs, cs, rep)
             if self._test_batches() is not None:
                 ev_in = ev_in + (cs, cs)
             ev_out = (sh_s, sh_c, cs, None)
@@ -1533,7 +1596,7 @@ class FederatedSimulation:
 
         def chunk(server_state, client_states, pending, x_stack, y_stack,
                   idx, em, sm, arrivals, staleness, val_batches, val_counts,
-                  test_batches=None, test_counts=None):
+                  staleness_exponent, test_batches=None, test_counts=None):
             def body(carry, per_event):
                 server_state, client_states, pending, e = carry
                 idx_r, em_r, sm_r, arr_r, stal_r = per_event
@@ -1543,7 +1606,7 @@ class FederatedSimulation:
                 server_state, client_states, pending, out = event(
                     server_state, client_states, pending, batches_next,
                     arr_r, stal_r, e, val_batches, val_counts,
-                    test_batches, test_counts,
+                    staleness_exponent, test_batches, test_counts,
                 )
                 return (server_state, client_states, pending, e + 1), out
 
@@ -1561,7 +1624,7 @@ class FederatedSimulation:
             cs = b.client_sharding()
             scs = b.stacked_client_sharding()
             in_sh = (self._sh_server_state, self._sh_client_states, cs,
-                     cs, cs, scs, scs, scs, scs, scs, cs, cs)
+                     cs, cs, scs, scs, scs, scs, scs, cs, cs, b.replicated())
             if self._test_batches() is not None:
                 in_sh = in_sh + (cs, cs)
             out_sh = (self._sh_server_state, self._sh_client_states, None)
@@ -2515,6 +2578,19 @@ class FederatedSimulation:
         else:
             self._fit_async_pipelined(n_rounds, plan)
 
+    def _staleness_exponent_input(self) -> jax.Array:
+        """The staleness exponent as a traced PROGRAM INPUT, read from the
+        live (outermost FedBuff) strategy attribute at each dispatch — so a
+        rebind of ``strategy.staleness_exponent`` (the sweep engine's
+        scalar hoisting) reaches the compiled async programs with zero
+        recompiles. Falls back to 0.0 for exotic async strategies without
+        the attribute (a legacy 2-arg ``async_aggregation_mask`` never
+        receives it — ``_build_async_fns`` shims the call arity)."""
+        return jnp.asarray(
+            float(getattr(self.strategy, "staleness_exponent", 0.0)),
+            jnp.float32,
+        )
+
     def _stage_prologue_batches(self):
         """Data-plan-1 batches for the async prologue, staged with the
         builder's clients sharding (no-op unsharded)."""
@@ -2585,7 +2661,8 @@ class FederatedSimulation:
                 prefetcher.schedule(e + 2)
             args = [self.server_state, self.client_states,
                     self._async_pending, batches_next, arrivals, staleness,
-                    jnp.asarray(e, jnp.int32), val_batches, val_counts]
+                    jnp.asarray(e, jnp.int32), val_batches, val_counts,
+                    self._staleness_exponent_input()]
             test = self._test_batches()
             if test is not None:
                 args.extend(test)
@@ -2672,7 +2749,7 @@ class FederatedSimulation:
         args = [self.server_state, self.client_states, pending,
                 x_bank, y_bank, idx, em, sm,
                 jnp.asarray(plan.arrivals), jnp.asarray(plan.staleness),
-                val_batches, val_counts]
+                val_batches, val_counts, self._staleness_exponent_input()]
         if test is not None:
             args.extend(test)
         with obs.span("fit_async_chunk", cat="fit",

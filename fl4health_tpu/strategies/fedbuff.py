@@ -84,14 +84,23 @@ class FedBuff(Strategy):
 
     # -- the async hook -------------------------------------------------
     def async_aggregation_mask(self, arrivals: jax.Array,
-                               staleness: jax.Array) -> jax.Array:
+                               staleness: jax.Array,
+                               exponent=None) -> jax.Array:
         """[C] fractional aggregation mask for one buffer-fill event:
         ``arrivals * 1/(1+staleness)^exponent`` (0 past ``max_staleness``).
         Jit-traceable; a staleness-0 arrival row returns ``arrivals``
-        bit-identically (the discount is exactly 1.0)."""
+        bit-identically (the discount is exactly 1.0).
+
+        ``exponent`` (default: this wrapper's configured
+        ``staleness_exponent``) may be a traced f32 scalar — the async
+        round programs pass the CURRENT ``strategy.staleness_exponent`` as
+        a program input each dispatch, so rebinding the attribute (the
+        sweep engine's scalar hoisting) changes the discount with zero
+        recompiles. ``max_staleness`` stays static by design: it is a
+        hard drop rule, part of the experiment's identity."""
         disc = staleness_discount(
             jnp.asarray(staleness, jnp.float32),
-            self.staleness_exponent,
+            self.staleness_exponent if exponent is None else exponent,
             self.max_staleness,
         )
         return jnp.asarray(arrivals, jnp.float32) * disc.astype(jnp.float32)

@@ -83,23 +83,63 @@ class FedOpt(Strategy):
         return FedOptState(params=new_params, opt_state=new_opt)
 
 
+# The factories below build their server optimizer through
+# ``optax.inject_hyperparams``, so the SERVER LEARNING RATE lives as a
+# traced leaf of ``FedOptState.opt_state`` (``opt_state.hyperparams
+# ["learning_rate"]``) instead of a Python constant baked into the jaxpr.
+# Two configs differing only in server lr therefore share one compiled
+# round program — the sweep engine (fl4health_tpu/sweep/) rebinds it per
+# cell with zero recompiles (pinned by tests/sweep/test_hoisting.py).
+# Everything else (betas, eps, momentum) stays STATIC on purpose: optax
+# folds expressions like ``1 - b1`` in Python double precision when the
+# scalar is a constant but in f32 when it is traced, so injecting them
+# would shift trajectories by ~1ulp — whereas the lr enters as a single
+# f32 multiply whose bits match the constant-folded build exactly
+# (bit-identity pinned by tests).
+#
+# COMPAT NOTE: the opt_state pytree structure changed (a plain optax
+# chain tuple -> InjectHyperparamsState). Server-state checkpoints saved
+# by a pre-hoisting build do not restore into the new template; re-save
+# from a fresh run (checkpoints here are per-run artifacts, not a stable
+# wire format).
+
 def fed_adam(lr: float = 0.1, b1: float = 0.9, b2: float = 0.99, eps: float = 1e-3,
              weighted_aggregation: bool = True) -> FedOpt:
     """FedAdam (Reddi et al. defaults: tau=1e-3)."""
-    return FedOpt(optax.adam(lr, b1=b1, b2=b2, eps=eps), weighted_aggregation)
+    return FedOpt(
+        optax.inject_hyperparams(
+            optax.adam, static_args=("b1", "b2", "eps", "eps_root")
+        )(learning_rate=lr, b1=b1, b2=b2, eps=eps),
+        weighted_aggregation,
+    )
 
 
 def fed_yogi(lr: float = 0.1, b1: float = 0.9, b2: float = 0.99, eps: float = 1e-3,
              weighted_aggregation: bool = True) -> FedOpt:
-    return FedOpt(optax.yogi(lr, b1=b1, b2=b2, eps=eps), weighted_aggregation)
+    return FedOpt(
+        optax.inject_hyperparams(
+            optax.yogi, static_args=("b1", "b2", "eps")
+        )(learning_rate=lr, b1=b1, b2=b2, eps=eps),
+        weighted_aggregation,
+    )
 
 
 def fed_adagrad(lr: float = 0.1, eps: float = 1e-3,
                 weighted_aggregation: bool = True) -> FedOpt:
-    return FedOpt(optax.adagrad(lr, eps=eps), weighted_aggregation)
+    return FedOpt(
+        optax.inject_hyperparams(
+            optax.adagrad, static_args=("eps", "initial_accumulator_value")
+        )(learning_rate=lr, eps=eps),
+        weighted_aggregation,
+    )
 
 
 def fed_avg_m(lr: float = 1.0, momentum: float = 0.9,
               weighted_aggregation: bool = True) -> FedOpt:
     """Server momentum (FedAvgM)."""
-    return FedOpt(optax.sgd(lr, momentum=momentum), weighted_aggregation)
+    return FedOpt(
+        optax.inject_hyperparams(
+            optax.sgd, static_args=("momentum", "nesterov")
+        )(learning_rate=lr, momentum=momentum),
+        weighted_aggregation,
+    )

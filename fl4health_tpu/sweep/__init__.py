@@ -1,0 +1,53 @@
+"""Shared-compilation scenario sweeps (ROADMAP item 5).
+
+A declarative grid runner over
+{strategy x client algorithm x non-IID partitioner x cohort size x fault
+plan x seed x scalar hyperparameter} that executes every cell through the
+repo's chunked-scan round programs while compiling once per SHAPE BUCKET,
+not once per cell (FedJAX's shared-compilation argument,
+arXiv:2108.02117). Three mechanisms carry it:
+
+1. trace-time hyperparameter hoisting (:mod:`.hoisting`) — scalars that
+   would bake into the jaxpr become traced program inputs / state leaves;
+2. shape bucketing (:mod:`.bucketing`) — cohorts pad to buckets with
+   zero-weight phantom clients, banks pad to a group row budget;
+3. cell packing (:mod:`.runner`) — cells sharing an executable stack
+   along a leading cell axis and dispatch as one batched scan run.
+
+Every cell reproduces its standalone ``FederatedSimulation.fit()``
+trajectory bit-identically (tests/sweep/) — packing and padding are pure
+perf, never semantics. See ``docs/module_guides/sweeps.md``.
+"""
+
+from fl4health_tpu.sweep.bucketing import GroupKey, SweepGroup, SweepPlan
+from fl4health_tpu.sweep.hoisting import (
+    SCALAR_BINDINGS,
+    ScalarBinding,
+    applicable_scalars,
+    apply_state_scalars,
+    bind_traced_scalars,
+)
+from fl4health_tpu.sweep.runner import (
+    CellResult,
+    SweepResult,
+    SweepRunner,
+    run_sweep,
+)
+from fl4health_tpu.sweep.spec import SweepCell, SweepSpec
+
+__all__ = [
+    "CellResult",
+    "GroupKey",
+    "SCALAR_BINDINGS",
+    "ScalarBinding",
+    "SweepCell",
+    "SweepGroup",
+    "SweepPlan",
+    "SweepResult",
+    "SweepRunner",
+    "SweepSpec",
+    "applicable_scalars",
+    "apply_state_scalars",
+    "bind_traced_scalars",
+    "run_sweep",
+]

@@ -1,0 +1,170 @@
+"""Shape bucketing — the sweep compiles O(buckets), not O(cells).
+
+A compiled round program's identity is its SHAPES plus its closure
+constants. Across a scenario grid the shape-relevant facts are: the
+cohort axis length, the data banks' padded row counts, the scan length
+(rounds) and the per-round plan shapes (local_steps x batch). Everything
+else — seeds, partition contents, per-client sample counts, hoisted
+scalars — enters as program inputs. This module groups cells by the facts
+that DO force a distinct executable:
+
+- strategy name and client-algorithm name (different aggregation/client
+  math => different program structure);
+- fault-plan name (the chaos layer compiles into the round closure);
+- the cohort's shape BUCKET (smallest configured bucket >= cohort; cells
+  pad to it with phantom clients that are masked to zero weight and zero
+  sample count — the fractional-mask machinery the repo already trusts
+  for sampling/quarantine/async discounting);
+- the group's bank ROW BUDGET (max padded example rows over its cells —
+  each cell's stacked banks zero-pad up to it; padding rows are never
+  indexed by a valid plan, so gathered batches are bit-identical).
+
+Fault plans with probabilistic faults draw a ``[n_clients]`` uniform
+vector, so padding the cohort would change the draws for REAL clients;
+padded buckets therefore reject probability<1 fault plans loudly
+(deterministic faults are per-client-stable under padding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.sweep.spec import SweepCell, SweepSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupKey:
+    """Identity of one shared executable (one program group)."""
+
+    strategy: str
+    client: str
+    fault: str
+    bucket: int
+
+    def label(self) -> str:
+        parts = [self.strategy, self.client]
+        if self.fault != "none":
+            parts.append(self.fault)
+        parts.append(f"b{self.bucket}")
+        return "/".join(parts)
+
+
+@dataclasses.dataclass
+class SweepGroup:
+    key: GroupKey
+    cells: list[SweepCell]
+    train_row_budget: int = 0
+    val_row_budget: int = 0
+
+
+@dataclasses.dataclass
+class SweepPlan:
+    """The up-front bucket plan — reported before any compile happens."""
+
+    groups: list[SweepGroup]
+    n_cells: int
+
+    @property
+    def buckets(self) -> list[int]:
+        return sorted({g.key.bucket for g in self.groups})
+
+    def describe(self) -> dict:
+        return {
+            "cells": self.n_cells,
+            "groups": len(self.groups),
+            "buckets": self.buckets,
+            "group_cells": {g.key.label(): len(g.cells) for g in self.groups},
+        }
+
+
+def _require_padding_safe_fault(fault_plan, fault_name: str,
+                                cohort: int, bucket: int) -> None:
+    if fault_plan is None or bucket == cohort:
+        return
+    bad = [
+        f for f in getattr(fault_plan, "client_faults", ())
+        if getattr(f, "probability", 1.0) < 1.0
+    ]
+    if bad:
+        raise ValueError(
+            f"fault plan {fault_name!r} has probabilistic faults "
+            f"(probability < 1), whose per-round uniform draw is shaped "
+            f"[n_clients] — padding cohort {cohort} to bucket {bucket} "
+            "would change the draws for REAL clients and break the "
+            "standalone-reproduction contract. Use probability-1 faults "
+            "with padded buckets, or give this cohort its own bucket."
+        )
+
+
+def plan_groups(spec: SweepSpec, cells: list[SweepCell],
+                data_for) -> SweepPlan:
+    """Group cells into shared-executable buckets and size each group's
+    bank row budgets. ``data_for(partitioner, cohort)`` returns the cell's
+    (unpadded) datasets — memoized by the caller so each partition is
+    materialized once."""
+    groups: dict[GroupKey, SweepGroup] = {}
+    for cell in cells:
+        bucket = spec.bucket_for(cell.cohort)
+        _require_padding_safe_fault(
+            spec.fault_plans[cell.fault], cell.fault, cell.cohort, bucket
+        )
+        key = GroupKey(strategy=cell.strategy, client=cell.client,
+                       fault=cell.fault, bucket=bucket)
+        groups.setdefault(key, SweepGroup(key=key, cells=[])).cells.append(
+            cell
+        )
+    for g in groups.values():
+        for cell in g.cells:
+            datasets = data_for(cell.partitioner, cell.cohort)
+            g.train_row_budget = max(
+                g.train_row_budget,
+                max(engine.data_rows(d.x_train) for d in datasets),
+            )
+            g.val_row_budget = max(
+                g.val_row_budget,
+                max(engine.data_rows(d.x_val) for d in datasets),
+            )
+    return SweepPlan(groups=list(groups.values()), n_cells=len(cells))
+
+
+# -- padding helpers --------------------------------------------------------
+
+def pad_datasets(datasets: list, bucket: int) -> list:
+    """Pad a cohort to ``bucket`` clients with copies of client 0 — the
+    phantom clients train on real-shaped data (their packets stay finite)
+    but are masked to zero aggregation weight, zero sample count and zero
+    eval count by the runner, so they cannot influence any real client or
+    the server state."""
+    if len(datasets) >= bucket:
+        return list(datasets)
+    return list(datasets) + [datasets[0]] * (bucket - len(datasets))
+
+
+def pad_stack_rows(stack, rows: int):
+    """Zero-pad a ``[C, n, ...]`` client-stacked data bank along the row
+    axis up to the group's row budget. Padding rows are never selected by
+    a valid index plan, so the gathered batches — and therefore the cell's
+    trajectory — are bit-identical to the unpadded bank's."""
+    def pad(leaf):
+        n = leaf.shape[1]
+        if n >= rows:
+            return leaf
+        width = [(0, 0), (0, rows - n)] + [(0, 0)] * (leaf.ndim - 2)
+        return jnp.pad(leaf, width)
+
+    return jax.tree_util.tree_map(pad, stack)
+
+
+def padded_mask(mask: np.ndarray, bucket: int) -> np.ndarray:
+    """Extend a [C] participation mask with zeros for phantom clients."""
+    c = mask.shape[-1]
+    if c >= bucket:
+        return mask
+    pad = [(0, 0)] * (mask.ndim - 1) + [(0, bucket - c)]
+    return np.pad(mask, pad)

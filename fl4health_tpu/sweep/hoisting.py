@@ -1,0 +1,368 @@
+"""Trace-time scalar hyperparameter hoisting — the sweep's compile saver.
+
+A jitted round program bakes every Python-scalar hyperparameter it reads at
+trace time into the jaxpr as a constant, so a grid sweeping "server lr x
+trim fraction" recompiles per cell even though nothing about the program's
+SHAPE changed. FedJAX (arXiv:2108.02117) identifies exactly this as the
+dominant cost of federated-simulation grids. This module is the repo's
+fix: a registry of hoistable scalars plus two rebind mechanisms that turn
+them into *traced values* of one shared executable:
+
+- **state leaves** — scalars that already live in the carried server
+  state (FedProx's ``drift_penalty_weight``) or were moved there
+  (``fed_adam``-family server lr via ``optax.inject_hyperparams`` ->
+  ``opt_state.hyperparams``). Rebinding is pure state surgery
+  (:func:`apply_state_scalars`); every compiled program — standalone
+  pipelined, chunked, or sweep cell — picks the new value up as an input.
+- **attr injection** — scalars read off a strategy attribute at trace
+  time (``RobustFedAvg.trim_fraction``/``max_update_norm``,
+  ``FedBuff.staleness_exponent``, ``CompressingStrategy``'s adaptive
+  top-k schedule endpoints). :func:`bind_traced_scalars` temporarily sets
+  the attribute to a TRACER while the sweep's cell program traces, so the
+  jaxpr takes the scalar as a program input (the per-cell ``hvec``); the
+  async round programs additionally feed ``staleness_exponent`` as a live
+  dispatch input so even a standalone async run rebinds it recompile-free.
+
+Shape-affecting knobs stay static by design and are NOT registered here:
+``CompressionConfig.topk_fraction`` (sizes the top-k selection and wire
+sidecar), ``quant_bits`` (wire format), ``AsyncConfig.buffer_size`` /
+``max_staleness`` (event-plan identity), Krum's ``num_byzantine`` /
+``multi_krum_m`` (selection arithmetic is static config by contract).
+Sweeping those is still legal — the runner just gives each value its own
+program group (an honest compile, reported in the bucket plan).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def wrapper_chain(strategy) -> list:
+    """``[strategy, strategy.inner, ...]`` down to the innermost."""
+    chain = [strategy]
+    while hasattr(chain[-1], "inner"):
+        chain.append(chain[-1].inner)
+    return chain
+
+
+def _find_owner(strategy, owner_type):
+    for s in wrapper_chain(strategy):
+        if isinstance(s, owner_type):
+            return s
+    return None
+
+
+def _replace_owned_state(strategy, state, owner_type, fn):
+    """Apply ``fn(owner_strategy, owner_state) -> new_owner_state`` at the
+    wrapper-chain level owning the scalar, rebuilding wrapper states above
+    it. Wrappers whose state IS the inner state (RobustFedAvg, FedBuff)
+    have no ``.inner`` state level and pass straight through."""
+    if isinstance(strategy, owner_type):
+        return fn(strategy, state)
+    if not hasattr(strategy, "inner"):
+        raise KeyError(f"no {owner_type.__name__} in the strategy chain")
+    if hasattr(state, "inner"):
+        return state.replace(inner=_replace_owned_state(
+            strategy.inner, state.inner, owner_type, fn
+        ))
+    return _replace_owned_state(strategy.inner, state, owner_type, fn)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarBinding:
+    """One hoistable scalar hyperparameter.
+
+    ``kind="attr"``: read off ``owner().attr`` at trace time; the sweep
+    injects a tracer for it (``bind_traced_scalars``), so it becomes an
+    ``hvec`` program input. ``kind="state"``: already a leaf of the
+    carried server state; ``set_state(owner, owner_state, value)`` rebinds
+    it. ``owner`` is a zero-arg callable returning the owning strategy
+    TYPE (lazy import, keeps this module cycle-free)."""
+
+    name: str
+    kind: str  # "attr" | "state"
+    owner: Callable[[], type]
+    attr: str = ""
+    set_state: Callable[[Any, Any, float], Any] | None = None
+    validate: Callable[[float], None] | None = None
+    #: optional owner-aware validation (e.g. a schedule endpoint against
+    #: its config's static ceiling) — runs wherever a CONCRETE value is
+    #: bound (the sweep's cell-input resolution), since a traced hvec
+    #: slice can only be range-clamped in-graph
+    validate_owner: Callable[[Any, float], None] | None = None
+    doc: str = ""
+
+    def find(self, strategy):
+        return _find_owner(strategy, self.owner())
+
+    def check(self, strategy, value: float) -> None:
+        """Validate a concrete value for this knob on this strategy chain."""
+        if self.validate is not None:
+            self.validate(float(value))
+        if self.validate_owner is not None:
+            owner = self.find(strategy)
+            if owner is not None:
+                self.validate_owner(owner, float(value))
+
+    def applies(self, strategy) -> bool:
+        owner = self.find(strategy)
+        if owner is None:
+            return False
+        if self.kind == "attr":
+            # an attr whose default is None encodes "feature not enabled"
+            # (e.g. no topk_schedule configured) — not sweepable then
+            return getattr(owner, self.attr, None) is not None
+        return True
+
+    def default(self, strategy) -> float:
+        owner = self.find(strategy)
+        if self.kind == "attr":
+            return float(getattr(owner, self.attr))
+        return float(self._state_default(owner))
+
+    def _state_default(self, owner) -> float:
+        raise NotImplementedError  # overridden per-binding below
+
+
+def _validate_fraction_half(v: float) -> None:
+    if not 0.0 <= v < 0.5:
+        raise ValueError(f"trim_fraction must be in [0, 0.5); got {v}")
+
+
+def _validate_positive(name: str):
+    def check(v: float) -> None:
+        if v <= 0:
+            raise ValueError(f"{name} must be positive; got {v}")
+    return check
+
+
+def _validate_nonnegative(name: str):
+    def check(v: float) -> None:
+        if v < 0:
+            raise ValueError(f"{name} must be >= 0; got {v}")
+    return check
+
+
+def _validate_unit(name: str):
+    def check(v: float) -> None:
+        if not 0.0 < v <= 1.0:
+            raise ValueError(f"{name} must be in (0, 1]; got {v}")
+    return check
+
+
+def _validate_under_topk_ceiling(name: str):
+    """Schedule endpoints above the static ``topk_fraction`` ceiling would
+    be silently clamped in-graph — two 'different' sweep cells running the
+    identical config. Reject at bind time instead, mirroring
+    ``CompressionConfig.__post_init__``'s static-schedule rule."""
+    def check(owner, v: float) -> None:
+        ceiling = owner.config.topk_fraction
+        if ceiling is not None and v > float(ceiling):
+            raise ValueError(
+                f"{name}={v} exceeds the static topk_fraction ceiling "
+                f"{ceiling} — the effective fraction would clamp to the "
+                "ceiling and the cell would silently duplicate the "
+                f"ceiling config; sweep values <= {ceiling}, or raise "
+                "topk_fraction"
+            )
+    return check
+
+
+# -- state-kind setters -----------------------------------------------------
+
+def _injected_hyperparams(opt_state) -> dict:
+    """The ``inject_hyperparams`` leaf dict of a FedOpt opt_state, or a
+    helpful error naming the factories that provide it."""
+    hp = getattr(opt_state, "hyperparams", None)
+    if hp is None or "learning_rate" not in hp:
+        raise ValueError(
+            "server_lr hoisting needs the server optimizer built through "
+            "optax.inject_hyperparams (the fed_adam/fed_yogi/fed_adagrad/"
+            "fed_avg_m factories do this); this FedOpt's opt_state has no "
+            "hyperparams['learning_rate'] leaf to rebind"
+        )
+    return hp
+
+
+def _set_server_lr(owner, owner_state, value: float):
+    opt_state = owner_state.opt_state
+    hp = _injected_hyperparams(opt_state)
+    lr = hp["learning_rate"]
+    new_hp = dict(hp)
+    new_hp["learning_rate"] = jnp.asarray(value, lr.dtype)
+    return owner_state.replace(opt_state=opt_state._replace(hyperparams=new_hp))
+
+
+def _set_proximal_weight(owner, owner_state, value: float):
+    return owner_state.replace(
+        drift_penalty_weight=jnp.asarray(
+            value, owner_state.drift_penalty_weight.dtype
+        )
+    )
+
+
+# -- the registry -----------------------------------------------------------
+
+def _fedopt_type():
+    from fl4health_tpu.strategies.fedopt import FedOpt
+    return FedOpt
+
+
+def _adaptive_constraint_type():
+    from fl4health_tpu.strategies.fedprox import FedAvgWithAdaptiveConstraint
+    return FedAvgWithAdaptiveConstraint
+
+
+def _robust_type():
+    from fl4health_tpu.resilience.aggregators import RobustFedAvg
+    return RobustFedAvg
+
+
+def _fedbuff_type():
+    from fl4health_tpu.strategies.fedbuff import FedBuff
+    return FedBuff
+
+
+def _compressing_type():
+    from fl4health_tpu.compression.strategy import CompressingStrategy
+    return CompressingStrategy
+
+
+class _ServerLrBinding(ScalarBinding):
+    def _state_default(self, owner) -> float:
+        # the factory-time value lives in the (not-yet-initialized)
+        # transform; read it from a throwaway init on a scalar template
+        state = owner.tx.init(jnp.zeros((1,), jnp.float32))
+        return float(_injected_hyperparams(state)["learning_rate"])
+
+
+class _MuBinding(ScalarBinding):
+    def _state_default(self, owner) -> float:
+        return float(owner.mu0)
+
+
+SCALAR_BINDINGS: dict[str, ScalarBinding] = {
+    b.name: b
+    for b in (
+        _ServerLrBinding(
+            name="server_lr", kind="state", owner=_fedopt_type,
+            set_state=_set_server_lr,
+            validate=_validate_positive("server_lr"),
+            doc="FedOpt-family server learning rate "
+                "(opt_state.hyperparams['learning_rate'] leaf)",
+        ),
+        _MuBinding(
+            name="proximal_weight", kind="state",
+            owner=_adaptive_constraint_type,
+            set_state=_set_proximal_weight,
+            validate=_validate_nonnegative("proximal_weight"),
+            doc="FedProx drift-penalty weight mu "
+                "(AdaptiveConstraintState.drift_penalty_weight leaf, "
+                "broadcast to clients in the payload)",
+        ),
+        ScalarBinding(
+            name="trim_fraction", kind="attr", owner=_robust_type,
+            attr="trim_fraction", validate=_validate_fraction_half,
+            doc="RobustFedAvg trimmed-mean per-end trim fraction "
+                "(rank weights over the sorted clients axis)",
+        ),
+        ScalarBinding(
+            name="max_update_norm", kind="attr", owner=_robust_type,
+            attr="max_update_norm",
+            validate=_validate_positive("max_update_norm"),
+            doc="RobustFedAvg norm-bounded-mean clip bound on each "
+                "client's update norm",
+        ),
+        ScalarBinding(
+            name="staleness_exponent", kind="attr", owner=_fedbuff_type,
+            attr="staleness_exponent",
+            validate=_validate_nonnegative("staleness_exponent"),
+            doc="FedBuff staleness discount exponent 1/(1+s)^e (async "
+                "round programs feed it as a live dispatch input)",
+        ),
+        ScalarBinding(
+            name="topk_f_start", kind="attr", owner=_compressing_type,
+            attr="topk_f_start", validate=_validate_unit("topk_f_start"),
+            validate_owner=_validate_under_topk_ceiling("topk_f_start"),
+            doc="CompressingStrategy adaptive top-k schedule start "
+                "fraction (requires CompressionConfig.topk_schedule)",
+        ),
+        ScalarBinding(
+            name="topk_f_end", kind="attr", owner=_compressing_type,
+            attr="topk_f_end", validate=_validate_unit("topk_f_end"),
+            validate_owner=_validate_under_topk_ceiling("topk_f_end"),
+            doc="CompressingStrategy adaptive top-k schedule end "
+                "fraction (requires CompressionConfig.topk_schedule)",
+        ),
+    )
+}
+
+
+def binding(name: str) -> ScalarBinding:
+    try:
+        return SCALAR_BINDINGS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown sweep scalar {name!r}; registered hoistable scalars: "
+            f"{sorted(SCALAR_BINDINGS)}"
+        ) from None
+
+
+def applicable_scalars(strategy) -> list[str]:
+    """Registered scalar names the given strategy chain can rebind,
+    registry order."""
+    return [n for n, b in SCALAR_BINDINGS.items() if b.applies(strategy)]
+
+
+def apply_state_scalars(strategy, server_state, values: dict[str, float]):
+    """Rebind state-kind scalars on a freshly-initialized server state —
+    the sweep's per-cell override for hyperparameters that live as state
+    leaves. Values are validated; unknown names raise."""
+    for name, value in values.items():
+        b = binding(name)
+        if b.kind != "state":
+            raise ValueError(
+                f"{name} is an attr-kind scalar; it rebinds through "
+                "bind_traced_scalars / the cell program's hvec input"
+            )
+        b.check(strategy, value)
+        server_state = _replace_owned_state(
+            strategy, server_state, b.owner(),
+            lambda owner, st: b.set_state(owner, st, float(value)),
+        )
+    return server_state
+
+
+@contextlib.contextmanager
+def bind_traced_scalars(strategy, values: dict[str, Any]):
+    """Temporarily set attr-kind scalars on their owning strategy objects
+    — typically to TRACERS, inside the trace of a sweep cell program, so
+    the jaxpr reads them as program inputs instead of baked constants.
+    Restores the original attributes on exit (also on error), so the
+    strategy object is unchanged for any later trace."""
+    saved: list[tuple[Any, str, Any]] = []
+    try:
+        for name, value in values.items():
+            b = binding(name)
+            if b.kind != "attr":
+                raise ValueError(
+                    f"{name} is a state-kind scalar; rebind it with "
+                    "apply_state_scalars on the cell's server state"
+                )
+            owner = b.find(strategy)
+            if owner is None:
+                raise ValueError(
+                    f"scalar {name!r} does not apply to this strategy "
+                    f"chain ({'/'.join(type(s).__name__ for s in wrapper_chain(strategy))})"
+                )
+            saved.append((owner, b.attr, getattr(owner, b.attr)))
+            setattr(owner, b.attr, value)
+        yield
+    finally:
+        for owner, attr, old in reversed(saved):
+            setattr(owner, attr, old)

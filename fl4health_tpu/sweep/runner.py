@@ -1,0 +1,602 @@
+"""SweepRunner — execute a scenario grid in O(buckets) compiles.
+
+Execution model (FedJAX's shared-compilation argument, arXiv:2108.02117):
+
+1. ``SweepSpec.expand_cells`` materializes the grid;
+   ``bucketing.plan_groups`` partitions it into PROGRAM GROUPS — cells
+   that can share one compiled executable (same strategy/client/fault
+   structure, same cohort bucket, same bank row budget).
+2. Per group, ONE template :class:`FederatedSimulation` is built and its
+   round closures (``_build_round_fns``) are wrapped into a *cell
+   program*: a chunked ``lax.scan`` over rounds whose per-cell variation
+   — seeds (initial states), data partitions (banks + index plans +
+   sample counts), participation masks, hoisted scalars (``hvec`` +
+   state leaves) — enters exclusively through PROGRAM INPUTS.
+3. Cells of a group either dispatch sequentially through the one jitted
+   cell program, or (``spec.pack=True``) stack along a new leading cell
+   axis and run as one ``lax.scan``-over-cells dispatch per pack — the
+   body is the very same cell-program closure, so packing is pure
+   dispatch amortization, never semantics.
+
+The standalone-reproduction contract: every cell's loss trajectory is
+bit-identical to ``FederatedSimulation.fit()`` on the same configuration
+(same seeds => same trajectory), pinned by
+tests/sweep/test_sweep.py::TestParity on both execution modes. Compile accounting rides the repo's
+``CompileMonitor`` (jax.monitoring backend-compile events), so the
+"compiles O(buckets) not O(cells)" claim is a measured artifact (the
+bench ``sweep`` block and ``fl_sweep_*`` metrics), not an assertion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.observability.jaxmon import CompileMonitor
+from fl4health_tpu.observability.registry import MetricsRegistry
+from fl4health_tpu.server.client_manager import FullParticipationManager
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.sweep import bucketing
+from fl4health_tpu.sweep.bucketing import SweepGroup, SweepPlan
+from fl4health_tpu.sweep.hoisting import (
+    SCALAR_BINDINGS,
+    apply_state_scalars,
+    bind_traced_scalars,
+    binding,
+)
+from fl4health_tpu.sweep.spec import SweepCell, SweepSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class CellResult:
+    """One cell's leaderboard row."""
+
+    cell: SweepCell
+    bucket: int
+    group: str
+    fit_losses: list[float]
+    eval_losses: list[float]
+    final_fit_loss: float
+    final_eval_loss: float
+    best_eval_loss: float
+    rounds_to_target: int | None
+    steps_per_s: float
+    wall_s: float
+    compiles_attributed: float
+
+    def row(self) -> dict:
+        """JSON-able leaderboard row (the ``sweep`` JSONL event body)."""
+        return {
+            "cell": self.cell.index,
+            "label": self.cell.label(),
+            "strategy": self.cell.strategy,
+            "client": self.cell.client,
+            "partitioner": self.cell.partitioner,
+            "cohort": self.cell.cohort,
+            "bucket": self.bucket,
+            "fault": self.cell.fault,
+            "seed": self.cell.seed,
+            "scalars": dict(self.cell.scalars),
+            "final_fit_loss": self.final_fit_loss,
+            "final_eval_loss": self.final_eval_loss,
+            "best_eval_loss": self.best_eval_loss,
+            "rounds_to_target": self.rounds_to_target,
+            "steps_per_s": self.steps_per_s,
+            "wall_s": self.wall_s,
+            "compiles_attributed": self.compiles_attributed,
+        }
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Everything a leaderboard / bench block needs.
+
+    ``programs_compiled`` counts XLA backend compiles during CELL-PROGRAM
+    DISPATCH — the executables the grid actually runs through, the number
+    shape bucketing + scalar hoisting exist to amortize. One-time host
+    staging warmup (per-cell state init, bank stacking: small eager ops
+    each compiling once per process regardless of grid size) is reported
+    separately as ``setup_compiles`` so neither number launders the
+    other."""
+
+    cells: list[CellResult]
+    plan: SweepPlan
+    programs_compiled: int
+    compile_s_total: float
+    setup_compiles: int
+    setup_compile_s: float
+    wall_s: float
+    pack: bool
+
+    @property
+    def cells_per_compile(self) -> float | None:
+        if self.programs_compiled <= 0:
+            return None
+        return len(self.cells) / self.programs_compiled
+
+    def leaderboard(self) -> list[CellResult]:
+        """Cells sorted best-final-eval-loss first (NaNs last)."""
+        def sort_key(r: CellResult):
+            v = r.final_eval_loss
+            return (not np.isfinite(v), v)
+        return sorted(self.cells, key=sort_key)
+
+    def bench_block(self) -> dict:
+        """The bench artifact's ``sweep`` block — the compile-amortization
+        claim as measured numbers."""
+        return {
+            "cells": len(self.cells),
+            "buckets": self.plan.buckets,
+            "groups": len(self.plan.groups),
+            "programs_compiled": self.programs_compiled,
+            "compile_s_total": self.compile_s_total,
+            "cells_per_compile": self.cells_per_compile,
+            "setup_compiles": self.setup_compiles,
+            "setup_compile_s": self.setup_compile_s,
+            "wall_s": self.wall_s,
+            "packed": self.pack,
+        }
+
+
+class SweepRunner:
+    """Execute a :class:`SweepSpec`; see the module docstring.
+
+    ``observability``: optional armed :class:`Observability` — when
+    enabled, the runner logs one ``sweep_plan`` event up front, one
+    ``sweep`` event per cell (the leaderboard rows
+    ``tools/perf_report.py --sweep`` renders) and one ``sweep_summary``
+    event, plus ``fl_sweep_*`` registry metrics. Compile accounting uses
+    the runner's own private registry/CompileMonitor either way, so the
+    measured ``programs_compiled`` never depends on observability being
+    on.
+    """
+
+    def __init__(self, spec: SweepSpec, observability: Any = None):
+        self.spec = spec
+        self.obs = observability
+        self._data_cache: dict[tuple[str, int], list[ClientDataset]] = {}
+        # staged device banks + eval batching, keyed by everything that
+        # shapes them — cells differing only in seeds/scalars reuse the
+        # exact same staged arrays instead of re-stacking per cell
+        self._bank_cache: dict[tuple, tuple] = {}
+
+    # -- data ----------------------------------------------------------
+    def _data_for(self, partitioner: str, cohort: int) -> list[ClientDataset]:
+        key = (partitioner, cohort)
+        if key not in self._data_cache:
+            datasets = list(self.spec.partitioners[partitioner](cohort))
+            if len(datasets) != cohort:
+                raise ValueError(
+                    f"partitioner {partitioner!r} returned {len(datasets)} "
+                    f"datasets for cohort {cohort}"
+                )
+            self._data_cache[key] = datasets
+        return self._data_cache[key]
+
+    # -- group machinery ------------------------------------------------
+    def _template_sim(self, group: SweepGroup) -> FederatedSimulation:
+        spec, key = self.spec, group.key
+        cell0 = group.cells[0]
+        datasets = bucketing.pad_datasets(
+            self._data_for(cell0.partitioner, cell0.cohort), key.bucket
+        )
+        metrics = spec.metrics() if spec.metrics is not None else (
+            MetricManager(())
+        )
+        return FederatedSimulation(
+            logic=spec.clients[key.client](),
+            tx=spec.tx(),
+            strategy=spec.strategies[key.strategy](),
+            datasets=datasets,
+            batch_size=spec.batch_size,
+            metrics=metrics,
+            local_steps=spec.local_steps,
+            seed=cell0.seed,
+            fault_plan=spec.fault_plans[key.fault],
+        )
+
+    def _group_hoisted_axes(self, sim: FederatedSimulation) -> list[str]:
+        """attr-kind hoisted scalars this group's cell program takes as
+        its ``hvec`` input — every applicable attr-kind binding (swept or
+        not: un-swept ones ride at their defaults, so the hvec layout is
+        a property of the GROUP, not of which cells sweep what)."""
+        return [
+            name for name, b in SCALAR_BINDINGS.items()
+            if b.kind == "attr" and b.applies(sim.strategy)
+        ]
+
+    def _build_cell_program(self, sim: FederatedSimulation,
+                            hoisted: list[str]):
+        """The group's shared cell program: a chunked fit+eval scan over
+        rounds, with sample counts and the hoisted scalars as traced
+        inputs. Body math mirrors ``_make_chunked_fit_with_eval`` (minus
+        telemetry/test-split), so a cell's trajectory is the standalone
+        chunked ``fit()`` trajectory bit-for-bit."""
+        fit_round, eval_round = sim._build_round_fns(False)
+        strategy = sim.strategy
+
+        def cell_body(cell):
+            overrides = {
+                name: cell["hvec"][i] for i, name in enumerate(hoisted)
+            }
+            with bind_traced_scalars(strategy, overrides):
+                def body(carry, per_round):
+                    server_state, client_states, r = carry
+                    idx_r, em_r, sm_r, mask_r = per_round
+                    batches = engine.gather_batches(
+                        cell["x_bank"], cell["y_bank"], idx_r, em_r, sm_r
+                    )
+                    (server_state, client_states, fit_losses, fit_metrics,
+                     _per) = fit_round(
+                        server_state, client_states, batches, mask_r, r,
+                        cell["val_batches"], cell["sample_counts"],
+                    )
+                    (client_states, ev_losses, ev_metrics, _pl,
+                     _pm) = eval_round(
+                        server_state, client_states, cell["val_batches"],
+                        cell["val_counts"],
+                    )
+                    out = {
+                        "fit_losses": fit_losses,
+                        "fit_metrics": fit_metrics,
+                        "eval_losses": ev_losses,
+                        "eval_metrics": ev_metrics,
+                    }
+                    return (server_state, client_states, r + 1), out
+
+                (_, _, _), outs = jax.lax.scan(
+                    body,
+                    (cell["server_state"], cell["client_states"],
+                     jnp.asarray(1, jnp.int32)),
+                    (cell["idx"], cell["em"], cell["sm"], cell["masks"]),
+                )
+            return outs
+
+        def packed(cells_in):
+            def body(carry, cell):
+                return carry, cell_body(cell)
+
+            _, outs = jax.lax.scan(body, 0, cells_in)
+            return outs
+
+        return jax.jit(cell_body), jax.jit(packed)
+
+    def _staged_banks(self, cell: SweepCell, group: SweepGroup,
+                      datasets: list) -> tuple:
+        """Staged device banks + eval batching + count vectors for one
+        cell — memoized on everything that shapes them (partitioner,
+        cohort, bucket, group row budgets), so a seed/scalar sweep reuses
+        the identical staged arrays instead of re-stacking them per cell.
+        Safe to share across dispatches: the cell programs never donate
+        their inputs."""
+        spec, bucket = self.spec, group.key.bucket
+        key = (cell.partitioner, cell.cohort, bucket,
+               group.train_row_budget, group.val_row_budget)
+        if key in self._bank_cache:
+            return self._bank_cache[key]
+        # data banks, padded to the group's shared row budgets
+        x_bank = bucketing.pad_stack_rows(
+            engine.pad_and_stack_data([d.x_train for d in datasets],
+                                      "x_train"),
+            group.train_row_budget,
+        )
+        y_bank = bucketing.pad_stack_rows(
+            engine.pad_and_stack_data([d.y_train for d in datasets],
+                                      "y_train"),
+            group.train_row_budget,
+        )
+        # eval split: fixed-order full pass, padded to the group's val
+        # step budget with zero-mask steps (never scored)
+        ns_val = [engine.data_rows(d.x_val) for d in datasets]
+        v_idx, v_em, v_sm = engine.multi_client_index_plans(
+            [[0]] * bucket, ns_val, spec.batch_size, shuffle=False
+        )
+        val_steps = -(-group.val_row_budget // spec.batch_size)
+        pad_steps = val_steps - v_idx.shape[1]
+        if pad_steps > 0:
+            v_idx = np.pad(v_idx, ((0, 0), (0, pad_steps), (0, 0)))
+            v_em = np.pad(v_em, ((0, 0), (0, pad_steps), (0, 0)))
+            v_sm = np.pad(v_sm, ((0, 0), (0, pad_steps)))
+        x_val = bucketing.pad_stack_rows(
+            engine.pad_and_stack_data([d.x_val for d in datasets], "x_val"),
+            group.val_row_budget,
+        )
+        y_val = bucketing.pad_stack_rows(
+            engine.pad_and_stack_data([d.y_val for d in datasets], "y_val"),
+            group.val_row_budget,
+        )
+        val_batches = engine.gather_batches(x_val, y_val, v_idx, v_em, v_sm)
+        val_counts = np.asarray(ns_val, np.float32)
+        sample_counts = np.asarray(
+            [d.n_train for d in datasets], np.float32
+        )
+        if bucket > cell.cohort:
+            # phantom clients: zero aggregation weight, zero eval weight
+            val_counts[cell.cohort:] = 0.0
+            sample_counts[cell.cohort:] = 0.0
+        staged = (x_bank, y_bank, val_batches,
+                  jnp.asarray(val_counts), jnp.asarray(sample_counts))
+        self._bank_cache[key] = staged
+        return staged
+
+    def _cell_inputs(self, sim: FederatedSimulation, group: SweepGroup,
+                     cell: SweepCell, hoisted: list[str]) -> dict:
+        """Build one cell's program inputs: re-seed the template sim's
+        states exactly as a standalone construction would, stage the
+        cell's padded banks/plans, and resolve scalar overrides."""
+        spec, bucket = self.spec, group.key.bucket
+        datasets = bucketing.pad_datasets(
+            self._data_for(cell.partitioner, cell.cohort), bucket
+        )
+        # per-cell state init — the constructor's exact derivation
+        sim.datasets = datasets
+        sim.rng = jax.random.PRNGKey(cell.seed)
+        sim._base_entropy = engine._entropy_from_key(sim.rng)
+        sim._init_states()
+        server_state = apply_state_scalars(
+            sim.strategy, sim.server_state,
+            {k: v for k, v in cell.scalars if binding(k).kind == "state"},
+        )
+        (x_bank, y_bank, val_batches, val_counts,
+         sample_counts) = self._staged_banks(cell, group, datasets)
+        # train plans (same PRNG-stream derivation as the standalone fit)
+        plans = [sim._round_plan(r) for r in range(1, spec.rounds + 1)]
+        idx = np.stack([p[0] for p in plans])
+        em = np.stack([p[1] for p in plans])
+        sm = np.stack([p[2] for p in plans])
+        # participation: full cohort, phantoms masked out (a standalone
+        # run draws the same all-ones mask for its real clients)
+        manager = FullParticipationManager(cell.cohort)
+        masks = np.stack([
+            bucketing.padded_mask(
+                np.asarray(manager.sample(
+                    jax.random.fold_in(sim.rng, 2000 + r), r
+                )),
+                bucket,
+            )
+            for r in range(1, spec.rounds + 1)
+        ])
+        # hoisted attr scalars: cell overrides or the strategy's defaults
+        defaults = {
+            name: SCALAR_BINDINGS[name].default(sim.strategy)
+            for name in hoisted
+        }
+        overrides = {
+            k: v for k, v in cell.scalars
+            if binding(k).kind == "attr"
+        }
+        for k, v in overrides.items():
+            binding(k).check(sim.strategy, v)
+        hvec = np.asarray(
+            [overrides.get(name, defaults[name]) for name in hoisted],
+            np.float32,
+        )
+        return {
+            "server_state": server_state,
+            "client_states": sim.client_states,
+            "x_bank": x_bank,
+            "y_bank": y_bank,
+            "idx": jnp.asarray(idx),
+            "em": jnp.asarray(em),
+            "sm": jnp.asarray(sm),
+            "masks": jnp.asarray(masks),
+            "val_batches": val_batches,
+            "val_counts": jnp.asarray(val_counts),
+            "sample_counts": jnp.asarray(sample_counts),
+            "hvec": jnp.asarray(hvec),
+        }
+
+    # -- execution -------------------------------------------------------
+    def run(self) -> SweepResult:
+        spec = self.spec
+        cells = spec.expand_cells()
+        plan = bucketing.plan_groups(spec, cells, self._data_for)
+        obs = self.obs if (self.obs is not None
+                           and getattr(self.obs, "enabled", False)) else None
+        # private compile accounting: the claim must not depend on
+        # observability being configured
+        registry = MetricsRegistry()
+        monitor = CompileMonitor(registry).install()
+        logger.info(
+            "sweep: %d cells -> %d program groups (buckets %s)",
+            plan.n_cells, len(plan.groups), plan.buckets,
+        )
+        if obs is not None:
+            obs.log_event(
+                "sweep_plan", **plan.describe(),
+                pack=spec.pack, max_pack=spec.max_pack,
+            )
+        t_start = time.perf_counter()
+        compiles0 = registry.counter("jax_backend_compiles_total").value
+        compile_s0 = registry.counter(
+            "jax_backend_compiles_seconds_total").value
+        results: list[CellResult] = []
+        dispatch_compiles = 0.0
+        dispatch_compile_s = 0.0
+        try:
+            for group in plan.groups:
+                group_results, g_compiles, g_compile_s = self._run_group(
+                    group, registry, obs
+                )
+                results.extend(group_results)
+                dispatch_compiles += g_compiles
+                dispatch_compile_s += g_compile_s
+        finally:
+            monitor.uninstall()
+        wall_s = time.perf_counter() - t_start
+        total_compiles = (
+            registry.counter("jax_backend_compiles_total").value - compiles0
+        )
+        total_compile_s = (
+            registry.counter("jax_backend_compiles_seconds_total").value
+            - compile_s0
+        )
+        results.sort(key=lambda r: r.cell.index)
+        out = SweepResult(
+            cells=results, plan=plan,
+            programs_compiled=int(dispatch_compiles),
+            compile_s_total=dispatch_compile_s,
+            setup_compiles=int(total_compiles - dispatch_compiles),
+            setup_compile_s=max(0.0, total_compile_s - dispatch_compile_s),
+            wall_s=wall_s, pack=spec.pack,
+        )
+        if obs is not None:
+            obs.log_event("sweep_summary", **out.bench_block())
+            reg = obs.registry
+            reg.counter(
+                "fl_sweep_cells_total",
+                help="sweep grid cells executed",
+            ).inc(len(results))
+            reg.gauge(
+                "fl_sweep_programs_compiled",
+                help="XLA backend compiles the sweep's cell dispatches "
+                     "paid (shared across cells via shape bucketing + "
+                     "scalar hoisting)",
+            ).set(float(out.programs_compiled))
+            if out.cells_per_compile is not None:
+                reg.gauge(
+                    "fl_sweep_cells_per_compile",
+                    help="grid cells amortized per compiled program",
+                ).set(float(out.cells_per_compile))
+            reg.counter(
+                "fl_sweep_compile_seconds_total",
+                help="XLA compile seconds of the sweep's cell dispatches",
+            ).inc(max(0.0, float(out.compile_s_total)))
+            reg.gauge(
+                "fl_sweep_wall_seconds",
+                help="wall seconds of the whole sweep run",
+            ).set(float(out.wall_s))
+        return out
+
+    def _run_group(self, group: SweepGroup, registry: MetricsRegistry,
+                   obs) -> tuple[list[CellResult], float, float]:
+        """Run one program group; returns (cell results, dispatch-bracket
+        compile count, dispatch-bracket compile seconds). The compile
+        brackets open right before each jitted cell/pack dispatch — input
+        staging (per-cell state init, bank stacking: one-time eager-op
+        warmup independent of grid size) is measured by the caller as
+        ``setup_compiles`` instead."""
+        spec = self.spec
+        sim = self._template_sim(group)
+        hoisted = self._group_hoisted_axes(sim)
+        cell_jit, packed_jit = self._build_cell_program(sim, hoisted)
+        results: list[CellResult] = []
+        t_group = time.perf_counter()
+        compiles = registry.counter("jax_backend_compiles_total")
+        compile_s = registry.counter("jax_backend_compiles_seconds_total")
+        group_compiles = group_compile_s = 0.0
+        outs_per_cell: list[tuple[SweepCell, dict, float]] = []
+        # inputs are staged one PACK at a time (not the whole group): a
+        # cell's inputs hold full padded data banks, so group-wide staging
+        # would scale device memory with the grid instead of the pack
+        if spec.pack:
+            # ONE pack size per group: the remainder chunk pads to the
+            # group's pack size by repeating its first cell (duplicate
+            # outputs discarded) — a little redundant compute instead of
+            # a second multi-second XLA compile for the odd shape
+            pack_size = min(spec.max_pack, len(group.cells))
+            for i in range(0, len(group.cells), pack_size):
+                chunk = group.cells[i:i + pack_size]
+                inputs = [self._cell_inputs(sim, group, cell, hoisted)
+                          for cell in chunk]
+                inputs += [inputs[0]] * (pack_size - len(chunk))
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *inputs
+                ) if len(inputs) > 1 else jax.tree_util.tree_map(
+                    lambda x: jnp.expand_dims(x, 0), inputs[0]
+                )
+                del inputs
+                jax.block_until_ready(stacked)
+                c0, s0 = compiles.value, compile_s.value
+                t0 = time.perf_counter()
+                outs = packed_jit(stacked)
+                outs = jax.device_get(jax.block_until_ready(outs))
+                wall = time.perf_counter() - t0
+                del stacked
+                pack_compile_s = compile_s.value - s0
+                group_compiles += compiles.value - c0
+                group_compile_s += pack_compile_s
+                # honest per-cell wall: the first dispatch's XLA compile
+                # lands in compile_s_total, never in throughput numbers
+                per_cell_wall = max(wall - pack_compile_s, 0.0) / len(chunk)
+                for j, cell in enumerate(chunk):
+                    cell_outs = jax.tree_util.tree_map(
+                        lambda a: a[j], outs
+                    )
+                    outs_per_cell.append((cell, cell_outs, per_cell_wall))
+        else:
+            for cell in group.cells:
+                inp = self._cell_inputs(sim, group, cell, hoisted)
+                jax.block_until_ready(inp)
+                c0, s0 = compiles.value, compile_s.value
+                t0 = time.perf_counter()
+                outs = cell_jit(inp)
+                outs = jax.device_get(jax.block_until_ready(outs))
+                wall = time.perf_counter() - t0
+                cell_compile_s = compile_s.value - s0
+                outs_per_cell.append(
+                    (cell, outs, max(wall - cell_compile_s, 0.0))
+                )
+                del inp
+                group_compiles += compiles.value - c0
+                group_compile_s += cell_compile_s
+        attributed = group_compiles / max(len(group.cells), 1)
+        for cell, outs, wall in outs_per_cell:
+            results.append(self._cell_result(
+                group, cell, outs, wall, attributed
+            ))
+        if obs is not None:
+            for r in results:
+                obs.log_event("sweep", **r.row())
+        logger.info(
+            "sweep group %s: %d cells, %d program compiles, %.2fs",
+            group.key.label(), len(group.cells), int(group_compiles),
+            time.perf_counter() - t_group,
+        )
+        return results, group_compiles, group_compile_s
+
+    def _cell_result(self, group: SweepGroup, cell: SweepCell, outs: dict,
+                     wall: float, compiles_attributed: float) -> CellResult:
+        spec = self.spec
+        fit_traj = [float(v) for v in
+                    np.asarray(outs["fit_losses"]["backward"])]
+        eval_traj = [float(v) for v in
+                     np.asarray(outs["eval_losses"]["checkpoint"])]
+        finite = [v for v in eval_traj if np.isfinite(v)]
+        best = min(finite) if finite else float("nan")
+        rtt = None
+        if spec.target_eval_loss is not None:
+            for i, v in enumerate(eval_traj):
+                if np.isfinite(v) and v <= spec.target_eval_loss:
+                    rtt = i + 1
+                    break
+        steps = spec.rounds * spec.local_steps * cell.cohort
+        return CellResult(
+            cell=cell,
+            bucket=group.key.bucket,
+            group=group.key.label(),
+            fit_losses=fit_traj,
+            eval_losses=eval_traj,
+            final_fit_loss=fit_traj[-1],
+            final_eval_loss=eval_traj[-1],
+            best_eval_loss=best,
+            rounds_to_target=rtt,
+            steps_per_s=steps / wall if wall > 0 else 0.0,
+            wall_s=wall,
+            compiles_attributed=compiles_attributed,
+        )
+
+
+def run_sweep(spec: SweepSpec, observability: Any = None) -> SweepResult:
+    """Convenience one-shot: ``SweepRunner(spec, observability).run()``."""
+    return SweepRunner(spec, observability).run()

@@ -1,0 +1,203 @@
+"""Per-round adaptive top-k fraction (CompressionConfig.topk_schedule):
+the effective kept fraction is a TRACED scalar schedule over rounds inside
+one compiled program — the static ``topk_fraction`` ceiling fixes the
+selection shape, rank weights do the adapting — and the schedule endpoints
+ride the sweep engine's scalar-hoisting machinery as sweepable axes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.compression.codecs import compress_update, topk_mask
+from fl4health_tpu.compression.config import CompressionConfig
+from fl4health_tpu.compression.strategy import CompressingStrategy
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+N_CLASSES = 3
+
+
+class TestScheduleConfig:
+    def test_requires_ceiling(self):
+        with pytest.raises(ValueError, match="ceiling"):
+            CompressionConfig(topk_schedule=("linear", 0.5, 0.1, 4))
+
+    def test_endpoints_must_fit_under_ceiling(self):
+        with pytest.raises(ValueError, match="f_end"):
+            CompressionConfig(topk_fraction=0.3,
+                              topk_schedule=("linear", 0.2, 0.5, 4))
+
+    def test_shape_and_kind_validated(self):
+        with pytest.raises(ValueError, match="linear"):
+            CompressionConfig(topk_fraction=0.5,
+                              topk_schedule=("cosine", 0.5, 0.1, 4))
+        with pytest.raises(ValueError, match="over_rounds"):
+            CompressionConfig(topk_fraction=0.5,
+                              topk_schedule=("linear", 0.5, 0.1, 0))
+
+    def test_describe_gains_key_only_with_schedule(self):
+        plain = CompressionConfig(topk_fraction=0.5)
+        assert "topk_schedule" not in plain.describe()
+        sched = CompressionConfig(
+            topk_fraction=0.5, topk_schedule=("linear", 0.5, 0.1, 4)
+        )
+        assert sched.describe()["topk_schedule"] == ["linear", 0.5, 0.1, 4]
+
+
+class TestEffectiveFraction:
+    def _strategy(self, over=5):
+        return CompressingStrategy(
+            FedAvg(),
+            CompressionConfig(topk_fraction=0.5, error_feedback=False,
+                              topk_schedule=("linear", 0.5, 0.1, over)),
+            n_clients=2,
+        )
+
+    def test_linear_interpolation_then_hold(self):
+        s = self._strategy(over=5)
+        f1 = float(s.effective_topk_fraction(jnp.asarray(1)))
+        f5 = float(s.effective_topk_fraction(jnp.asarray(5)))
+        f9 = float(s.effective_topk_fraction(jnp.asarray(9)))
+        assert f1 == pytest.approx(0.5)
+        assert f5 == pytest.approx(0.1)
+        assert f9 == pytest.approx(0.1)  # holds f_end after over_rounds
+        f3 = float(s.effective_topk_fraction(jnp.asarray(3)))
+        assert f1 > f3 > f5
+
+    def test_no_schedule_returns_none(self):
+        s = CompressingStrategy(
+            FedAvg(), CompressionConfig(topk_fraction=0.5), n_clients=2
+        )
+        assert s.effective_topk_fraction(jnp.asarray(1)) is None
+
+    def test_rank_mask_keeps_effective_count(self):
+        flat = jnp.asarray(np.linspace(1.0, 100.0, 100, dtype=np.float32))
+        full = topk_mask(flat, 50)
+        assert int(full.sum()) == 50
+        eff = topk_mask(flat, 50, jnp.asarray(10, jnp.int32))
+        assert int(eff.sum()) == 10
+        # the survivors are the 10 largest magnitudes
+        assert bool(jnp.all(eff[-10:] == 1.0))
+
+    def test_compress_update_respects_effective_fraction(self):
+        cfg = CompressionConfig(topk_fraction=0.5, error_feedback=False)
+        upd = {"w": jnp.asarray(np.linspace(-1, 1, 64, dtype=np.float32))}
+        key = jax.random.PRNGKey(0)
+        dec_full, _ = compress_update(upd, None, key, cfg)
+        dec_eff, _ = compress_update(
+            upd, None, key, cfg, topk_fraction_eff=jnp.float32(0.125)
+        )
+        assert int((dec_full["w"] != 0).sum()) == 32
+        assert int((dec_eff["w"] != 0).sum()) == 8
+
+    def test_effective_none_bit_identical_to_plain(self):
+        cfg = CompressionConfig(topk_fraction=0.3, quant_bits=8)
+        upd = {"w": jnp.asarray(np.random.default_rng(0).normal(
+            size=128).astype(np.float32))}
+        res = {"w": jnp.zeros((128,), jnp.float32)}
+        key = jax.random.PRNGKey(7)
+        a, ra = compress_update(upd, res, key, cfg)
+        b, rb = compress_update(upd, res, key, cfg, topk_fraction_eff=None)
+        np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
+        np.testing.assert_array_equal(np.asarray(ra["w"]),
+                                      np.asarray(rb["w"]))
+
+
+class TestEndToEnd:
+    def _sim(self, config, seed=5):
+        datasets = []
+        for i in range(3):
+            x, y = synthetic_classification(
+                jax.random.PRNGKey(i), 40, (6,), N_CLASSES
+            )
+            datasets.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+        model = engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES))
+        return FederatedSimulation(
+            logic=engine.ClientLogic(model, engine.masked_cross_entropy),
+            tx=optax.sgd(0.05),
+            strategy=FedAvg(),
+            datasets=datasets,
+            batch_size=8,
+            metrics=MetricManager(()),
+            local_steps=2,
+            seed=seed,
+            execution_mode="chunked",
+            compression=config,
+        )
+
+    def test_schedule_trains_and_differs_from_constant(self):
+        sched = self._sim(CompressionConfig(
+            topk_fraction=0.5, topk_schedule=("linear", 0.5, 0.05, 4)
+        ))
+        const = self._sim(CompressionConfig(topk_fraction=0.5))
+        hs = sched.fit(4)
+        hc = const.fit(4)
+        losses_s = [h.eval_losses["checkpoint"] for h in hs]
+        losses_c = [h.eval_losses["checkpoint"] for h in hc]
+        assert all(np.isfinite(losses_s))
+        # round 1 keeps the full ceiling fraction on both configs; later
+        # rounds tighten the schedule's effective fraction, so the
+        # trajectories must separate (the schedule actually bites)
+        assert losses_s[0] == losses_c[0]
+        assert losses_s[-1] != losses_c[-1]
+
+    def test_schedule_endpoint_is_a_sweepable_axis(self):
+        """Two cells differing only in topk_f_end share ONE compiled
+        program — the endpoint rides the traced-scalar (hvec) machinery."""
+        from fl4health_tpu.sweep import SweepSpec, run_sweep
+
+        def partitioner(cohort):
+            out = []
+            for i in range(cohort):
+                x, y = synthetic_classification(
+                    jax.random.PRNGKey(i), 40, (6,), N_CLASSES
+                )
+                out.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+            return out
+
+        def model():
+            return engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES))
+
+        spec = SweepSpec(
+            strategies={"comp": lambda: CompressingStrategy(
+                FedAvg(),
+                CompressionConfig(topk_fraction=0.5, error_feedback=False,
+                                  topk_schedule=("linear", 0.5, 0.1, 2)),
+            )},
+            clients={"sgd": lambda: engine.ClientLogic(
+                model(), engine.masked_cross_entropy
+            )},
+            partitioners={"p0": partitioner},
+            rounds=2, batch_size=8, local_steps=2,
+            tx=lambda: optax.sgd(0.05),
+            seeds=(5,), cohort_sizes=(3,),
+            scalars={"topk_f_end": (0.1, 0.4)},
+        )
+        res = run_sweep(spec)
+        assert len(res.cells) == 2
+        assert res.programs_compiled <= 1, res.bench_block()
+        a, b = res.cells
+        # round 1 keeps the shared start fraction (equal trajectories so
+        # far); round 2's aggregate diverges with the endpoint, visible in
+        # the post-aggregation eval of that round
+        assert a.eval_losses[0] == b.eval_losses[0]
+        assert a.eval_losses[-1] != b.eval_losses[-1]
+
+
+def test_one_round_ramp_is_f_end_immediately():
+    # over_rounds=1 must not silently behave as a 2-round ramp
+    s = CompressingStrategy(
+        FedAvg(),
+        CompressionConfig(topk_fraction=0.5, error_feedback=False,
+                          topk_schedule=("linear", 0.5, 0.1, 1)),
+        n_clients=2,
+    )
+    assert float(s.effective_topk_fraction(jnp.asarray(1))) == (
+        pytest.approx(0.1)
+    )

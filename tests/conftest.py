@@ -60,6 +60,14 @@ def pytest_configure(config):
         "themselves when fewer than 8 devices are visible "
         "(eight_devices fixture).",
     )
+    config.addinivalue_line(
+        "markers",
+        "sweep: shared-compilation scenario-sweep lanes "
+        "(fl4health_tpu/sweep/). The tier-1-safe smoke subset (hoisting "
+        "compile-counter pins, small-grid bit-identity parity) runs by "
+        "default; exhaustive grids also carry 'slow'. Select with "
+        "-m sweep.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

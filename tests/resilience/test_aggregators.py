@@ -220,3 +220,30 @@ class TestRobustInSimulation:
         sim = make_sim(RobustFedAvg("median"))
         hist = sim.fit(5)
         assert hist[-1].fit_losses["backward"] < hist[0].fit_losses["backward"]
+
+
+def test_trimmed_mean_rejects_out_of_range_numpy_scalar():
+    # np.float32 is not a Python float subclass; the concrete-validation
+    # path must still catch it (sweep-hoisting regression guard)
+    C = 5
+    with pytest.raises(ValueError, match=r"\[0, 0.5\)"):
+        trimmed_mean({"w": jnp.zeros((C, 2))}, jnp.ones(C), np.float32(0.7))
+
+
+def test_trimmed_mean_traced_fraction_matches_static():
+    C, vals = 6, [1.0, 2.0, 3.0, 4.0, 100.0, -50.0]
+    mask = jnp.ones(C)
+    static = trimmed_mean({"w": jnp.asarray(vals)}, mask, 0.2)
+    traced = jax.jit(
+        lambda tf: trimmed_mean({"w": jnp.asarray(vals)}, mask, tf)
+    )(jnp.float32(0.2))
+    np.testing.assert_array_equal(np.asarray(static["w"]),
+                                  np.asarray(traced["w"]))
+
+
+def test_trimmed_mean_rejects_out_of_range_concrete_jnp_scalar():
+    # concrete jnp scalars validate like Python floats; only TRACED
+    # values take the in-graph clamp
+    C = 5
+    with pytest.raises(ValueError, match=r"\[0, 0.5\)"):
+        trimmed_mean({"w": jnp.zeros((C, 2))}, jnp.ones(C), jnp.float32(0.7))

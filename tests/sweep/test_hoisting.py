@@ -1,0 +1,274 @@
+"""Compile-counter regression pins for scalar hyperparameter hoisting.
+
+The contract (ISSUE 11 / docs/module_guides/sweeps.md): changing server
+lr / proximal weight / staleness exponent / trim fraction does NOT
+trigger a recompile after hoisting — the scalar reaches the compiled
+round programs as a traced value (state leaf or program input), so a
+rebind + refit reuses the warm executable, and the rebound run matches a
+run constructed with that value from scratch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.fedprox import FedProxClientLogic
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.observability.jaxmon import CompileMonitor
+from fl4health_tpu.observability.registry import MetricsRegistry
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.async_schedule import AsyncConfig
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.fedopt import fed_adam
+from fl4health_tpu.strategies.fedprox import FedAvgWithAdaptiveConstraint
+from fl4health_tpu.sweep import apply_state_scalars, bind_traced_scalars
+from fl4health_tpu.sweep.hoisting import SCALAR_BINDINGS, binding
+
+N_CLASSES = 3
+
+pytestmark = pytest.mark.sweep
+
+
+def _datasets(n=3):
+    out = []
+    for i in range(n):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(i), 40, (6,), N_CLASSES
+        )
+        out.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+    return out
+
+
+def _sim(strategy, logic=None, **kw):
+    model = engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES))
+    return FederatedSimulation(
+        logic=logic or engine.ClientLogic(model, engine.masked_cross_entropy),
+        tx=optax.sgd(0.05),
+        strategy=strategy,
+        datasets=_datasets(),
+        batch_size=8,
+        metrics=MetricManager(()),
+        local_steps=2,
+        seed=5,
+        execution_mode="chunked",
+        **kw,
+    )
+
+
+def _losses(history):
+    return [h.eval_losses["checkpoint"] for h in history]
+
+
+def _reset(sim):
+    sim.history = []
+    sim.rng = jax.random.PRNGKey(5)
+    sim._base_entropy = engine._entropy_from_key(sim.rng)
+    sim._init_states()
+
+
+def _refit_compiles(sim, rounds=2):
+    """Backend-compile delta across a refit of an already-warm sim."""
+    registry = MetricsRegistry()
+    with CompileMonitor(registry):
+        sim.fit(rounds)
+    return int(registry.counter("jax_backend_compiles_total").value)
+
+
+class TestServerLrHoisting:
+    def test_rebind_is_recompile_free_and_effective(self):
+        sim = _sim(fed_adam(0.1))
+        sim.fit(2)  # warm compile at lr=0.1
+        _reset(sim)
+        sim.server_state = apply_state_scalars(
+            sim.strategy, sim.server_state, {"server_lr": 0.5}
+        )
+        assert _refit_compiles(sim) == 0
+        rebound = _losses(sim.history)
+
+        fresh = _sim(fed_adam(0.5))
+        fresh.fit(2)
+        np.testing.assert_array_equal(rebound, _losses(fresh.history))
+
+    def test_plain_tx_rejected_with_guidance(self):
+        from fl4health_tpu.strategies.fedopt import FedOpt
+
+        strat = FedOpt(optax.adam(0.1))
+        state = strat.init({"w": jnp.zeros((2,))})
+        with pytest.raises(ValueError, match="inject_hyperparams"):
+            apply_state_scalars(strat, state, {"server_lr": 0.5})
+
+
+class TestProximalWeightHoisting:
+    def test_rebind_is_recompile_free_and_effective(self):
+        def make(mu):
+            model = engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES))
+            return _sim(
+                FedAvgWithAdaptiveConstraint(
+                    initial_drift_penalty_weight=mu, adapt_loss_weight=False
+                ),
+                logic=FedProxClientLogic(model, engine.masked_cross_entropy),
+            )
+
+        sim = make(0.1)
+        sim.fit(2)
+        _reset(sim)
+        sim.server_state = apply_state_scalars(
+            sim.strategy, sim.server_state, {"proximal_weight": 1.5}
+        )
+        assert _refit_compiles(sim) == 0
+        rebound = _losses(sim.history)
+
+        fresh = make(1.5)
+        fresh.fit(2)
+        np.testing.assert_array_equal(rebound, _losses(fresh.history))
+        # and the knob matters on this config (non-vacuous pin)
+        base = make(0.1)
+        base.fit(2)
+        assert rebound != _losses(base.history)
+
+
+class TestStalenessExponentHoisting:
+    def _make(self, exponent):
+        return _sim(
+            FedAvg(),
+            async_config=AsyncConfig(
+                buffer_size=2, staleness_exponent=exponent,
+                base_compute_s=1.0, compute_jitter=0.5, seed=11,
+            ),
+        )
+
+    def test_rebind_is_recompile_free_and_effective(self):
+        sim = self._make(0.5)
+        sim.fit(3)
+        base = _losses(sim.history)
+        _reset(sim)
+        sim.strategy.staleness_exponent = 0.9
+        assert _refit_compiles(sim, 3) == 0
+        rebound = _losses(sim.history)
+
+        fresh = self._make(0.9)
+        fresh.fit(3)
+        np.testing.assert_array_equal(rebound, _losses(fresh.history))
+        # the jittered schedule produces real staleness, so the exponent
+        # must move the trajectory — otherwise this pin is vacuous
+        assert rebound != base
+
+
+class TestTracedScalarBinding:
+    def test_binding_restores_attributes(self):
+        from fl4health_tpu.resilience.aggregators import RobustFedAvg
+
+        strat = RobustFedAvg("trimmed_mean", trim_fraction=0.2)
+        with bind_traced_scalars(strat, {"trim_fraction": jnp.float32(0.3)}):
+            assert float(strat.trim_fraction) == pytest.approx(0.3)
+        assert strat.trim_fraction == 0.2
+
+    def test_unknown_scalar_named(self):
+        with pytest.raises(KeyError, match="registered hoistable"):
+            binding("nonexistent_knob")
+
+    def test_state_kind_rejected_by_attr_binder(self):
+        strat = fed_adam(0.1)
+        with pytest.raises(ValueError, match="state-kind"):
+            with bind_traced_scalars(strat, {"server_lr": 0.5}):
+                pass
+
+    def test_attr_kind_rejected_by_state_binder(self):
+        from fl4health_tpu.resilience.aggregators import RobustFedAvg
+
+        strat = RobustFedAvg("trimmed_mean")
+        state = strat.init({"w": jnp.zeros((2,))})
+        with pytest.raises(ValueError, match="attr-kind"):
+            apply_state_scalars(strat, state, {"trim_fraction": 0.3})
+
+    def test_registry_docs_cover_every_binding(self):
+        for name, b in SCALAR_BINDINGS.items():
+            assert b.doc, name
+            assert b.kind in ("attr", "state"), name
+
+
+def test_server_lr_default_probe_names_the_factories():
+    """Reading the binding default on a non-injected FedOpt must raise the
+    guidance error, not a raw AttributeError."""
+    from fl4health_tpu.strategies.fedopt import FedOpt
+
+    with pytest.raises(ValueError, match="inject_hyperparams"):
+        SCALAR_BINDINGS["server_lr"].default(FedOpt(optax.adam(0.1)))
+
+
+def test_topk_endpoint_above_ceiling_rejected_at_bind():
+    """A schedule endpoint above the static topk_fraction ceiling would
+    silently clamp in-graph — two 'different' cells running one config;
+    the binding validator rejects it with guidance instead."""
+    from fl4health_tpu.compression.config import CompressionConfig
+    from fl4health_tpu.compression.strategy import CompressingStrategy
+
+    strat = CompressingStrategy(
+        FedAvg(),
+        CompressionConfig(topk_fraction=0.3, error_feedback=False,
+                          topk_schedule=("linear", 0.3, 0.1, 2)),
+        n_clients=2,
+    )
+    with pytest.raises(ValueError, match="ceiling"):
+        SCALAR_BINDINGS["topk_f_end"].check(strat, 0.6)
+
+
+def test_legacy_two_arg_async_mask_still_traces():
+    """Duck-typed strategies with the pre-hoisting 2-arg
+    async_aggregation_mask signature keep working (call arity shimmed)."""
+    from fl4health_tpu.strategies.fedbuff import FedBuff
+
+    class LegacyBuff(FedBuff):
+        def async_aggregation_mask(self, arrivals, staleness):  # 2-arg
+            return super().async_aggregation_mask(arrivals, staleness)
+
+    sim = _sim(
+        LegacyBuff(FedAvg(), staleness_exponent=0.5),
+        async_config=AsyncConfig(
+            buffer_size=2, staleness_exponent=0.5,
+            base_compute_s=1.0, compute_jitter=0.5, seed=11,
+        ),
+    )
+    hist = sim.fit(2)
+    assert np.isfinite(_losses(hist)).all()
+
+
+def test_exponent_taking_async_mask_without_attribute_rejected():
+    """An exponent-accepting hook on a strategy with no staleness_exponent
+    attribute would silently get the 0.0 fallback (no discounting) —
+    rejected loudly at program-build time instead."""
+    from fl4health_tpu.strategies.base import Strategy
+
+    class ExoticBuff(Strategy):
+        def __init__(self, inner):
+            self.inner = inner
+            self.weighted_aggregation = inner.weighted_aggregation
+            self.weighted_eval_aggregation = inner.weighted_eval_aggregation
+
+        def init(self, params):
+            return self.inner.init(params)
+
+        def global_params(self, s):
+            return self.inner.global_params(s)
+
+        def client_payload(self, s, r):
+            return self.inner.client_payload(s, r)
+
+        def aggregate(self, s, results, r):
+            return self.inner.aggregate(s, results, r)
+
+        def async_aggregation_mask(self, arrivals, staleness, exponent=None):
+            return arrivals
+
+    import jax.numpy as jnp2
+
+    sim = _sim(ExoticBuff(FedAvg()))
+    sim.async_config = AsyncConfig(buffer_size=2)
+    sim._async_active = True
+    with pytest.raises(ValueError, match="staleness_exponent"):
+        sim._build_async_fns(False)

@@ -1,0 +1,300 @@
+"""Scenario-sweep engine: spec expansion, bucket plan, and THE contract —
+every sweep cell's trajectory is bit-identical to the same configuration
+run standalone through ``FederatedSimulation.fit()``, on both execution
+modes, including a fault-plan cell and a padded-bucket cell. Packing and
+padding are pure perf, never semantics."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.ditto import MrMtlClientLogic
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.resilience.faults import ClientFault, FaultPlan
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.fedopt import fed_adam
+from fl4health_tpu.sweep import SweepSpec, run_sweep
+
+N_CLASSES = 3
+
+pytestmark = pytest.mark.sweep
+
+
+def _model():
+    return engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES))
+
+
+def _partitioner(salt):
+    """Deterministic non-IID-ish partitioner: per-client draw + unequal
+    train-set sizes (so sample_counts genuinely vary across partitions)."""
+
+    def build(cohort):
+        out = []
+        for i in range(cohort):
+            x, y = synthetic_classification(
+                jax.random.PRNGKey(1000 * salt + i), 40, (6,), N_CLASSES
+            )
+            n = 24 + 4 * ((i + salt) % 3)
+            out.append(ClientDataset(
+                np.asarray(x[:n]), np.asarray(y[:n]),
+                np.asarray(x[32:]), np.asarray(y[32:]),
+            ))
+        return out
+
+    return build
+
+
+CLIENTS = {
+    "sgd": lambda: engine.ClientLogic(_model(), engine.masked_cross_entropy),
+    "mrmtl": lambda: MrMtlClientLogic(
+        _model(), engine.masked_cross_entropy, lam=0.5
+    ),
+}
+STRATEGIES = {"fedavg": FedAvg, "fedadam": lambda: fed_adam(0.1)}
+
+
+def _spec(**overrides):
+    kw = dict(
+        strategies=STRATEGIES,
+        clients=CLIENTS,
+        partitioners={"p0": _partitioner(0)},
+        rounds=2,
+        batch_size=8,
+        local_steps=2,
+        tx=lambda: optax.sgd(0.05),
+        seeds=(5, 7),
+        cohort_sizes=(3,),
+    )
+    kw.update(overrides)
+    return SweepSpec(**kw)
+
+
+def _standalone(cell, spec, datasets, execution_mode, fault_plan=None):
+    """The cell's exact configuration as an ordinary simulation."""
+    sim = FederatedSimulation(
+        logic=CLIENTS[cell.client](),
+        tx=spec.tx(),
+        strategy=STRATEGIES[cell.strategy](),
+        datasets=datasets,
+        batch_size=spec.batch_size,
+        metrics=MetricManager(()),
+        local_steps=spec.local_steps,
+        seed=cell.seed,
+        execution_mode=execution_mode,
+        fault_plan=fault_plan,
+    )
+    hist = sim.fit(spec.rounds)
+    return ([h.fit_losses["backward"] for h in hist],
+            [h.eval_losses["checkpoint"] for h in hist])
+
+
+class TestSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="strategies"):
+            _spec(strategies={})
+        with pytest.raises(ValueError, match="local_steps"):
+            _spec(local_steps=0)
+        with pytest.raises(KeyError, match="registered hoistable"):
+            _spec(scalars={"not_a_knob": (1.0,)})
+        with pytest.raises(ValueError, match="bucket"):
+            _spec(cohort_sizes=(3, 9), cohort_buckets=(4,))
+        with pytest.raises(ValueError, match="seeds"):
+            _spec(seeds=())
+
+    def test_expand_cells_collapses_inapplicable_scalars(self):
+        # server_lr applies to fedadam only: fedavg cells collapse to one
+        # per (client, seed) instead of sweeping a knob they cannot bind
+        spec = _spec(scalars={"server_lr": (0.1, 0.3)})
+        cells = spec.expand_cells()
+        fedavg = [c for c in cells if c.strategy == "fedavg"]
+        fedadam = [c for c in cells if c.strategy == "fedadam"]
+        assert len(fedavg) == 2 * 2  # clients x seeds
+        assert len(fedadam) == 2 * 2 * 2  # clients x seeds x lr values
+        assert all(c.scalars == () for c in fedavg)
+        assert {c.scalar_dict["server_lr"] for c in fedadam} == {0.1, 0.3}
+
+    def test_probabilistic_fault_rejected_under_padding(self):
+        plan = FaultPlan(seed=3, client_faults=(
+            ClientFault(clients=(1,), kind="dropout", probability=0.5),
+        ))
+        spec = _spec(fault_plans={"flaky": plan}, cohort_buckets=(4,))
+        with pytest.raises(ValueError, match="probabilistic"):
+            run_sweep(spec)
+
+
+class TestParity:
+    def test_grid_matches_standalone_chunked(self):
+        """2 strategies x 2 client algorithms x 2 seeds: every cell's fit
+        AND eval trajectory equals the standalone chunked fit bit-for-bit
+        (same seeds => same trajectory)."""
+        spec = _spec()
+        res = run_sweep(spec)
+        assert len(res.cells) == 8
+        datasets = _partitioner(0)(3)
+        for r in res.cells:
+            fit_ref, eval_ref = _standalone(r.cell, spec, datasets, "chunked")
+            np.testing.assert_array_equal(r.fit_losses, fit_ref,
+                                          err_msg=r.cell.label())
+            np.testing.assert_array_equal(r.eval_losses, eval_ref,
+                                          err_msg=r.cell.label())
+
+    def test_cell_matches_standalone_pipelined(self):
+        # The sweep reproduces the CHUNKED programs bit-for-bit (asserted
+        # above); the pipelined mode itself differs from chunked by ~1ulp
+        # in eval reductions, so the cross-mode pin uses the repo's
+        # established tolerance (test_pipeline.py
+        # test_chunked_and_pipelined_fit_agree_on_fixed_seed: rtol=1e-6).
+        spec = _spec(strategies={"fedadam": STRATEGIES["fedadam"]},
+                     clients={"sgd": CLIENTS["sgd"]}, seeds=(5,))
+        res = run_sweep(spec)
+        (r,) = res.cells
+        fit_ref, eval_ref = _standalone(
+            r.cell, spec, _partitioner(0)(3), "pipelined"
+        )
+        np.testing.assert_allclose(r.fit_losses, fit_ref, rtol=1e-6)
+        np.testing.assert_allclose(r.eval_losses, eval_ref, rtol=1e-6)
+
+    @pytest.mark.parametrize("mode", ["chunked", "pipelined"])
+    def test_fault_plan_cell_matches_standalone(self, mode):
+        """A deterministic corruption fault compiles into the sweep's cell
+        program exactly as into the standalone round programs."""
+        plan = FaultPlan(seed=3, client_faults=(
+            ClientFault(clients=(1,), kind="scale", scale=-2.0,
+                        probability=1.0, start_round=2),
+        ))
+        spec = _spec(strategies={"fedavg": STRATEGIES["fedavg"]},
+                     clients={"sgd": CLIENTS["sgd"]}, seeds=(5,),
+                     fault_plans={"scale2": plan})
+        res = run_sweep(spec)
+        (r,) = res.cells
+        fit_ref, eval_ref = _standalone(
+            r.cell, spec, _partitioner(0)(3), mode, fault_plan=plan
+        )
+        if mode == "chunked":
+            np.testing.assert_array_equal(r.fit_losses, fit_ref)
+            np.testing.assert_array_equal(r.eval_losses, eval_ref)
+        else:  # repo cross-mode tolerance (see the pipelined test above)
+            np.testing.assert_allclose(r.fit_losses, fit_ref, rtol=1e-6)
+            np.testing.assert_allclose(r.eval_losses, eval_ref, rtol=1e-6)
+
+    @pytest.mark.parametrize("mode", ["chunked", "pipelined"])
+    def test_padded_bucket_cell_matches_standalone(self, mode):
+        """Cohort 3 padded to bucket 4: the phantom client is zero-weight
+        everywhere (aggregation, losses, eval counts), so the trajectory
+        equals the unpadded standalone run bit-for-bit."""
+        spec = _spec(strategies={"fedadam": STRATEGIES["fedadam"]},
+                     clients={"sgd": CLIENTS["sgd"]}, seeds=(5,),
+                     cohort_buckets=(4,))
+        res = run_sweep(spec)
+        (r,) = res.cells
+        assert r.bucket == 4 and r.cell.cohort == 3
+        fit_ref, eval_ref = _standalone(
+            r.cell, spec, _partitioner(0)(3), mode
+        )
+        if mode == "chunked":
+            np.testing.assert_array_equal(r.fit_losses, fit_ref)
+            np.testing.assert_array_equal(r.eval_losses, eval_ref)
+        else:  # repo cross-mode tolerance (see the pipelined test above)
+            np.testing.assert_allclose(r.fit_losses, fit_ref, rtol=1e-6)
+            np.testing.assert_allclose(r.eval_losses, eval_ref, rtol=1e-6)
+
+
+class TestSharedCompilation:
+    def test_24_cell_grid_compiles_at_most_cells_over_3(self):
+        """THE acceptance pin: a 24-cell {strategy x client x partitioner
+        x seed (x lr)} grid dispatches through <= cells/3 compiled
+        programs, measured by CompileMonitor around the cell dispatches."""
+        spec = _spec(
+            partitioners={"p0": _partitioner(0), "p1": _partitioner(1)},
+            scalars={"server_lr": (0.1, 0.3)},
+            rounds=1, local_steps=1,
+        )
+        res = run_sweep(spec)
+        assert len(res.cells) == 24
+        assert len(res.plan.groups) == 4  # strategies x clients
+        assert res.programs_compiled <= len(res.cells) // 3, (
+            res.bench_block()
+        )
+        assert all(np.isfinite(r.final_eval_loss) for r in res.cells)
+
+    def test_pack_and_sequential_agree_bitwise(self):
+        spec = _spec(seeds=(5,), rounds=1, local_steps=1)
+        packed = run_sweep(spec)
+        sequential = run_sweep(_spec(seeds=(5,), rounds=1, local_steps=1,
+                                     pack=False))
+        for a, b in zip(packed.cells, sequential.cells):
+            assert a.cell == b.cell
+            np.testing.assert_array_equal(a.fit_losses, b.fit_losses)
+            np.testing.assert_array_equal(a.eval_losses, b.eval_losses)
+
+    def test_events_and_metrics_land(self, tmp_path):
+        from fl4health_tpu.observability import Observability
+
+        obs = Observability(enabled=True, output_dir=str(tmp_path))
+        obs.start()
+        spec = _spec(strategies={"fedavg": STRATEGIES["fedavg"]},
+                     clients={"sgd": CLIENTS["sgd"]}, seeds=(5, 7),
+                     rounds=1, local_steps=1)
+        res = run_sweep(spec, observability=obs)
+        events = list(obs.registry.events)
+        kinds = [e["event"] for e in events]
+        assert kinds.count("sweep_plan") == 1
+        assert kinds.count("sweep") == len(res.cells) == 2
+        assert kinds.count("sweep_summary") == 1
+        cell_rows = [e for e in events if e["event"] == "sweep"]
+        for row in cell_rows:
+            assert {"label", "final_eval_loss", "steps_per_s",
+                    "compiles_attributed"} <= set(row)
+        assert (obs.registry.gauge("fl_sweep_programs_compiled").value
+                == float(res.programs_compiled))
+        obs.shutdown()
+
+
+class TestRemainderPack:
+    def test_uneven_group_keeps_one_packed_program(self):
+        """3 cells with max_pack=2: the remainder chunk pads to the pack
+        size (duplicate outputs discarded), so the group still compiles
+        exactly one packed program and results match the even path."""
+        spec = _spec(strategies={"fedavg": STRATEGIES["fedavg"]},
+                     clients={"sgd": CLIENTS["sgd"]}, seeds=(5, 7, 11),
+                     rounds=1, local_steps=1, max_pack=2)
+        res = run_sweep(spec)
+        assert len(res.cells) == 3
+        assert res.programs_compiled <= 1, res.bench_block()
+        full = run_sweep(_spec(
+            strategies={"fedavg": STRATEGIES["fedavg"]},
+            clients={"sgd": CLIENTS["sgd"]}, seeds=(5, 7, 11),
+            rounds=1, local_steps=1, max_pack=4,
+        ))
+        for a, b in zip(res.cells, full.cells):
+            np.testing.assert_array_equal(a.eval_losses, b.eval_losses)
+
+
+def test_kwargs_only_async_mask_treated_as_two_arg():
+    """A **kwargs-style duck-typed hook cannot absorb the positionally
+    passed exponent — the arity shim must classify it as 2-arg."""
+    from fl4health_tpu.metrics.base import MetricManager
+    from fl4health_tpu.server.async_schedule import AsyncConfig
+    from fl4health_tpu.strategies.fedbuff import FedBuff
+
+    class KwargsBuff(FedBuff):
+        def async_aggregation_mask(self, arrivals, staleness, **kwargs):
+            return super().async_aggregation_mask(arrivals, staleness)
+
+    datasets = _partitioner(0)(3)
+    sim = FederatedSimulation(
+        logic=CLIENTS["sgd"](), tx=optax.sgd(0.05),
+        strategy=KwargsBuff(FedAvg(), staleness_exponent=0.5),
+        datasets=datasets, batch_size=8, metrics=MetricManager(()),
+        local_steps=2, seed=5, execution_mode="chunked",
+        async_config=AsyncConfig(buffer_size=2, staleness_exponent=0.5,
+                                 base_compute_s=1.0, compute_jitter=0.5,
+                                 seed=11),
+    )
+    hist = sim.fit(2)
+    assert np.isfinite([h.eval_losses["checkpoint"] for h in hist]).all()

@@ -531,3 +531,120 @@ def test_cli_output_byte_stable_without_async_fields(tmp_path):
     assert "stale" not in out
     assert "cadence" not in out
     assert "async" not in out
+
+
+# -- scenario-sweep leaderboard (fl4health_tpu/sweep/ PR) -------------------
+
+def _sweep_cell(i, **kw):
+    base = dict(cell=i, label=f"fedavg/sgd/p0/c3/s{i}",
+                strategy="fedavg", client="sgd", partitioner="p0",
+                cohort=3, bucket=3, fault="none", seed=i, scalars={},
+                final_fit_loss=1.0 - 0.1 * i, final_eval_loss=0.9 - 0.1 * i,
+                best_eval_loss=0.9 - 0.1 * i, rounds_to_target=None,
+                steps_per_s=12.0, wall_s=0.5, compiles_attributed=0.5)
+    base.update(kw)
+    return {"event": "sweep", **base}
+
+
+def _sweep_summary(**kw):
+    base = dict(cells=2, groups=1, buckets=[3], programs_compiled=1,
+                compile_s_total=0.8, cells_per_compile=2.0, wall_s=1.2)
+    base.update(kw)
+    return {"event": "sweep_summary", **base}
+
+
+def test_sweep_leaderboard_ranks_best_first_nans_last():
+    cells = [_sweep_cell(1), _sweep_cell(2),
+             _sweep_cell(3, final_eval_loss=float("nan"),
+                         best_eval_loss=float("nan"))]
+    table = perf_report.render_sweep_leaderboard(cells)
+    lines = table.splitlines()
+    assert lines[0].split() == ["cell", "config", "final_loss", "best_loss",
+                                "to_target", "steps/s", "compiles"]
+    # cell 2 (0.7) beats cell 1 (0.8); the NaN cell ranks last with '-'
+    body = [ln.split() for ln in lines[2:]]
+    assert [r[0] for r in body] == ["2", "1", "3"]
+    assert body[-1][2] == "-"
+
+
+def test_cli_sweep_flag_renders_leaderboard_only(tmp_path):
+    path = _log_with_events(
+        tmp_path, [_round(1)],
+        [_sweep_cell(1), _sweep_cell(2), _sweep_summary()],
+    )
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path),
+         "--sweep"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "final_loss" in out and "cells_per_compile: 2.0" in out
+    assert "compile_ms" not in out  # no round table in --sweep mode
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path),
+         "--sweep", "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert len(doc["sweep"]) == 2
+    assert doc["sweep_summary"]["programs_compiled"] == 1
+
+
+def test_cli_sweep_only_log_renders_without_round_events(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w") as f:
+        for rec in (_sweep_cell(1), _sweep_summary(cells=1)):
+            f.write(json.dumps({"ts": 0, **rec}) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path)],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "final_loss" in out and "programs_compiled: 1" in out
+
+
+def test_cli_sweep_flag_fails_loudly_without_sweep_events(tmp_path):
+    path = _log(tmp_path, [_round(1)])
+    res = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path),
+         "--sweep"],
+        capture_output=True, text=True,
+    )
+    assert res.returncode == 1
+    assert "no 'sweep' events" in res.stderr
+
+
+def test_cli_output_byte_stable_without_sweep_events(tmp_path):
+    """Legacy logs must render the exact pre-sweep shape: no leaderboard,
+    no sweep JSON keys."""
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "final_loss" not in out and "sweep" not in out
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert "sweep" not in doc and "sweep_summary" not in doc
+
+
+def test_sweep_leaderboard_tolerates_null_and_nan_loss_mix():
+    cells = [_sweep_cell(1), _sweep_cell(2, final_eval_loss=None),
+             _sweep_cell(3, final_eval_loss=float("nan"))]
+    lines = perf_report.render_sweep_leaderboard(cells).splitlines()
+    assert lines[2].split()[0] == "1"  # the real loss ranks first
+    assert {r.split()[2] for r in lines[3:]} == {"-"}
+
+
+def test_cli_sweep_only_log_honors_json(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w") as f:
+        for rec in (_sweep_cell(1), _sweep_summary(cells=1)):
+            f.write(json.dumps({"ts": 0, **rec}) + "\n")
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path),
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert len(doc["sweep"]) == 1
+    assert doc["sweep_summary"]["cells"] == 1

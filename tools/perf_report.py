@@ -258,6 +258,61 @@ def render_quarantine_table(events: list[dict]) -> str:
     )
 
 
+def _sorted_sweep_cells(cells: list[dict]) -> list[dict]:
+    return sorted(cells, key=lambda r: r.get("cell", 0))
+
+
+def render_sweep_leaderboard(cells: list[dict]) -> str:
+    """The scenario-sweep leaderboard: one row per grid cell, best final
+    eval loss first (NaN/missing losses last). Rendered only when a log
+    carries ``sweep`` events, so legacy logs keep their exact output
+    shape."""
+    def fmt(v, spec="{:.4g}"):
+        if v is None or (isinstance(v, float) and v != v):
+            return "-"
+        return spec.format(v)
+
+    def rank(rec):
+        # one float key: None and NaN both collapse to +inf (render '-',
+        # sort last) — mixing them must not TypeError the whole report
+        v = rec.get("final_eval_loss")
+        if v is None or (isinstance(v, float) and v != v):
+            return float("inf")
+        return float(v)
+
+    ranked = sorted(cells, key=rank)
+    return _render_generic_table(
+        ("cell", "config", "final_loss", "best_loss", "to_target",
+         "steps/s", "compiles"),
+        (
+            [
+                str(int(rec.get("cell", 0))),
+                str(rec.get("label", "-")),
+                fmt(rec.get("final_eval_loss")),
+                fmt(rec.get("best_eval_loss")),
+                ("-" if rec.get("rounds_to_target") is None
+                 else str(int(rec["rounds_to_target"]))),
+                fmt(rec.get("steps_per_s"), "{:.3g}"),
+                fmt(rec.get("compiles_attributed"), "{:.2g}"),
+            ]
+            for rec in ranked
+        ),
+    )
+
+
+def summarize_sweep(summary_events: list[dict]) -> dict[str, Any]:
+    """The last ``sweep_summary`` event's compile-amortization facts."""
+    if not summary_events:
+        return {}
+    rec = summary_events[-1]
+    return {
+        k: rec[k]
+        for k in ("cells", "groups", "buckets", "programs_compiled",
+                  "compile_s_total", "cells_per_compile", "wall_s")
+        if k in rec
+    }
+
+
 def render_program_table(programs: list[dict]) -> str:
     """Per-compiled-program table from ``program`` introspection events:
     cost-model FLOPs/bytes, HBM footprint, compile wall, persistent-cache
@@ -345,6 +400,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("log", help="path to metrics.jsonl")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
+    ap.add_argument("--sweep", action="store_true",
+                    help="render only the scenario-sweep leaderboard "
+                         "(fl4health_tpu/sweep/ 'sweep' events)")
     args = ap.parse_args(argv)
     try:
         events = load_events(args.log)  # ONE parse serves every table
@@ -352,13 +410,37 @@ def main(argv: list[str] | None = None) -> int:
         programs = _latest_programs(events.get("program", []))
         faults = _sorted_rounds(events.get("fault", []))
         quarantine = _sorted_rounds(events.get("quarantine", []))
+        sweep_cells = _sorted_sweep_cells(events.get("sweep", []))
+        sweep_summary = summarize_sweep(events.get("sweep_summary", []))
     except OSError as e:
         # a missing/unreadable log is an error exit, not a traceback
         print(f"perf_report: cannot read {args.log}: {e}", file=sys.stderr)
         return 2
+    def emit_sweep_only() -> int:
+        # one emission shape for both sweep-only entry paths (--sweep and
+        # the no-round-events fallback)
+        if args.json:
+            print(json.dumps({"sweep_summary": sweep_summary,
+                              "sweep": sweep_cells}, indent=2))
+            return 0
+        print(render_sweep_leaderboard(sweep_cells))
+        if sweep_summary:
+            print()
+            for k, v in sweep_summary.items():
+                print(f"{k}: {v}")
+        return 0
+
+    if args.sweep:
+        if not sweep_cells:
+            print(f"no 'sweep' events in {args.log}", file=sys.stderr)
+            return 1
+        return emit_sweep_only()
     if not rounds:
         # empty or fully-unparseable JSONL: loud non-zero exit, never an
-        # empty table a CI grep would happily accept
+        # empty table a CI grep would happily accept — unless the log is a
+        # sweep-only run, whose leaderboard IS its round table
+        if sweep_cells:
+            return emit_sweep_only()
         print(f"no 'round' events in {args.log}", file=sys.stderr)
         return 1
     if args.json:
@@ -369,6 +451,9 @@ def main(argv: list[str] | None = None) -> int:
             doc["faults"] = faults
         if quarantine:
             doc["quarantine"] = quarantine
+        if sweep_cells:
+            doc["sweep"] = sweep_cells
+            doc["sweep_summary"] = sweep_summary
         print(json.dumps(doc, indent=2))
         return 0
     print(render_table(rounds))
@@ -384,9 +469,17 @@ def main(argv: list[str] | None = None) -> int:
     if quarantine:
         print()
         print(render_quarantine_table(quarantine))
+    if sweep_cells:
+        # scenario-sweep runs only: the leaderboard rides along — legacy
+        # logs keep the exact old output shape (byte-stable, tested)
+        print()
+        print(render_sweep_leaderboard(sweep_cells))
     print()
     for k, v in summarize(rounds).items():
         print(f"{k}: {v}")
+    if sweep_summary:
+        for k, v in sweep_summary.items():
+            print(f"sweep_{k}: {v}")
     return 0
 
 
