@@ -669,6 +669,118 @@ def timed_precision_block(timing: bool = True) -> dict:
     }
 
 
+def timed_recovery_block(timing: bool = True) -> dict:
+    """Recovery block (the preemption-survivability PR acceptance metric):
+    durable state-checkpoint write/restore latency and frame bytes on a
+    compact federated config, plus the end-to-end resume-overhead ratio —
+    the wall of [run killed at the midpoint + restore + finish] over the
+    uninterrupted run's wall. A ratio near 1.0 is the claim: preemption is
+    a detour, not a restart.
+
+    Write/restore latencies are pure host I/O (serialize + atomic publish
+    + CRC verify), exact on any backend, and always land; ``timing=False``
+    (the CPU-fallback annotation) nulls only the fit-wall resume arm —
+    XLA:CPU round walls are harness health, not speed claims."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from fl4health_tpu.checkpointing.state import SimulationStateCheckpointer
+
+    def make(ckpt_dir=None, every=1):
+        import optax
+
+        from fl4health_tpu.clients import engine as _engine
+        from fl4health_tpu.datasets.synthetic import synthetic_classification
+        from fl4health_tpu.metrics import efficient
+        from fl4health_tpu.metrics.base import MetricManager
+        from fl4health_tpu.models.cnn import Mlp
+        from fl4health_tpu.server.simulation import (
+            ClientDataset,
+            FederatedSimulation,
+        )
+        from fl4health_tpu.strategies.fedavg import FedAvg
+
+        datasets = []
+        for i in range(8):
+            x, y = synthetic_classification(
+                jax.random.PRNGKey(i), 48, (8,), 3, class_sep=1.5
+            )
+            datasets.append(ClientDataset(x[:40], y[:40], x[40:], y[40:]))
+        model = _engine.from_flax(Mlp(features=(16,), n_outputs=3))
+        logic = _engine.ClientLogic(model, _engine.masked_cross_entropy)
+        ck = None
+        if ckpt_dir is not None:
+            ck = SimulationStateCheckpointer(ckpt_dir, keep=2,
+                                             checkpoint_every=every)
+        return FederatedSimulation(
+            logic=logic, tx=optax.sgd(0.05), strategy=FedAvg(),
+            datasets=datasets, batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_steps=LOCAL_STEPS, seed=7, state_checkpointer=ck,
+        )
+
+    tmp = tempfile.mkdtemp(prefix="fl4h_bench_recovery_")
+    try:
+        # -- write/restore latency + frame bytes (host I/O, always) ------
+        sim = make()
+        sim.fit(1)  # realistic state: one optimizer step behind it
+        trees = jax.device_get({"server_state": sim.server_state,
+                                "client_states": sim.client_states})
+        ck = SimulationStateCheckpointer(os.path.join(tmp, "lat"), keep=2)
+        write_s = []
+        for i in range(5):
+            t0 = time.perf_counter()
+            ck.save_simulation_snapshot(trees, i + 1, sim.n_clients, [])
+            write_s.append(time.perf_counter() - t0)
+        frame_bytes = int(ck.last_save_stats["bytes"])
+        sim2 = make()
+        t0 = time.perf_counter()
+        next_round = ck.load_simulation(sim2)
+        restore_s = time.perf_counter() - t0
+        assert next_round == 6
+        out = {
+            "write_ms_median": round(sorted(write_s)[2] * 1000.0, 3),
+            "restore_ms": round(restore_s * 1000.0, 3),
+            "frame_bytes": frame_bytes,
+            "ring_generations": len(ck.generations()),
+        }
+        if not timing:
+            out.update({"fit_s_uninterrupted": None,
+                        "fit_s_killed_plus_resumed": None,
+                        "resume_overhead_ratio": None, "rounds": 0})
+            return out
+        # -- resume-overhead ratio (fit arms) ----------------------------
+        rounds = max(TIMED_ROUNDS * 2, 6)
+        mid = rounds // 2
+        # unmeasured warmup: every arm below reuses these compiles (via
+        # the persistent cache), so the ratio compares I/O + dispatch, not
+        # which arm happened to pay XLA first
+        make(os.path.join(tmp, "warm"), every=mid).fit(rounds)
+        t0 = time.perf_counter()
+        make(os.path.join(tmp, "full"), every=mid).fit(rounds)
+        full_wall = time.perf_counter() - t0
+        drill_dir = os.path.join(tmp, "drill")
+        t0 = time.perf_counter()
+        make(drill_dir, every=mid).fit(mid)  # the "killed" half
+        t_part1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        make(drill_dir, every=mid).fit(rounds)  # restore + finish
+        t_resumed = time.perf_counter() - t0
+        out.update({
+            "fit_s_uninterrupted": round(full_wall, 5),
+            "fit_s_killed_plus_resumed": round(t_part1 + t_resumed, 5),
+            "resume_overhead_ratio": round(
+                (t_part1 + t_resumed) / full_wall, 3
+            ) if full_wall > 0 else None,
+            "rounds": rounds,
+        })
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def timed_sweep_block(timing: bool = True) -> dict:
     """Sweep block (the shared-compilation PR acceptance metric): run a
     24-cell {2 strategies x 2 client algorithms x 2 partitioners x 2
@@ -1165,6 +1277,18 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
             and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
         )
         out["sweep"] = timed_sweep_block(timing=s_timing)
+    # Durable checkpoint/resume (the preemption-survivability PR metric).
+    # Same gating shape: FL4HEALTH_BENCH_RECOVERY=1 forces the full block,
+    # =0 disables it, "auto" always measures the (host-I/O, exact)
+    # write/restore latencies + frame bytes but nulls the fit-wall
+    # resume-overhead arm on the CPU fallback.
+    want_rec = os.environ.get("FL4HEALTH_BENCH_RECOVERY", "auto")
+    if want_rec != "0":
+        rec_timing = want_rec == "1" or (
+            want_rec == "auto"
+            and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+        )
+        out["recovery"] = timed_recovery_block(timing=rec_timing)
     # Mesh-sharded rounds (the massive-cohort PR metric): opt-in only —
     # FL4HEALTH_BENCH_MESH=1 — because it compiles two extra chunked scans
     # and needs a multi-device backend (single-device runs report skipped).
@@ -1279,6 +1403,11 @@ def run_measurement() -> None:
         # tail-independence PR metric (virtual-clock cadences always
         # measured; fit arms null on the CPU fallback)
         "async": cifar.get("async"),
+        # durable checkpoint/resume ({write_ms_median, restore_ms,
+        # frame_bytes, resume_overhead_ratio}) — the preemption-
+        # survivability PR metric (host-I/O latencies always measured;
+        # the resume-overhead fit arm null on the CPU fallback)
+        "recovery": cifar.get("recovery"),
     }
     if fallback_note:
         record["note"] = fallback_note

@@ -21,6 +21,9 @@ from fl4health_tpu.checkpointing.checkpointer import (
     save_params,
 )
 from fl4health_tpu.checkpointing.state import (
+    CheckpointConfigMismatchError,
+    CheckpointCorruptError,
+    RestoreInfo,
     SimulationStateCheckpointer,
     Snapshotter,
     StateCheckpointer,
@@ -30,10 +33,13 @@ __all__ = [
     "AsyncCheckpointWriter",
     "BestLossCheckpointer",
     "BestMetricCheckpointer",
+    "CheckpointConfigMismatchError",
+    "CheckpointCorruptError",
     "CheckpointMode",
     "FunctionCheckpointer",
     "LatestCheckpointer",
     "ParamsCheckpointer",
+    "RestoreInfo",
     "SimulationStateCheckpointer",
     "Snapshotter",
     "StateCheckpointer",

@@ -12,19 +12,103 @@ a small typed header replaces the reference's per-type snapshotter zoo. The
 typed layer that remains is ``Snapshotter``s for host-side python values
 (ints, floats, strings, dataclass records) which ride alongside the array
 payload.
+
+Crash consistency (the preemption-survivable contract):
+
+- **Versioned, CRC-footed frames.** One checkpoint is ONE file —
+  ``[magic][version][header-length][header JSON][msgpack blob][CRC32]`` —
+  written to a temp sibling and published with a single ``os.replace``
+  (``core.io.atomic_write``), so a SIGKILL mid-write can never tear the
+  published path. The CRC32 footer covers every preceding byte, so a file
+  torn by a non-atomic filesystem (or corrupted at rest) is DETECTED at
+  restore instead of deserializing garbage into live training state.
+- **Retention ring.** The last ``keep`` generations are retained as
+  ``<name>.g<NNNNNNNN>.ckpt`` (monotonic generation numbers, oldest pruned
+  after each atomic publish). Restore walks newest→oldest: a corrupt newest
+  generation logs a warning, counts as a fallback, and the previous good
+  generation restores instead — a preemption mid-rotation costs one
+  checkpoint interval, never the run.
+- **Config binding.** The header carries the run-manifest ``config_hash``
+  (observability/manifest.py) of the run that wrote it; restoring into a
+  simulation whose resume-relevant config hashes differently raises
+  :class:`CheckpointConfigMismatchError` — a checkpoint can't silently
+  resume a *different* experiment.
+- **Typed corruption errors.** Torn/truncated/CRC-mismatched files raise
+  :class:`CheckpointCorruptError` naming the file, so an operator (or the
+  ring fallback) knows exactly which artifact died.
+
+Legacy (pre-ring) ``<name>.ckpt`` files — no magic, no CRC — still load
+(format version 0), so checkpoints written before this format survive the
+upgrade.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import importlib
 import json
+import logging
 import os
+import re
+import time
+import zlib
 from abc import ABC, abstractmethod
-from typing import Any, Mapping
+from typing import Any, Callable, Mapping
 
 from flax import serialization
 
 from fl4health_tpu.core.io import atomic_write
+
+logger = logging.getLogger(__name__)
+
+# Frame layout v1: MAGIC (8B) | version u32 BE | header length u64 BE |
+# header JSON (utf-8) | msgpack blob | CRC32 u32 BE over all prior bytes.
+_MAGIC = b"FL4HCKPT"
+FORMAT_VERSION = 1
+# magic + version + header length + (empty header) + (empty blob) + crc
+_MIN_FRAME = len(_MAGIC) + 4 + 8 + 4
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed structural validation (truncated frame,
+    CRC mismatch, unparseable header, unknown format version). The message
+    names the file so the ring fallback / operator knows which generation
+    died."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+class CheckpointConfigMismatchError(ValueError):
+    """The checkpoint was written by a run whose resume-relevant config
+    hashes differently — restoring it would silently continue a different
+    experiment."""
+
+    def __init__(self, path: str, stored: str, current: str):
+        super().__init__(
+            f"checkpoint {path} was written under config_hash {stored} but "
+            f"this run's resume-relevant config hashes to {current}; a "
+            "checkpoint resumes only the experiment that wrote it (rebuild "
+            "the simulation with the original configuration, or clear() the "
+            "checkpoint directory to start fresh)"
+        )
+        self.path = path
+        self.stored = stored
+        self.current = current
+
+
+@dataclasses.dataclass
+class RestoreInfo:
+    """Facts about one successful restore — which file/generation won, and
+    which newer generations were skipped as corrupt (the ring fallback)."""
+
+    path: str
+    generation: int  # 0 for a legacy (pre-ring) file
+    nbytes: int
+    meta: dict
+    fallback_skipped: list[str] = dataclasses.field(default_factory=list)
 
 
 class Snapshotter(ABC):
@@ -50,83 +134,348 @@ class SerializableSnapshotter(Snapshotter):
         return payload
 
 
+def _resolve_dataclass(spec: str):
+    """``module:QualName`` -> class, or None when unresolvable (the caller
+    degrades to raw dicts rather than failing the whole restore)."""
+    mod_name, _, qual = spec.partition(":")
+    try:
+        obj: Any = importlib.import_module(mod_name)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        return obj if dataclasses.is_dataclass(obj) else None
+    except Exception:
+        logger.warning("cannot resolve checkpoint record class %r", spec)
+        return None
+
+
 class DataclassListSnapshotter(Snapshotter):
-    """A list of dataclass records (e.g. RoundRecord history)."""
+    """A list of dataclass records (e.g. RoundRecord history).
+
+    The header stores the record class name alongside the rows, so a
+    NON-empty payload restores real dataclass instances even when the
+    caller's template list is empty (the natural resume template — the
+    fresh run has no history yet). Legacy headers (a bare row list, no
+    class name) still load; without a template *or* a stored class name
+    they degrade to raw dicts, the old behavior."""
 
     def save(self, value):
-        return [dataclasses.asdict(v) for v in value]
+        payload: dict[str, Any] = {
+            "rows": [dataclasses.asdict(v) for v in value]
+        }
+        if value:
+            cls = type(value[0])
+            payload["record_class"] = f"{cls.__module__}:{cls.__qualname__}"
+        return payload
 
     def load(self, payload, template):
-        if not payload:
+        if payload is None:
+            return []
+        if isinstance(payload, list):  # legacy header: bare row list
+            rows, record_class = payload, None
+        else:
+            rows = payload.get("rows", [])
+            record_class = payload.get("record_class")
+        if not rows:
             return []
         cls = type(template[0]) if template else None
+        if cls is None and record_class:
+            cls = _resolve_dataclass(record_class)
         if cls is None:
-            return payload
-        return [cls(**row) for row in payload]
+            return rows
+        return [cls(**row) for row in rows]
 
 
 class StateCheckpointer:
-    """Save/load a named bag of state: array pytrees go into one msgpack blob,
-    host-side values into a JSON header. Loading requires templates with the
-    same structure (the caller always has them — it constructs the run first,
-    then restores into it).
+    """Save/load a named bag of state: array pytrees go into one msgpack
+    blob, host-side values into a JSON header. Loading requires templates
+    with the same structure (the caller always has them — it constructs the
+    run first, then restores into it).
 
-    One checkpoint is ONE file — [8-byte header length][header JSON][msgpack
-    blob] — written to a temp name and moved into place with a single
-    ``os.replace``, so a preemption can never leave header and arrays from
-    different rounds (the crash window the reference's per-attribute
-    ``torch.save`` files have).
+    ``keep`` sizes the retention ring (≥1; 2 by default so a corrupt newest
+    generation still has a good predecessor). ``checkpoint_every`` is the
+    save cadence the simulation honors — on the chunked execution path it
+    also sets ``rounds_per_dispatch``, so each snapshot rides the existing
+    chunk-boundary host touch instead of forcing per-round dispatch.
+    ``config_hash`` binds every frame to the writing run's resume-relevant
+    config (``FederatedSimulation`` fills it in at ``fit()`` when left
+    None). ``on_save`` is an optional callback receiving a stats dict
+    ``{path, generation, bytes, write_s, ...extra_meta}`` after each
+    publish — the simulation wires it to the ``fl_ckpt_*`` metrics; it may
+    run on the async writer thread.
     """
 
-    def __init__(self, directory: str, name: str = "state"):
+    def __init__(self, directory: str, name: str = "state", *,
+                 keep: int = 2, checkpoint_every: int = 1,
+                 config_hash: str | None = None,
+                 on_save: Callable[[dict], None] | None = None):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1; got {keep}")
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1; got {checkpoint_every}"
+            )
         self.directory = directory
         self.name = name
+        self.keep = int(keep)
+        self.checkpoint_every = int(checkpoint_every)
+        self.config_hash = config_hash
+        self.on_save = on_save
+        self.last_save_stats: dict | None = None
+        self.last_restore_info: RestoreInfo | None = None
 
+    # -- paths -----------------------------------------------------------
     @property
-    def _path(self) -> str:
+    def _legacy_path(self) -> str:
         return os.path.join(self.directory, f"{self.name}.ckpt")
 
-    def exists(self) -> bool:
-        return os.path.exists(self._path)
+    # kept for callers/tests that reference the pre-ring single path
+    _path = _legacy_path
 
+    def _generation_path(self, gen: int) -> str:
+        return os.path.join(self.directory, f"{self.name}.g{gen:08d}.ckpt")
+
+    def generations(self) -> list[tuple[int, str]]:
+        """(generation, path) pairs present on disk, oldest first."""
+        pat = re.compile(re.escape(self.name) + r"\.g(\d{8})\.ckpt$")
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for fname in names:
+            m = pat.fullmatch(fname)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, fname)))
+        return sorted(out)
+
+    def candidate_paths(self) -> list[tuple[int, str]]:
+        """Restore candidates newest-first: ring generations, then the
+        legacy single file (generation 0) if present."""
+        cands = list(reversed(self.generations()))
+        if os.path.exists(self._legacy_path):
+            cands.append((0, self._legacy_path))
+        return cands
+
+    def exists(self) -> bool:
+        return bool(self.candidate_paths())
+
+    def _orphan_tmp_paths(self) -> list[str]:
+        """Temp siblings (``<frame>.tmp.<pid>``) a SIGKILL mid-write left
+        behind — ``atomic_write`` unlinks them on a Python exception, but
+        a hard kill can't. A preemptible job would otherwise leak one
+        full-frame file per eviction, forever."""
+        pat = re.compile(
+            re.escape(self.name) + r"\.(g\d{8}\.)?ckpt\.tmp\.\d+$"
+        )
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        return [os.path.join(self.directory, n) for n in names
+                if pat.fullmatch(n)]
+
+    def _prune_orphan_tmp(self) -> None:
+        # called right after an atomic publish: our own temp file has been
+        # renamed away by then, so everything still matching is litter
+        # from a killed writer (single-writer-per-directory contract)
+        for path in self._orphan_tmp_paths():
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def clear(self) -> None:
+        for _gen, path in self.candidate_paths():
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self._prune_orphan_tmp()
+
+    # -- save ------------------------------------------------------------
     def save(self, trees: Mapping[str, Any], host: Mapping[str, Any] | None = None,
-             snapshotters: Mapping[str, Snapshotter] | None = None) -> None:
+             snapshotters: Mapping[str, Snapshotter] | None = None,
+             extra_meta: Mapping[str, Any] | None = None) -> dict:
+        """Serialize + atomically publish one new generation, prune the
+        ring to ``keep``, and return the save stats dict."""
+        t0 = time.perf_counter()
         os.makedirs(self.directory, exist_ok=True)
         snapshotters = snapshotters or {}
-        header = {}
+        host_header: dict[str, Any] = {}
         for k, v in (host or {}).items():
             snap = snapshotters.get(k, SerializableSnapshotter())
-            header[k] = snap.save(v)
-        header_bytes = json.dumps(header).encode("utf-8")
+            host_header[k] = snap.save(v)
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "config_hash": self.config_hash,
+            "saved_unix": time.time(),
+            **dict(extra_meta or {}),
+        }
+        header_bytes = json.dumps(
+            {"host": host_header, "meta": meta}
+        ).encode("utf-8")
         blob = serialization.to_bytes(dict(trees))
-        with atomic_write(self._path, "wb") as f:  # single atomic publish
-            f.write(len(header_bytes).to_bytes(8, "big"))
-            f.write(header_bytes)
-            f.write(blob)
+        gens = self.generations()
+        gen = (gens[-1][0] + 1) if gens else 1
+        path = self._generation_path(gen)
+        body = b"".join((
+            _MAGIC,
+            FORMAT_VERSION.to_bytes(4, "big"),
+            len(header_bytes).to_bytes(8, "big"),
+            header_bytes,
+            blob,
+        ))
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        with atomic_write(path, "wb") as f:  # single atomic publish
+            f.write(body)
+            f.write(crc.to_bytes(4, "big"))
+        # rotation: prune only AFTER the new generation is durable, so a
+        # kill anywhere in save() leaves at least the previous good ring
+        for old_gen, old_path in gens[:max(len(gens) + 1 - self.keep, 0)]:
+            try:
+                os.remove(old_path)
+            except OSError:
+                logger.warning("could not prune checkpoint generation %d "
+                               "(%s)", old_gen, old_path)
+        # ...and sweep up temp litter a previous process's mid-write kill
+        # left behind (our own temp was just renamed into place)
+        self._prune_orphan_tmp()
+        stats = {
+            "path": path,
+            "generation": gen,
+            "bytes": len(body) + 4,
+            "write_s": time.perf_counter() - t0,
+            **dict(extra_meta or {}),
+        }
+        self.last_save_stats = stats
+        if self.on_save is not None:
+            try:
+                self.on_save(dict(stats))
+            except Exception:
+                # metrics/reporting hooks must never take down a save (it
+                # may be the last durable state before a preemption)
+                logger.warning("checkpoint on_save hook failed",
+                               exc_info=True)
+        return stats
 
-    def _read(self) -> tuple[dict, bytes]:
-        with open(self._path, "rb") as f:
-            n = int.from_bytes(f.read(8), "big")
-            header = json.loads(f.read(n).decode("utf-8"))
-            blob = f.read()
-        return header, blob
+    # -- read / verify ---------------------------------------------------
+    def _read_file(self, path: str) -> tuple[dict, dict, bytes]:
+        """Parse + verify ONE checkpoint file -> (host_header, meta, blob).
+        Raises :class:`CheckpointCorruptError` naming the file on any
+        structural failure."""
+        with open(path, "rb") as f:
+            data = f.read()
+        if not data.startswith(_MAGIC):
+            # legacy v0: [8B header length][header JSON][blob], no CRC
+            if len(data) < 8:
+                raise CheckpointCorruptError(path, "truncated legacy frame")
+            n = int.from_bytes(data[:8], "big")
+            if 8 + n > len(data):
+                raise CheckpointCorruptError(
+                    path, "truncated legacy header (torn write?)"
+                )
+            try:
+                header = json.loads(data[8:8 + n].decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise CheckpointCorruptError(
+                    path, f"unparseable legacy header ({e})"
+                ) from e
+            return header, {"format_version": 0}, data[8 + n:]
+        if len(data) < _MIN_FRAME:
+            raise CheckpointCorruptError(
+                path, f"truncated frame ({len(data)} bytes)"
+            )
+        body, crc_stored = data[:-4], int.from_bytes(data[-4:], "big")
+        if (zlib.crc32(body) & 0xFFFFFFFF) != crc_stored:
+            raise CheckpointCorruptError(
+                path, "CRC32 mismatch (torn or corrupt write)"
+            )
+        version = int.from_bytes(data[8:12], "big")
+        if version > FORMAT_VERSION:
+            raise CheckpointCorruptError(
+                path,
+                f"format version {version} is newer than this build's "
+                f"{FORMAT_VERSION}",
+            )
+        hlen = int.from_bytes(data[12:20], "big")
+        if 20 + hlen > len(body):
+            raise CheckpointCorruptError(path, "truncated header")
+        try:
+            header = json.loads(body[20:20 + hlen].decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                path, f"unparseable header ({e})"
+            ) from e
+        return (header.get("host", {}), header.get("meta", {}),
+                body[20 + hlen:])
 
-    def load(self, tree_templates: Mapping[str, Any],
-             host_templates: Mapping[str, Any] | None = None,
-             snapshotters: Mapping[str, Snapshotter] | None = None,
-             ) -> tuple[dict, dict]:
+    def _read(self) -> tuple[dict, dict, bytes, RestoreInfo]:
+        """Newest-good read with ring fallback: walk candidates newest to
+        oldest, skipping (and logging) corrupt generations. Raises the
+        newest file's :class:`CheckpointCorruptError` when every candidate
+        is bad, and ``FileNotFoundError`` when none exists."""
+        cands = self.candidate_paths()
+        if not cands:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory!r} "
+                f"(name={self.name!r})"
+            )
+        skipped: list[str] = []
+        first_err: CheckpointCorruptError | None = None
+        for gen, path in cands:
+            try:
+                host, meta, blob = self._read_file(path)
+            except CheckpointCorruptError as e:
+                logger.warning(
+                    "checkpoint generation %d is corrupt (%s); falling "
+                    "back to the previous generation", gen, e,
+                )
+                skipped.append(path)
+                first_err = first_err or e
+                continue
+            info = RestoreInfo(
+                path=path, generation=gen,
+                nbytes=os.path.getsize(path), meta=meta,
+                fallback_skipped=skipped,
+            )
+            return host, meta, blob, info
+        assert first_err is not None
+        raise first_err
+
+    # -- load ------------------------------------------------------------
+    def load_with_info(
+        self, tree_templates: Mapping[str, Any],
+        host_templates: Mapping[str, Any] | None = None,
+        snapshotters: Mapping[str, Snapshotter] | None = None,
+        expected_config_hash: str | None = None,
+    ) -> tuple[dict, dict, RestoreInfo]:
         snapshotters = snapshotters or {}
-        header, blob = self._read()
+        header, meta, blob, info = self._read()
+        stored = meta.get("config_hash")
+        if (expected_config_hash is not None and stored is not None
+                and stored != expected_config_hash):
+            raise CheckpointConfigMismatchError(
+                info.path, stored, expected_config_hash
+            )
         trees = serialization.from_bytes(dict(tree_templates), blob)
         host = {}
         for k, template in (host_templates or {}).items():
             snap = snapshotters.get(k, SerializableSnapshotter())
             host[k] = snap.load(header.get(k), template)
-        return trees, host
+        self.last_restore_info = info
+        return trees, host, info
 
-    def clear(self) -> None:
-        if os.path.exists(self._path):
-            os.remove(self._path)
+    def load(self, tree_templates: Mapping[str, Any],
+             host_templates: Mapping[str, Any] | None = None,
+             snapshotters: Mapping[str, Snapshotter] | None = None,
+             expected_config_hash: str | None = None,
+             ) -> tuple[dict, dict]:
+        trees, host, _info = self.load_with_info(
+            tree_templates, host_templates, snapshotters,
+            expected_config_hash=expected_config_hash,
+        )
+        return trees, host
 
 
 class SimulationStateCheckpointer(StateCheckpointer):
@@ -134,7 +483,14 @@ class SimulationStateCheckpointer(StateCheckpointer):
     current_round, history, server_name — state_checkpointer.py:438-448) AND
     the client defaults (model, optimizers, schedulers, steps, meters
     :296-325), because the simulation's stacked client TrainState carries every
-    client's model/optimizer/RNG in one pytree."""
+    client's model/optimizer/RNG in one pytree.
+
+    Beyond the synchronous roles it also snapshots buffered-async runs
+    (``save_async_snapshot``/``load_async_simulation``): the FedBuff
+    ``pending`` update buffer, the event cursor, and the virtual clock —
+    plus a fingerprint of the consumed prefix of the static event plan, so
+    a resume can PROVE it is continuing the same arrival schedule before
+    splicing restored state into it."""
 
     TREES = ("server_state", "client_states")
 
@@ -162,38 +518,141 @@ class SimulationStateCheckpointer(StateCheckpointer):
         kwargs = dict(
             trees=dict(trees),
             host={
+                "kind": "sync",
                 "current_round": current_round,
                 "n_clients": n_clients,
                 "history": list(history),
             },
             snapshotters={"history": DataclassListSnapshotter()},
+            extra_meta={"round": current_round, "kind": "sync"},
         )
         if writer is not None:
             writer.submit(self.save, **kwargs)
         else:
             self.save(**kwargs)
 
-    def load_simulation(self, sim) -> int:
-        """Restore in place; returns the next round to run (1-based)."""
-        from fl4health_tpu.server.simulation import RoundRecord
-
-        trees, host = self.load(
-            tree_templates={
-                "server_state": sim.server_state,
-                "client_states": sim.client_states,
-            },
-            host_templates={
-                "current_round": 0,
-                "n_clients": sim.n_clients,
-                "history": [RoundRecord(0, {}, {}, {}, {}, 0.0, 0.0)],
+    def save_async_snapshot(
+        self, trees, event: int, n_clients: int, history,
+        plan_fingerprint: str, virtual_time_s: float, writer=None,
+    ) -> None:
+        """Persist a buffered-async snapshot: server state, client stack
+        AND the in-flight ``pending`` update buffer, with the event cursor,
+        virtual clock, and the fingerprint of the event plan's consumed
+        prefix (``server.async_schedule.plan_fingerprint``)."""
+        kwargs = dict(
+            trees=dict(trees),
+            host={
+                "kind": "async",
+                "current_event": event,
+                "n_clients": n_clients,
+                "history": list(history),
+                "plan_fingerprint": plan_fingerprint,
+                "virtual_time_s": float(virtual_time_s),
             },
             snapshotters={"history": DataclassListSnapshotter()},
+            extra_meta={"round": event, "kind": "async"},
         )
-        if host["n_clients"] != sim.n_clients:
+        if writer is not None:
+            writer.submit(self.save, **kwargs)
+        else:
+            self.save(**kwargs)
+
+    def _history_template(self):
+        from fl4health_tpu.server.simulation import RoundRecord
+
+        # one template record keeps LEGACY payloads (bare row lists with no
+        # stored class name) restoring real RoundRecords
+        return [RoundRecord(0, {}, {}, {}, {}, 0.0, 0.0)]
+
+    def load_simulation(self, sim) -> int:
+        """Restore in place; returns the next round to run (1-based).
+        Header facts (kind/cohort/config binding) are validated BEFORE the
+        array blob deserializes, so a wrong-experiment restore fails with
+        its real reason, never a pytree-structure error. Mesh runs get the
+        restored host arrays ``device_put`` back onto the round programs'
+        shardings (``sim.adopt_restored_state``)."""
+        header, _meta, blob, info = self._read()
+        if (header.get("kind") or "sync") != "sync":
             raise ValueError(
-                f"checkpoint has {host['n_clients']} clients, run has {sim.n_clients}"
+                f"checkpoint {info.path} was written by a buffered-async "
+                "run (it carries a pending update buffer); resume it with "
+                "the same async_config instead"
             )
-        sim.server_state = trees["server_state"]
-        sim.client_states = trees["client_states"]
-        sim.history = host["history"]
-        return int(host["current_round"]) + 1
+        if header["n_clients"] != sim.n_clients:
+            raise ValueError(
+                f"checkpoint has {header['n_clients']} clients, run has "
+                f"{sim.n_clients}"
+            )
+        self._check_config(info, sim)
+        trees = serialization.from_bytes(
+            {"server_state": sim.server_state,
+             "client_states": sim.client_states},
+            blob,
+        )
+        sim.adopt_restored_state(trees["server_state"],
+                                 trees["client_states"])
+        sim.history = DataclassListSnapshotter().load(
+            header.get("history"), self._history_template()
+        )
+        self.last_restore_info = info
+        return int(header["current_round"]) + 1
+
+    def load_async_simulation(self, sim, pending_template, plan) -> int:
+        """Restore a buffered-async run mid-plan; returns the next EVENT to
+        run (1-based). Verifies the stored plan-prefix fingerprint against
+        the (re-derived) static event plan, so splicing restored state into
+        a *different* arrival schedule fails loudly instead of silently
+        de-synchronizing staleness accounting."""
+        from fl4health_tpu.server.async_schedule import plan_fingerprint
+
+        header, _meta, blob, info = self._read()
+        if (header.get("kind") or "sync") != "async":
+            raise ValueError(
+                f"checkpoint {info.path} was written by a synchronous run "
+                "(no pending update buffer); resume it without async_config"
+            )
+        if header["n_clients"] != sim.n_clients:
+            raise ValueError(
+                f"checkpoint has {header['n_clients']} clients, run has "
+                f"{sim.n_clients}"
+            )
+        self._check_config(info, sim)
+        event = int(header["current_event"])
+        if event > plan.n_events:
+            raise ValueError(
+                f"checkpoint is at event {event} but the resumed plan has "
+                f"only {plan.n_events} events; fit() at least {event} rounds"
+            )
+        expected_fp = plan_fingerprint(plan, event)
+        if (header.get("plan_fingerprint")
+                and header["plan_fingerprint"] != expected_fp):
+            raise ValueError(
+                f"checkpoint {info.path} was written under a different "
+                "async event plan (fingerprint mismatch over the first "
+                f"{event} events) — the AsyncConfig seed, FaultPlan, cohort "
+                "and buffer_size must match the interrupted run for the "
+                "buffered updates to resume bit-identically"
+            )
+        trees = serialization.from_bytes(
+            {"server_state": sim.server_state,
+             "client_states": sim.client_states,
+             "pending": pending_template},
+            blob,
+        )
+        sim.adopt_restored_state(
+            trees["server_state"], trees["client_states"],
+            pending=trees["pending"],
+        )
+        sim.history = DataclassListSnapshotter().load(
+            header.get("history"), self._history_template()
+        )
+        self.last_restore_info = info
+        return event + 1
+
+    def _check_config(self, info: RestoreInfo, sim) -> None:
+        stored = info.meta.get("config_hash")
+        current = self.config_hash
+        if current is None:
+            current = sim._resume_config_hash()
+        if stored is not None and current is not None and stored != current:
+            raise CheckpointConfigMismatchError(info.path, stored, current)

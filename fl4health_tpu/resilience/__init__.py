@@ -20,7 +20,11 @@ halt. This subsystem is the next step, four pillars:
   against, not asserted;
 - :mod:`~fl4health_tpu.resilience.retry` — retry/backoff, failure-reason
   classification and per-silo circuit breakers for the concurrent
-  quorum-based ``broadcast_round`` in ``transport/coordinator.py``.
+  quorum-based ``broadcast_round`` in ``transport/coordinator.py``;
+- :mod:`~fl4health_tpu.resilience.recovery` — the crash-drill harness
+  proving preemption survival: a subprocess ``fit()`` SIGKILLed at a
+  seeded point (including mid-checkpoint-write), resumed from the
+  retention ring, and pinned bit-identical to the uninterrupted run.
 """
 
 from fl4health_tpu.resilience.aggregators import (
@@ -45,6 +49,13 @@ from fl4health_tpu.resilience.quarantine import (
     init_quarantine,
     quarantine_step,
 )
+from fl4health_tpu.resilience.recovery import (
+    DrillResult,
+    KillPoint,
+    corrupt_newest_generation,
+    install_kill_hook,
+    run_child,
+)
 from fl4health_tpu.resilience.retry import (
     CircuitBreaker,
     CircuitOpenError,
@@ -54,6 +65,11 @@ from fl4health_tpu.resilience.retry import (
 )
 
 __all__ = [
+    "DrillResult",
+    "KillPoint",
+    "corrupt_newest_generation",
+    "install_kill_hook",
+    "run_child",
     "ROBUST_METHODS",
     "RobustFedAvg",
     "coordinate_median",

@@ -42,6 +42,7 @@ which is how the simulation pins ``async == sync`` bit-identically.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import heapq
 
 import numpy as np
@@ -50,6 +51,8 @@ __all__ = [
     "AsyncConfig",
     "AsyncEventPlan",
     "build_event_plan",
+    "plan_fingerprint",
+    "plan_prefix_fingerprints",
     "staleness_discount",
     "sync_round_times",
 ]
@@ -148,6 +151,39 @@ class AsyncEventPlan:
             "async_virtual_time_s": float(self.event_times[e]),
             "async_cadence_vs": float(self.cadences()[e]),
         }
+
+
+def plan_prefix_fingerprints(plan: AsyncEventPlan) -> list[str]:
+    """Per-event prefix digests of a static event plan: entry ``e-1`` is a
+    short hash over events ``1..e``'s arrivals, staleness and virtual
+    times. A checkpoint written after event ``e`` stores entry ``e-1``, so
+    a resume can verify it is splicing state into the SAME arrival
+    schedule (AsyncConfig seed / FaultPlan / cohort / buffer_size all feed
+    the plan, so any drift changes the digest). Incremental sha256 — one
+    pass over the plan for all E prefixes."""
+    h = hashlib.sha256()
+    out: list[str] = []
+    arrivals = np.ascontiguousarray(plan.arrivals, np.float32)
+    staleness = np.ascontiguousarray(plan.staleness, np.float32)
+    times = np.ascontiguousarray(plan.event_times, np.float64)
+    for e in range(plan.n_events):
+        h.update(arrivals[e].tobytes())
+        h.update(staleness[e].tobytes())
+        h.update(times[e].tobytes())
+        out.append(h.copy().hexdigest()[:16])
+    return out
+
+
+def plan_fingerprint(plan: AsyncEventPlan, n_events: int) -> str:
+    """The prefix digest over the first ``n_events`` events (see
+    :func:`plan_prefix_fingerprints`); empty-prefix digest for 0."""
+    if n_events < 0 or n_events > plan.n_events:
+        raise ValueError(
+            f"n_events must be in [0, {plan.n_events}]; got {n_events}"
+        )
+    if n_events == 0:
+        return hashlib.sha256().hexdigest()[:16]
+    return plan_prefix_fingerprints(plan)[n_events - 1]
 
 
 def staleness_discount(staleness, exponent=0.5,

@@ -19,9 +19,11 @@ responsibilities without any per-round serialize/deserialize.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import logging
+import sys
 import time
 from typing import Any, Callable, Sequence
 
@@ -36,7 +38,7 @@ from fl4health_tpu.clients import engine
 from fl4health_tpu.observability import Observability
 from fl4health_tpu.observability import device_specs
 from fl4health_tpu.observability import telemetry as telem
-from fl4health_tpu.observability.manifest import run_manifest
+from fl4health_tpu.observability.manifest import config_hash, run_manifest
 from fl4health_tpu.observability.telemetry import RoundTelemetry
 from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
 from fl4health_tpu.core import pytree as ptu
@@ -197,6 +199,9 @@ class _RoundWork:
     # event (staleness stats, virtual cadence) from the static plan —
     # merged into the round record/metrics by the consumer
     async_info: dict | None = None
+    # async checkpoint extras: the plan-prefix fingerprint + virtual clock
+    # stored with the event's state snapshot (None on sync rounds)
+    resume_meta: dict | None = None
 
 
 class FederatedSimulation:
@@ -469,13 +474,26 @@ class FederatedSimulation:
                     "train_data_provider: the async event programs bake "
                     "their data at dispatch time"
                 )
-            if self.model_checkpointers or self.state_checkpointer is not None:
+            if self.model_checkpointers:
                 raise ValueError(
-                    "async_config is not composable with per-round "
-                    "checkpointing yet: there is no synchronous "
-                    "post-fit/pre-aggregation moment inside a fused "
-                    "buffer-fill event (checkpoint manually between "
-                    "fit() calls instead)"
+                    "async_config is not composable with per-round model "
+                    "checkpointing: there is no synchronous post-fit/"
+                    "pre-aggregation moment inside a fused buffer-fill "
+                    "event (state checkpointing — resume — composes; use "
+                    "state_checkpointer)"
+                )
+            sc = self.state_checkpointer
+            if sc is not None and not (
+                hasattr(sc, "save_async_snapshot")
+                and hasattr(sc, "load_async_simulation")
+            ):
+                raise ValueError(
+                    "async state checkpointing needs a checkpointer that "
+                    "can snapshot the pending update buffer and the event "
+                    "cursor (save_async_snapshot/load_async_simulation — "
+                    f"SimulationStateCheckpointer); {type(sc).__name__} "
+                    "cannot, so an interrupted async run could not resume "
+                    "mid-plan"
                 )
         # fit() dispatch strategy: "auto" routes through the on-device
         # multi-round chunked scan whenever the configuration permits (see
@@ -501,6 +519,12 @@ class FederatedSimulation:
         self._prefetcher: RoundPrefetcher | None = None
         self._ckpt_writer: AsyncCheckpointWriter | None = None
         self._fit_n_rounds = 0
+        # facts of the restore a fit() performed (manifest `resume`
+        # descriptor); None on fresh runs
+        self._resume_info: dict | None = None
+        # per-event prefix digests of the async plan (computed when async
+        # checkpointing is active; event e's snapshot stores entry e-1)
+        self._async_prefix_fps: list[str] | None = None
         # Measured per-round program FLOPs from build-time introspection
         # (observability/introspect.py); None until a fit() captures it.
         # Feeds the measured-MFU numbers in _record_round_metrics.
@@ -1595,8 +1619,9 @@ class FederatedSimulation:
         _, event = self._build_async_fns(self._telemetry_enabled)
 
         def chunk(server_state, client_states, pending, x_stack, y_stack,
-                  idx, em, sm, arrivals, staleness, val_batches, val_counts,
-                  staleness_exponent, test_batches=None, test_counts=None):
+                  idx, em, sm, arrivals, staleness, start_event,
+                  val_batches, val_counts, staleness_exponent,
+                  test_batches=None, test_counts=None):
             def body(carry, per_event):
                 server_state, client_states, pending, e = carry
                 idx_r, em_r, sm_r, arr_r, stal_r = per_event
@@ -1610,13 +1635,14 @@ class FederatedSimulation:
                 )
                 return (server_state, client_states, pending, e + 1), out
 
-            (server_state, client_states, _p, _e), outs = jax.lax.scan(
+            (server_state, client_states, pending, _e), outs = jax.lax.scan(
                 body,
-                (server_state, client_states, pending,
-                 jnp.asarray(1, jnp.int32)),
+                (server_state, client_states, pending, start_event),
                 (idx, em, sm, arrivals, staleness),
             )
-            return server_state, client_states, outs
+            # pending is RETURNED: the next chunk (checkpoint boundary)
+            # carries it forward, and the boundary snapshot persists it
+            return server_state, client_states, pending, outs
 
         b = self._program_builder
         in_sh = out_sh = None
@@ -1624,10 +1650,12 @@ class FederatedSimulation:
             cs = b.client_sharding()
             scs = b.stacked_client_sharding()
             in_sh = (self._sh_server_state, self._sh_client_states, cs,
-                     cs, cs, scs, scs, scs, scs, scs, cs, cs, b.replicated())
+                     cs, cs, scs, scs, scs, scs, scs, b.replicated(),
+                     cs, cs, b.replicated())
             if self._test_batches() is not None:
                 in_sh = in_sh + (cs, cs)
-            out_sh = (self._sh_server_state, self._sh_client_states, None)
+            out_sh = (self._sh_server_state, self._sh_client_states, cs,
+                      None)
         self._async_chunked_jit = b.jit(
             chunk, donate=(0, 1, 2), in_shardings=in_sh, out_shardings=out_sh
         )
@@ -1689,8 +1717,17 @@ class FederatedSimulation:
             return "train_data_provider needs a host data refresh every round"
         if self.model_checkpointers:
             return "per-round model checkpointing needs per-round host access"
-        if self.state_checkpointer is not None:
-            return "per-round durable state checkpointing (and resume)"
+        # Durable state checkpointing no longer demotes the chunked path:
+        # snapshot-capable checkpointers save at chunk boundaries (the run
+        # dispatches in checkpoint_every-round chunks and the snapshot
+        # rides the existing boundary host touch). Only the legacy
+        # sim-reading API — save_simulation(sim, round) against LIVE state
+        # every round — still needs the per-round loop.
+        if (self.state_checkpointer is not None
+                and not hasattr(self.state_checkpointer,
+                                "save_simulation_snapshot")):
+            return ("legacy state checkpointer (save_simulation reads live "
+                    "per-round state)")
         if not self.failure_policy.accept_failures:
             return "accept_failures=False must be able to terminate mid-run"
         # Observability per se no longer demotes the chunked path: in-graph
@@ -1755,6 +1792,26 @@ class FederatedSimulation:
         logging.getLogger(__name__).info(
             "fit: execution_mode=%s (%s)", mode, mode_reason
         )
+        # Resume BEFORE the manifest/introspection: a restored run's
+        # manifest carries its `resume` descriptor, and the chunked paths
+        # size their dispatches from the remaining rounds. The async event
+        # plan is derived first — the resume must fingerprint-verify the
+        # consumed prefix against it.
+        plan = None
+        if self._async_active and n_rounds >= 1:
+            from fl4health_tpu.server.async_schedule import build_event_plan
+
+            plan = build_event_plan(
+                self.async_config, n_rounds, self.n_clients, self._fault_plan
+            )
+            self._async_plan = plan
+        try:
+            start_round = self._maybe_resume(n_rounds, plan)
+        except BaseException:
+            # a failed restore (all generations corrupt, config mismatch)
+            # must still disarm the hooks this fit() armed
+            obs.shutdown()
+            raise
         if obs.watchdog is not None and not self._telemetry_enabled:
             logging.getLogger(__name__).warning(
                 "HealthWatchdog attached but in-graph telemetry is off "
@@ -1783,12 +1840,18 @@ class FederatedSimulation:
             # exported as manifest.json): provenance that makes a scraped
             # metrics page interpretable — versions, chip, mode, config hash
             try:
+                extra = None
+                if self._resume_info is not None:
+                    # resumed runs disclose where they picked up — the key
+                    # is absent on fresh runs so legacy manifests are stable
+                    extra = {"resume": dict(self._resume_info)}
                 obs.update_manifest(run_manifest(
                     execution_mode=mode,
                     execution_mode_reason=mode_reason,
                     donation=bool(_donate_argnums(0, 1)),
                     mesh=self._program_builder.descriptor(),
                     config=self._manifest_config(n_rounds),
+                    extra=extra,
                 ))
             except Exception:
                 logging.getLogger(__name__).warning(
@@ -1803,18 +1866,23 @@ class FederatedSimulation:
                 # would be dishonest — staleness/cadence metrics carry the
                 # async story instead.)
                 with obs.span("introspect", cat="fit"):
-                    self._introspect_programs(mode, n_rounds)
+                    # the chunked path dispatches checkpoint_every-round
+                    # chunks when a snapshot checkpointer is attached —
+                    # introspect the program shape fit() will actually run
+                    self._introspect_programs(
+                        mode, self._rounds_per_dispatch(n_rounds, start_round)
+                    )
         for r in self.reporters:
             r.report({"host_type": "server", "fit_start": time.time(),
                       "num_rounds": n_rounds, "execution_mode": mode,
                       "execution_mode_reason": mode_reason})
         try:
             if self._async_active and n_rounds >= 1:
-                self._fit_async(n_rounds, mode)
+                self._fit_async(n_rounds, mode, plan, start_round)
             elif mode == EXEC_CHUNKED:
-                self._fit_chunked(n_rounds)
+                self._fit_chunked(n_rounds, start_round)
             else:
-                self._fit_pipelined(n_rounds)
+                self._fit_pipelined(n_rounds, start_round)
         finally:
             # shutdown (not just export) ALWAYS runs — even when a round
             # raises (ClientFailuresError): it detaches the compile monitor
@@ -1864,6 +1932,229 @@ class FederatedSimulation:
             # key absent on single-chip builds so legacy hashes are stable
             config["mesh"] = self._program_builder.descriptor()
         return config
+
+    # -- crash-consistent checkpoint/resume ------------------------------
+    def _resume_config_hash(self) -> str:
+        """The resume-relevant experiment identity a checkpoint binds to:
+        the manifest config minus the knobs that may legitimately differ
+        between an interrupted run and its resume — ``n_rounds`` (resuming
+        with more rounds is the point), ``execution_mode`` (trajectories
+        are pinned identical across modes, so cross-mode resume is legal),
+        ``telemetry`` (observability never changes the trajectory) and
+        ``mesh`` (placement, not math — restored arrays are re-sharded
+        onto whatever mesh the resuming run deploys)."""
+        cfg = {
+            k: v for k, v in self._manifest_config(0).items()
+            if k not in ("n_rounds", "execution_mode", "telemetry", "mesh")
+        }
+        return config_hash(cfg)
+
+    def adopt_restored_state(self, server_state, client_states,
+                             pending=None) -> None:
+        """Install restored (host numpy) trees as the live training state.
+        Under a mesh the arrays are ``device_put`` back onto the round
+        programs' ``NamedSharding``s — the same placement a fresh build
+        pins via in_shardings — so the first resumed dispatch never pays an
+        implicit gather-and-reshard; single-chip runs get one committed
+        device transfer instead of a per-dispatch host upload."""
+        b = self._program_builder
+        if b.mesh is not None:
+            server_state = b.put(server_state, self._sh_server_state)
+            client_states = b.put(client_states, self._sh_client_states)
+            if pending is not None:
+                pending = b.put(pending, b.client_sharding())
+        else:
+            server_state = jax.device_put(server_state)
+            client_states = jax.device_put(client_states)
+            if pending is not None:
+                pending = jax.device_put(pending)
+        self.server_state = server_state
+        self.client_states = client_states
+        if pending is not None:
+            self._async_pending = pending
+
+    def _ckpt_every(self) -> int | None:
+        """The attached snapshot checkpointer's save cadence in rounds
+        (None when no snapshot-capable checkpointer is attached)."""
+        sc = self.state_checkpointer
+        if sc is None or not hasattr(sc, "save_simulation_snapshot"):
+            return None
+        return max(int(getattr(sc, "checkpoint_every", 1) or 1), 1)
+
+    def _rounds_per_dispatch(self, n_rounds: int, start_round: int = 1) -> int:
+        """Scan length of the chunked path's next dispatch: all remaining
+        rounds, capped at ``checkpoint_every`` when snapshots are due at
+        chunk boundaries."""
+        remaining = max(n_rounds - start_round + 1, 1)
+        every = self._ckpt_every()
+        return remaining if every is None else min(every, remaining)
+
+    def _checkpoint_due(self, rnd: int) -> bool:
+        every = self._ckpt_every()
+        if every is None:
+            return False
+        return rnd % every == 0 or rnd >= self._fit_n_rounds
+
+    def _async_pending_template(self, val_batches):
+        """Host-shaped template of the async ``pending`` buffer (the tree
+        the prologue produces), via ``jax.eval_shape`` — no device work, no
+        prologue dispatch — for deserializing a restored buffer into."""
+        prologue, _ = self._build_async_fns(self._telemetry_enabled)
+        batches1 = self._round_batches(1)
+        _states_sds, pending_sds = jax.eval_shape(
+            prologue, self.server_state, self.client_states, batches1,
+            val_batches,
+        )
+        return jax.tree_util.tree_map(
+            lambda s: np.zeros(s.shape, s.dtype), pending_sds
+        )
+
+    def _maybe_resume(self, n_rounds: int, plan=None) -> int:
+        """Bind the checkpointer to this run (config hash + metrics hook)
+        and restore the newest good generation when one exists. Returns the
+        first round/event to run (1 on a fresh start). Sets
+        ``self._resume_info`` for the manifest's ``resume`` descriptor."""
+        self._resume_info = None
+        sc = self.state_checkpointer
+        if sc is None:
+            return 1
+        # bind the frame's config hash + fl_ckpt_* metrics hook once —
+        # explicit user-set values win
+        if getattr(sc, "config_hash", "absent") is None:
+            sc.config_hash = self._resume_config_hash()
+        if getattr(sc, "on_save", "absent") is None:
+            sc.on_save = self._emit_checkpoint_stats
+        if not (hasattr(sc, "exists") and sc.exists()):
+            return 1
+        if self._async_active:
+            if n_rounds < 1:
+                return 1
+            val_batches, _ = self._val_batches()
+            template = self._async_pending_template(val_batches)
+            start = sc.load_async_simulation(self, template, plan)
+        elif hasattr(sc, "load_simulation"):
+            # fit_with_per_round_checkpointing resume (base_server.py:143-229)
+            start = sc.load_simulation(self)
+        else:
+            return 1
+        info = getattr(sc, "last_restore_info", None)
+        self._resume_info = {
+            "next_round": int(start),
+            "kind": "async" if self._async_active else "sync",
+        }
+        if info is not None:
+            self._resume_info.update(
+                path=info.path, generation=info.generation,
+                bytes=info.nbytes,
+                fallback_skipped=list(info.fallback_skipped),
+            )
+        obs = self.observability
+        if obs.enabled:
+            reg = obs.registry
+            reg.counter(
+                "fl_ckpt_restores_total",
+                help="state-checkpoint restores (resumed runs)",
+            ).inc()
+            if info is not None and info.fallback_skipped:
+                reg.counter(
+                    "fl_ckpt_fallbacks_total",
+                    help="corrupt checkpoint generations skipped by the "
+                         "retention-ring fallback at restore",
+                ).inc(len(info.fallback_skipped))
+            obs.log_event("resume", **self._resume_info)
+        logging.getLogger(__name__).info(
+            "resumed from checkpoint: next %s %d",
+            "event" if self._async_active else "round", start,
+        )
+        return start
+
+    def _emit_checkpoint_stats(self, stats: dict) -> None:
+        """``fl_ckpt_*`` metrics + one ``checkpoint`` JSONL event per
+        durable save. Runs on whichever thread persisted the frame (the
+        async writer under the pipelined loop) — the registry is
+        thread-safe and this hook never raises into the writer."""
+        obs = self.observability
+        if not obs.enabled:
+            return
+        reg = obs.registry
+        reg.counter(
+            "fl_ckpt_writes_total", help="durable state-checkpoint writes",
+        ).inc()
+        reg.counter(
+            "fl_ckpt_bytes_written_total",
+            help="bytes of durable state-checkpoint frames written",
+        ).inc(int(stats.get("bytes", 0)))
+        reg.counter(
+            "fl_ckpt_write_seconds_total",
+            help="wall seconds spent serializing+writing state checkpoints "
+                 "(off the round loop under the async writer)",
+        ).inc(float(stats.get("write_s", 0.0)))
+        reg.gauge(
+            "fl_ckpt_last_write_ms",
+            help="wall milliseconds of the most recent checkpoint write",
+        ).set(float(stats.get("write_s", 0.0)) * 1000.0)
+        reg.gauge(
+            "fl_ckpt_generation",
+            help="newest durable checkpoint generation in the retention ring",
+        ).set(float(stats.get("generation", 0)))
+        reg.log_event(
+            "checkpoint",
+            round=stats.get("round"),
+            generation=stats.get("generation"),
+            bytes=stats.get("bytes"),
+            write_ms=round(float(stats.get("write_s", 0.0)) * 1000.0, 3),
+            path=stats.get("path"),
+            kind=stats.get("kind", "sync"),
+        )
+
+    def _close_ckpt_writer(self, writer) -> None:
+        """Close the async checkpoint writer on EVERY exit path and surface
+        its stored failure without masking an in-flight exception.
+        ``close()`` drains the queue before joining, so a run that halts
+        (``TrainingHealthError``, ``ClientFailuresError``) still publishes
+        its last completed-round checkpoint before the error propagates."""
+        writer.close()
+        in_flight = sys.exc_info()[1] is not None
+        try:
+            writer.raise_pending()
+        except BaseException:
+            if not in_flight:
+                raise
+            logging.getLogger(__name__).warning(
+                "checkpoint write failed during error shutdown (the "
+                "primary exception propagates)", exc_info=True,
+            )
+
+    @contextlib.contextmanager
+    def _ckpt_writer_scope(self, active: bool,
+                           attach_model_ckpts: bool = False):
+        """THE async-checkpoint-writer lifecycle, shared by every fit path:
+        yields a fresh :class:`AsyncCheckpointWriter` (or None when
+        ``active`` is False), flushes it on clean exit, and on EVERY exit —
+        error paths included — drains+closes it, surfaces stored write
+        failures without masking an in-flight exception
+        (:meth:`_close_ckpt_writer`), detaches any model checkpointers and
+        resets ``self._ckpt_writer``."""
+        if not active:
+            yield None
+            return
+        writer = self._ckpt_writer = AsyncCheckpointWriter()
+        attached = []
+        if attach_model_ckpts:
+            for _mode, ckpt in self.model_checkpointers:
+                if hasattr(ckpt, "async_writer"):
+                    ckpt.async_writer = writer
+                    attached.append(ckpt)
+        try:
+            yield writer
+            writer.flush()  # clean exit: every submitted write is durable
+        finally:
+            try:
+                self._close_ckpt_writer(writer)
+            finally:
+                for ckpt in attached:
+                    ckpt.async_writer = None
+                self._ckpt_writer = None
 
     def _introspect_programs(self, mode: str, n_rounds: int) -> None:
         """Capture XLA cost/memory analysis for the round programs this
@@ -1955,59 +2246,54 @@ class FederatedSimulation:
             )
 
     # -- pipelined per-round path --------------------------------------
-    def _fit_pipelined(self, n_rounds: int) -> None:
+    def _fit_pipelined(self, n_rounds: int, start_round: int = 1) -> None:
         """The per-round path, pipelined: each round the producer (this
         thread) dispatches fit+eval and hands the round's results — one
         fused device tree plus any host snapshots donation would otherwise
         invalidate — to a background RoundConsumer that runs the host
         epilogue for round r while the device executes round r+1. The next
-        round's batches are prefetched concurrently."""
+        round's batches are prefetched concurrently. ``start_round`` > 1
+        continues a restored run (``_maybe_resume``)."""
         obs = self.observability
         with obs.span("setup", cat="fit"):
             val_batches, val_counts = self._val_batches()
-            start_round = 1
-            if self.state_checkpointer is not None and self.state_checkpointer.exists():
-                # fit_with_per_round_checkpointing resume (base_server.py:143-229)
-                start_round = self.state_checkpointer.load_simulation(self)
         self._fit_n_rounds = n_rounds
         # the round program donates the states — break any Python-level
         # buffer aliasing once; round outputs stay alias-free thereafter
         self.server_state, self.client_states = _dedupe_donated(
             self.server_state, self.client_states
         )
-        consumer = self._consumer = RoundConsumer(maxsize=self.pipeline_depth)
-        # per-round data staging is SHARDED under a mesh: the prefetcher's
-        # device_put splits the gathered [C, ...] batch stack over the
-        # clients axis while the previous round still runs
-        prefetcher = self._prefetcher = RoundPrefetcher(self)
-        writer = None
-        if self.model_checkpointers or self.state_checkpointer is not None:
-            writer = self._ckpt_writer = AsyncCheckpointWriter()
-            for _mode, ckpt in self.model_checkpointers:
-                if hasattr(ckpt, "async_writer"):
-                    ckpt.async_writer = writer
-        try:
-            if start_round <= n_rounds:
-                prefetcher.schedule(start_round)
-            for rnd in range(start_round, n_rounds + 1):
-                consumer.raise_pending()
-                # opt-in XProf capture of ONE chosen round (profile_round_idx)
-                with obs.maybe_profile(rnd):
-                    self._run_round(rnd, val_batches, val_counts)
-            consumer.flush()  # barrier: every round's epilogue has run
-            if writer is not None:
-                writer.flush()  # ...and every checkpoint write is durable
-        finally:
-            consumer.close()
-            prefetcher.close()
-            if writer is not None:
-                writer.close()
-                for _mode, ckpt in self.model_checkpointers:
-                    if getattr(ckpt, "async_writer", None) is writer:
-                        ckpt.async_writer = None
-            self._consumer = None
-            self._prefetcher = None
-            self._ckpt_writer = None
+        # the writer scope flushes on clean exit and, on error exits,
+        # drains + surfaces write failures without masking the in-flight
+        # exception — a halted run still publishes its last
+        # completed-round checkpoint
+        with self._ckpt_writer_scope(
+            bool(self.model_checkpointers
+                 or self.state_checkpointer is not None),
+            attach_model_ckpts=True,
+        ):
+            consumer = self._consumer = RoundConsumer(
+                maxsize=self.pipeline_depth
+            )
+            # per-round data staging is SHARDED under a mesh: the
+            # prefetcher's device_put splits the gathered [C, ...] batch
+            # stack over the clients axis while the previous round still
+            # runs
+            prefetcher = self._prefetcher = RoundPrefetcher(self)
+            try:
+                if start_round <= n_rounds:
+                    prefetcher.schedule(start_round)
+                for rnd in range(start_round, n_rounds + 1):
+                    consumer.raise_pending()
+                    # opt-in XProf capture of ONE round (profile_round_idx)
+                    with obs.maybe_profile(rnd):
+                        self._run_round(rnd, val_batches, val_counts)
+                consumer.flush()  # barrier: every round's epilogue has run
+            finally:
+                consumer.close()
+                prefetcher.close()
+                self._consumer = None
+                self._prefetcher = None
 
     def _run_round(self, rnd: int, val_batches, val_counts) -> None:
         """Producer half of one federated round: configure_fit -> fit
@@ -2093,9 +2379,14 @@ class FederatedSimulation:
                            for m, _ in self.model_checkpointers)
             need_post = any(m == CheckpointMode.POST_AGGREGATION
                             for m, _ in self.model_checkpointers)
+            # snapshot only on due rounds (checkpoint_every cadence): the
+            # device-side copies + fused pull of two full state trees are
+            # the entire per-round cost of durable state, so off-cadence
+            # rounds skip them entirely
             snapshot_state = (
                 self.state_checkpointer is not None
                 and hasattr(self.state_checkpointer, "save_simulation_snapshot")
+                and self._checkpoint_due(rnd)
             )
             pre_agg_params = None
             if need_pre:
@@ -2234,7 +2525,9 @@ class FederatedSimulation:
             if consumer is not None:
                 consumer.submit(functools.partial(self._finish_round, work))
                 legacy_state_save = (
-                    self.state_checkpointer is not None and not snapshot_state
+                    self.state_checkpointer is not None
+                    and not hasattr(self.state_checkpointer,
+                                    "save_simulation_snapshot")
                 )
                 if legacy_state_save or not self.failure_policy.accept_failures:
                     # Correctness over overlap, two cases:
@@ -2320,15 +2613,32 @@ class FederatedSimulation:
             # per-round durable state (_save_server_state, base_server.py:420)
             with obs.span("checkpoint", round=rnd, mode="state"):
                 if state_trees is not None:
-                    self.state_checkpointer.save_simulation_snapshot(
-                        state_trees, rnd, self.n_clients,
-                        list(self.history), writer=self._ckpt_writer,
-                    )
-                else:
+                    if work.resume_meta is not None:
+                        # buffered-async event snapshot: the trees include
+                        # the pending buffer, plus the plan-prefix
+                        # fingerprint + virtual clock the resume verifies
+                        self.state_checkpointer.save_async_snapshot(
+                            state_trees, rnd, self.n_clients,
+                            list(self.history),
+                            plan_fingerprint=work.resume_meta[
+                                "plan_fingerprint"],
+                            virtual_time_s=work.resume_meta[
+                                "virtual_time_s"],
+                            writer=self._ckpt_writer,
+                        )
+                    else:
+                        self.state_checkpointer.save_simulation_snapshot(
+                            state_trees, rnd, self.n_clients,
+                            list(self.history), writer=self._ckpt_writer,
+                        )
+                elif not hasattr(self.state_checkpointer,
+                                 "save_simulation_snapshot"):
                     # legacy sim-based API: reads live sim state — safe ONLY
                     # because the producer flushes this round's epilogue
                     # before dispatching the next round (see _run_round)
                     self.state_checkpointer.save_simulation(self, rnd)
+                # else: snapshot-capable checkpointer, off-cadence round —
+                # nothing due
         obs_summary = None
         if obs.enabled:
             obs_summary = self._record_round_metrics(
@@ -2369,13 +2679,23 @@ class FederatedSimulation:
             )
 
     # -- chunked on-device path ----------------------------------------
-    def _fit_chunked(self, n_rounds: int) -> None:
-        """fit()'s chunked route: ALL rounds execute in one compiled
-        lax.scan dispatch (fit + eval per round on device), then ONE fused
-        device->host pull materializes every RoundRecord. Per-round host
-        overhead collapses to the record/report loop at the end. Per-round
-        participation masks come from the same PRNG stream as the pipelined
-        path, so the trajectories match.
+    def _fit_chunked(self, n_rounds: int, start_round: int = 1) -> None:
+        """fit()'s chunked route: the rounds execute as compiled lax.scan
+        dispatches (fit + eval per round on device), then ONE fused
+        device->host pull per dispatch materializes the RoundRecords.
+        Per-round host overhead collapses to the record/report loop at
+        each chunk boundary. Per-round participation masks come from the
+        same PRNG stream as the pipelined path, so the trajectories match.
+
+        Without a state checkpointer the whole run is ONE dispatch, as
+        before. With a snapshot-capable checkpointer the run dispatches in
+        ``checkpoint_every``-round chunks and each boundary's host touch
+        (the fused pull that already happens there) also snapshots the
+        state trees for a durable, crash-consistent save — checkpointing
+        no longer costs the fast path (``state_checkpointer`` is not in
+        ``_chunk_ineligibility``). The scan body is identical for every
+        chunk length, so a chunked-with-checkpoints run is bit-identical
+        to the single-dispatch one (pinned by tests).
 
         With observability enabled the per-round gauges, JSONL ``round`` /
         ``telemetry`` events and reporter observability payloads are
@@ -2383,9 +2703,39 @@ class FederatedSimulation:
         ``_record_round_metrics`` the pipelined consumer runs, so nothing
         is pipelined-only. The HealthWatchdog screens each round's
         telemetry in order; a halt raises ``TrainingHealthError`` naming
-        the first offending round (the device work has already completed —
-        one dispatch covers the run — but the failure is just as loud)."""
+        the first offending round (the chunk's device work has already
+        completed, but the failure is just as loud)."""
+        if start_round > n_rounds:
+            return  # restored state already covers the requested rounds
+        sc = self.state_checkpointer
+        chunk_ckpt = (sc is not None
+                      and hasattr(sc, "save_simulation_snapshot"))
+        self._fit_n_rounds = n_rounds
+        with self._ckpt_writer_scope(chunk_ckpt) as writer:
+            s = start_round
+            while s <= n_rounds:
+                k = self._rounds_per_dispatch(n_rounds, s)
+                self._run_sync_chunk(s, k)
+                if chunk_ckpt:
+                    # the snapshot rides the chunk-boundary host touch: a
+                    # host pull of the fresh state outputs BEFORE the next
+                    # chunk's dispatch donates them away
+                    trees = jax.device_get({
+                        "server_state": self.server_state,
+                        "client_states": self.client_states,
+                    })
+                    sc.save_simulation_snapshot(
+                        trees, s + k - 1, self.n_clients,
+                        list(self.history), writer=writer,
+                    )
+                s += k
+
+    def _run_sync_chunk(self, start_round: int, k: int) -> None:
+        """Dispatch rounds ``[start_round, start_round+k)`` as one compiled
+        scan and run their host epilogue (the pre-checkpointing
+        ``_fit_chunked`` body, offset-aware)."""
         obs = self.observability
+        n_rounds = k
         compiles_before = compile_s_before = 0.0
         if obs.enabled:
             compiles_before = obs.registry.counter(
@@ -2399,7 +2749,8 @@ class FederatedSimulation:
         self.server_state, self.client_states = _dedupe_donated(
             self.server_state, self.client_states
         )
-        plans = [self._round_plan(r) for r in range(1, n_rounds + 1)]
+        rounds = range(start_round, start_round + k)
+        plans = [self._round_plan(r) for r in rounds]
         idx = jnp.asarray(np.stack([p[0] for p in plans]))
         em = jnp.asarray(np.stack([p[1] for p in plans]))
         sm = jnp.asarray(np.stack([p[2] for p in plans]))
@@ -2407,21 +2758,23 @@ class FederatedSimulation:
             self.client_manager.sample(
                 jax.random.fold_in(self.rng, 2000 + r), r
             )
-            for r in range(1, n_rounds + 1)
+            for r in rounds
         ])
         masks_np = np.asarray(mask_stack)
         x_bank, y_bank = self._sharded_train_banks()
         args = [self.server_state, self.client_states,
                 x_bank, y_bank, idx, em, sm,
-                mask_stack, jnp.asarray(1, jnp.int32), val_batches, val_counts]
+                mask_stack, jnp.asarray(start_round, jnp.int32),
+                val_batches, val_counts]
         if test is not None:
             args.extend(test)
-        with obs.span("fit_chunk", cat="fit", rounds=n_rounds) as chunk_span:
+        with obs.span("fit_chunk", cat="fit", rounds=n_rounds,
+                      start_round=start_round) as chunk_span:
             self.server_state, self.client_states, outs = chunked(*args)
-            # fence (enabled path only): total device wait for the whole
-            # run, amortized per round below
+            # fence (enabled path only): total device wait for the chunk,
+            # amortized per round below
             _, device_wait_total = obs.fence(outs)
-            stacked = jax.device_get(outs)  # the run's ONE fused host pull
+            stacked = jax.device_get(outs)  # the chunk's ONE fused host pull
             if obs.enabled:
                 chunk_span.set(device_wait_s=device_wait_total)
         compiles_after = compile_s_after = None
@@ -2435,6 +2788,7 @@ class FederatedSimulation:
         self._chunked_epilogue(
             n_rounds, stacked, masks_np, compiles_before, compile_s_before,
             compiles_after, compile_s_after, per_round_s, device_wait_round,
+            start_round=start_round,
         )
 
     def _chunked_epilogue(
@@ -2442,18 +2796,19 @@ class FederatedSimulation:
         compiles_before: float, compile_s_before: float,
         compiles_after: float | None, compile_s_after: float | None,
         per_round_s: float, device_wait_round: float,
-        async_plan=None,
+        async_plan=None, start_round: int = 1,
     ) -> None:
         """Per-round host epilogue over a chunked dispatch's stacked
         outputs: failure screen, RoundRecords, metrics/reports, watchdog —
         shared by the synchronous chunked route and the buffered-async
         chunked route (``async_plan`` adds per-event staleness/cadence
-        facts to each round's metrics)."""
+        facts to each round's metrics). ``start_round`` offsets the round
+        numbering for non-initial chunks (checkpoint boundaries, resume)."""
         obs = self.observability
         telemetry_stack = stacked.get("telemetry")
         quarantine_stack = stacked.get("quarantine")
         for i in range(n_rounds):
-            rnd = i + 1
+            rnd = start_round + i
             per_fit_i = {
                 k: v[i] for k, v in stacked["per_client_fit_losses"].items()
             }
@@ -2509,7 +2864,7 @@ class FederatedSimulation:
                     compile_s_after=(compile_s_after if i == 0
                                      else compile_s_before),
                     telemetry=telemetry_i,
-                    async_info=(self._async_event_info(async_plan, i)
+                    async_info=(self._async_event_info(async_plan, rnd - 1)
                                 if async_plan is not None else None),
                 )
             if quarantine_stack is not None:
@@ -2549,19 +2904,17 @@ class FederatedSimulation:
         ]
         return info
 
-    def _fit_async(self, n_rounds: int, mode: str) -> None:
-        """fit()'s buffered-async route: resolve the virtual-clock arrival
-        schedule to a static event plan (pure function of the async
-        config's seed, the FaultPlan and the cohort — identical across
-        execution modes, resumes and processes), then run ``n_rounds``
-        buffer-fill EVENTS as compiled programs. Each event is one
-        RoundRecord: cadence is set by arrival rate, not the tail."""
-        from fl4health_tpu.server.async_schedule import build_event_plan
-
-        plan = build_event_plan(
-            self.async_config, n_rounds, self.n_clients, self._fault_plan
-        )
-        self._async_plan = plan
+    def _fit_async(self, n_rounds: int, mode: str, plan,
+                   start_event: int = 1) -> None:
+        """fit()'s buffered-async route: the virtual-clock arrival
+        schedule was resolved to a static event plan at fit() entry (pure
+        function of the async config's seed, the FaultPlan and the cohort
+        — identical across execution modes, resumes and processes); run
+        the remaining buffer-fill EVENTS as compiled programs. Each event
+        is one RoundRecord: cadence is set by arrival rate, not the tail.
+        ``start_event`` > 1 continues a restored run whose pending buffer,
+        event cursor and plan-prefix fingerprint ``_maybe_resume``
+        verified."""
         obs = self.observability
         if obs.enabled:
             obs.log_event(
@@ -2573,10 +2926,19 @@ class FederatedSimulation:
                 virtual_wall_s=float(plan.event_times[-1]),
                 mean_cadence_vs=float(plan.cadences().mean()),
             )
+        if start_event > n_rounds:
+            return  # restored state already covers the requested events
+        self._async_prefix_fps = None
+        if self._ckpt_every() is not None:
+            from fl4health_tpu.server.async_schedule import (
+                plan_prefix_fingerprints,
+            )
+
+            self._async_prefix_fps = plan_prefix_fingerprints(plan)
         if mode == EXEC_CHUNKED:
-            self._fit_async_chunked(n_rounds, plan)
+            self._fit_async_chunked(n_rounds, plan, start_event)
         else:
-            self._fit_async_pipelined(n_rounds, plan)
+            self._fit_async_pipelined(n_rounds, plan, start_event)
 
     def _staleness_exponent_input(self) -> jax.Array:
         """The staleness exponent as a traced PROGRAM INPUT, read from the
@@ -2598,12 +2960,16 @@ class FederatedSimulation:
             self._round_batches(1), self._program_builder.client_sharding()
         )
 
-    def _fit_async_pipelined(self, n_rounds: int, plan) -> None:
+    def _fit_async_pipelined(self, n_rounds: int, plan,
+                             start_event: int = 1) -> None:
         """Per-event async path: prologue dispatch fills the pending
         buffer, then each buffer-fill event dispatches one fused
         aggregate->eval->restart program while the RoundConsumer runs the
         previous event's host epilogue and the prefetcher stages the next
-        event's restart batches (data plan e+1)."""
+        event's restart batches (data plan e+1). On resume
+        (``start_event`` > 1) the restored pending buffer replaces the
+        prologue — the interrupted run's in-flight updates pick up
+        mid-plan."""
         obs = self.observability
         prologue_jit, _ = self._make_async_programs()
         with obs.span("setup", cat="fit"):
@@ -2612,28 +2978,34 @@ class FederatedSimulation:
         self.server_state, self.client_states = _dedupe_donated(
             self.server_state, self.client_states
         )
-        consumer = self._consumer = RoundConsumer(maxsize=self.pipeline_depth)
-        prefetcher = self._prefetcher = RoundPrefetcher(self)
-        try:
-            with obs.span("async_prologue", cat="fit"):
-                batches1 = self._stage_prologue_batches()
-                self.client_states, self._async_pending = prologue_jit(
-                    self.server_state, self.client_states, batches1,
-                    val_batches,
-                )
-            # event e restarts its clients on data plan e+1
-            prefetcher.schedule(2)
-            for e in range(1, n_rounds + 1):
-                consumer.raise_pending()
-                with obs.maybe_profile(e):
-                    self._run_async_event(e, plan, val_batches, val_counts)
-            consumer.flush()
-        finally:
-            consumer.close()
-            prefetcher.close()
-            self._consumer = None
-            self._prefetcher = None
-            self._async_pending = None
+        with self._ckpt_writer_scope(self._ckpt_every() is not None):
+            consumer = self._consumer = RoundConsumer(
+                maxsize=self.pipeline_depth
+            )
+            prefetcher = self._prefetcher = RoundPrefetcher(self)
+            try:
+                if start_event == 1:
+                    with obs.span("async_prologue", cat="fit"):
+                        batches1 = self._stage_prologue_batches()
+                        (self.client_states,
+                         self._async_pending) = prologue_jit(
+                            self.server_state, self.client_states, batches1,
+                            val_batches,
+                        )
+                # event e restarts its clients on data plan e+1
+                prefetcher.schedule(start_event + 1)
+                for e in range(start_event, n_rounds + 1):
+                    consumer.raise_pending()
+                    with obs.maybe_profile(e):
+                        self._run_async_event(e, plan, val_batches,
+                                              val_counts)
+                consumer.flush()
+            finally:
+                consumer.close()
+                prefetcher.close()
+                self._consumer = None
+                self._prefetcher = None
+                self._async_pending = None
 
     def _run_async_event(self, e: int, plan, val_batches, val_counts) -> None:
         """Producer half of one buffer-fill event (mirrors ``_run_round``):
@@ -2694,6 +3066,24 @@ class FederatedSimulation:
             if "test_losses" in out:
                 device_results["test_losses"] = out["test_losses"]
                 device_results["test_metrics"] = out["test_metrics"]
+            resume_meta = None
+            if self._checkpoint_due(e):
+                # async snapshot: server + client stack + the in-flight
+                # pending buffer — device-side copies (all three are
+                # donated into the next event) riding the consumer's
+                # fused transfer, with the plan-prefix fingerprint and
+                # virtual clock the resume verifies
+                with obs.span("state_snapshot", round=e, what="async"):
+                    device_results["_state_trees"] = jax.tree_util.tree_map(
+                        jnp.copy,
+                        {"server_state": self.server_state,
+                         "client_states": self.client_states,
+                         "pending": self._async_pending},
+                    )
+                resume_meta = {
+                    "plan_fingerprint": self._async_prefix_fps[e - 1],
+                    "virtual_time_s": float(plan.event_times[e - 1]),
+                }
             work = _RoundWork(
                 round=e,
                 device_results=device_results,
@@ -2705,6 +3095,7 @@ class FederatedSimulation:
                 compiles_after=compiles_after,
                 compile_s_after=compile_s_after,
                 async_info=self._async_event_info(plan, e - 1),
+                resume_meta=resume_meta,
             )
             if consumer is not None:
                 consumer.submit(functools.partial(self._finish_round, work))
@@ -2715,19 +3106,19 @@ class FederatedSimulation:
             else:
                 self._finish_round(work)
 
-    def _fit_async_chunked(self, n_rounds: int, plan) -> None:
-        """Async chunked route: prologue dispatch + ONE lax.scan dispatch
-        over every buffer-fill event, then the shared chunked epilogue
+    def _fit_async_chunked(self, n_rounds: int, plan,
+                           start_event: int = 1) -> None:
+        """Async chunked route: prologue dispatch + lax.scan dispatches
+        over the buffer-fill events, then the shared chunked epilogue
         reconstructs per-event records (with staleness/cadence facts) from
-        the stacked pull."""
+        each stacked pull. Like the sync chunked route, an attached
+        snapshot checkpointer splits the scan at ``checkpoint_every``
+        boundaries and persists (server, clients, pending) there; on
+        resume the restored pending buffer replaces the prologue."""
         obs = self.observability
-        compiles_before = compile_s_before = 0.0
-        if obs.enabled:
-            compiles_before = obs.registry.counter(
-                "jax_backend_compiles_total").value
-            compile_s_before = obs.registry.counter(
-                "jax_backend_compiles_seconds_total").value
-        t_start = time.time()
+        sc = self.state_checkpointer
+        chunk_ckpt = self._ckpt_every() is not None
+        self._fit_n_rounds = n_rounds
         val_batches, val_counts = self._val_batches()
         test = self._test_batches()
         prologue_jit, _ = self._make_async_programs()
@@ -2735,43 +3126,81 @@ class FederatedSimulation:
         self.server_state, self.client_states = _dedupe_donated(
             self.server_state, self.client_states
         )
-        with obs.span("async_prologue", cat="fit"):
-            batches1 = self._stage_prologue_batches()
-            self.client_states, pending = prologue_jit(
-                self.server_state, self.client_states, batches1, val_batches
-            )
-        # event e restarts on data plan e+1: stack plans 2..E+1
-        plans = [self._round_plan(e + 1) for e in range(1, n_rounds + 1)]
-        idx = jnp.asarray(np.stack([p[0] for p in plans]))
-        em = jnp.asarray(np.stack([p[1] for p in plans]))
-        sm = jnp.asarray(np.stack([p[2] for p in plans]))
+        if start_event == 1:
+            with obs.span("async_prologue", cat="fit"):
+                batches1 = self._stage_prologue_batches()
+                self.client_states, pending = prologue_jit(
+                    self.server_state, self.client_states, batches1,
+                    val_batches,
+                )
+        else:
+            pending = self._async_pending  # restored mid-plan buffer
+        # the attribute's job is done (the local carries the buffer from
+        # here); clear it so no stale device tree outlives this fit()
+        self._async_pending = None
         x_bank, y_bank = self._sharded_train_banks()
-        args = [self.server_state, self.client_states, pending,
-                x_bank, y_bank, idx, em, sm,
-                jnp.asarray(plan.arrivals), jnp.asarray(plan.staleness),
-                val_batches, val_counts, self._staleness_exponent_input()]
-        if test is not None:
-            args.extend(test)
-        with obs.span("fit_async_chunk", cat="fit",
-                      rounds=n_rounds) as chunk_span:
-            self.server_state, self.client_states, outs = chunked(*args)
-            _, device_wait_total = obs.fence(outs)
-            stacked = jax.device_get(outs)
-            if obs.enabled:
-                chunk_span.set(device_wait_s=device_wait_total)
-        compiles_after = compile_s_after = None
-        if obs.enabled:
-            compiles_after = obs.registry.counter(
-                "jax_backend_compiles_total").value
-            compile_s_after = obs.registry.counter(
-                "jax_backend_compiles_seconds_total").value
-        per_round_s = (time.time() - t_start) / max(n_rounds, 1)
-        device_wait_round = device_wait_total / max(n_rounds, 1)
-        self._chunked_epilogue(
-            n_rounds, stacked, plan.arrivals, compiles_before,
-            compile_s_before, compiles_after, compile_s_after, per_round_s,
-            device_wait_round, async_plan=plan,
-        )
+        with self._ckpt_writer_scope(chunk_ckpt) as writer:
+            s = start_event
+            while s <= n_rounds:
+                k = self._rounds_per_dispatch(n_rounds, s)
+                compiles_before = compile_s_before = 0.0
+                if obs.enabled:
+                    compiles_before = obs.registry.counter(
+                        "jax_backend_compiles_total").value
+                    compile_s_before = obs.registry.counter(
+                        "jax_backend_compiles_seconds_total").value
+                t_start = time.time()
+                # event e restarts on data plan e+1: stack plans s+1..s+k
+                plans = [self._round_plan(e + 1)
+                         for e in range(s, s + k)]
+                idx = jnp.asarray(np.stack([p[0] for p in plans]))
+                em = jnp.asarray(np.stack([p[1] for p in plans]))
+                sm = jnp.asarray(np.stack([p[2] for p in plans]))
+                args = [self.server_state, self.client_states, pending,
+                        x_bank, y_bank, idx, em, sm,
+                        jnp.asarray(plan.arrivals[s - 1:s - 1 + k]),
+                        jnp.asarray(plan.staleness[s - 1:s - 1 + k]),
+                        jnp.asarray(s, jnp.int32),
+                        val_batches, val_counts,
+                        self._staleness_exponent_input()]
+                if test is not None:
+                    args.extend(test)
+                with obs.span("fit_async_chunk", cat="fit", rounds=k,
+                              start_event=s) as chunk_span:
+                    (self.server_state, self.client_states, pending,
+                     outs) = chunked(*args)
+                    _, device_wait_total = obs.fence(outs)
+                    stacked = jax.device_get(outs)
+                    if obs.enabled:
+                        chunk_span.set(device_wait_s=device_wait_total)
+                compiles_after = compile_s_after = None
+                if obs.enabled:
+                    compiles_after = obs.registry.counter(
+                        "jax_backend_compiles_total").value
+                    compile_s_after = obs.registry.counter(
+                        "jax_backend_compiles_seconds_total").value
+                per_round_s = (time.time() - t_start) / max(k, 1)
+                device_wait_round = device_wait_total / max(k, 1)
+                self._chunked_epilogue(
+                    k, stacked, plan.arrivals[s - 1:s - 1 + k],
+                    compiles_before, compile_s_before, compiles_after,
+                    compile_s_after, per_round_s, device_wait_round,
+                    async_plan=plan, start_round=s,
+                )
+                if chunk_ckpt:
+                    e_done = s + k - 1
+                    trees = jax.device_get({
+                        "server_state": self.server_state,
+                        "client_states": self.client_states,
+                        "pending": pending,
+                    })
+                    sc.save_async_snapshot(
+                        trees, e_done, self.n_clients, list(self.history),
+                        plan_fingerprint=self._async_prefix_fps[e_done - 1],
+                        virtual_time_s=float(plan.event_times[e_done - 1]),
+                        writer=writer,
+                    )
+                s += k
 
     def _emit_quarantine_metrics(self, rnd: int, q_np: np.ndarray) -> None:
         """``fl_quarantine_*`` gauges/counters + one ``quarantine`` JSONL

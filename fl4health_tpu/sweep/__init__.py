@@ -29,6 +29,7 @@ from fl4health_tpu.sweep.hoisting import (
 )
 from fl4health_tpu.sweep.runner import (
     CellResult,
+    SweepLedger,
     SweepResult,
     SweepRunner,
     run_sweep,
@@ -37,6 +38,7 @@ from fl4health_tpu.sweep.spec import SweepCell, SweepSpec
 
 __all__ = [
     "CellResult",
+    "SweepLedger",
     "GroupKey",
     "SCALAR_BINDINGS",
     "ScalarBinding",

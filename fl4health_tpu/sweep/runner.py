@@ -30,7 +30,9 @@ bench ``sweep`` block and ``fl_sweep_*`` metrics), not an assertion.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
+import os
 import time
 from typing import Any
 
@@ -117,6 +119,8 @@ class SweepResult:
     setup_compile_s: float
     wall_s: float
     pack: bool
+    # cells restored from a completion ledger instead of re-run (resume)
+    resumed_cells: int = 0
 
     @property
     def cells_per_compile(self) -> float | None:
@@ -134,7 +138,7 @@ class SweepResult:
     def bench_block(self) -> dict:
         """The bench artifact's ``sweep`` block — the compile-amortization
         claim as measured numbers."""
-        return {
+        block = {
             "cells": len(self.cells),
             "buckets": self.plan.buckets,
             "groups": len(self.plan.groups),
@@ -146,6 +150,114 @@ class SweepResult:
             "wall_s": self.wall_s,
             "packed": self.pack,
         }
+        if self.resumed_cells:
+            # resumed grids only — fresh runs keep the legacy block shape
+            block["resumed_cells"] = self.resumed_cells
+        return block
+
+
+def _spec_fingerprint(spec: SweepSpec, cells: list[SweepCell]) -> str:
+    """Grid identity a completion ledger binds to: the fully-expanded cell
+    labels (strategy/client/partitioner/cohort/fault/seed/scalars) plus
+    the per-cell run shape. Factories are opaque callables, so the labels
+    — not the factory objects — ARE the checkable identity; a ledger from
+    a different grid must never silently skip this grid's cells."""
+    from fl4health_tpu.observability.manifest import config_hash
+
+    return config_hash({
+        "cells": [c.label() for c in cells],
+        "rounds": spec.rounds,
+        "batch_size": spec.batch_size,
+        "local_steps": spec.local_steps,
+    })
+
+
+class SweepLedger:
+    """Crash-consistent per-cell completion ledger (append-only JSONL).
+
+    One ``header`` line binds the file to a grid fingerprint; one ``cell``
+    line per completed cell carries its full leaderboard row AND loss
+    trajectories, so a resumed run reconstructs the cell's
+    :class:`CellResult` without re-dispatching it. Each append is
+    flush+fsync'd — a SIGKILL can tear at most the line being written,
+    and ``load_completed`` skips unparseable (torn) lines, so the worst a
+    crash costs is the pack in flight."""
+
+    def __init__(self, path: str, fingerprint: str):
+        self.path = path
+        self.fingerprint = fingerprint
+        self._fh = None
+
+    def load_completed(self) -> dict[int, dict]:
+        """{cell index: ledger row} of completed cells. Raises ValueError
+        when the ledger belongs to a different grid (fingerprint mismatch)
+        or carries cell rows with no verifiable header."""
+        if not os.path.exists(self.path):
+            return {}
+        rows: dict[int, dict] = {}
+        saw_header = False
+        with open(self.path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # a torn tail from the killed run — the pack it
+                    # described re-runs
+                    logger.warning(
+                        "%s:%d: skipping torn ledger line", self.path,
+                        lineno,
+                    )
+                    continue
+                kind = rec.get("kind")
+                if kind == "header":
+                    if rec.get("spec_hash") != self.fingerprint:
+                        raise ValueError(
+                            f"sweep ledger {self.path} was written for a "
+                            f"different grid (spec_hash "
+                            f"{rec.get('spec_hash')} != "
+                            f"{self.fingerprint}); point ledger_path at a "
+                            "fresh file or delete the stale ledger"
+                        )
+                    saw_header = True
+                elif kind == "cell":
+                    rows[int(rec["cell"])] = rec
+        if rows and not saw_header:
+            raise ValueError(
+                f"sweep ledger {self.path} has cell rows but no header — "
+                "not a ledger this grid can verify; delete or move it"
+            )
+        return rows
+
+    def open_for_append(self) -> None:
+        write_header = not os.path.exists(self.path) or os.path.getsize(
+            self.path) == 0
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        self._fh = open(self.path, "a")
+        if write_header:
+            self._write({"kind": "header", "spec_hash": self.fingerprint,
+                         "version": 1})
+
+    def append(self, result: CellResult) -> None:
+        self._write({
+            "kind": "cell",
+            **result.row(),
+            "fit_losses": result.fit_losses,
+            "eval_losses": result.eval_losses,
+        })
+
+    def _write(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
 
 
 class SweepRunner:
@@ -159,11 +271,19 @@ class SweepRunner:
     the runner's own private registry/CompileMonitor either way, so the
     measured ``programs_compiled`` never depends on observability being
     on.
+
+    ``ledger_path``: optional :class:`SweepLedger` file. Completed cells
+    append to it (flush+fsync per pack) and a re-run of the same grid
+    restores them instead of re-dispatching — a killed grid re-runs only
+    unfinished packs, against the executables the surviving cells of each
+    group already share.
     """
 
-    def __init__(self, spec: SweepSpec, observability: Any = None):
+    def __init__(self, spec: SweepSpec, observability: Any = None,
+                 ledger_path: str | None = None):
         self.spec = spec
         self.obs = observability
+        self.ledger_path = ledger_path
         self._data_cache: dict[tuple[str, int], list[ClientDataset]] = {}
         # staged device banks + eval batching, keyed by everything that
         # shapes them — cells differing only in seeds/scalars reuse the
@@ -403,6 +523,32 @@ class SweepRunner:
         plan = bucketing.plan_groups(spec, cells, self._data_for)
         obs = self.obs if (self.obs is not None
                            and getattr(self.obs, "enabled", False)) else None
+        # completion ledger (resume): restore finished cells, re-run only
+        # the rest — the surviving cells of each group still share its
+        # compiled executables
+        ledger: SweepLedger | None = None
+        completed: dict[int, dict] = {}
+        if self.ledger_path is not None:
+            ledger = SweepLedger(self.ledger_path,
+                                 _spec_fingerprint(spec, cells))
+            completed = ledger.load_completed()
+        cell_by_index = {c.index: c for c in cells}
+        resumed = [
+            self._restore_cell_result(cell_by_index[i], row)
+            for i, row in sorted(completed.items())
+            if i in cell_by_index
+        ]
+        if completed:
+            logger.info(
+                "sweep resume: %d/%d cells restored from %s",
+                len(resumed), len(cells), self.ledger_path,
+            )
+        if obs is not None:
+            # restored cells get their `sweep` leaderboard events too —
+            # the resumed run's log must render the FULL grid, matching
+            # the sweep_summary it emits (re-run cells log in _run_group)
+            for r in resumed:
+                obs.log_event("sweep", **r.row())
         # private compile accounting: the claim must not depend on
         # observability being configured
         registry = MetricsRegistry()
@@ -420,19 +566,29 @@ class SweepRunner:
         compiles0 = registry.counter("jax_backend_compiles_total").value
         compile_s0 = registry.counter(
             "jax_backend_compiles_seconds_total").value
-        results: list[CellResult] = []
+        results: list[CellResult] = list(resumed)
         dispatch_compiles = 0.0
         dispatch_compile_s = 0.0
         try:
+            if ledger is not None:
+                ledger.open_for_append()
             for group in plan.groups:
+                remaining = [c for c in group.cells
+                             if c.index not in completed]
+                if not remaining:
+                    continue  # whole group restored — nothing to compile
+                if len(remaining) < len(group.cells):
+                    group = dataclasses.replace(group, cells=remaining)
                 group_results, g_compiles, g_compile_s = self._run_group(
-                    group, registry, obs
+                    group, registry, obs, ledger=ledger
                 )
                 results.extend(group_results)
                 dispatch_compiles += g_compiles
                 dispatch_compile_s += g_compile_s
         finally:
             monitor.uninstall()
+            if ledger is not None:
+                ledger.close()
         wall_s = time.perf_counter() - t_start
         total_compiles = (
             registry.counter("jax_backend_compiles_total").value - compiles0
@@ -449,6 +605,7 @@ class SweepRunner:
             setup_compiles=int(total_compiles - dispatch_compiles),
             setup_compile_s=max(0.0, total_compile_s - dispatch_compile_s),
             wall_s=wall_s, pack=spec.pack,
+            resumed_cells=len(resumed),
         )
         if obs is not None:
             obs.log_event("sweep_summary", **out.bench_block())
@@ -479,13 +636,16 @@ class SweepRunner:
         return out
 
     def _run_group(self, group: SweepGroup, registry: MetricsRegistry,
-                   obs) -> tuple[list[CellResult], float, float]:
+                   obs, ledger: "SweepLedger | None" = None,
+                   ) -> tuple[list[CellResult], float, float]:
         """Run one program group; returns (cell results, dispatch-bracket
         compile count, dispatch-bracket compile seconds). The compile
         brackets open right before each jitted cell/pack dispatch — input
         staging (per-cell state init, bank stacking: one-time eager-op
         warmup independent of grid size) is measured by the caller as
-        ``setup_compiles`` instead."""
+        ``setup_compiles`` instead. Each completed pack's results append
+        to the ``ledger`` (when given) BEFORE the next pack dispatches,
+        so a kill mid-grid re-runs only unfinished packs."""
         spec = self.spec
         sim = self._template_sim(group)
         hoisted = self._group_hoisted_axes(sim)
@@ -495,7 +655,14 @@ class SweepRunner:
         compiles = registry.counter("jax_backend_compiles_total")
         compile_s = registry.counter("jax_backend_compiles_seconds_total")
         group_compiles = group_compile_s = 0.0
-        outs_per_cell: list[tuple[SweepCell, dict, float]] = []
+
+        def finish(cell, cell_outs, wall, attributed):
+            r = self._cell_result(group, cell, cell_outs, wall, attributed)
+            results.append(r)
+            if ledger is not None:
+                ledger.append(r)
+            return r
+
         # inputs are staged one PACK at a time (not the whole group): a
         # cell's inputs hold full padded data banks, so group-wide staging
         # would scale device memory with the grid instead of the pack
@@ -523,17 +690,19 @@ class SweepRunner:
                 outs = jax.device_get(jax.block_until_ready(outs))
                 wall = time.perf_counter() - t0
                 del stacked
+                pack_compiles = compiles.value - c0
                 pack_compile_s = compile_s.value - s0
-                group_compiles += compiles.value - c0
+                group_compiles += pack_compiles
                 group_compile_s += pack_compile_s
                 # honest per-cell wall: the first dispatch's XLA compile
                 # lands in compile_s_total, never in throughput numbers
                 per_cell_wall = max(wall - pack_compile_s, 0.0) / len(chunk)
+                attributed = pack_compiles / len(chunk)
                 for j, cell in enumerate(chunk):
                     cell_outs = jax.tree_util.tree_map(
                         lambda a: a[j], outs
                     )
-                    outs_per_cell.append((cell, cell_outs, per_cell_wall))
+                    finish(cell, cell_outs, per_cell_wall, attributed)
         else:
             for cell in group.cells:
                 inp = self._cell_inputs(sim, group, cell, hoisted)
@@ -543,18 +712,13 @@ class SweepRunner:
                 outs = cell_jit(inp)
                 outs = jax.device_get(jax.block_until_ready(outs))
                 wall = time.perf_counter() - t0
+                cell_compiles = compiles.value - c0
                 cell_compile_s = compile_s.value - s0
-                outs_per_cell.append(
-                    (cell, outs, max(wall - cell_compile_s, 0.0))
-                )
                 del inp
-                group_compiles += compiles.value - c0
+                group_compiles += cell_compiles
                 group_compile_s += cell_compile_s
-        attributed = group_compiles / max(len(group.cells), 1)
-        for cell, outs, wall in outs_per_cell:
-            results.append(self._cell_result(
-                group, cell, outs, wall, attributed
-            ))
+                finish(cell, outs, max(wall - cell_compile_s, 0.0),
+                       cell_compiles)
         if obs is not None:
             for r in results:
                 obs.log_event("sweep", **r.row())
@@ -564,6 +728,32 @@ class SweepRunner:
             time.perf_counter() - t_group,
         )
         return results, group_compiles, group_compile_s
+
+    def _restore_cell_result(self, cell: SweepCell, row: dict) -> CellResult:
+        """Rebuild a completed cell's :class:`CellResult` from its ledger
+        row — the resume path's no-recompute restore."""
+        if row.get("label") != cell.label():
+            # the spec fingerprint should make this unreachable; fail loud
+            # rather than attribute a stale trajectory to the wrong cell
+            raise ValueError(
+                f"ledger row for cell {cell.index} is labeled "
+                f"{row.get('label')!r} but the grid expands it as "
+                f"{cell.label()!r}"
+            )
+        return CellResult(
+            cell=cell,
+            bucket=int(row.get("bucket", cell.cohort)),
+            group=str(row.get("group", "")),
+            fit_losses=[float(v) for v in row.get("fit_losses", [])],
+            eval_losses=[float(v) for v in row.get("eval_losses", [])],
+            final_fit_loss=float(row.get("final_fit_loss", float("nan"))),
+            final_eval_loss=float(row.get("final_eval_loss", float("nan"))),
+            best_eval_loss=float(row.get("best_eval_loss", float("nan"))),
+            rounds_to_target=row.get("rounds_to_target"),
+            steps_per_s=float(row.get("steps_per_s", 0.0)),
+            wall_s=float(row.get("wall_s", 0.0)),
+            compiles_attributed=float(row.get("compiles_attributed", 0.0)),
+        )
 
     def _cell_result(self, group: SweepGroup, cell: SweepCell, outs: dict,
                      wall: float, compiles_attributed: float) -> CellResult:
@@ -597,6 +787,8 @@ class SweepRunner:
         )
 
 
-def run_sweep(spec: SweepSpec, observability: Any = None) -> SweepResult:
-    """Convenience one-shot: ``SweepRunner(spec, observability).run()``."""
-    return SweepRunner(spec, observability).run()
+def run_sweep(spec: SweepSpec, observability: Any = None,
+              ledger_path: str | None = None) -> SweepResult:
+    """Convenience one-shot:
+    ``SweepRunner(spec, observability, ledger_path).run()``."""
+    return SweepRunner(spec, observability, ledger_path=ledger_path).run()

@@ -68,6 +68,16 @@ def pytest_configure(config):
         "default; exhaustive grids also carry 'slow'. Select with "
         "-m sweep.",
     )
+    config.addinivalue_line(
+        "markers",
+        "crash: crash-drill recovery lanes (resilience/recovery.py — "
+        "subprocess fit() SIGKILLed at a seeded point, resumed, pinned "
+        "bit-identical). The tier-1-safe smoke subset (in-process "
+        "kill-and-resume, ring fallback, one subprocess drill per "
+        "execution mode) runs by default; the full kill-matrix "
+        "(mid-write, async, corruption variants) also carries 'slow'. "
+        "Select with -m crash.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,54 @@
+"""core/io.atomic_write torn-file semantics — the primitive every durable
+artifact (state checkpoints, metrics exports, reporter dumps) rides.
+
+The crash-consistency contract: a writer that dies mid-write (exception
+here; a real SIGKILL in tests/resilience/test_recovery.py) must leave the
+previously-published file byte-identical, because the bytes only land on
+the published path via one ``os.replace``.
+"""
+
+import os
+
+import pytest
+
+from fl4health_tpu.core.io import atomic_write
+
+
+def test_success_replaces_previous_content(tmp_path):
+    p = str(tmp_path / "artifact.txt")
+    with atomic_write(p) as f:
+        f.write("generation 1")
+    with atomic_write(p) as f:
+        f.write("generation 2")
+    assert open(p).read() == "generation 2"
+
+
+def test_parent_directories_created(tmp_path):
+    p = str(tmp_path / "a" / "b" / "artifact.txt")
+    with atomic_write(p) as f:
+        f.write("x")
+    assert open(p).read() == "x"
+
+
+def test_exception_mid_write_preserves_previous_generation(tmp_path):
+    """Kill-during-write: the published path keeps the PREVIOUS bytes and
+    the torn temp file is removed — nothing half-written is observable."""
+    p = str(tmp_path / "artifact.bin")
+    with atomic_write(p, "wb") as f:
+        f.write(b"good generation")
+    with pytest.raises(RuntimeError, match="torn"):
+        with atomic_write(p, "wb") as f:
+            f.write(b"partial garb")  # flushed or not — must never publish
+            raise RuntimeError("torn write")
+    assert open(p, "rb").read() == b"good generation"
+    assert os.listdir(tmp_path) == ["artifact.bin"]  # temp cleaned up
+
+
+def test_exception_with_no_previous_file_leaves_nothing(tmp_path):
+    p = str(tmp_path / "artifact.bin")
+    with pytest.raises(ValueError):
+        with atomic_write(p, "wb") as f:
+            f.write(b"doomed")
+            raise ValueError("no")
+    assert not os.path.exists(p)
+    assert os.listdir(tmp_path) == []

@@ -1,0 +1,86 @@
+"""Simulation factories for the crash-drill subprocess harness
+(``fl4health_tpu/resilience/recovery.py``). The drill child loads this
+file by PATH and calls ``factory(ckpt_dir)`` — keep it import-light (no
+pytest) and fully deterministic (fixed seeds, tiny model) so every child
+process reproduces the same trajectory bit-for-bit."""
+
+import jax
+import numpy as np
+import optax
+
+from fl4health_tpu.checkpointing.state import SimulationStateCheckpointer
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.async_schedule import AsyncConfig
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+
+N_CLASSES = 3
+N_CLIENTS = 2
+
+
+def _datasets():
+    out = []
+    for i in range(N_CLIENTS):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(20 + i), 32, (6,), N_CLASSES
+        )
+        x = np.asarray(x)
+        out.append(ClientDataset(x[:24], y[:24], x[24:], y[24:]))
+    return out
+
+
+def _base(ckpt_dir, *, checkpoint_every=1, **kwargs):
+    defaults = dict(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(8,), n_outputs=N_CLASSES)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05),
+        strategy=None,
+        datasets=_datasets(),
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=2,
+        local_epochs=None,
+        seed=9,
+    )
+    if defaults["strategy"] is None:
+        from fl4health_tpu.strategies.fedavg import FedAvg
+
+        defaults["strategy"] = FedAvg()
+    if ckpt_dir is not None:
+        defaults["state_checkpointer"] = SimulationStateCheckpointer(
+            str(ckpt_dir), checkpoint_every=checkpoint_every, keep=3,
+        )
+    defaults.update(kwargs)
+    return FederatedSimulation(**defaults)
+
+
+def sync_chunked(ckpt_dir):
+    return _base(ckpt_dir, checkpoint_every=2, execution_mode="chunked")
+
+
+def sync_pipelined(ckpt_dir):
+    return _base(ckpt_dir, checkpoint_every=2, execution_mode="pipelined")
+
+
+def sync_chunked_every1(ckpt_dir):
+    return _base(ckpt_dir, checkpoint_every=1, execution_mode="chunked")
+
+
+def _async(ckpt_dir, mode):
+    return _base(
+        ckpt_dir, checkpoint_every=1, execution_mode=mode,
+        async_config=AsyncConfig(buffer_size=2, compute_jitter=0.3, seed=13),
+    )
+
+
+def async_chunked(ckpt_dir):
+    return _async(ckpt_dir, "chunked")
+
+
+def async_pipelined(ckpt_dir):
+    return _async(ckpt_dir, "pipelined")

@@ -1,0 +1,143 @@
+"""THE crash drill — subprocess fit() SIGKILLed at a seeded point, resumed
+from the retention ring, pinned bit-identical to the uninterrupted run.
+
+This is the acceptance proof of the preemption-survivable-federation PR
+(the same pinned-claim discipline TestRobustnessClaim set for Byzantine
+faults): a real subprocess, a real SIGKILL (no atexit, no flushing), a
+real resume from disk, compared BYTE-identically (serialized final params
++ full loss trajectory) against an arm that was never interrupted.
+
+Tier-1 lane (marker ``crash``): one post-save SIGKILL drill per sync
+execution mode. The heavier matrix — mid-checkpoint-write kill,
+corrupt-newest-generation fallback, buffered-async mid-plan resume — also
+carries ``slow``.
+"""
+
+import os
+import signal
+
+import pytest
+
+from fl4health_tpu.resilience.recovery import (
+    corrupt_newest_generation,
+    run_child,
+)
+
+FACTORY_FILE = os.path.join(os.path.dirname(__file__),
+                            "recovery_factories.py")
+
+
+def _repo_root():
+    # tests live at <repo>/tests/resilience/
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _spec(tmp_path, tag, factory, n_rounds, ckpt_dir, kill=None):
+    out_dir = str(tmp_path / f"{tag}_out")
+    return {
+        "factory_file": FACTORY_FILE,
+        "factory_name": factory,
+        "n_rounds": n_rounds,
+        "ckpt_dir": str(ckpt_dir) if ckpt_dir is not None else None,
+        "out_dir": out_dir,
+        "kill": kill,
+        "jax_cache_dir": os.path.join(_repo_root(), ".jax_test_cache"),
+    }
+
+
+def _run(tmp_path, tag, factory, n_rounds, ckpt_dir, kill=None):
+    spec = _spec(tmp_path, tag, factory, n_rounds, ckpt_dir, kill)
+    return run_child(spec, str(tmp_path / f"{tag}_spec.json"))
+
+
+def _drill(tmp_path, factory, n_rounds=4, kill=None,
+           damage_newest=None):
+    """straight arm + killed arm + resumed arm; returns (straight,
+    resumed). ``damage_newest`` optionally corrupts the newest surviving
+    generation between kill and resume (the ring-fallback drill)."""
+    straight = _run(tmp_path, "straight", factory, n_rounds,
+                    tmp_path / "straight_ckpt")
+    assert straight.returncode == 0, straight.stderr[-2000:]
+    ckpt_dir = tmp_path / "drill_ckpt"
+    killed = _run(tmp_path, "killed", factory, n_rounds, ckpt_dir,
+                  kill=kill)
+    assert killed.returncode == -signal.SIGKILL, (
+        f"expected SIGKILL exit, got {killed.returncode}: "
+        f"{killed.stderr[-2000:]}"
+    )
+    assert killed.params_bytes is None  # it really died before finishing
+    if damage_newest is not None:
+        corrupt_newest_generation(str(ckpt_dir), mode=damage_newest)
+    resumed = _run(tmp_path, "resumed", factory, n_rounds, ckpt_dir)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    return straight, resumed
+
+
+def _assert_bit_identical(straight, resumed, n_rounds):
+    assert resumed.params_bytes == straight.params_bytes, (
+        "resumed final params differ from the uninterrupted run"
+    )
+    assert resumed.history == straight.history
+    assert [row["round"] for row in resumed.history] == list(
+        range(1, n_rounds + 1)
+    )
+
+
+@pytest.mark.crash
+@pytest.mark.parametrize("factory", ["sync_chunked", "sync_pipelined"])
+def test_sigkill_after_round2_resumes_bit_identical(tmp_path, factory):
+    """SIGKILL right after round 2's checkpoint publishes, on BOTH
+    execution modes: the resumed run's final params and trajectory are
+    byte-identical to the uninterrupted arm's."""
+    straight, resumed = _drill(
+        tmp_path, factory, n_rounds=4,
+        kill={"round": 2, "phase": "post_save"},
+    )
+    _assert_bit_identical(straight, resumed, 4)
+
+
+@pytest.mark.crash
+@pytest.mark.slow
+def test_sigkill_mid_checkpoint_write_leaves_previous_generation(tmp_path):
+    """The torn-write drill: the kill lands mid-way through round 2's
+    checkpoint WRITE. Atomic publish means the torn bytes die in the temp
+    file; round 1's generation survives and the resume continues from it —
+    bit-identical."""
+    straight, resumed = _drill(
+        tmp_path, "sync_chunked_every1", n_rounds=4,
+        kill={"round": 2, "phase": "mid_write", "byte_offset": 200},
+    )
+    _assert_bit_identical(straight, resumed, 4)
+
+
+@pytest.mark.crash
+@pytest.mark.slow
+@pytest.mark.parametrize("damage", ["truncate", "flip"])
+def test_corrupt_newest_generation_falls_back_and_still_matches(
+        tmp_path, damage):
+    """Kill after round 2 (ring holds rounds 1 and 2), then damage the
+    newest generation on disk. Restore must detect the corruption (CRC),
+    fall back to round 1's generation, and STILL reproduce the
+    uninterrupted trajectory."""
+    straight, resumed = _drill(
+        tmp_path, "sync_chunked_every1", n_rounds=3,
+        kill={"round": 2, "phase": "post_save"},
+        damage_newest=damage,
+    )
+    _assert_bit_identical(straight, resumed, 3)
+
+
+@pytest.mark.crash
+@pytest.mark.slow
+@pytest.mark.parametrize("factory", ["async_chunked", "async_pipelined"])
+def test_async_sigkill_resumes_mid_plan_bit_identical(tmp_path, factory):
+    """Buffered-async drill: the kill lands after event 2's snapshot (which
+    persisted the pending buffer + event cursor + virtual clock); the
+    resumed run continues the static event plan mid-flight and matches the
+    uninterrupted arm byte-identically."""
+    straight, resumed = _drill(
+        tmp_path, factory, n_rounds=4,
+        kill={"round": 2, "phase": "post_save"},
+    )
+    _assert_bit_identical(straight, resumed, 4)

@@ -1,0 +1,425 @@
+"""Checkpoint/resume across execution modes (in-process lane of the
+preemption-survivable-federation contract):
+
+- a snapshot-capable state checkpointer NO LONGER demotes auto mode off
+  the chunked fast path (the acceptance pin) — only the legacy
+  sim-reading API does;
+- the chunked route dispatches in checkpoint_every-round chunks, saves at
+  each boundary, and stays on-trajectory vs the uncheckpointed run;
+- kill-and-resume (object thrown away, rebuilt, restored from disk) is
+  BIT-identical to the uninterrupted run with the same cadence — sync and
+  buffered-async, pipelined and chunked;
+- wrong-experiment restores fail loudly (config hash, sync<->async kind,
+  async plan fingerprint);
+- error-exit paths still publish the last completed round's checkpoint.
+
+The subprocess SIGKILL matrix lives in tests/resilience/test_recovery.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.checkpointing.state import (
+    CheckpointConfigMismatchError,
+    SimulationStateCheckpointer,
+)
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.async_schedule import AsyncConfig
+from fl4health_tpu.server.simulation import (
+    EXEC_CHUNKED,
+    EXEC_PIPELINED,
+    ClientDataset,
+    ClientFailuresError,
+    FailurePolicy,
+    FederatedSimulation,
+)
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+N_CLASSES = 3
+N_CLIENTS = 3
+
+
+def _datasets(poison_client=None):
+    out = []
+    for i in range(N_CLIENTS):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(10 + i), 56, (6,), N_CLASSES
+        )
+        x = np.asarray(x)
+        if i == poison_client:
+            x = x.copy()
+            x[:, 0] = np.nan
+        out.append(ClientDataset(x[:32], y[:32], x[32:48], y[32:48]))
+    return out
+
+
+def _sim(ckpt_dir=None, *, checkpoint_every=1, keep=2, **kwargs):
+    defaults = dict(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=_datasets(),
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1,
+        seed=5,
+    )
+    if ckpt_dir is not None:
+        defaults["state_checkpointer"] = SimulationStateCheckpointer(
+            str(ckpt_dir), checkpoint_every=checkpoint_every, keep=keep,
+        )
+    defaults.update(kwargs)
+    return FederatedSimulation(**defaults)
+
+
+def _flat(params):
+    return np.asarray(jax.flatten_util.ravel_pytree(
+        jax.device_get(params))[0])
+
+
+def _losses(history):
+    return [(h.round, h.fit_losses["backward"], h.eval_losses["checkpoint"])
+            for h in history]
+
+
+# ---------------------------------------------------------------------------
+# Mode selection: the acceptance pin
+# ---------------------------------------------------------------------------
+
+class TestModeSelection:
+    def test_state_checkpointer_keeps_auto_on_the_chunked_path(self,
+                                                               tmp_path):
+        """THE acceptance criterion: enabling state_checkpointer no longer
+        appears in _chunk_ineligibility — auto mode stays chunked."""
+        sim = _sim(tmp_path / "st")
+        assert sim._chunk_ineligibility() is None
+        mode, reason = sim._select_execution_mode(4)
+        assert mode == EXEC_CHUNKED
+        assert "checkpoint" not in reason
+
+    def test_legacy_sim_reading_checkpointer_still_demotes(self, tmp_path):
+        from fl4health_tpu.checkpointing.state import StateCheckpointer
+
+        class Legacy(StateCheckpointer):
+            def save_simulation(self, sim, current_round):
+                pass
+
+        sim = _sim(state_checkpointer=Legacy(str(tmp_path)))
+        why = sim._chunk_ineligibility()
+        assert why is not None and "legacy" in why
+        assert sim._select_execution_mode(4)[0] == EXEC_PIPELINED
+
+    def test_forced_chunked_with_checkpointer_is_accepted(self, tmp_path):
+        sim = _sim(tmp_path / "st", execution_mode="chunked")
+        assert sim._select_execution_mode(2)[0] == EXEC_CHUNKED
+
+
+# ---------------------------------------------------------------------------
+# Chunked-path checkpointing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.crash
+class TestChunkedCheckpointing:
+    def test_chunked_with_checkpointer_matches_uncheckpointed(self,
+                                                              tmp_path):
+        """checkpoint_every=2 over 5 rounds dispatches 2+2+1 chunks; the
+        trajectory stays on the repo's cross-program tolerance vs the
+        single-dispatch run, and every boundary saved."""
+        saves = []
+        plain = _sim(execution_mode="chunked")
+        hp = plain.fit(5)
+        sim = _sim(tmp_path / "st", checkpoint_every=2, keep=10,
+                   execution_mode="chunked")
+        sim.state_checkpointer.on_save = saves.append
+        hc = sim.fit(5)
+        assert [s["round"] for s in saves] == [2, 4, 5]
+        assert len(sim.state_checkpointer.generations()) == 3
+        for a, b in zip(hp, hc):
+            np.testing.assert_allclose(
+                a.fit_losses["backward"], b.fit_losses["backward"],
+                rtol=1e-6,
+            )
+        np.testing.assert_allclose(
+            _flat(plain.global_params), _flat(sim.global_params), rtol=1e-6
+        )
+
+    def test_chunked_kill_and_resume_is_bit_identical(self, tmp_path):
+        """Both arms run chunked with the same cadence; the resumed arm is
+        killed (object discarded) after round 2 — final params and the
+        continued trajectory must match BITWISE (same chunk shapes, same
+        round-indexed streams)."""
+        straight = _sim(tmp_path / "a", checkpoint_every=2,
+                        execution_mode="chunked")
+        hs = straight.fit(4)
+        part1 = _sim(tmp_path / "b", checkpoint_every=2,
+                     execution_mode="chunked")
+        part1.fit(2)
+        part2 = _sim(tmp_path / "b", checkpoint_every=2,
+                     execution_mode="chunked")
+        hr = part2.fit(4)
+        np.testing.assert_array_equal(
+            _flat(straight.global_params), _flat(part2.global_params)
+        )
+        assert _losses(hr) == _losses(hs)
+        assert [h.round for h in hr] == [1, 2, 3, 4]
+
+    def test_resume_with_all_rounds_done_is_a_noop(self, tmp_path):
+        a = _sim(tmp_path / "st")
+        a.fit(3)
+        b = _sim(tmp_path / "st")
+        hist = b.fit(3)
+        assert [h.round for h in hist] == [1, 2, 3]
+        np.testing.assert_array_equal(_flat(a.global_params),
+                                      _flat(b.global_params))
+
+    def test_pipelined_cadence_skips_off_rounds(self, tmp_path):
+        saves = []
+        sim = _sim(tmp_path / "st", checkpoint_every=3, keep=10,
+                   execution_mode="pipelined")
+        sim.state_checkpointer.on_save = saves.append
+        sim.fit(7)
+        assert [s["round"] for s in saves] == [3, 6, 7]
+
+
+# ---------------------------------------------------------------------------
+# Cross-mode resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.crash
+class TestCrossModeResume:
+    @pytest.mark.parametrize("first,second", [
+        ("pipelined", "chunked"), ("chunked", "pipelined"),
+    ])
+    def test_resume_across_modes(self, tmp_path, first, second):
+        """A checkpoint written under one execution mode restores under the
+        other (trajectories are pinned identical across modes, so this is
+        legal — and the config hash deliberately excludes the mode)."""
+        ref = _sim(execution_mode=second)
+        href = ref.fit(4)
+        part1 = _sim(tmp_path / "st", execution_mode=first)
+        part1.fit(2)
+        part2 = _sim(tmp_path / "st", execution_mode=second)
+        hr = part2.fit(4)
+        assert [h.round for h in hr] == [1, 2, 3, 4]
+        np.testing.assert_allclose(
+            _flat(ref.global_params), _flat(part2.global_params), atol=1e-6
+        )
+        for a, b in zip(href[2:], hr[2:]):
+            np.testing.assert_allclose(
+                a.fit_losses["backward"], b.fit_losses["backward"],
+                rtol=1e-6,
+            )
+
+
+# ---------------------------------------------------------------------------
+# Guard rails
+# ---------------------------------------------------------------------------
+
+class TestRestoreGuards:
+    def test_config_mismatch_rejected(self, tmp_path):
+        a = _sim(tmp_path / "st")
+        a.fit(1)
+        b = _sim(tmp_path / "st", batch_size=4)
+        with pytest.raises(CheckpointConfigMismatchError):
+            b.fit(2)
+
+    def test_client_count_mismatch_still_names_clients(self, tmp_path):
+        a = _sim(tmp_path / "st")
+        a.fit(1)
+        datasets = _datasets() + [_datasets()[0]]
+        b = _sim(tmp_path / "st", datasets=datasets)
+        with pytest.raises(ValueError, match="clients"):
+            b.fit(2)
+
+    def test_sync_checkpoint_rejected_by_async_run(self, tmp_path):
+        a = _sim(tmp_path / "st")
+        a.fit(1)
+        b = _sim(tmp_path / "st",
+                 async_config=AsyncConfig(buffer_size=N_CLIENTS))
+        with pytest.raises(ValueError, match="synchronous run"):
+            b.fit(2)
+
+    def test_async_checkpoint_rejected_by_sync_run(self, tmp_path):
+        a = _sim(tmp_path / "st",
+                 async_config=AsyncConfig(buffer_size=N_CLIENTS))
+        a.fit(1)
+        b = _sim(tmp_path / "st")
+        with pytest.raises(ValueError, match="buffered-async"):
+            b.fit(2)
+
+    def test_manifest_and_events_carry_resume_descriptor(self, tmp_path):
+        from fl4health_tpu.observability import Observability
+        from fl4health_tpu.observability.registry import MetricsRegistry
+        from fl4health_tpu.observability.spans import Tracer
+
+        a = _sim(tmp_path / "st")
+        a.fit(2)
+        reg = MetricsRegistry()
+        obs = Observability(registry=reg, tracer=Tracer(enabled=False))
+        b = _sim(tmp_path / "st", observability=obs)
+        b.fit(4)
+        assert obs.manifest["resume"]["next_round"] == 3
+        assert obs.manifest["resume"]["kind"] == "sync"
+        kinds = [e["event"] for e in reg.events]
+        assert "resume" in kinds
+        assert "checkpoint" in kinds
+        assert reg.counter("fl_ckpt_restores_total").value == 1
+        assert reg.counter("fl_ckpt_writes_total").value >= 1
+        ckpt_events = [e for e in reg.events if e["event"] == "checkpoint"]
+        assert all("write_ms" in e and "bytes" in e for e in ckpt_events)
+
+
+# ---------------------------------------------------------------------------
+# Error-exit paths still publish the last completed checkpoint
+# ---------------------------------------------------------------------------
+
+@pytest.mark.crash
+class TestErrorExitPublishes:
+    def test_halted_run_still_publishes_round1_checkpoint(self, tmp_path):
+        """Satellite pin: a run that HALTS (poison arrives at round 2 and
+        accept_failures=False terminates it) still flushes the async
+        checkpoint writer on the error exit — round 1's durable state is
+        on disk before ClientFailuresError propagates."""
+        def provider(rnd):
+            if rnd == 2:
+                poisoned = _datasets(poison_client=1)
+                return ([np.asarray(d.x_train) for d in poisoned],
+                        [np.asarray(d.y_train) for d in poisoned])
+            return None
+
+        def make():
+            return _sim(
+                tmp_path / "st",
+                train_data_provider=provider,
+                failure_policy=FailurePolicy(accept_failures=False),
+            )
+
+        with pytest.raises(ClientFailuresError):
+            make().fit(3)
+        fresh = make()
+        start = fresh.state_checkpointer.load_simulation(fresh)
+        assert start == 2
+        assert [h.round for h in fresh.history] == [1]
+
+
+# ---------------------------------------------------------------------------
+# Buffered-async resume (in-process kill)
+# ---------------------------------------------------------------------------
+
+def _async_sim(ckpt_dir=None, *, checkpoint_every=1, fault_plan=None,
+               **kwargs):
+    cfg = AsyncConfig(buffer_size=2, base_compute_s=1.0, compute_jitter=0.3,
+                      seed=11)
+    return _sim(ckpt_dir, checkpoint_every=checkpoint_every,
+                async_config=cfg, fault_plan=fault_plan, **kwargs)
+
+
+@pytest.mark.crash
+class TestAsyncResume:
+    @pytest.mark.parametrize("mode", ["pipelined", "chunked"])
+    def test_async_kill_and_resume_is_bit_identical(self, tmp_path, mode):
+        """An interrupted async run resumes MID-PLAN: the restored pending
+        buffer, event cursor and virtual clock continue the same static
+        event plan bit-identically, on both execution modes."""
+        straight = _async_sim(tmp_path / "a", execution_mode=mode)
+        hs = straight.fit(5)
+        part1 = _async_sim(tmp_path / "b", execution_mode=mode)
+        part1.fit(2)
+        part2 = _async_sim(tmp_path / "b", execution_mode=mode)
+        hr = part2.fit(5)
+        np.testing.assert_array_equal(
+            _flat(straight.global_params), _flat(part2.global_params)
+        )
+        assert _losses(hr) == _losses(hs)
+        assert [h.round for h in hr] == [1, 2, 3, 4, 5]
+
+    def test_async_chunked_with_ckpt_matches_plain_async(self, tmp_path):
+        plain = _async_sim(execution_mode="chunked")
+        hp = plain.fit(4)
+        sim = _async_sim(tmp_path / "st", checkpoint_every=2,
+                         execution_mode="chunked")
+        hc = sim.fit(4)
+        for a, b in zip(hp, hc):
+            np.testing.assert_allclose(
+                a.fit_losses["backward"], b.fit_losses["backward"],
+                rtol=1e-6,
+            )
+        np.testing.assert_allclose(
+            _flat(plain.global_params), _flat(sim.global_params), rtol=1e-6
+        )
+
+    def test_plan_fingerprint_mismatch_rejected(self, tmp_path):
+        """Same config hash, different arrival schedule (a slow-fault plan
+        reshapes the virtual clock): the resume must refuse to splice the
+        buffered updates into a different plan."""
+        from fl4health_tpu.resilience.faults import ClientFault, FaultPlan
+
+        part1 = _async_sim(tmp_path / "st")
+        part1.fit(2)
+        slow = FaultPlan(seed=3, client_faults=(
+            ClientFault(kind="slow", clients=(0,), scale=5.0),
+        ))
+        part2 = _async_sim(tmp_path / "st", fault_plan=slow)
+        with pytest.raises(ValueError, match="fingerprint"):
+            part2.fit(5)
+
+    def test_resume_past_plan_end_rejected(self, tmp_path):
+        part1 = _async_sim(tmp_path / "st")
+        part1.fit(3)
+        part2 = _async_sim(tmp_path / "st")
+        with pytest.raises(ValueError, match="event"):
+            part2.fit(2)  # checkpoint is at event 3 > requested 2
+
+
+# ---------------------------------------------------------------------------
+# Mesh-aware restore
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multichip
+@pytest.mark.crash
+class TestMeshRestore:
+    def _mesh_sim(self, ckpt_dir):
+        from fl4health_tpu.parallel.program import MeshConfig
+
+        datasets = []
+        for i in range(8):
+            x, y = synthetic_classification(
+                jax.random.PRNGKey(30 + i), 40, (6,), N_CLASSES
+            )
+            datasets.append(ClientDataset(x[:24], y[:24], x[24:], y[24:]))
+        return _sim(ckpt_dir, datasets=datasets,
+                    mesh=MeshConfig(clients=8), execution_mode="chunked",
+                    checkpoint_every=2)
+
+    def test_restore_replaces_state_onto_the_mesh_shardings(
+            self, tmp_path, eight_devices):
+        """Tentpole part 5: restored host arrays are device_put back onto
+        the round programs' NamedShardings — and the resumed mesh run
+        matches the uninterrupted mesh run."""
+        straight = self._mesh_sim(tmp_path / "a")
+        hs = straight.fit(4)
+        part1 = self._mesh_sim(tmp_path / "b")
+        part1.fit(2)
+        part2 = self._mesh_sim(tmp_path / "b")
+        # the moment after restore, BEFORE any dispatch: the client stack
+        # must already sit on the clients-axis sharding
+        start = part2.state_checkpointer.load_simulation(part2)
+        assert start == 3
+        leaf = jax.tree_util.tree_leaves(part2.client_states.params)[0]
+        expected = part2._program_builder.client_sharding()
+        assert leaf.sharding.is_equivalent_to(expected, leaf.ndim)
+        hr = part2.fit(4)
+        np.testing.assert_array_equal(
+            _flat(straight.global_params), _flat(part2.global_params)
+        )
+        assert _losses(hr) == _losses(hs)

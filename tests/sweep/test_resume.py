@@ -1,0 +1,157 @@
+"""Sweep completion ledger — a killed grid re-runs only unfinished packs.
+
+The resume contract: ledger rows restore completed cells' exact results
+(trajectories included), the re-run dispatches — and compiles — only the
+missing cells, and a ledger from a DIFFERENT grid is rejected instead of
+silently skipping cells. Torn tails (the line a SIGKILL interrupted) are
+skipped, costing at most the pack in flight."""
+
+import json
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.server.simulation import ClientDataset
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.sweep import SweepSpec, run_sweep
+from fl4health_tpu.sweep.runner import SweepLedger, _spec_fingerprint
+
+pytestmark = [pytest.mark.sweep, pytest.mark.crash]
+
+N_CLASSES = 3
+
+
+def _partitioner(salt):
+    def build(cohort):
+        out = []
+        for i in range(cohort):
+            x, y = synthetic_classification(
+                jax.random.PRNGKey(1000 * salt + i), 40, (6,), N_CLASSES
+            )
+            n = 24 + 4 * ((i + salt) % 3)
+            out.append(ClientDataset(
+                np.asarray(x[:n]), np.asarray(y[:n]),
+                np.asarray(x[32:]), np.asarray(y[32:]),
+            ))
+        return out
+
+    return build
+
+
+def _client_logic():
+    return engine.ClientLogic(
+        engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES)),
+        engine.masked_cross_entropy,
+    )
+
+
+def _spec(**overrides):
+    kw = dict(
+        strategies={"fedavg": FedAvg},
+        clients={"sgd": _client_logic},
+        partitioners={"p0": _partitioner(0)},
+        rounds=2,
+        batch_size=8,
+        local_steps=2,
+        tx=lambda: optax.sgd(0.05),
+        metrics=lambda: MetricManager(()),
+        seeds=(5, 7, 9, 11),
+        cohort_sizes=(3,),
+        max_pack=2,
+    )
+    kw.update(overrides)
+    return SweepSpec(**kw)
+
+
+def _rows(res):
+    return {
+        r.cell.index: (r.fit_losses, r.eval_losses, r.cell.label())
+        for r in res.cells
+    }
+
+
+class TestLedgerResume:
+    def test_full_rerun_restores_everything_with_zero_compiles(self,
+                                                               tmp_path):
+        ledger = str(tmp_path / "ledger.jsonl")
+        first = run_sweep(_spec(), ledger_path=ledger)
+        assert first.resumed_cells == 0
+        again = run_sweep(_spec(), ledger_path=ledger)
+        assert again.resumed_cells == len(first.cells)
+        assert again.programs_compiled == 0  # nothing re-dispatched
+        assert _rows(again) == _rows(first)
+        assert "resumed_cells" in again.bench_block()
+        assert "resumed_cells" not in first.bench_block()
+
+    def test_partial_ledger_reruns_only_missing_cells(self, tmp_path):
+        ledger = str(tmp_path / "ledger.jsonl")
+        full = run_sweep(_spec(), ledger_path=ledger)
+        # keep the header + the first completed pack (2 cells of 4)
+        lines = open(ledger).read().splitlines()
+        cell_lines = [ln for ln in lines
+                      if json.loads(ln).get("kind") == "cell"]
+        kept = [lines[0]] + cell_lines[:2]
+        open(ledger, "w").write("\n".join(kept) + "\n")
+        resumed = run_sweep(_spec(), ledger_path=ledger)
+        assert resumed.resumed_cells == 2
+        # trajectories identical to the uninterrupted grid, restored and
+        # re-run cells alike (per-cell seeds/plans are index-derived)
+        assert _rows(resumed) == _rows(full)
+        # and the ledger is now complete again
+        final = run_sweep(_spec(), ledger_path=ledger)
+        assert final.resumed_cells == 4
+        assert final.programs_compiled == 0
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        ledger = str(tmp_path / "ledger.jsonl")
+        run_sweep(_spec(), ledger_path=ledger)
+        with open(ledger, "a") as f:
+            f.write('{"kind": "cell", "cell": 99, "label": "torn')  # no \n
+        resumed = run_sweep(_spec(), ledger_path=ledger)
+        assert resumed.resumed_cells == 4
+
+    def test_foreign_grid_ledger_rejected(self, tmp_path):
+        ledger = str(tmp_path / "ledger.jsonl")
+        run_sweep(_spec(), ledger_path=ledger)
+        other = _spec(seeds=(1, 2))
+        with pytest.raises(ValueError, match="different grid"):
+            run_sweep(other, ledger_path=ledger)
+
+    def test_headerless_cell_rows_rejected(self, tmp_path):
+        ledger = tmp_path / "ledger.jsonl"
+        ledger.write_text('{"kind": "cell", "cell": 0, "label": "x"}\n')
+        with pytest.raises(ValueError, match="no header"):
+            run_sweep(_spec(), ledger_path=str(ledger))
+
+    def test_fingerprint_binds_grid_shape(self):
+        spec = _spec()
+        cells = spec.expand_cells()
+        assert (_spec_fingerprint(spec, cells)
+                == _spec_fingerprint(_spec(), _spec().expand_cells()))
+        assert (_spec_fingerprint(spec, cells)
+                != _spec_fingerprint(_spec(rounds=3),
+                                     _spec(rounds=3).expand_cells()))
+
+    def test_ledger_append_is_flushed_per_pack(self, tmp_path):
+        """Every completed pack's rows are durable before run() returns —
+        the crash granularity the resume contract promises."""
+        path = str(tmp_path / "ledger.jsonl")
+        res = run_sweep(_spec(), ledger_path=path)
+        recs = [json.loads(ln) for ln in open(path).read().splitlines()]
+        assert recs[0]["kind"] == "header"
+        cell_recs = [r for r in recs if r["kind"] == "cell"]
+        assert len(cell_recs) == len(res.cells)
+        for r in cell_recs:
+            assert "fit_losses" in r and "eval_losses" in r
+
+    def test_no_ledger_keeps_legacy_behavior(self):
+        res = run_sweep(_spec(seeds=(5,)))
+        assert res.resumed_cells == 0
+        ledger_free = SweepLedger  # symbol exported for direct users
+        assert ledger_free is not None

@@ -648,3 +648,73 @@ def test_cli_sweep_only_log_honors_json(tmp_path):
     ).stdout)
     assert len(doc["sweep"]) == 1
     assert doc["sweep_summary"]["cells"] == 1
+
+
+# -- durable-checkpoint columns (preemption-survivable federation PR) -------
+
+def test_ckpt_columns_render_when_checkpoint_events_present(tmp_path):
+    path = _log_with_events(
+        tmp_path, [_round(1), _round(2)],
+        [{"event": "checkpoint", "round": 2, "generation": 1,
+          "bytes": 4096, "write_ms": 3.25, "kind": "sync"}],
+    )
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    header = out.splitlines()[0].split()
+    assert "ckpt_ms" in header and "ckpt_bytes" in header
+    assert "4096" in out and "3.2" in out
+    # round 1 had no save (off-cadence): renders '-' in the ckpt columns
+    row1 = out.splitlines()[2].split()
+    assert row1[header.index("ckpt_ms")] == "-"
+    assert "ckpt_writes: 1" in out
+    assert "ckpt_bytes: 4096" in out
+
+
+def test_ckpt_fields_merge_sums_multiple_frames_per_round():
+    rounds = perf_report.merge_checkpoint_fields(
+        [_round(1)],
+        [{"round": 1, "bytes": 100, "write_ms": 1.0},
+         {"round": 1, "bytes": 50, "write_ms": 0.5}],
+    )
+    assert rounds[0]["ckpt_bytes"] == 150
+    assert rounds[0]["ckpt_write_ms"] == 1.5
+    summary = perf_report.summarize(rounds)
+    assert summary["ckpt_writes"] == 1
+    assert summary["ckpt_bytes"] == 150
+
+
+def test_ckpt_fields_absent_keeps_legacy_table_byte_stable(tmp_path):
+    """Logs without `checkpoint` events must render the EXACT legacy
+    output — header set and summary keys unchanged."""
+    rounds = perf_report.merge_checkpoint_fields(
+        [_round(1), _round(2)], []
+    )
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    assert "ckpt_ms" not in header and "ckpt_bytes" not in header
+    assert header == [h for h, _, _ in perf_report.COLUMNS]
+    assert "ckpt_writes" not in perf_report.summarize(rounds)
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "ckpt" not in out
+
+
+def test_cli_json_includes_checkpoint_events_when_present(tmp_path):
+    path = _log_with_events(
+        tmp_path, [_round(1)],
+        [{"event": "checkpoint", "round": 1, "generation": 2,
+          "bytes": 2048, "write_ms": 1.5, "kind": "async"}],
+    )
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert doc["checkpoints"][0]["generation"] == 2
+    assert doc["rounds"][0]["ckpt_bytes"] == 2048
+    assert doc["summary"]["ckpt_write_ms"] == 1.5

@@ -86,6 +86,40 @@ ASYNC_COLUMNS = (
     ("cadence_vs", "async_cadence_vs", lambda v: f"{v:.3g}"),
 )
 
+# Durable-checkpoint fields (checkpointing/state.py): write wall and frame
+# bytes of the round's state-checkpoint saves, folded in from `checkpoint`
+# events by round. Optional like the telemetry columns — logs from runs
+# without a state checkpointer keep their exact old table shape
+# (byte-stable, tested).
+CKPT_COLUMNS = (
+    ("ckpt_ms", "ckpt_write_ms", lambda v: f"{v:.1f}"),
+    ("ckpt_bytes", "ckpt_bytes", lambda v: str(int(v))),
+)
+
+
+def merge_checkpoint_fields(rounds: list[dict],
+                            ckpt_events: list[dict]) -> list[dict]:
+    """Fold ``checkpoint`` events' write-ms/bytes into the matching round
+    rows (summed when a round publishes several frames). Rounds without a
+    save — off-cadence rounds — keep no ckpt fields and render '-'."""
+    if not ckpt_events:
+        return rounds
+    by_round: dict[int, dict] = {}
+    for rec in ckpt_events:
+        r = rec.get("round")
+        if r is None:
+            continue
+        agg = by_round.setdefault(
+            int(r), {"ckpt_write_ms": 0.0, "ckpt_bytes": 0}
+        )
+        agg["ckpt_write_ms"] += float(rec.get("write_ms", 0.0))
+        agg["ckpt_bytes"] += int(rec.get("bytes", 0))
+    return [
+        {**rec, **by_round[int(rec.get("round", 0))]}
+        if int(rec.get("round", 0)) in by_round else rec
+        for rec in rounds
+    ]
+
 
 def load_events(path: str) -> dict[str, list[dict]]:
     """Parse the JSONL log into {event_kind: [records]}. Malformed lines
@@ -139,7 +173,7 @@ def active_columns(rounds: list[dict]) -> tuple:
     event."""
     extra = tuple(
         col for col in (TELEMETRY_COLUMNS + WIRE_COLUMNS + MESH_COLUMNS
-                        + PRECISION_COLUMNS + ASYNC_COLUMNS)
+                        + PRECISION_COLUMNS + ASYNC_COLUMNS + CKPT_COLUMNS)
         if any(col[1] in rec for rec in rounds)
     )
     return COLUMNS + extra
@@ -392,6 +426,12 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
                if "steps_per_s_per_chip" in r]
         if sps:
             summary["steps_per_s_per_chip"] = round(sum(sps) / len(sps), 4)
+    if any("ckpt_bytes" in r for r in rounds):
+        # checkpointed runs only — write count, total frame bytes and total
+        # write wall (legacy summaries stay byte-stable)
+        summary["ckpt_writes"] = sum(1 for r in rounds if "ckpt_bytes" in r)
+        summary["ckpt_bytes"] = int(tot("ckpt_bytes"))
+        summary["ckpt_write_ms"] = round(tot("ckpt_write_ms"), 3)
     return summary
 
 
@@ -412,6 +452,8 @@ def main(argv: list[str] | None = None) -> int:
         quarantine = _sorted_rounds(events.get("quarantine", []))
         sweep_cells = _sorted_sweep_cells(events.get("sweep", []))
         sweep_summary = summarize_sweep(events.get("sweep_summary", []))
+        checkpoints = _sorted_rounds(events.get("checkpoint", []))
+        rounds = merge_checkpoint_fields(rounds, checkpoints)
     except OSError as e:
         # a missing/unreadable log is an error exit, not a traceback
         print(f"perf_report: cannot read {args.log}: {e}", file=sys.stderr)
@@ -454,6 +496,8 @@ def main(argv: list[str] | None = None) -> int:
         if sweep_cells:
             doc["sweep"] = sweep_cells
             doc["sweep_summary"] = sweep_summary
+        if checkpoints:
+            doc["checkpoints"] = checkpoints
         print(json.dumps(doc, indent=2))
         return 0
     print(render_table(rounds))
