@@ -557,6 +557,98 @@ class SimulationStateCheckpointer(StateCheckpointer):
         else:
             self.save(**kwargs)
 
+    def save_cohort_snapshot(
+        self, trees, current_round: int, slots: int, registry_size: int,
+        registry_rows: dict, history, writer=None,
+    ) -> None:
+        """Persist a cohort-slot snapshot: the [slots]-shaped server/client
+        state trees PLUS the registry's dirty rows (``ClientRegistry.
+        export_rows``) — every participated client's persistent
+        ``TrainState`` and strategy rows, keyed by the registry ids stored
+        in the frame header. ``n_clients`` in the header is the SLOT count
+        (the restore template's shape); ``registry_size`` binds the frame
+        to its client population."""
+        trees = dict(trees)
+        c_ids = registry_rows.get("client_ids")
+        s_ids = registry_rows.get("strategy_ids")
+        if registry_rows.get("client_rows") is not None:
+            trees["registry_client_rows"] = registry_rows["client_rows"]
+        if registry_rows.get("strategy_rows") is not None:
+            trees["registry_strategy_rows"] = registry_rows["strategy_rows"]
+        kwargs = dict(
+            trees=trees,
+            host={
+                "kind": "cohort",
+                "current_round": current_round,
+                "n_clients": slots,
+                "registry_size": registry_size,
+                "registry_client_ids": [
+                    int(i) for i in (c_ids if c_ids is not None else ())
+                ],
+                "registry_strategy_ids": [
+                    int(i) for i in (s_ids if s_ids is not None else ())
+                ],
+                "history": list(history),
+            },
+            snapshotters={"history": DataclassListSnapshotter()},
+            extra_meta={"round": current_round, "kind": "cohort"},
+        )
+        if writer is not None:
+            writer.submit(self.save, **kwargs)
+        else:
+            self.save(**kwargs)
+
+    def load_cohort_simulation(self, sim) -> int:
+        """Restore a cohort-slot run: slot states adopt onto the live
+        simulation (mesh-aware, like the sync path) and the registry's
+        dirty rows — sized from the header's id lists — reload into the
+        sparse stores, so every participated client resumes from its last
+        persisted row. Returns the next round to run (1-based)."""
+        header, _meta, blob, info = self._read()
+        kind = header.get("kind") or "sync"
+        if kind != "cohort":
+            raise ValueError(
+                f"checkpoint {info.path} was written by a {kind} run; a "
+                "cohort-slot simulation can only resume cohort frames "
+                "(they carry the registry's dirty rows)"
+            )
+        if header["n_clients"] != sim.n_clients:
+            raise ValueError(
+                f"checkpoint has {header['n_clients']} cohort slots, run "
+                f"has {sim.n_clients}"
+            )
+        if header.get("registry_size") != sim.registry_size:
+            raise ValueError(
+                f"checkpoint registry holds {header.get('registry_size')} "
+                f"clients, run's registry holds {sim.registry_size}"
+            )
+        self._check_config(info, sim)
+        c_ids = header.get("registry_client_ids") or []
+        s_ids = header.get("registry_strategy_ids") or []
+        templates = {
+            "server_state": sim.server_state,
+            "client_states": sim.client_states,
+        }
+        row_templates = sim.registry.row_templates(len(c_ids), len(s_ids))
+        if "client_rows" in row_templates:
+            templates["registry_client_rows"] = row_templates["client_rows"]
+        if "strategy_rows" in row_templates:
+            templates["registry_strategy_rows"] = (
+                row_templates["strategy_rows"]
+            )
+        trees = serialization.from_bytes(templates, blob)
+        sim.adopt_restored_state(trees["server_state"],
+                                 trees["client_states"])
+        sim.registry.load_rows(
+            c_ids, trees.get("registry_client_rows"),
+            s_ids, trees.get("registry_strategy_rows"),
+        )
+        sim.history = DataclassListSnapshotter().load(
+            header.get("history"), self._history_template()
+        )
+        self.last_restore_info = info
+        return int(header["current_round"]) + 1
+
     def _history_template(self):
         from fl4health_tpu.server.simulation import RoundRecord
 
@@ -572,11 +664,18 @@ class SimulationStateCheckpointer(StateCheckpointer):
         restored host arrays ``device_put`` back onto the round programs'
         shardings (``sim.adopt_restored_state``)."""
         header, _meta, blob, info = self._read()
-        if (header.get("kind") or "sync") != "sync":
+        kind = header.get("kind") or "sync"
+        if kind == "async":
             raise ValueError(
                 f"checkpoint {info.path} was written by a buffered-async "
                 "run (it carries a pending update buffer); resume it with "
                 "the same async_config instead"
+            )
+        if kind != "sync":
+            raise ValueError(
+                f"checkpoint {info.path} was written by a {kind} run (its "
+                "frame carries extra state — registry rows); resume it "
+                "with the matching cohort configuration instead"
             )
         if header["n_clients"] != sim.n_clients:
             raise ValueError(

@@ -928,12 +928,20 @@ def multi_client_index_plans(
     n_steps: int | None = None,
     local_epochs: int | None = None,
     shuffle: bool = True,
+    pad_steps: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Cohort-wide batch plan: (idx [C,S,B], example_mask [C,S,B],
     step_mask [C,S]) numpy arrays, padded to the cohort's max step count.
 
     Pure host-side index math — the per-client DataLoader loop collapsed into
     one plan that feeds a single device gather (``gather_batches``).
+
+    ``pad_steps`` pins the step axis to a FIXED length instead of the
+    cohort's max (extra steps carry step_mask 0, full no-ops). Cohort-slot
+    rounds (``server/registry.py``) pad every round's plan to the
+    REGISTRY-wide step budget so the compiled slot program's shape never
+    depends on which clients were sampled. Raises if any client's plan
+    exceeds it.
     """
     plans = []
     for ent, n in zip(entropies, ns):
@@ -950,6 +958,14 @@ def multi_client_index_plans(
         plans.append((idx, em, sm))
     n_clients = len(plans)
     max_steps = max(p[0].shape[0] for p in plans)
+    if pad_steps is not None:
+        if max_steps > pad_steps:
+            raise ValueError(
+                f"pad_steps={pad_steps} is smaller than the largest "
+                f"client plan ({max_steps} steps); the fixed step budget "
+                "must cover every client in the registry"
+            )
+        max_steps = pad_steps
     idx_all = np.zeros((n_clients, max_steps, batch_size), np.int32)
     em_all = np.zeros((n_clients, max_steps, batch_size), np.float32)
     sm_all = np.zeros((n_clients, max_steps), np.float32)

@@ -168,6 +168,25 @@ class CompressingStrategy(Strategy):
             residual=residual_spec,
         )
 
+    def state_rows(self, server_state: CompressedExchangeState):
+        """Per-client ``[C, ...]`` EF residual rows (``None`` subtree when
+        error feedback is off) plus the inner strategy's rows, for
+        cohort-slot gather/scatter (``server/registry.py``): each client's
+        residual follows it in and out of the sampled cohort, so error
+        feedback stays exact under partial participation."""
+        return {
+            "residual": server_state.residual,
+            "inner": self.inner.state_rows(server_state.inner),
+        }
+
+    def scatter_state_rows(self, server_state: CompressedExchangeState, rows):
+        return CompressedExchangeState(
+            inner=self.inner.scatter_state_rows(
+                server_state.inner, rows["inner"]
+            ),
+            residual=rows["residual"],
+        )
+
     def divergence_reference(self, server_state: CompressedExchangeState):
         return self.inner.divergence_reference(server_state.inner)
 

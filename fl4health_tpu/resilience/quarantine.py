@@ -267,6 +267,26 @@ class QuarantiningStrategy(Strategy):
             quarantine=P(clients_axis),
         )
 
+    def state_rows(self, server_state: QuarantineServerState):
+        """Per-client quarantine bookkeeping (strikes / probation / dead
+        streak — all ``[C]``) plus whatever the inner strategy carries per
+        client, for cohort-slot gather/scatter (``server/registry.py``).
+        NOTE: under a sampled cohort, probation (``release_in``) counts a
+        client's PARTICIPATING rounds — its row only steps when gathered —
+        rather than wall-clock rounds as in the dense path."""
+        return {
+            "quarantine": server_state.quarantine,
+            "inner": self.inner.state_rows(server_state.inner),
+        }
+
+    def scatter_state_rows(self, server_state: QuarantineServerState, rows):
+        return QuarantineServerState(
+            inner=self.inner.scatter_state_rows(
+                server_state.inner, rows["inner"]
+            ),
+            quarantine=rows["quarantine"],
+        )
+
     def divergence_reference(self, server_state: QuarantineServerState):
         return self.inner.divergence_reference(server_state.inner)
 

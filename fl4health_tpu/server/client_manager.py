@@ -10,16 +10,65 @@ Bernoulli, may return empty), FixedSamplingByFractionClientManager
 TPU-native design: a manager maps (rng, round) -> [n_clients] 0/1 mask; shapes
 stay static so sampling composes with jit. "Empty cohort allowed" is a flag,
 not an exception path.
+
+Cohort-slot execution (``server/registry.py``) adds an index-plan view:
+``sample_indices(rng, round, slots) -> ([slots] int32, valid)`` — the
+ascending registry ids of the sampled clients, padded to a fixed slot
+count — so a round over a million-client registry never materializes an
+``[n_clients]`` mask on device. For FullParticipation / Poisson /
+FixedSampling the two views are pinned coherent (``sample_indices``'
+first ``valid`` entries are exactly ``np.nonzero(sample(rng, round))[0]``
+under the same rng); ``FixedFractionManager`` trades that realization
+coherence for an O(n)-cheap draw (see its ``sample_indices`` docstring) —
+same distribution, same determinism, different subset.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fl4health_tpu.core.types import PRNGKey
+
+
+class CohortOverflowError(ValueError):
+    """A sampling draw selected more clients than the configured cohort
+    slots can hold (``CohortConfig(slots=K)``); raise rather than silently
+    truncating the cohort — dropping sampled clients would bias both the
+    trajectory and any DP accounting tied to the sampling fraction."""
+
+
+def _fraction_floor(fraction: float, n: int) -> int:
+    """``floor(fraction * n)`` with an epsilon guard: inexact binary
+    products like ``0.7 * 10 == 6.999999999999999`` must floor to 7, not 6
+    — without the guard the realized cohort silently undershoots the
+    configured fraction on exactly the "clean" fractions users pick."""
+    return int(math.floor(fraction * n + 1e-9))
+
+
+def _pack_indices(chosen: np.ndarray, slots: int,
+                  scheme: str) -> tuple[np.ndarray, int]:
+    """Pack a drawn id set into the fixed ``[slots]`` plan: ascending ids
+    first, the remainder padded with the first valid id (slot 0's data is
+    real-shaped; the pad slots carry participation weight 0). Empty draws
+    pad with id 0."""
+    chosen = np.asarray(chosen)
+    valid = int(chosen.shape[0])
+    if valid > slots:
+        raise CohortOverflowError(
+            f"{scheme} drew {valid} clients but the cohort has only "
+            f"{slots} slots; raise CohortConfig(slots=...) above the "
+            "scheme's worst-case draw (or lower its fraction)"
+        )
+    out = np.zeros((slots,), np.int32)
+    out[:valid] = np.sort(chosen).astype(np.int32)
+    if 0 < valid < slots:
+        out[valid:] = out[0]
+    return out, valid
 
 
 class ClientManager:
@@ -34,6 +83,23 @@ class ClientManager:
     def sample(self, rng: PRNGKey, round_idx: int) -> jax.Array:
         raise NotImplementedError
 
+    def sample_indices(self, rng: PRNGKey, round_idx: int,
+                       slots: int) -> tuple[np.ndarray, int]:
+        """Cohort-slot index plan: ``([slots] int32 registry ids, valid)``.
+
+        Contract (pinned by tests/server/test_client_manager_properties.py):
+        the first ``valid`` entries are exactly
+        ``np.nonzero(sample(rng, round_idx))[0]`` — the same draw, viewed
+        as ascending ids instead of a dense mask — and padding repeats the
+        first valid id. Overflowing ``slots`` raises
+        :class:`CohortOverflowError`. Subclasses override with vectorized
+        draws; this default derives the plan from the dense mask so exotic
+        managers stay coherent by construction."""
+        mask = np.asarray(jax.device_get(self.sample(rng, round_idx)))
+        return _pack_indices(
+            np.nonzero(mask > 0)[0], slots, type(self).__name__
+        )
+
     def sample_all(self) -> jax.Array:
         return jnp.ones((self.n_clients,), jnp.float32)
 
@@ -45,6 +111,12 @@ class FullParticipationManager(ClientManager):
 
     def sample(self, rng, round_idx):
         return self.sample_all()
+
+    def sample_indices(self, rng, round_idx, slots):
+        return _pack_indices(
+            np.arange(self.n_clients, dtype=np.int32), slots,
+            type(self).__name__,
+        )
 
 
 class FixedFractionManager(ClientManager):
@@ -58,16 +130,39 @@ class FixedFractionManager(ClientManager):
                 f"min_clients={min_clients} exceeds n_clients={n_clients}"
             )
         # the CONFIGURED q (what a DP accountant composes with); the realized
-        # count k may round/floor away from q*n (and never exceeds n)
+        # count k may round/floor away from q*n (and never exceeds n).
+        # Epsilon-safe floor: int() truncation floored 0.7*10 -> 6.
         self.fraction = fraction
         self.min_clients = min_clients
-        self.k = min(n_clients, max(min_clients, int(fraction * n_clients)))
+        self.k = min(
+            n_clients, max(min_clients, _fraction_floor(fraction, n_clients))
+        )
 
     def sample(self, rng, round_idx):
         rng = jax.random.fold_in(rng, round_idx)
         perm = jax.random.permutation(rng, self.n_clients)
         mask = jnp.zeros((self.n_clients,), jnp.float32)
         return mask.at[perm[: self.k]].set(1.0)
+
+    def sample_indices(self, rng, round_idx, slots):
+        # The index view draws the k clients with the SMALLEST uniform
+        # values — the classic without-replacement construction,
+        # distribution-identical to the dense mask's permutation draw but
+        # O(n) uniform bits + one argpartition instead of XLA's full
+        # random sort (55 ms -> ~1 ms at n=100k, the difference between a
+        # hidden and an exposed staging cost). The tradeoff, pinned by
+        # tests: FixedFractionManager's index view is its OWN
+        # deterministic stream — same (rng, round) always yields the same
+        # cohort, but not the same SUBSET the dense permutation mask
+        # realizes (the dense draw cannot change: cohort=None trajectories
+        # are pinned bit-identical across releases).
+        rng = jax.random.fold_in(rng, round_idx)
+        u = np.asarray(jax.random.uniform(rng, (self.n_clients,)))
+        if self.k >= self.n_clients:
+            chosen = np.arange(self.n_clients)
+        else:
+            chosen = np.argpartition(u, self.k)[: self.k]
+        return _pack_indices(chosen, slots, type(self).__name__)
 
 
 class PoissonSamplingManager(ClientManager):
@@ -102,6 +197,19 @@ class PoissonSamplingManager(ClientManager):
             mask = mask | (u <= threshold)
         return mask.astype(jnp.float32)
 
+    def sample_indices(self, rng, round_idx, slots):
+        # the SAME per-client uniform draw as the dense mask (one
+        # vectorized op); only the selected ids leave the host
+        rng = jax.random.fold_in(rng, round_idx)
+        u = np.asarray(jax.random.uniform(rng, (self.n_clients,)))
+        mask = u < self.fraction
+        if self.min_clients > 0:
+            threshold = np.sort(u)[self.min_clients - 1]
+            mask = mask | (u <= threshold)
+        return _pack_indices(
+            np.nonzero(mask)[0], slots, type(self).__name__
+        )
+
 
 class FixedSamplingManager(ClientManager):
     """Draw once, reuse every round (FedDG-GA's reproducibility requirement,
@@ -110,7 +218,9 @@ class FixedSamplingManager(ClientManager):
     def __init__(self, n_clients: int, fraction: float = 1.0):
         super().__init__(n_clients)
         self.fraction = fraction
-        self.k = max(1, int(fraction * n_clients))
+        # epsilon-safe floor (see _fraction_floor): int() truncation
+        # undershot clean fractions like 0.7*10
+        self.k = max(1, _fraction_floor(fraction, n_clients))
         self._cached: jax.Array | None = None
 
     def sample(self, rng, round_idx):
@@ -119,6 +229,16 @@ class FixedSamplingManager(ClientManager):
             mask = jnp.zeros((self.n_clients,), jnp.float32)
             self._cached = mask.at[perm[: self.k]].set(1.0)
         return self._cached
+
+    def sample_indices(self, rng, round_idx, slots):
+        # coherence with the cached-draw semantics: the FIRST call (either
+        # view) fixes the sample; both views then report the same ids
+        if self._cached is None:
+            self.sample(rng, round_idx)
+        mask = np.asarray(self._cached)
+        return _pack_indices(
+            np.nonzero(mask > 0)[0], slots, type(self).__name__
+        )
 
     def reset_sample(self):
         self._cached = None

@@ -108,6 +108,19 @@ class RoundPrefetcher:
 
     def schedule(self, round_idx: int) -> None:
         sim = self._sim
+        if getattr(sim, "_cohort_active", False):
+            # cohort-slot staging: sample the round's cohort ids, gather
+            # its [K, ...] slot tensors from the host registry and
+            # device_put them (sharded under a mesh) — all of it a pure
+            # function of (rng, round, registry data), so it runs here
+            # while the previous round executes. Per-client STATE is
+            # deliberately absent (it depends on the previous round's
+            # registry scatter — the producer gathers it after its gate).
+            self._pending = (
+                round_idx,
+                self._pool.submit(sim._stage_cohort_round, round_idx),
+            )
+            return
         # capture the stacks NOW: take() compares by identity to detect a
         # mid-flight set_train_data swap
         x_stack, y_stack = sim._x_train_stack, sim._y_train_stack
@@ -126,6 +139,10 @@ class RoundPrefetcher:
     def take(self, round_idx: int):
         sim = self._sim
         pending, self._pending = self._pending, None
+        if getattr(sim, "_cohort_active", False):
+            if pending is not None and pending[0] == round_idx:
+                return pending[1].result()
+            return sim._stage_cohort_round(round_idx)
         if pending is None or pending[0] != round_idx:
             return self._place(sim._round_batches(round_idx))
         (x_stack, y_stack), plan, batches = pending[1].result()

@@ -101,6 +101,38 @@ class Strategy:
         (``parallel/program.py RoundProgramBuilder``)."""
         return None
 
+    def state_rows(self, server_state: Any) -> Any:
+        """Per-client rows of the server state: a pytree whose every leaf
+        carries a leading ``[C]`` client axis (wrapper bookkeeping like
+        quarantine strikes, error-feedback residuals), or ``None`` when
+        the strategy keeps no per-client server state.
+
+        Cohort-slot execution (``server/registry.py``) gathers these rows
+        for the sampled cohort into fixed ``[K]`` slot tensors before each
+        round and scatters the updated rows back into the host registry
+        afterwards. Strategies exposing rows MUST (a) initialize every
+        client's row identically in ``init`` (client-symmetric start — the
+        registry derives un-touched clients' rows from one prototype) and
+        (b) keep client ``i``'s row a function of client ``i``'s
+        participation only. Wrapper strategies compose by embedding the
+        inner strategy's rows under an ``"inner"`` key; state-passthrough
+        wrappers (``FedBuff``, whose state IS the inner state) delegate
+        wholesale."""
+        return None
+
+    def scatter_state_rows(self, server_state: Any, rows: Any) -> Any:
+        """Inverse of :meth:`state_rows`: the server state with its
+        per-client rows replaced by ``rows`` (the same structure
+        ``state_rows`` returned, leaves re-gathered to a new leading
+        axis). Must be pure tree surgery — no math — so gather/scatter
+        round-trips bit-identically."""
+        if jax.tree_util.tree_leaves(rows):
+            raise ValueError(
+                f"{type(self).__name__} has no per-client state rows to "
+                "scatter into (state_rows() is None)"
+            )
+        return server_state
+
     def global_params(self, server_state: Any) -> Params:
         """The current global model params (for checkpointing/eval)."""
         return server_state.params

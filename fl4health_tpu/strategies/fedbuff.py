@@ -126,6 +126,14 @@ class FedBuff(Strategy):
     def global_params(self, server_state: Any):
         return self.inner.global_params(server_state)
 
+    def state_rows(self, server_state: Any):
+        # state passthrough: FedBuff's state IS the inner state, so its
+        # per-client rows are exactly the inner strategy's rows
+        return self.inner.state_rows(server_state)
+
+    def scatter_state_rows(self, server_state: Any, rows):
+        return self.inner.scatter_state_rows(server_state, rows)
+
     def divergence_reference(self, server_state: Any):
         return self.inner.divergence_reference(server_state)
 

@@ -102,6 +102,40 @@ def _require_padding_safe_fault(fault_plan, fault_name: str,
         )
 
 
+def _require_padding_safe_manager(spec: SweepSpec, cell: SweepCell,
+                                  bucket: int) -> None:
+    """Probability<1 Poisson managers are rejected under padded buckets —
+    the fault-plan padding POLICY applied to sampling draws.
+
+    Today the runner draws masks host-side from a manager built over the
+    REAL cohort and only zero-pads the result, so padding does not
+    actually shift the draws. The rule exists as a contract, not a
+    present-day hazard: probabilistic per-client draws are the one
+    manager family whose realization is coupled to the population shape,
+    and any future in-graph or bucket-shaped sampling (the natural next
+    optimization: folding the mask draw into the cell program, exactly
+    where the fault plans already live) would silently change REAL
+    clients' draws under padding. Rejecting now keeps the axis's
+    composability promise identical to the fault plans' and makes that
+    refactor non-breaking."""
+    if bucket == cell.cohort:
+        return
+    from fl4health_tpu.server.client_manager import PoissonSamplingManager
+
+    manager = spec.client_managers[cell.manager](cell.cohort)
+    if (isinstance(manager, PoissonSamplingManager)
+            and manager.fraction < 1.0):
+        raise ValueError(
+            f"client manager {cell.manager!r} is Poisson with "
+            f"probability {manager.fraction} < 1: probabilistic "
+            "per-client draws are shape-coupled to the population, and "
+            f"padding cohort {cell.cohort} to bucket {bucket} is "
+            "excluded by the same rule as probabilistic fault plans "
+            "(see bucketing._require_padding_safe_manager). Give this "
+            "cohort its own bucket, or use a fixed-fraction manager."
+        )
+
+
 def plan_groups(spec: SweepSpec, cells: list[SweepCell],
                 data_for) -> SweepPlan:
     """Group cells into shared-executable buckets and size each group's
@@ -114,6 +148,7 @@ def plan_groups(spec: SweepSpec, cells: list[SweepCell],
         _require_padding_safe_fault(
             spec.fault_plans[cell.fault], cell.fault, cell.cohort, bucket
         )
+        _require_padding_safe_manager(spec, cell, bucket)
         key = GroupKey(strategy=cell.strategy, client=cell.client,
                        fault=cell.fault, bucket=bucket)
         groups.setdefault(key, SweepGroup(key=key, cells=[])).cells.append(

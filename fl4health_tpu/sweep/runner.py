@@ -87,6 +87,7 @@ class CellResult:
             "cohort": self.cell.cohort,
             "bucket": self.bucket,
             "fault": self.cell.fault,
+            "manager": self.cell.manager,
             "seed": self.cell.seed,
             "scalars": dict(self.cell.scalars),
             "final_fit_loss": self.final_fit_loss,
@@ -474,9 +475,21 @@ class SweepRunner:
         idx = np.stack([p[0] for p in plans])
         em = np.stack([p[1] for p in plans])
         sm = np.stack([p[2] for p in plans])
-        # participation: full cohort, phantoms masked out (a standalone
-        # run draws the same all-ones mask for its real clients)
-        manager = FullParticipationManager(cell.cohort)
+        # participation: the cell's sampling manager (default: full
+        # participation), drawn over the REAL cohort from the standalone
+        # run's exact PRNG stream (fold_in(rng, 2000+round)), then
+        # zero-padded for phantom clients — a standalone
+        # FederatedSimulation(client_manager=...) run draws the same
+        # masks for its real clients
+        manager = (spec.client_managers[cell.manager](cell.cohort)
+                   or FullParticipationManager(cell.cohort))
+        if manager.n_clients != cell.cohort:
+            raise ValueError(
+                f"client manager {cell.manager!r} covers "
+                f"{manager.n_clients} clients but the cell's cohort is "
+                f"{cell.cohort}; the factory must size the manager from "
+                "its cohort argument"
+            )
         masks = np.stack([
             bucketing.padded_mask(
                 np.asarray(manager.sample(

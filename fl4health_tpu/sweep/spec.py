@@ -16,9 +16,14 @@ Design constraints (v1, enforced loudly):
 - ``local_steps`` only: per-epoch plans derive their step count from each
   partition's size, which would make the compiled scan length a function
   of the partitioner — exactly the shape drift the sweep exists to avoid.
-- full participation: per-cell sampling managers would be a second PRNG
-  stream to reconcile with the standalone-run contract; a cohort-size axis
-  plus fault-plan dropout already covers partial-cohort behavior.
+- sampling managers ARE sweepable (``client_managers`` axis): masks are
+  host-drawn from the standalone run's exact PRNG stream, so a manager
+  cell reproduces ``FederatedSimulation(client_manager=...)`` bit-for-bit
+  and never changes program shapes. The one exclusion: probability<1
+  Poisson managers under a PADDED bucket (the fault-plan padding policy
+  applied to sampling draws — see
+  ``bucketing._require_padding_safe_manager`` for why this is a contract
+  rather than a present-day draw hazard).
 - test splits are not swept (val split only) — one eval program per group.
 """
 
@@ -43,6 +48,7 @@ class SweepCell:
     fault: str
     seed: int
     scalars: tuple[tuple[str, float], ...] = ()
+    manager: str = "full"
 
     @property
     def scalar_dict(self) -> dict[str, float]:
@@ -53,6 +59,10 @@ class SweepCell:
                  f"c{self.cohort}"]
         if self.fault != "none":
             parts.append(self.fault)
+        if self.manager != "full":
+            # absent for the default axis value, so pre-manager-axis
+            # grids keep their exact labels (and thus ledger fingerprints)
+            parts.append(f"m:{self.manager}")
         parts.append(f"s{self.seed}")
         parts += [f"{k}={v:g}" for k, v in self.scalars]
         return "/".join(parts)
@@ -73,6 +83,18 @@ class SweepSpec:
     ``scalars``: hoisted-scalar axes by registered name
     (``sweep.hoisting.SCALAR_BINDINGS``) -> values; cells whose strategy
     chain lacks the knob collapse to one cell per remaining combo.
+    ``client_managers``: sampling-manager axis — name ->
+    ``f(cohort_size) -> ClientManager | None`` (None = full
+    participation, the default). Masks are drawn host-side from the SAME
+    PRNG stream a standalone run with that manager would use
+    (``fold_in(rng, 2000 + round)``), so manager cells keep the
+    standalone-reproduction contract; the manager never changes program
+    shapes, so it composes with bucketing — EXCEPT probability<1 Poisson
+    managers under a padded bucket, which are rejected loudly (the
+    fault-plan padding policy applied to sampling draws; rationale in
+    ``bucketing._require_padding_safe_manager``). The name ``"full"`` is
+    reserved for full participation (factory returning None): cell labels
+    omit it, keeping pre-axis ledger fingerprints valid.
     ``cohort_buckets``: optional ascending shape buckets; each cell runs
     padded to the smallest bucket >= its cohort (phantom clients are
     zero-weight — pure perf, never semantics). Default: one bucket per
@@ -99,6 +121,9 @@ class SweepSpec:
     )
     scalars: Mapping[str, Sequence[float]] = dataclasses.field(
         default_factory=dict
+    )
+    client_managers: Mapping[str, Callable[[int], Any]] = dataclasses.field(
+        default_factory=lambda: {"full": lambda cohort: None}
     )
     cohort_buckets: Sequence[int] | None = None
     pack: bool = True
@@ -129,6 +154,27 @@ class SweepSpec:
             )
         if self.max_pack < 1:
             raise ValueError(f"max_pack must be >= 1; got {self.max_pack}")
+        if not self.client_managers:
+            raise ValueError(
+                "SweepSpec.client_managers must be non-empty (use the "
+                "default {'full': lambda cohort: None} for full "
+                "participation)"
+            )
+        if "full" in self.client_managers:
+            # The NAME "full" is reserved: cell labels omit it (so
+            # pre-manager-axis grids keep their exact labels and thus
+            # ledger fingerprints), which means a sampling manager hiding
+            # behind it would fingerprint-collide with a genuine
+            # full-participation grid and restore the wrong trajectories
+            # on resume. Probe the factory once to enforce the contract.
+            probe = self.client_managers["full"](2)
+            if probe is not None:
+                raise ValueError(
+                    "client_managers name 'full' is reserved for full "
+                    "participation (its factory must return None — cell "
+                    f"labels omit it); got {type(probe).__name__} — "
+                    "register the sampling manager under another name"
+                )
         for name in self.scalars:
             binding(name)  # raises with the registered-name list
         if self.cohort_buckets is not None:
@@ -168,9 +214,9 @@ class SweepSpec:
         by_strategy = self.applicable_scalar_axes()
         cells: list[SweepCell] = []
         idx = 0
-        for strat, client, part, cohort, fault in itertools.product(
+        for strat, client, part, cohort, fault, manager in itertools.product(
             self.strategies, self.clients, self.partitioners,
-            self.cohort_sizes, self.fault_plans,
+            self.cohort_sizes, self.fault_plans, self.client_managers,
         ):
             axes = by_strategy[strat]
             combos: list[tuple[tuple[str, float], ...]] = [()]
@@ -185,7 +231,7 @@ class SweepSpec:
                 cells.append(SweepCell(
                     index=idx, strategy=strat, client=client,
                     partitioner=part, cohort=int(cohort), fault=fault,
-                    seed=int(seed), scalars=combo,
+                    seed=int(seed), scalars=combo, manager=manager,
                 ))
                 idx += 1
         return cells

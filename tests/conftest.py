@@ -78,6 +78,15 @@ def pytest_configure(config):
         "(mid-write, async, corruption variants) also carries 'slow'. "
         "Select with -m crash.",
     )
+    config.addinivalue_line(
+        "markers",
+        "bigcohort: cohort-slot registry lanes (server/registry.py "
+        "ClientRegistry + CohortConfig). The tier-1-safe smoke subset "
+        "(slots=N bit-identity parity, sample_indices/mask coherence, "
+        "O(K) compiled-footprint introspection pins) runs by default; "
+        "million-client property sweeps and registry-growth benches also "
+        "carry 'slow'. Select with -m bigcohort.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -140,3 +140,163 @@ class TestOtherManagers:
         c = _mask_np(mgr, 999, 7)
         assert c.shape == (10,) and int(c.sum()) == 5
         del c
+
+
+class TestFractionFloorRegression:
+    """int() truncation floored inexact binary products (0.7 * 10 ==
+    6.999999999999999 -> 6); the epsilon-safe floor must realize the
+    exact fraction on every 'clean' (fraction, n) pair."""
+
+    @pytest.mark.parametrize("n,frac,expected_k", [
+        (10, 0.7, 7),     # 0.7*10 == 6.999999999999999 under float64
+        (30, 0.3, 9),     # 0.3*30 == 8.999999999999998
+        (100, 0.29, 29),  # 0.29*100 == 28.999999999999996
+        (10, 0.1, 1),
+        (3, 1.0, 3),
+        (7, 0.5, 3),      # true floors stay floors
+        (9, 0.33, 2),     # 2.97 floors to 2 (not rounded up)
+    ])
+    def test_fixed_fraction_k(self, n, frac, expected_k):
+        assert FixedFractionManager(n, frac).k == expected_k
+        m = _mask_np(FixedFractionManager(n, frac), 0, 1)
+        assert int(m.sum()) == expected_k
+
+    @pytest.mark.parametrize("n,frac,expected_k", [
+        (10, 0.7, 7), (30, 0.3, 9), (10, 0.1, 1),
+    ])
+    def test_fixed_sampling_k(self, n, frac, expected_k):
+        assert FixedSamplingManager(n, frac).k == expected_k
+
+
+class TestSampleIndices:
+    """The cohort-slot index view: for FullParticipation / Poisson /
+    FixedSampling it is COHERENT with the dense mask (first `valid`
+    entries == nonzero(sample()) under the same rng, ascending; padding
+    repeats the first valid id). FixedFractionManager trades realization
+    coherence for an O(n)-cheap draw — exact-k, deterministic,
+    duplicate-free, but its own subset."""
+
+    def _coherent_managers(self, n):
+        return [
+            FullParticipationManager(n),
+            PoissonSamplingManager(n, 0.3),
+            PoissonSamplingManager(n, 0.3, min_clients=2),
+        ]
+
+    def test_indices_match_dense_mask(self):
+        n = 16
+        for mgr in self._coherent_managers(n):
+            for seed in SEEDS:
+                rng = jax.random.PRNGKey(seed)
+                mask = np.asarray(mgr.sample(rng, 3))
+                idx, valid = mgr.sample_indices(rng, 3, n)
+                expected = np.nonzero(mask > 0)[0]
+                assert valid == expected.size
+                np.testing.assert_array_equal(idx[:valid], expected)
+                if 0 < valid < n:
+                    assert (idx[valid:] == idx[0]).all()
+
+    def test_fixed_fraction_index_view_invariants(self):
+        mgr = FixedFractionManager(16, 0.4, min_clients=1)
+        for seed in SEEDS:
+            rng = jax.random.PRNGKey(seed)
+            idx, valid = mgr.sample_indices(rng, 3, 16)
+            assert valid == mgr.k
+            chosen = idx[:valid]
+            assert (np.sort(chosen) == chosen).all()
+            assert np.unique(chosen).size == valid
+            assert chosen.min() >= 0 and chosen.max() < 16
+            idx2, valid2 = mgr.sample_indices(rng, 3, 16)
+            np.testing.assert_array_equal(idx, idx2)
+            # a different round is a different draw
+            idx3, _ = mgr.sample_indices(rng, 4, 16)
+            assert not np.array_equal(idx, idx3)
+
+    def test_fixed_fraction_full_k_is_everyone(self):
+        idx, valid = FixedFractionManager(6, 1.0).sample_indices(
+            jax.random.PRNGKey(0), 1, 6
+        )
+        assert valid == 6
+        np.testing.assert_array_equal(idx, np.arange(6))
+
+    def test_fixed_sampling_views_agree(self):
+        from fl4health_tpu.server.client_manager import FixedSamplingManager
+
+        mgr = FixedSamplingManager(12, 0.5)
+        rng = jax.random.PRNGKey(3)
+        idx, valid = mgr.sample_indices(rng, 1, 12)
+        mask = np.asarray(mgr.sample(rng, 1))
+        np.testing.assert_array_equal(idx[:valid], np.nonzero(mask > 0)[0])
+        # second view call reuses the cached draw
+        idx2, valid2 = mgr.sample_indices(jax.random.PRNGKey(999), 9, 12)
+        np.testing.assert_array_equal(idx, idx2)
+
+    def test_overflow_raises(self):
+        from fl4health_tpu.server.client_manager import CohortOverflowError
+
+        with pytest.raises(CohortOverflowError, match="slots"):
+            FullParticipationManager(8).sample_indices(
+                jax.random.PRNGKey(0), 1, 4
+            )
+
+    def test_empty_draw_pads_zero(self):
+        idx, valid = PoissonSamplingManager(8, 0.0).sample_indices(
+            jax.random.PRNGKey(0), 1, 3
+        )
+        assert valid == 0
+        np.testing.assert_array_equal(idx, np.zeros(3, np.int32))
+
+    def test_base_class_default_derives_from_mask(self):
+        from fl4health_tpu.server.client_manager import ClientManager
+
+        class OddManager(ClientManager):
+            def sample(self, rng, round_idx):
+                m = jnp.zeros((self.n_clients,), jnp.float32)
+                return m.at[1::2].set(1.0)
+
+        idx, valid = OddManager(8).sample_indices(jax.random.PRNGKey(0), 1, 4)
+        assert valid == 4
+        np.testing.assert_array_equal(idx, [1, 3, 5, 7])
+
+
+class TestLargeRegistryDraws:
+    """Managers must draw a million-client registry in vectorized ops —
+    no Python per-client loops — and keep the exact-k / determinism /
+    coherence invariants at scale."""
+
+    N = 1_000_000
+
+    def test_fixed_fraction_million_exact_k(self):
+        mgr = FixedFractionManager(self.N, 0.0001)
+        assert mgr.k == 100
+        rng = jax.random.PRNGKey(0)
+        idx, valid = mgr.sample_indices(rng, 1, 128)
+        assert valid == 100
+        assert idx.dtype == np.int32
+        assert (np.sort(idx[:valid]) == idx[:valid]).all()
+        assert np.unique(idx[:valid]).size == valid
+        assert idx.max() < self.N
+        idx2, valid2 = mgr.sample_indices(rng, 1, 128)
+        np.testing.assert_array_equal(idx, idx2)
+
+    def test_poisson_million_rate(self):
+        mgr = PoissonSamplingManager(self.N, 0.0001)
+        idx, valid = mgr.sample_indices(jax.random.PRNGKey(1), 2, 400)
+        # Bernoulli(1e-4) over 1e6 draws: ~100 +- 5 sigma
+        assert 50 <= valid <= 150
+        assert np.unique(idx[:valid]).size == valid
+
+    def test_full_participation_million(self):
+        idx, valid = FullParticipationManager(self.N).sample_indices(
+            jax.random.PRNGKey(0), 1, self.N
+        )
+        assert valid == self.N
+        assert idx[0] == 0 and idx[-1] == self.N - 1
+
+    @pytest.mark.slow
+    def test_poisson_indices_match_mask_at_million(self):
+        mgr = PoissonSamplingManager(self.N, 0.0001)
+        rng = jax.random.PRNGKey(5)
+        mask = np.asarray(mgr.sample(rng, 1))
+        idx, valid = mgr.sample_indices(rng, 1, 400)
+        np.testing.assert_array_equal(idx[:valid], np.nonzero(mask > 0)[0])

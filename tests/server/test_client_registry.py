@@ -1,0 +1,250 @@
+"""Unit tests for the cohort registry plumbing (server/registry.py):
+sparse row stores, data sources, host staging parity with the dense
+device gather, and checkpoint row round-trips."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.registry_presets import (
+    dirichlet_registry_source,
+)
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.server.registry import (
+    ClientRegistry,
+    CohortConfig,
+    IndexedPoolSource,
+    ListDataSource,
+    _SparseRowStore,
+    as_registry_source,
+)
+from fl4health_tpu.server.simulation import ClientDataset
+
+pytestmark = pytest.mark.bigcohort
+
+
+def make_datasets(n=4, rows=40):
+    out = []
+    for i in range(n):
+        x, y = synthetic_classification(jax.random.PRNGKey(i), rows, (6,), 3)
+        out.append(ClientDataset(
+            np.asarray(x[:32]), np.asarray(y[:32]),
+            np.asarray(x[32:]), np.asarray(y[32:]),
+        ))
+    return out
+
+
+class TestCohortConfig:
+    def test_validates_slots(self):
+        with pytest.raises(ValueError, match="slots"):
+            CohortConfig(slots=0)
+        assert CohortConfig(slots=3).slots == 3
+
+
+class TestSparseRowStore:
+    def test_gather_defaults_then_scatter_overrides(self):
+        store = _SparseRowStore("t")
+        fresh = {"a": np.zeros((3, 2)), "b": np.ones((3,))}
+        out = store.gather(np.array([5, 9, 2]), fresh)
+        np.testing.assert_array_equal(out["a"], np.zeros((3, 2)))
+        rows = {"a": np.arange(6.0).reshape(3, 2), "b": np.array([7., 8., 9.])}
+        store.scatter(np.array([5, 9, 2]), rows, valid=2)  # id 2 is a pad
+        assert store.dirty == 2
+        out = store.gather(np.array([9, 2, 5]), fresh)
+        np.testing.assert_array_equal(out["a"][0], [2.0, 3.0])  # id 9
+        np.testing.assert_array_equal(out["a"][1], [0.0, 0.0])  # id 2 fresh
+        np.testing.assert_array_equal(out["a"][2], [0.0, 1.0])  # id 5
+        assert out["b"][2] == 7.0
+
+    def test_scatter_copies_rows_out_of_the_stack(self):
+        store = _SparseRowStore("t")
+        rows = {"a": np.zeros((2, 2))}
+        store.scatter(np.array([0, 1]), rows, valid=2)
+        rows["a"][0, 0] = 99.0  # mutating the stack must not reach the store
+        out = store.gather(np.array([0]), {"a": np.full((1, 2), -1.0)})
+        assert out["a"][0, 0] == 0.0
+
+    def test_export_load_roundtrip(self):
+        store = _SparseRowStore("t")
+        store.scatter(np.array([7, 3]),
+                      {"a": np.array([[1.0], [2.0]])}, valid=2)
+        ids, stacked = store.export()
+        np.testing.assert_array_equal(ids, [3, 7])
+        fresh = _SparseRowStore("t2")
+        fresh.load(ids, stacked)
+        out = fresh.gather(np.array([3, 7]), {"a": np.zeros((2, 1))})
+        np.testing.assert_array_equal(out["a"], [[2.0], [1.0]])
+
+    def test_empty_export(self):
+        ids, stacked = _SparseRowStore("t").export()
+        assert ids.size == 0 and stacked is None
+
+
+class TestDataSources:
+    def test_list_source_rejects_test_split(self):
+        x = np.zeros((4, 2), np.float32)
+        y = np.zeros((4,), np.int32)
+        ds = [ClientDataset(x, y, x, y, x_test=x, y_test=y)]
+        with pytest.raises(ValueError, match="test split"):
+            ListDataSource(ds)
+
+    def test_list_source_rejects_row_mismatch(self):
+        x = np.zeros((4, 2), np.float32)
+        with pytest.raises(ValueError, match="one-to-one"):
+            ListDataSource([ClientDataset(
+                x, np.zeros((3,), np.int32), x, np.zeros((4,), np.int32)
+            )])
+
+    def test_as_registry_source_passthrough_and_wrap(self):
+        src = ListDataSource(make_datasets(2))
+        assert as_registry_source(src) is src
+        wrapped = as_registry_source(make_datasets(2))
+        assert isinstance(wrapped, ListDataSource)
+
+    def test_indexed_pool_source_views(self):
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        y = np.arange(10, dtype=np.int32)
+        src = IndexedPoolSource(
+            (x, y), (x, y),
+            train_indices=[np.array([0, 1, 2]), np.array([3, 4])],
+            val_indices=[np.array([5]), np.array([6, 7])],
+        )
+        assert src.n_clients == 2
+        np.testing.assert_array_equal(src.train_sizes(), [3, 2])
+        xt, yt = src.client_train(1)
+        np.testing.assert_array_equal(yt, [3, 4])
+        np.testing.assert_array_equal(xt, x[[3, 4]])
+
+    def test_indexed_pool_source_bounds_and_empties(self):
+        x = np.zeros((4, 2), np.float32)
+        y = np.zeros((4,), np.int32)
+        with pytest.raises(ValueError, match="row 9"):
+            IndexedPoolSource((x, y), (x, y), [np.array([9])],
+                              [np.array([0])])
+        with pytest.raises(ValueError, match="empty"):
+            IndexedPoolSource((x, y), (x, y), [np.array([], np.int64)],
+                              [np.array([0])])
+
+
+class TestStagingParity:
+    def test_stage_round_matches_dense_gather(self):
+        """Host-side slot staging for the identity cohort reproduces the
+        dense device-bank gather bit-for-bit (same plans, same rows)."""
+        datasets = make_datasets(4)
+        reg = ClientRegistry(ListDataSource(datasets), batch_size=8,
+                             local_steps=None, local_epochs=1)
+        rng = jax.random.PRNGKey(5)
+        base_entropy = engine._entropy_from_key(rng)
+        # dense reference
+        x_stack = engine.pad_and_stack_data([d.x_train for d in datasets])
+        y_stack = engine.pad_and_stack_data([d.y_train for d in datasets])
+        plan = engine.multi_client_index_plans(
+            [[*base_entropy, 1000 + 2, i] for i in range(4)],
+            [d.n_train for d in datasets], 8, local_epochs=1,
+        )
+        dense = engine.gather_batches(x_stack, y_stack, *plan)
+        staged = reg.stage_round(np.arange(4), 4, base_entropy, 2)
+        np.testing.assert_array_equal(
+            np.asarray(dense.x), staged["batches"].x
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dense.y), staged["batches"].y
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dense.example_mask), staged["batches"].example_mask
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dense.step_mask), staged["batches"].step_mask
+        )
+        np.testing.assert_array_equal(
+            staged["sample_counts"], [d.n_train for d in datasets]
+        )
+
+    def test_pad_slots_are_masked_and_duplicate_first(self):
+        reg = ClientRegistry(ListDataSource(make_datasets(4)), batch_size=8,
+                             local_steps=2, local_epochs=None)
+        staged = reg.stage_round(np.array([2, 1, 2, 2]), 2,
+                                 [0, 0], 1)
+        np.testing.assert_array_equal(staged["mask"], [1, 1, 0, 0])
+        assert staged["val_counts"][2] == 0.0
+        assert staged["sample_counts"][3] == 0.0
+
+    def test_step_budget_is_registry_wide(self):
+        # heterogeneous sizes: budget covers the BIGGEST client even when
+        # the sampled cohort is all-small
+        x_big, y_big = (np.zeros((100, 2), np.float32),
+                        np.zeros((100,), np.int32))
+        x_small, y_small = (np.zeros((8, 2), np.float32),
+                            np.zeros((8,), np.int32))
+        ds = [ClientDataset(x_small, y_small, x_small, y_small),
+              ClientDataset(x_big, y_big, x_big, y_big)]
+        reg = ClientRegistry(ListDataSource(ds), batch_size=8,
+                             local_steps=None, local_epochs=1)
+        assert reg.train_steps == 13  # ceil(100/8)
+        staged = reg.stage_round(np.array([0]), 1, [0, 0], 1)
+        assert staged["batches"].step_mask.shape == (1, 13)
+        # the small client's extra steps are masked no-ops
+        assert staged["batches"].step_mask[0].sum() == 1
+
+
+class TestDirichletPresets:
+    def test_registry_source_shapes_and_determinism(self):
+        x, y = synthetic_classification(jax.random.PRNGKey(0), 256, (4,), 5)
+        x, y = np.asarray(x), np.asarray(y)
+        a = dirichlet_registry_source(x, y, 50, beta=0.5, seed=7)
+        b = dirichlet_registry_source(x, y, 50, beta=0.5, seed=7)
+        assert a.n_clients == 50
+        assert (a.train_sizes() >= 1).all()
+        assert (a.val_sizes() >= 1).all()
+        np.testing.assert_array_equal(a.train_sizes(), b.train_sizes())
+        xt, yt = a.client_train(3)
+        xt2, yt2 = b.client_train(3)
+        np.testing.assert_array_equal(yt, yt2)
+        np.testing.assert_array_equal(xt, xt2)
+
+    def test_no_densification(self):
+        """The preset's per-client indices are views into ONE permutation
+        — total index memory is O(pool), never O(N x shard copies)."""
+        x, y = synthetic_classification(jax.random.PRNGKey(0), 128, (4,), 5)
+        src = dirichlet_registry_source(
+            np.asarray(x), np.asarray(y), 30, beta=0.3, seed=1
+        )
+        # most shards share a base buffer (top-up rows may be fresh)
+        assert any(ix.base is not None for ix in src._train_idx)
+
+    def test_heterogeneity_with_low_beta(self):
+        x, y = synthetic_classification(jax.random.PRNGKey(0), 512, (4,), 4)
+        src = dirichlet_registry_source(
+            np.asarray(x), np.asarray(y), 8, beta=0.1, seed=3
+        )
+        sizes = src.train_sizes()
+        # low beta concentrates labels: shard sizes spread widely
+        assert sizes.max() > 2 * max(int(sizes.min()), 1)
+
+    def test_works_as_simulation_registry(self):
+        import optax
+
+        from fl4health_tpu.metrics.base import MetricManager
+        from fl4health_tpu.models.cnn import Mlp
+        from fl4health_tpu.server.client_manager import FixedFractionManager
+        from fl4health_tpu.server.simulation import FederatedSimulation
+        from fl4health_tpu.strategies.fedavg import FedAvg
+
+        x, y = synthetic_classification(jax.random.PRNGKey(0), 256, (6,), 3)
+        src = dirichlet_registry_source(
+            np.asarray(x), np.asarray(y), 20, beta=0.5, seed=2
+        )
+        model = engine.from_flax(Mlp(features=(8,), n_outputs=3))
+        sim = FederatedSimulation(
+            logic=engine.ClientLogic(model, engine.masked_cross_entropy),
+            tx=optax.sgd(0.05), strategy=FedAvg(), datasets=src,
+            batch_size=8, metrics=MetricManager(()), local_steps=2,
+            cohort=CohortConfig(slots=4),
+            client_manager=FixedFractionManager(20, 0.2),
+        )
+        hist = sim.fit(3)
+        assert len(hist) == 3
+        for r in hist:
+            assert np.isfinite(r.fit_losses["backward"])

@@ -1,0 +1,411 @@
+"""Cohort-slot execution (server/registry.py): rounds compile and run in
+O(sampled cohort), not O(registry).
+
+The pinned contracts:
+- ``cohort=None`` is the dense path, untouched (the rest of the suite);
+- ``slots == n_clients`` under full participation is BIT-IDENTICAL to the
+  dense path on both execution modes — params and trajectory — including
+  under the stateful wrapper stack Quarantining(Compressing(Scaffold))
+  whose per-client server rows ride the registry gather/scatter cycle;
+- the compiled slot program's XLA cost/memory analysis is IDENTICAL
+  across registry sizes at fixed K (the O(K) proof);
+- cohort checkpoints resume bit-identically, registry rows included.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fl4health_tpu.checkpointing.state import SimulationStateCheckpointer
+from fl4health_tpu.clients import engine
+from fl4health_tpu.clients.scaffold import ScaffoldClientLogic
+from fl4health_tpu.compression.config import CompressionConfig
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.observability import Observability
+from fl4health_tpu.observability.introspect import ProgramIntrospector
+from fl4health_tpu.observability.registry import MetricsRegistry
+from fl4health_tpu.resilience.quarantine import (
+    QuarantinePolicy,
+    QuarantiningStrategy,
+)
+from fl4health_tpu.server.client_manager import (
+    CohortOverflowError,
+    FixedFractionManager,
+    PoissonSamplingManager,
+)
+from fl4health_tpu.server.registry import CohortConfig
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+from fl4health_tpu.strategies.scaffold import Scaffold
+
+pytestmark = pytest.mark.bigcohort
+
+N_CLASSES = 3
+
+
+def make_datasets(n=4, rows=40, seed0=0):
+    out = []
+    for i in range(n):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(seed0 + i), rows, (6,), N_CLASSES
+        )
+        out.append(ClientDataset(
+            np.asarray(x[:32]), np.asarray(y[:32]),
+            np.asarray(x[32:]), np.asarray(y[32:]),
+        ))
+    return out
+
+
+def make_sim(n=4, cohort=None, mode="auto", manager=None, strategy=None,
+             logic_cls=None, compression=None, state_checkpointer=None,
+             local_epochs=1, local_steps=None, seed=5, datasets=None,
+             observability=None):
+    model = engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES))
+    if logic_cls is not None:
+        logic = logic_cls(model, engine.masked_cross_entropy)
+    else:
+        logic = engine.ClientLogic(model, engine.masked_cross_entropy)
+    return FederatedSimulation(
+        logic=logic,
+        tx=optax.sgd(0.05),
+        strategy=strategy or FedAvg(),
+        datasets=datasets if datasets is not None else make_datasets(n),
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=local_epochs,
+        local_steps=local_steps,
+        seed=seed,
+        cohort=cohort,
+        execution_mode=mode,
+        client_manager=manager,
+        compression=compression,
+        state_checkpointer=state_checkpointer,
+        observability=observability,
+    )
+
+
+def flat(tree):
+    return np.asarray(
+        jax.flatten_util.ravel_pytree(jax.device_get(tree))[0]
+    )
+
+
+def assert_histories_equal(a, b):
+    assert [r.round for r in a] == [r.round for r in b]
+    for ra, rb in zip(a, b):
+        assert ra.fit_losses == rb.fit_losses, (ra.round, ra.fit_losses,
+                                                rb.fit_losses)
+        assert ra.eval_losses == rb.eval_losses, ra.round
+        assert ra.fit_metrics == rb.fit_metrics, ra.round
+
+
+class TestSlotsEqualsDenseParity:
+    def test_fedavg_slots_n_bitwise_vs_both_dense_modes(self):
+        dense_p = make_sim(mode="pipelined")
+        hp = dense_p.fit(4)
+        dense_c = make_sim(mode="chunked")
+        hc = dense_c.fit(4)
+        slot = make_sim(cohort=CohortConfig(slots=4))
+        hs = slot.fit(4)
+        assert_histories_equal(hp, hs)
+        assert_histories_equal(hc, hs)
+        p = flat(dense_p.global_params)
+        assert np.array_equal(p, flat(slot.global_params))
+        assert np.array_equal(p, flat(dense_c.global_params))
+
+    def test_wrapper_stack_gather_scatter_parity(self):
+        """THE acceptance pin: Quarantining(Compressing(SCAFFOLD)) —
+        per-client quarantine rows + EF residual rows + in-client control
+        variates all round-trip through the registry bit-exactly."""
+        def build(**kw):
+            return make_sim(
+                strategy=QuarantiningStrategy(Scaffold(), QuarantinePolicy()),
+                logic_cls=lambda m, c: ScaffoldClientLogic(
+                    m, c, learning_rate=0.05
+                ),
+                compression=CompressionConfig(
+                    topk_fraction=0.5, error_feedback=True, quant_bits=8,
+                    seed=3,
+                ),
+                **kw,
+            )
+
+        dense_p = build(mode="pipelined")
+        hp = dense_p.fit(4)
+        dense_c = build(mode="chunked")
+        hc = dense_c.fit(4)
+        slot = build(cohort=CohortConfig(slots=4))
+        hs = slot.fit(4)
+        assert_histories_equal(hp, hs)
+        assert_histories_equal(hc, hs)
+        assert np.array_equal(flat(dense_p.global_params),
+                              flat(slot.global_params))
+        # the persistent per-client server rows (quarantine bookkeeping +
+        # EF residuals) match the dense server state's rows exactly
+        dense_rows = flat(dense_p.strategy.state_rows(dense_p.server_state))
+        slot_rows = flat(
+            slot.registry.gather_strategy_rows(np.arange(4))
+        )
+        assert np.array_equal(dense_rows, slot_rows)
+        # and the persistent client TrainState rows (params, momenta,
+        # SCAFFOLD control variates, PRNG cursors) match the dense stack
+        assert np.array_equal(
+            flat(dense_p.client_states),
+            flat(slot.registry.gather_client_states(np.arange(4))),
+        )
+
+    def test_local_steps_config_parity(self):
+        dense = make_sim(mode="pipelined", local_epochs=None, local_steps=3)
+        hd = dense.fit(3)
+        slot = make_sim(cohort=CohortConfig(slots=4), local_epochs=None,
+                        local_steps=3)
+        hs = slot.fit(3)
+        assert_histories_equal(hd, hs)
+        assert np.array_equal(flat(dense.global_params),
+                              flat(slot.global_params))
+
+
+class TestSampledCohorts:
+    def test_fixed_fraction_runs_with_k_slots(self):
+        sim = make_sim(
+            n=6, cohort=CohortConfig(slots=3),
+            manager=FixedFractionManager(6, 0.5),
+        )
+        hist = sim.fit(4)
+        assert len(hist) == 4
+        for r in hist:
+            assert np.isfinite(r.fit_losses["backward"])
+        # every participant's row materialized at most once per client
+        assert 3 <= sim.registry.dirty_rows <= 6
+
+    def test_state_persists_across_participations(self):
+        """A client sampled in rounds r and r' resumes from its scattered
+        row: re-running the same seeds reproduces the exact trajectory
+        (any gather/scatter loss would break this determinism)."""
+        def run():
+            sim = make_sim(
+                n=6, cohort=CohortConfig(slots=3),
+                manager=FixedFractionManager(6, 0.5),
+            )
+            sim.fit(5)
+            return [r.fit_losses["backward"] for r in sim.history], flat(
+                sim.global_params
+            )
+
+        la, pa = run()
+        lb, pb = run()
+        assert la == lb
+        assert np.array_equal(pa, pb)
+
+    def test_empty_poisson_cohort_round_is_noop(self):
+        sim = make_sim(
+            n=4, cohort=CohortConfig(slots=2),
+            manager=PoissonSamplingManager(4, 0.0),
+        )
+        p0 = flat(sim.global_params)
+        hist = sim.fit(2)
+        assert len(hist) == 2
+        assert np.array_equal(p0, flat(sim.global_params))
+
+
+class TestOKProof:
+    def test_slot_program_cost_identical_across_registry_sizes(self):
+        """The O(K) pin: the compiled slot fit program's cost-model FLOPs
+        and device-memory footprint are a function of (slots, step
+        budgets, batch, example shape) — NEVER of the registry size."""
+        reports = {}
+        for n in (8, 32):
+            sim = make_sim(
+                n=n, cohort=CohortConfig(slots=4),
+                manager=FixedFractionManager(n, 4 / n),
+                datasets=make_datasets(n, rows=40),
+            )
+            intro = ProgramIntrospector(MetricsRegistry())
+            aa = sim.registry.abstract_round_args(sim.n_clients)
+            rep = intro.introspect_jit(
+                "fit_round", sim._fit_round,
+                (sim.server_state, sim.client_states, aa["batches"],
+                 aa["mask"], jnp.asarray(1, jnp.int32), aa["val_batches"],
+                 aa["sample_counts"]),
+            )
+            assert rep is not None
+            reports[n] = rep
+        assert reports[8].flops is not None  # a None==None pass is vacuous
+        assert reports[8].peak_hbm_bytes is not None
+        assert reports[8].flops == reports[32].flops
+        assert reports[8].peak_hbm_bytes == reports[32].peak_hbm_bytes
+        assert reports[8].bytes_accessed == reports[32].bytes_accessed
+
+    def test_fit_introspection_lands_registry_fields(self):
+        obs = Observability(enabled=True, introspection=True)
+        sim = make_sim(
+            n=6, cohort=CohortConfig(slots=3),
+            manager=FixedFractionManager(6, 0.5),
+            observability=obs,
+        )
+        sim.fit(2)
+        events = [e for e in obs.registry.events if e["event"] == "round"]
+        assert len(events) == 2
+        for e in events:
+            assert e["cohort_slots"] == 3
+            assert e["registry_size"] == 6
+            assert e["cohort_valid"] == 3
+            assert "stage_ms" in e and "scatter_ms" in e
+        programs = [e for e in obs.registry.events
+                    if e["event"] == "program"]
+        assert {p["name"] for p in programs} >= {"fit_round_t",
+                                                 "eval_round_t"}
+
+
+class TestCohortResume:
+    def test_resume_bit_identical(self, tmp_path):
+        def build(sc=None):
+            return make_sim(
+                n=6, cohort=CohortConfig(slots=3),
+                manager=FixedFractionManager(6, 0.5),
+                state_checkpointer=sc,
+            )
+
+        ref = build()
+        href = ref.fit(5)
+        a = build(SimulationStateCheckpointer(str(tmp_path), "st"))
+        a.fit(2)
+        b = build(SimulationStateCheckpointer(str(tmp_path), "st"))
+        b.fit(5)
+        assert_histories_equal(href, b.history)
+        assert np.array_equal(flat(ref.global_params),
+                              flat(b.global_params))
+
+    def test_sync_frame_rejected_by_cohort_run(self, tmp_path):
+        dense = make_sim(
+            state_checkpointer=SimulationStateCheckpointer(
+                str(tmp_path), "st"
+            ),
+        )
+        dense.fit(1)
+        slot = make_sim(
+            cohort=CohortConfig(slots=4),
+            state_checkpointer=SimulationStateCheckpointer(
+                str(tmp_path), "st"
+            ),
+        )
+        with pytest.raises(ValueError, match="sync run"):
+            slot.fit(2)
+
+    def test_cohort_frame_rejected_by_sync_run(self, tmp_path):
+        slot = make_sim(
+            cohort=CohortConfig(slots=4),
+            state_checkpointer=SimulationStateCheckpointer(
+                str(tmp_path), "st"
+            ),
+        )
+        slot.fit(1)
+        dense = make_sim(
+            state_checkpointer=SimulationStateCheckpointer(
+                str(tmp_path), "st"
+            ),
+        )
+        with pytest.raises(ValueError, match="cohort"):
+            dense.fit(2)
+
+
+class TestCompositionRules:
+    def test_full_participation_needs_enough_slots(self):
+        with pytest.raises(ValueError, match="slots >= registry size"):
+            make_sim(n=4, cohort=CohortConfig(slots=2))
+
+    def test_manager_over_wrong_population_rejected(self):
+        with pytest.raises(ValueError, match="registry"):
+            make_sim(n=4, cohort=CohortConfig(slots=2),
+                     manager=FixedFractionManager(8, 0.25))
+
+    def test_overflow_raises_loudly(self):
+        sim = make_sim(
+            n=6, cohort=CohortConfig(slots=1),
+            manager=PoissonSamplingManager(6, 0.9),
+        )
+        with pytest.raises(CohortOverflowError):
+            sim.fit(8)
+
+    def test_forced_chunked_rejected(self):
+        sim = make_sim(n=4, cohort=CohortConfig(slots=4), mode="chunked")
+        with pytest.raises(ValueError, match="cohort-slot"):
+            sim.fit(1)
+
+    def test_async_composition_rejected(self):
+        from fl4health_tpu.server.async_schedule import AsyncConfig
+
+        with pytest.raises(ValueError, match="async"):
+            FederatedSimulation(
+                logic=engine.ClientLogic(
+                    engine.from_flax(Mlp(features=(12,),
+                                         n_outputs=N_CLASSES)),
+                    engine.masked_cross_entropy,
+                ),
+                tx=optax.sgd(0.05), strategy=FedAvg(),
+                datasets=make_datasets(4), batch_size=8,
+                metrics=MetricManager(()), local_epochs=1,
+                cohort=CohortConfig(slots=4),
+                async_config=AsyncConfig(buffer_size=2),
+            )
+
+    def test_bad_cohort_type_rejected(self):
+        with pytest.raises(TypeError, match="CohortConfig"):
+            make_sim(cohort={"slots": 4})
+
+    def test_update_after_eval_strategy_rejected(self):
+        class Host(FedAvg):
+            def update_after_eval(self, s, el, em, m):
+                return s
+
+        with pytest.raises(ValueError, match="update_after_eval"):
+            make_sim(cohort=CohortConfig(slots=4), strategy=Host())
+
+    def test_fit_zero_rounds_noop(self):
+        sim = make_sim(cohort=CohortConfig(slots=4))
+        assert sim.fit(0) == []
+
+
+@pytest.mark.multichip
+class TestCohortUnderMesh:
+    def test_mesh_slot_run_matches_unsharded(self, eight_devices):
+        from fl4health_tpu.parallel.program import MeshConfig
+
+        def build(mesh=None):
+            return make_sim(
+                n=16, cohort=CohortConfig(slots=8),
+                manager=FixedFractionManager(16, 0.5),
+                datasets=make_datasets(16),
+                **({"mode": "auto"} if mesh is None else {}),
+            ) if mesh is None else FederatedSimulation(
+                logic=engine.ClientLogic(
+                    engine.from_flax(Mlp(features=(12,),
+                                         n_outputs=N_CLASSES)),
+                    engine.masked_cross_entropy,
+                ),
+                tx=optax.sgd(0.05), strategy=FedAvg(),
+                datasets=make_datasets(16), batch_size=8,
+                metrics=MetricManager((efficient.accuracy(),)),
+                local_epochs=1, seed=5, cohort=CohortConfig(slots=8),
+                client_manager=FixedFractionManager(16, 0.5), mesh=mesh,
+            )
+
+        plain = build()
+        hp = plain.fit(3)
+        sharded = build(MeshConfig(clients=8))
+        hs = sharded.fit(3)
+        for rp, rs in zip(hp, hs):
+            np.testing.assert_allclose(
+                rp.fit_losses["backward"], rs.fit_losses["backward"],
+                rtol=1e-6,
+            )
+        np.testing.assert_allclose(
+            flat(plain.global_params), flat(sharded.global_params),
+            rtol=1e-6, atol=1e-7,
+        )

@@ -298,3 +298,115 @@ def test_kwargs_only_async_mask_treated_as_two_arg():
     )
     hist = sim.fit(2)
     assert np.isfinite([h.eval_losses["checkpoint"] for h in hist]).all()
+
+
+class TestClientManagerAxis:
+    """The sampling-manager axis (ROADMAP item 5 follow-up): manager
+    cells reproduce a standalone run with that client_manager
+    bit-identically, the axis composes with bucketing, and probability<1
+    Poisson managers are rejected under padded buckets (the fault-plan
+    rule applied to sampling draws)."""
+
+    MANAGERS = {
+        "full": lambda cohort: None,
+        "half": lambda cohort: __import__(
+            "fl4health_tpu.server.client_manager", fromlist=["x"]
+        ).FixedFractionManager(cohort, 0.5),
+    }
+
+    def test_expansion_includes_manager_axis(self):
+        spec = _spec(client_managers=self.MANAGERS,
+                     strategies={"fedavg": FedAvg},
+                     clients={"sgd": CLIENTS["sgd"]}, seeds=(5,))
+        cells = spec.expand_cells()
+        assert {c.manager for c in cells} == {"full", "half"}
+        # default-manager labels stay exactly the pre-axis labels
+        full = [c for c in cells if c.manager == "full"][0]
+        assert "m:" not in full.label()
+        half = [c for c in cells if c.manager == "half"][0]
+        assert "m:half" in half.label()
+
+    def test_manager_cell_matches_standalone(self):
+        from fl4health_tpu.server.client_manager import FixedFractionManager
+
+        spec = _spec(client_managers=self.MANAGERS,
+                     strategies={"fedavg": FedAvg},
+                     clients={"sgd": CLIENTS["sgd"]}, seeds=(5,))
+        result = run_sweep(spec)
+        by_manager = {r.cell.manager: r for r in result.cells}
+        assert set(by_manager) == {"full", "half"}
+        cell = by_manager["half"].cell
+        datasets = _partitioner(0)(cell.cohort)
+        sim = FederatedSimulation(
+            logic=CLIENTS[cell.client](),
+            tx=spec.tx(),
+            strategy=FedAvg(),
+            datasets=datasets,
+            batch_size=spec.batch_size,
+            metrics=MetricManager(()),
+            local_steps=spec.local_steps,
+            seed=cell.seed,
+            execution_mode="chunked",
+            client_manager=FixedFractionManager(cell.cohort, 0.5),
+        )
+        hist = sim.fit(spec.rounds)
+        np.testing.assert_array_equal(
+            np.asarray(by_manager["half"].fit_losses),
+            np.asarray([h.fit_losses["backward"] for h in hist]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(by_manager["half"].eval_losses),
+            np.asarray([h.eval_losses["checkpoint"] for h in hist]),
+        )
+
+    def test_poisson_under_padding_rejected(self):
+        from fl4health_tpu.server.client_manager import PoissonSamplingManager
+
+        spec = _spec(
+            client_managers={
+                "poisson": lambda cohort: PoissonSamplingManager(cohort, 0.5),
+            },
+            strategies={"fedavg": FedAvg},
+            clients={"sgd": CLIENTS["sgd"]},
+            seeds=(5,),
+            cohort_sizes=(3,),
+            cohort_buckets=(4,),
+        )
+        with pytest.raises(ValueError, match="Poisson"):
+            run_sweep(spec)
+
+    def test_poisson_without_padding_allowed(self):
+        from fl4health_tpu.server.client_manager import PoissonSamplingManager
+
+        spec = _spec(
+            client_managers={
+                "poisson": lambda cohort: PoissonSamplingManager(cohort, 0.5),
+            },
+            strategies={"fedavg": FedAvg},
+            clients={"sgd": CLIENTS["sgd"]},
+            seeds=(5,),
+        )
+        result = run_sweep(spec)
+        assert len(result.cells) == 1
+
+    def test_wrong_sized_manager_rejected(self):
+        from fl4health_tpu.server.client_manager import FixedFractionManager
+
+        spec = _spec(
+            client_managers={
+                "bad": lambda cohort: FixedFractionManager(cohort + 1, 0.5),
+            },
+            strategies={"fedavg": FedAvg},
+            clients={"sgd": CLIENTS["sgd"]},
+            seeds=(5,),
+        )
+        with pytest.raises(ValueError, match="cohort"):
+            run_sweep(spec)
+
+    def test_full_name_reserved_for_full_participation(self):
+        from fl4health_tpu.server.client_manager import FixedFractionManager
+
+        with pytest.raises(ValueError, match="reserved"):
+            _spec(client_managers={
+                "full": lambda cohort: FixedFractionManager(cohort, 0.5),
+            })

@@ -718,3 +718,58 @@ def test_cli_json_includes_checkpoint_events_when_present(tmp_path):
     assert doc["checkpoints"][0]["generation"] == 2
     assert doc["rounds"][0]["ckpt_bytes"] == 2048
     assert doc["summary"]["ckpt_write_ms"] == 1.5
+
+
+def test_cohort_columns_render_when_fields_present():
+    rounds = [
+        _round(1, cohort_slots=64, cohort_valid=60, registry_size=100000,
+               registry_dirty_rows=60, stage_ms=12.5, gather_ms=3.0,
+               scatter_ms=1.25, staged_bytes=1 << 20),
+        _round(2, cohort_slots=64, cohort_valid=64, registry_size=100000,
+               registry_dirty_rows=118, stage_ms=11.0, gather_ms=2.8,
+               scatter_ms=1.0, staged_bytes=1 << 20),
+    ]
+    table = perf_report.render_table(rounds)
+    head = table.splitlines()[0]
+    assert "slots" in head and "cohort" in head and "registry" in head
+    assert "stage_ms" in head and "scatter_ms" in head
+    assert "100000" in table and "12.5" in table
+
+
+def test_cohort_summary_keys():
+    rounds = [
+        _round(1, cohort_slots=8, cohort_valid=8, registry_size=500,
+               stage_ms=10.0, scatter_ms=2.0),
+        _round(2, cohort_slots=8, cohort_valid=7, registry_size=500,
+               stage_ms=14.0, scatter_ms=4.0),
+    ]
+    s = perf_report.summarize(rounds)
+    assert s["cohort_slots"] == 8
+    assert s["registry_size"] == 500
+    assert s["stage_ms_mean"] == 12.0
+    assert s["scatter_ms_mean"] == 3.0
+
+
+def test_cohort_fields_absent_keeps_legacy_table_byte_stable():
+    rounds = [_round(1), _round(2)]
+    with_cohort = rounds + [
+        _round(3, cohort_slots=4, cohort_valid=4, registry_size=64,
+               stage_ms=1.0, scatter_ms=0.5),
+    ]
+    legacy = perf_report.render_table(rounds)
+    assert "slots" not in legacy.splitlines()[0]
+    assert "registry" not in legacy.splitlines()[0]
+    s = perf_report.summarize(rounds)
+    assert "cohort_slots" not in s and "registry_size" not in s
+    assert "registry" in perf_report.render_table(with_cohort)
+
+
+def test_cli_output_byte_stable_without_cohort_fields(tmp_path):
+    """End-to-end: a dense-path log's CLI output must not change at all
+    because cohort columns exist in the tool."""
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "slots" not in out and "registry" not in out

@@ -96,6 +96,18 @@ CKPT_COLUMNS = (
     ("ckpt_bytes", "ckpt_bytes", lambda v: str(int(v))),
 )
 
+# Cohort-slot fields (server/registry.py): slot occupancy, registry size
+# and the host staging wall of the round's gather/scatter cycle. Optional
+# like the telemetry columns — dense-path logs keep their exact old table
+# shape (byte-stable, tested).
+COHORT_COLUMNS = (
+    ("slots", "cohort_slots", lambda v: str(int(v))),
+    ("cohort", "cohort_valid", lambda v: str(int(v))),
+    ("registry", "registry_size", lambda v: str(int(v))),
+    ("stage_ms", "stage_ms", lambda v: f"{v:.1f}"),
+    ("scatter_ms", "scatter_ms", lambda v: f"{v:.1f}"),
+)
+
 
 def merge_checkpoint_fields(rounds: list[dict],
                             ckpt_events: list[dict]) -> list[dict]:
@@ -173,7 +185,8 @@ def active_columns(rounds: list[dict]) -> tuple:
     event."""
     extra = tuple(
         col for col in (TELEMETRY_COLUMNS + WIRE_COLUMNS + MESH_COLUMNS
-                        + PRECISION_COLUMNS + ASYNC_COLUMNS + CKPT_COLUMNS)
+                        + PRECISION_COLUMNS + ASYNC_COLUMNS + CKPT_COLUMNS
+                        + COHORT_COLUMNS)
         if any(col[1] in rec for rec in rounds)
     )
     return COLUMNS + extra
@@ -432,6 +445,22 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
         summary["ckpt_writes"] = sum(1 for r in rounds if "ckpt_bytes" in r)
         summary["ckpt_bytes"] = int(tot("ckpt_bytes"))
         summary["ckpt_write_ms"] = round(tot("ckpt_write_ms"), 3)
+    if any("cohort_slots" in r for r in rounds):
+        # cohort-slot runs only — slot/registry facts plus the mean host
+        # staging/scatter walls (the overlap the slot path must hide)
+        summary["cohort_slots"] = int(max(
+            float(r.get("cohort_slots", 0)) for r in rounds
+        ))
+        summary["registry_size"] = int(max(
+            float(r.get("registry_size", 0)) for r in rounds
+        ))
+        stage = [float(r["stage_ms"]) for r in rounds if "stage_ms" in r]
+        if stage:
+            summary["stage_ms_mean"] = round(sum(stage) / len(stage), 3)
+        scat = [float(r["scatter_ms"]) for r in rounds
+                if "scatter_ms" in r]
+        if scat:
+            summary["scatter_ms_mean"] = round(sum(scat) / len(scat), 3)
     return summary
 
 
