@@ -510,6 +510,59 @@ def timed_telemetry_overhead(sim) -> dict:
     }
 
 
+def timed_flightrec_overhead(sim) -> dict:
+    """Host cost of the flight recorder (flight-recorder PR acceptance
+    metric): per-round wall of the REAL ``fit()`` driver loop with the
+    black-box ring disabled vs enabled (the default). The recorder only
+    copies host data the round epilogue already pulled off-device, so the
+    expected overhead is noise-level — this block exists to prove that on
+    real accelerators, the same way ``telemetry_overhead`` proves the
+    in-graph half."""
+    from fl4health_tpu.observability import (
+        MetricsRegistry,
+        Observability,
+        Tracer,
+    )
+
+    prev_obs = sim.observability
+    prev_mode = sim.execution_mode
+    # pipelined: the mode whose consumer-thread epilogue hosts the
+    # recorder feed (the chunked scan would amortize it invisibly)
+    sim.execution_mode = "pipelined"
+
+    def arm(flight: bool) -> float:
+        obs = Observability(
+            enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+            sync_device=False, flight_recorder=flight,
+        )
+        sim.observability = obs
+        try:
+            sim._build_compiled()
+            sim.fit(1)  # warmup: every program fit() touches is compiled
+            t0 = time.perf_counter()
+            sim.fit(TIMED_ROUNDS)
+            return (time.perf_counter() - t0) / TIMED_ROUNDS
+        finally:
+            obs.shutdown()
+
+    try:
+        plain_s = arm(False)
+        recording_s = arm(True)
+    finally:
+        sim.observability = prev_obs
+        sim.execution_mode = prev_mode
+        sim._build_compiled()
+    return {
+        "round_s_plain": round(plain_s, 5),
+        "round_s_recording": round(recording_s, 5),
+        "overhead_pct": (
+            round(100.0 * (recording_s - plain_s) / plain_s, 2)
+            if plain_s > 0 else None
+        ),
+        "rounds": TIMED_ROUNDS,
+    }
+
+
 def timed_resilience_overhead(sim) -> dict:
     """Device cost of Byzantine-robust aggregation (resilience PR
     acceptance metric): per-round time of the compiled fit round under the
@@ -1350,6 +1403,17 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
         and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
     ):
         out["telemetry_overhead"] = timed_telemetry_overhead(sim)
+    # Flight-recorder host cost: the real fit() driver loop with the
+    # black-box ring off vs on (flight-recorder PR acceptance metric).
+    # Same gating shape: FL4HEALTH_BENCH_FLIGHTREC=1 forces, =0 disables,
+    # "auto" skips only the CPU fallback (two extra fit() warms would
+    # strain its budget).
+    want_f = os.environ.get("FL4HEALTH_BENCH_FLIGHTREC", "auto")
+    if want_f == "1" or (
+        want_f == "auto"
+        and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+    ):
+        out["flightrec_overhead"] = timed_flightrec_overhead(sim)
     # Robust-aggregator round time vs the plain weighted mean (resilience
     # PR acceptance metric). Same gating shape: FL4HEALTH_BENCH_RESILIENCE
     # =1 forces, =0 disables, "auto" skips only the CPU fallback. Runs

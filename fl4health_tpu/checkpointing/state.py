@@ -99,6 +99,95 @@ class CheckpointConfigMismatchError(ValueError):
         self.current = current
 
 
+# -- frame primitives --------------------------------------------------------
+# The versioned CRC-footed frame is reusable beyond checkpoints: the flight
+# recorder's postmortem ring (observability/bundle.py) publishes its host
+# records through the SAME writer, so every durable artifact in the repo
+# shares one corruption-detection story.
+
+def write_frame(path: str, trees: Mapping[str, Any],
+                host_header: Mapping[str, Any] | None = None,
+                meta: Mapping[str, Any] | None = None) -> dict:
+    """Serialize + atomically publish ONE versioned frame at ``path``:
+    ``[magic][version][header-length][header JSON][msgpack blob][CRC32]``.
+    ``trees`` is any flax-serializable pytree bag (the msgpack blob);
+    ``host_header``/``meta`` land in the JSON header. Returns
+    ``{path, bytes, write_s}``."""
+    t0 = time.perf_counter()
+    header_bytes = json.dumps(
+        {"host": dict(host_header or {}), "meta": {
+            "format_version": FORMAT_VERSION,
+            "saved_unix": time.time(),
+            **dict(meta or {}),
+        }}
+    ).encode("utf-8")
+    blob = serialization.to_bytes(dict(trees))
+    body = b"".join((
+        _MAGIC,
+        FORMAT_VERSION.to_bytes(4, "big"),
+        len(header_bytes).to_bytes(8, "big"),
+        header_bytes,
+        blob,
+    ))
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    with atomic_write(path, "wb") as f:  # single atomic publish
+        f.write(body)
+        f.write(crc.to_bytes(4, "big"))
+    return {"path": path, "bytes": len(body) + 4,
+            "write_s": time.perf_counter() - t0}
+
+
+def read_frame(path: str) -> tuple[dict, dict, bytes]:
+    """Parse + CRC-verify one frame -> (host_header, meta, msgpack blob).
+    Raises :class:`CheckpointCorruptError` naming the file on any
+    structural failure; legacy (pre-magic) v0 files still load."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.startswith(_MAGIC):
+        # legacy v0: [8B header length][header JSON][blob], no CRC
+        if len(data) < 8:
+            raise CheckpointCorruptError(path, "truncated legacy frame")
+        n = int.from_bytes(data[:8], "big")
+        if 8 + n > len(data):
+            raise CheckpointCorruptError(
+                path, "truncated legacy header (torn write?)"
+            )
+        try:
+            header = json.loads(data[8:8 + n].decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                path, f"unparseable legacy header ({e})"
+            ) from e
+        return header, {"format_version": 0}, data[8 + n:]
+    if len(data) < _MIN_FRAME:
+        raise CheckpointCorruptError(
+            path, f"truncated frame ({len(data)} bytes)"
+        )
+    body, crc_stored = data[:-4], int.from_bytes(data[-4:], "big")
+    if (zlib.crc32(body) & 0xFFFFFFFF) != crc_stored:
+        raise CheckpointCorruptError(
+            path, "CRC32 mismatch (torn or corrupt write)"
+        )
+    version = int.from_bytes(data[8:12], "big")
+    if version > FORMAT_VERSION:
+        raise CheckpointCorruptError(
+            path,
+            f"format version {version} is newer than this build's "
+            f"{FORMAT_VERSION}",
+        )
+    hlen = int.from_bytes(data[12:20], "big")
+    if 20 + hlen > len(body):
+        raise CheckpointCorruptError(path, "truncated header")
+    try:
+        header = json.loads(body[20:20 + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(
+            path, f"unparseable header ({e})"
+        ) from e
+    return (header.get("host", {}), header.get("meta", {}),
+            body[20 + hlen:])
+
+
 @dataclasses.dataclass
 class RestoreInfo:
     """Facts about one successful restore — which file/generation won, and
@@ -306,30 +395,13 @@ class StateCheckpointer:
         for k, v in (host or {}).items():
             snap = snapshotters.get(k, SerializableSnapshotter())
             host_header[k] = snap.save(v)
-        meta = {
-            "format_version": FORMAT_VERSION,
-            "config_hash": self.config_hash,
-            "saved_unix": time.time(),
-            **dict(extra_meta or {}),
-        }
-        header_bytes = json.dumps(
-            {"host": host_header, "meta": meta}
-        ).encode("utf-8")
-        blob = serialization.to_bytes(dict(trees))
         gens = self.generations()
         gen = (gens[-1][0] + 1) if gens else 1
         path = self._generation_path(gen)
-        body = b"".join((
-            _MAGIC,
-            FORMAT_VERSION.to_bytes(4, "big"),
-            len(header_bytes).to_bytes(8, "big"),
-            header_bytes,
-            blob,
-        ))
-        crc = zlib.crc32(body) & 0xFFFFFFFF
-        with atomic_write(path, "wb") as f:  # single atomic publish
-            f.write(body)
-            f.write(crc.to_bytes(4, "big"))
+        frame_stats = write_frame(
+            path, trees, host_header=host_header,
+            meta={"config_hash": self.config_hash, **dict(extra_meta or {})},
+        )
         # rotation: prune only AFTER the new generation is durable, so a
         # kill anywhere in save() leaves at least the previous good ring
         for old_gen, old_path in gens[:max(len(gens) + 1 - self.keep, 0)]:
@@ -344,7 +416,7 @@ class StateCheckpointer:
         stats = {
             "path": path,
             "generation": gen,
-            "bytes": len(body) + 4,
+            "bytes": frame_stats["bytes"],
             "write_s": time.perf_counter() - t0,
             **dict(extra_meta or {}),
         }
@@ -363,52 +435,9 @@ class StateCheckpointer:
     def _read_file(self, path: str) -> tuple[dict, dict, bytes]:
         """Parse + verify ONE checkpoint file -> (host_header, meta, blob).
         Raises :class:`CheckpointCorruptError` naming the file on any
-        structural failure."""
-        with open(path, "rb") as f:
-            data = f.read()
-        if not data.startswith(_MAGIC):
-            # legacy v0: [8B header length][header JSON][blob], no CRC
-            if len(data) < 8:
-                raise CheckpointCorruptError(path, "truncated legacy frame")
-            n = int.from_bytes(data[:8], "big")
-            if 8 + n > len(data):
-                raise CheckpointCorruptError(
-                    path, "truncated legacy header (torn write?)"
-                )
-            try:
-                header = json.loads(data[8:8 + n].decode("utf-8"))
-            except (UnicodeDecodeError, json.JSONDecodeError) as e:
-                raise CheckpointCorruptError(
-                    path, f"unparseable legacy header ({e})"
-                ) from e
-            return header, {"format_version": 0}, data[8 + n:]
-        if len(data) < _MIN_FRAME:
-            raise CheckpointCorruptError(
-                path, f"truncated frame ({len(data)} bytes)"
-            )
-        body, crc_stored = data[:-4], int.from_bytes(data[-4:], "big")
-        if (zlib.crc32(body) & 0xFFFFFFFF) != crc_stored:
-            raise CheckpointCorruptError(
-                path, "CRC32 mismatch (torn or corrupt write)"
-            )
-        version = int.from_bytes(data[8:12], "big")
-        if version > FORMAT_VERSION:
-            raise CheckpointCorruptError(
-                path,
-                f"format version {version} is newer than this build's "
-                f"{FORMAT_VERSION}",
-            )
-        hlen = int.from_bytes(data[12:20], "big")
-        if 20 + hlen > len(body):
-            raise CheckpointCorruptError(path, "truncated header")
-        try:
-            header = json.loads(body[20:20 + hlen].decode("utf-8"))
-        except (UnicodeDecodeError, json.JSONDecodeError) as e:
-            raise CheckpointCorruptError(
-                path, f"unparseable header ({e})"
-            ) from e
-        return (header.get("host", {}), header.get("meta", {}),
-                body[20 + hlen:])
+        structural failure. Thin wrapper over :func:`read_frame` (the
+        shared frame primitive)."""
+        return read_frame(path)
 
     def _read(self) -> tuple[dict, dict, bytes, RestoreInfo]:
         """Newest-good read with ring fallback: walk candidates newest to

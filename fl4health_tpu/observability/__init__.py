@@ -48,6 +48,12 @@ import os
 from typing import Any
 
 from fl4health_tpu.observability.exposition import ScrapeServer
+from fl4health_tpu.observability.flightrec import (
+    DEFAULT_WINDOW,
+    FlightRecorder,
+    SigtermShutdown,
+    trap_sigterm,
+)
 from fl4health_tpu.observability.health import (
     HealthPolicy,
     HealthWatchdog,
@@ -81,6 +87,9 @@ from fl4health_tpu.observability.spans import (
 
 __all__ = [
     "Observability",
+    "FlightRecorder",
+    "SigtermShutdown",
+    "trap_sigterm",
     "Tracer",
     "Span",
     "MetricsRegistry",
@@ -158,6 +167,8 @@ class Observability:
         introspection: bool = True,
         http_port: int | None = None,
         http_host: str = "127.0.0.1",
+        flight_recorder: "bool | FlightRecorder" = True,
+        flightrec_window: int | None = None,
     ):
         self.enabled = enabled
         self.output_dir = output_dir
@@ -171,6 +182,21 @@ class Observability:
         self.introspection = introspection
         self.http_port = http_port
         self.http_host = http_host
+        # Flight recorder (observability/flightrec.py): ALWAYS-ON by
+        # default — a bounded ring of the last rounds' host-side records,
+        # fed by data the round epilogues already pulled (zero device
+        # syncs, recorder-on pinned bit-identical to recorder-off).
+        # Bundles publish under output_dir on abnormal ends; without an
+        # output_dir the ring stays queryable in memory.
+        if isinstance(flight_recorder, FlightRecorder):
+            self.flight_recorder: FlightRecorder | None = flight_recorder
+        elif flight_recorder:
+            self.flight_recorder = FlightRecorder(
+                window=flightrec_window or DEFAULT_WINDOW
+            )
+        else:
+            self.flight_recorder = None
+        self._unhealthy: str | None = None
         self.introspector = ProgramIntrospector(self.registry)
         self._manifest: dict[str, Any] = {}
         self._scrape_server: ScrapeServer | None = None
@@ -218,6 +244,7 @@ class Observability:
         survives multiple runs (``shutdown`` disarms it between them).
         Idempotent; no-op when disabled."""
         if self.enabled:
+            self._unhealthy = None  # per-run: a fresh fit() is healthy
             if self.watchdog is not None:
                 self.watchdog.reset()
             if not self.tracer.enabled:
@@ -225,6 +252,15 @@ class Observability:
                 # makes transport/engine spans visible
                 self.tracer.enabled = True
                 self._owns_tracer_enable = True
+            if self.output_dir is not None:
+                # crash-safe black box: mirror spans to trace.json AS THEY
+                # HAPPEN (Chrome JSON Array Format stays loadable even if
+                # the process dies mid-run; export() finalizes the
+                # complete envelope over it at shutdown)
+                os.makedirs(self.output_dir, exist_ok=True)
+                self.tracer.stream_to(
+                    os.path.join(self.output_dir, "trace.json")
+                )
             self.compile_monitor.install()
             if self.http_port is not None and self._scrape_server is None:
                 # live pull endpoint for the armed lifetime of the handle —
@@ -234,8 +270,47 @@ class Observability:
                     manifest_provider=lambda: dict(self._manifest),
                     host=self.http_host,
                     port=self.http_port,
+                    health_provider=lambda: self._unhealthy,
                 )
         return self
+
+    # -- abnormal-end surface -------------------------------------------
+    @property
+    def unhealthy_reason(self) -> str | None:
+        """The verdict summary once the run halted, else None (healthy)."""
+        return self._unhealthy
+
+    def mark_unhealthy(self, reason: str) -> None:
+        """Flip ``/healthz`` to 503 with ``reason`` as the body — called on
+        a watchdog halt and on every postmortem bundle dump, so the armed
+        scrape endpoint stops reporting a dying run healthy."""
+        self._unhealthy = str(reason)
+
+    def dump_bundle(self, verdict: "dict[str, Any]") -> str | None:
+        """Publish a postmortem bundle (``observability/bundle.py``) under
+        ``output_dir`` from the flight recorder's ring + the live trace/
+        registry/manifest. Returns the bundle path, or None when disabled
+        or there is nowhere to publish. Marks the run unhealthy."""
+        if not self.enabled or self.output_dir is None:
+            return None
+        from fl4health_tpu.observability.bundle import dump_bundle
+
+        path = dump_bundle(
+            self.output_dir, verdict,
+            recorder=self.flight_recorder,
+            tracer=self.tracer if self.tracer.enabled else None,
+            registry=self.registry,
+            manifest=self._manifest or None,
+        )
+        self.mark_unhealthy(
+            f"{verdict.get('kind', 'exception')}: "
+            f"{verdict.get('message', '')} (bundle: {path})"
+        )
+        self.registry.counter(
+            "fl_flightrec_bundles_total",
+            help="postmortem bundles published on abnormal ends",
+        ).inc()
+        return path
 
     # -- tracing ---------------------------------------------------------
     def span(self, name: str, cat: str = "round", **args: Any):
@@ -324,6 +399,9 @@ class Observability:
             self._scrape_server = None
         if self._owns_tracer_enable:
             self.tracer.enabled = False
+            # a stream export() didn't finalize (no output_dir, or a
+            # different path) still terminates cleanly here
+            self.tracer.close_stream()
             self.tracer.clear()
             self._owns_tracer_enable = False
         if "events" in paths:

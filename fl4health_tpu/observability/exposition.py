@@ -12,7 +12,11 @@ mid-run by an actual Prometheus (or ``curl``):
 - ``GET /manifest`` — the run manifest JSON
   (``observability/manifest.py``): versions, backend, device kind/count,
   execution mode + reason, donation gating, config hash;
-- ``GET /healthz``  — liveness probe.
+- ``GET /healthz``  — liveness probe. Goes **503** once the run is marked
+  unhealthy (a watchdog halt or a postmortem bundle dump —
+  ``Observability.mark_unhealthy``), with the verdict summary as the
+  body, so an orchestrator's health check stops reporting a run healthy
+  mid-``TrainingHealthError`` teardown.
 
 Zero third-party deps (zero-egress box) and zero cost on the round hot
 path: a scrape reads host-side floats under the registry lock — it never
@@ -41,6 +45,9 @@ class ScrapeServer:
     ``manifest_provider`` is called per ``/manifest`` request so the
     served document tracks live updates (e.g. the execution mode chosen
     by the current ``fit()``), not a bind-time snapshot.
+    ``health_provider`` is called per ``/healthz`` request and returns
+    None while healthy, or a verdict-summary string once the run halted —
+    the endpoint then answers 503 with that summary as the body.
     """
 
     def __init__(
@@ -49,9 +56,11 @@ class ScrapeServer:
         manifest_provider: Callable[[], dict[str, Any]] | None = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        health_provider: Callable[[], str | None] | None = None,
     ):
         registry_ref = registry
         provider = manifest_provider
+        health = health_provider
 
         class Handler(BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes, ctype: str) -> None:
@@ -71,7 +80,12 @@ class ScrapeServer:
                     self._send(200, json.dumps(mani, default=str).encode(),
                                "application/json")
                 elif path == "/healthz":
-                    self._send(200, b"ok\n", "text/plain; charset=utf-8")
+                    verdict = health() if health is not None else None
+                    if verdict is None:
+                        self._send(200, b"ok\n", "text/plain; charset=utf-8")
+                    else:
+                        body = f"unhealthy: {verdict}\n".encode("utf-8")
+                        self._send(503, body, "text/plain; charset=utf-8")
                 else:
                     self._send(404, b"not found\n",
                                "text/plain; charset=utf-8")

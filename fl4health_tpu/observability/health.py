@@ -354,9 +354,17 @@ class HealthWatchdog:
 
         if halts:
             check, _action, clients, msg = halts[0]
-            raise TrainingHealthError(
+            err = TrainingHealthError(
                 f"HealthWatchdog[{check}] halted training at round "
                 f"{round_idx}: {msg}",
                 round=round_idx, clients=clients, check=check,
             )
+            if obs is not None and getattr(obs, "enabled", False):
+                # flip the live /healthz probe to 503 BEFORE the raise
+                # unwinds fit() — an orchestrator polling the armed scrape
+                # endpoint must not see "ok" mid-teardown
+                mark = getattr(obs, "mark_unhealthy", None)
+                if mark is not None:
+                    mark(str(err))
+            raise err
         return summary

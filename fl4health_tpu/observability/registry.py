@@ -19,8 +19,12 @@ contention is negligible next to an XLA dispatch).
 
 from __future__ import annotations
 
+import glob
+import gzip
 import json
 import math
+import os
+import re
 import threading
 import time
 from typing import Any, Iterable, Mapping
@@ -225,12 +229,36 @@ class MetricsRegistry:
     oldest dropped first, counted by ``fl_events_dropped_total``) so a
     multi-thousand-round run — a few events per round plus per-client
     telemetry vectors — cannot grow host memory and the dumped log without
-    bound. ``max_events=None`` disables the cap."""
+    bound. ``max_events=None`` disables the cap.
 
-    def __init__(self, max_events: int | None = DEFAULT_MAX_EVENTS):
+    ``rollover="archive"`` (opt-in; requires ``archive_path``) preserves
+    evicted history instead of dropping it: evictions happen in segments of
+    ~10% of the cap, each gzipped to ``<archive_path>.NNNN.jsonl.gz`` next
+    to where the log will be dumped, retaining at most ``max_archives``
+    segments (oldest deleted first) — so postmortem bundles can include
+    pre-rollover events while disk stays bounded. The default
+    (``rollover="drop"``) is byte-identical to the legacy behavior."""
+
+    def __init__(self, max_events: int | None = DEFAULT_MAX_EVENTS,
+                 rollover: str = "drop", archive_path: str | None = None,
+                 max_archives: int = 8):
         if max_events is not None and max_events < 1:
             raise ValueError(f"max_events must be >= 1 or None, got {max_events}")
+        if rollover not in ("drop", "archive"):
+            raise ValueError(
+                f"rollover must be 'drop' or 'archive'; got {rollover!r}"
+            )
+        if rollover == "archive" and not archive_path:
+            raise ValueError("rollover='archive' requires archive_path")
+        if max_archives < 1:
+            raise ValueError(f"max_archives must be >= 1; got {max_archives}")
         self.max_events = max_events
+        self.rollover = rollover
+        self.archive_path = archive_path
+        self.max_archives = int(max_archives)
+        # resume the sequence past any segments already on disk — a new
+        # registry reusing an archive_path must not overwrite history
+        self._archive_seq = self._existing_archive_seq()
         self._metrics: dict[tuple[str, tuple], Any] = {}
         self._helps: dict[str, str] = {}
         self._events: list[dict] = []
@@ -281,18 +309,82 @@ class MetricsRegistry:
         visible in ``fl_events_dropped_total``)."""
         rec = {"ts": time.time(), "event": event, **fields}
         dropped = 0
+        evicted: list[dict] | None = None
         with self._lock:
             self._events.append(rec)
             if self.max_events is not None and len(self._events) > self.max_events:
-                dropped = len(self._events) - self.max_events
-                del self._events[:dropped]
+                if self.rollover == "archive":
+                    # evict a SEGMENT (~10% of the cap) so the gzip cost
+                    # amortizes instead of landing on every append
+                    n = max(len(self._events) - self.max_events,
+                            max(self.max_events // 10, 1))
+                    n = min(n, len(self._events) - 1)  # keep the new record
+                    evicted = self._events[:n]
+                    del self._events[:n]
+                else:
+                    dropped = len(self._events) - self.max_events
+                    del self._events[:dropped]
         if dropped:
             # outside the registry lock: counter() re-acquires it
             self.counter(
                 "fl_events_dropped_total",
                 help="JSONL event-log records dropped by size rollover",
             ).inc(dropped)
+        if evicted:
+            self._archive_segment(evicted)
         return rec
+
+    def _archive_segment(self, records: list[dict]) -> None:
+        """Gzip one evicted segment next to the (future) log dump and prune
+        the archive set to ``max_archives``. Archive failures degrade to
+        drop semantics — the log must never take down the run."""
+        try:
+            with self._lock:
+                # seq/path allocation under the registry lock: concurrent
+                # evicting threads (round consumer + checkpoint on_save)
+                # must not collide on one segment path
+                self._archive_seq += 1
+                path = (f"{self.archive_path}."
+                        f"{self._archive_seq:04d}.jsonl.gz")
+            with atomic_write(path, "wb") as f:
+                with gzip.GzipFile(fileobj=f, mode="wb") as gz:
+                    for rec in records:
+                        gz.write((json.dumps(rec, default=str) + "\n")
+                                 .encode("utf-8"))
+            segs = self.archive_paths()
+            for old in segs[:max(len(segs) - self.max_archives, 0)]:
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass
+            self.counter(
+                "fl_events_archived_total",
+                help="JSONL event-log records preserved to gzip archive "
+                     "segments by rollover",
+            ).inc(len(records))
+        except Exception:
+            self.counter(
+                "fl_events_dropped_total",
+                help="JSONL event-log records dropped by size rollover",
+            ).inc(len(records))
+
+    def archive_paths(self) -> list[str]:
+        """Existing archive segments, oldest first (empty without
+        ``rollover='archive'``)."""
+        if not self.archive_path:
+            return []
+        # escape the base: a path with glob metacharacters ([run-v4] ...)
+        # must still discover/prune its own segments
+        return sorted(glob.glob(f"{glob.escape(self.archive_path)}"
+                                ".*.jsonl.gz"))
+
+    def _existing_archive_seq(self) -> int:
+        best = 0
+        for p in self.archive_paths():
+            m = re.search(r"\.(\d+)\.jsonl\.gz$", p)
+            if m:
+                best = max(best, int(m.group(1)))
+        return best
 
     @property
     def events(self) -> list[dict]:

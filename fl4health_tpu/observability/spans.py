@@ -13,10 +13,21 @@ external dependency.
 Disabled-path contract: a disabled tracer's ``span()`` returns a shared
 no-op context manager — no allocation, no locking, no clock reads — so the
 round hot loop pays nothing when observability is off.
+
+Crash safety: ``export()`` publishes a complete ``{"traceEvents": [...]}``
+envelope atomically at shutdown, but a process that DIES mid-run never
+reaches it. ``stream_to(path)`` additionally appends each event to ``path``
+as it is recorded, in the Chrome trace *JSON Array Format* — whose closing
+``]`` is optional per the trace-event spec, so the file stays loadable in
+Perfetto even after a SIGKILL mid-run. An ``atexit`` hook terminates the
+array on any orderly interpreter exit, and :func:`load_trace` is the
+tolerant reader (complete envelope, terminated array, or a stream torn
+mid-line) the postmortem tooling uses.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -24,6 +35,40 @@ import time
 from typing import Any
 
 from fl4health_tpu.core.io import atomic_write
+
+
+def load_trace(path: str) -> dict:
+    """Load a Chrome trace written by this module — the complete
+    ``{"traceEvents": [...]}`` envelope, a bare event array, or an
+    UNTERMINATED streamed array (the crash case: trailing comma, or a
+    partial final line torn by the kill). Returns the envelope form;
+    raises ``ValueError`` when nothing parseable remains."""
+    with open(path) as f:
+        text = f.read()
+    doc = None
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        # streamed array killed mid-run: strip any torn final line, close
+        # the array ourselves
+        body = text.strip()
+        while body:
+            candidate = body.rstrip().rstrip(",")
+            try:
+                doc = json.loads(candidate + "]")
+                break
+            except json.JSONDecodeError:
+                # drop the last (possibly partial) line and retry
+                cut = body.rfind("\n")
+                if cut < 0:
+                    break
+                body = body[:cut]
+    if doc is None:
+        raise ValueError(f"{path}: no parseable trace content")
+    if isinstance(doc, list):
+        events = [e for e in doc if e]  # drop the {} terminator sentinel
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    return doc
 
 
 class _NullSpan:
@@ -93,6 +138,73 @@ class Tracer:
         self._events: list[dict] = []
         self._lock = threading.Lock()
         self._local = threading.local()
+        self._stream = None
+        self._stream_path: str | None = None
+        self._atexit_registered = False
+
+    # -- crash-safe streaming -------------------------------------------
+    def stream_to(self, path: str) -> str | None:
+        """Mirror every recorded event to ``path`` as it happens, in the
+        Chrome JSON Array Format (loadable even unterminated — the spec
+        makes the closing ``]`` optional, and :func:`load_trace` tolerates
+        a torn final line). Events are flushed per record: span volume is a
+        handful per round, so durability costs nothing measurable. Returns
+        the path, or None when a different stream is already open (the
+        first owner wins — a second Observability handle must not redirect
+        a shared tracer's black box)."""
+        with self._lock:
+            if self._stream is not None:
+                return path if self._stream_path == path else None
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            self._stream = open(path, "w")
+            self._stream_path = path
+            self._stream.write("[\n")
+            self._stream.write(json.dumps({
+                "name": "process_name", "ph": "M", "pid": os.getpid(),
+                "tid": 0, "args": {"name": self.process_name},
+            }) + ",\n")
+            self._stream.flush()
+            # replay whatever was recorded before the stream opened, so a
+            # tracer enabled earlier than Observability.start() loses
+            # nothing
+            for evt in self._events:
+                self._stream.write(json.dumps(evt) + ",\n")
+            self._stream.flush()
+        if not self._atexit_registered:
+            # orderly exits (incl. unhandled exceptions) terminate the
+            # array; a SIGKILL can't run this, which is why the format is
+            # chosen to stay loadable without it
+            atexit.register(self.close_stream)
+            self._atexit_registered = True
+        return path
+
+    @property
+    def stream_path(self) -> str | None:
+        return self._stream_path
+
+    def _stream_event(self, evt: dict) -> None:
+        # caller holds self._lock
+        if self._stream is not None:
+            try:
+                self._stream.write(json.dumps(evt, default=str) + ",\n")
+                self._stream.flush()
+            except (OSError, ValueError):  # closed/readonly fs: stop trying
+                self._stream = None
+
+    def close_stream(self) -> None:
+        """Terminate the streamed array (``{}]`` — the empty object is the
+        terminator sentinel ``load_trace`` drops) and close the file.
+        Idempotent; safe from ``atexit``."""
+        with self._lock:
+            stream, self._stream = self._stream, None
+            self._stream_path = None
+        if stream is not None:
+            try:
+                stream.write("{}]\n")
+                stream.close()
+            except (OSError, ValueError):
+                pass
 
     # -- depth bookkeeping (thread-local; tests assert nesting) ----------
     def _enter_depth(self) -> int:
@@ -123,6 +235,7 @@ class Tracer:
         }
         with self._lock:
             self._events.append(evt)
+            self._stream_event(evt)
 
     def counter(self, name: str, **series: float) -> None:
         """A Chrome counter track sample ("ph": "C")."""
@@ -136,6 +249,7 @@ class Tracer:
         }
         with self._lock:
             self._events.append(evt)
+            self._stream_event(evt)
 
     def _record(self, name, cat, start_ns, end_ns, depth, args) -> None:
         evt = {
@@ -150,6 +264,7 @@ class Tracer:
         }
         with self._lock:
             self._events.append(evt)
+            self._stream_event(evt)
 
     # -- introspection / export -----------------------------------------
     @property
@@ -174,9 +289,13 @@ class Tracer:
 
     def export(self, path: str) -> str:
         """Atomically write the trace JSON (a crash mid-dump never leaves a
-        truncated, unloadable trace at the published path)."""
+        truncated, unloadable trace at the published path). When a live
+        stream targets the same path it is closed first, so the complete
+        envelope REPLACES the streamed array at shutdown."""
+        if self._stream_path == path:
+            self.close_stream()
         with atomic_write(path) as f:
-            json.dump(self.to_chrome_trace(), f)
+            json.dump(self.to_chrome_trace(), f, default=str)
         return path
 
 

@@ -50,18 +50,27 @@ _HISTORY = "history.json"
 
 @dataclasses.dataclass(frozen=True)
 class KillPoint:
-    """Where the child SIGKILLs itself.
+    """Where (and how) the child kills itself.
 
     ``round``: the checkpoint save (by its ``round``/event meta) that arms
     the kill. ``phase="post_save"`` kills right after that save's atomic
     publish returns — the canonical "preempted between rounds" drill.
     ``phase="mid_write"`` kills ``byte_offset`` bytes into that save's
     file write — the torn-write drill: the temp file dies mid-body and the
-    previously published generation must survive untouched."""
+    previously published generation must survive untouched.
+
+    ``signal_name`` selects the delivery: ``"SIGKILL"`` (default — no
+    atexit, no flushing, eviction fidelity) or ``"SIGTERM"`` — the
+    graceful-preemption drill: ``fit()``'s trap converts it into a
+    :class:`~fl4health_tpu.observability.flightrec.SigtermShutdown`, the
+    flight recorder publishes a postmortem bundle naming the kill round,
+    and the child exits 143 (``mid_write`` stays SIGKILL-only: a handler
+    running mid-torn-write would defeat the torn-write fidelity)."""
 
     round: int
     phase: str = "post_save"
     byte_offset: int = 64
+    signal_name: str = "SIGKILL"
 
     def __post_init__(self):
         if self.phase not in ("post_save", "mid_write"):
@@ -74,6 +83,17 @@ class KillPoint:
             raise ValueError(
                 f"byte_offset must be >= 1; got {self.byte_offset}"
             )
+        if self.signal_name not in ("SIGKILL", "SIGTERM"):
+            raise ValueError(
+                f"signal_name must be 'SIGKILL' or 'SIGTERM'; "
+                f"got {self.signal_name!r}"
+            )
+        if self.phase == "mid_write" and self.signal_name != "SIGKILL":
+            raise ValueError("mid_write drills are SIGKILL-only")
+
+    @property
+    def signum(self) -> int:
+        return getattr(signal, self.signal_name)
 
 
 @dataclasses.dataclass
@@ -144,7 +164,10 @@ def install_kill_hook(checkpointer, kill: KillPoint) -> None:
                 state_mod.atomic_write = _orig_atomic_write
         out = orig_save(trees, host=host, snapshotters=snapshotters,
                         extra_meta=extra_meta)
-        os.kill(os.getpid(), signal.SIGKILL)
+        # SIGKILL dies here; SIGTERM raises SigtermShutdown in the MAIN
+        # thread (this save may run on the async-writer thread) — the
+        # fit() loop then dumps its postmortem bundle and exits 143
+        os.kill(os.getpid(), kill.signum)
         return out
 
     checkpointer.save = save

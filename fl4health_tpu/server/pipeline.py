@@ -61,6 +61,21 @@ class RoundConsumer(SingleWorkerQueue):
 
     def __init__(self, maxsize: int = 2, name: str = "fl-round-consumer"):
         super().__init__(maxsize=maxsize, name=name)
+        # newest round whose epilogue FINISHED (not merely was submitted) —
+        # the flight recorder's verdict quotes this so a postmortem can
+        # distinguish "round r recorded" from "round r+1 died in flight"
+        self.last_completed_round: int | None = None
+
+    def submit_round(self, round_idx: int, job) -> None:
+        """Submit one round's host epilogue, tracking its completion in
+        ``last_completed_round`` once the job ran (worker thread, FIFO —
+        the value is monotone)."""
+
+        def _job():
+            job()
+            self.last_completed_round = int(round_idx)
+
+        self.submit(_job)
 
 
 class RoundPrefetcher:

@@ -39,6 +39,7 @@ from fl4health_tpu.clients import engine
 from fl4health_tpu.observability import Observability
 from fl4health_tpu.observability import device_specs
 from fl4health_tpu.observability import telemetry as telem
+from fl4health_tpu.observability.flightrec import trap_sigterm
 from fl4health_tpu.observability.manifest import config_hash, run_manifest
 from fl4health_tpu.observability.telemetry import RoundTelemetry
 from fl4health_tpu.clients.engine import Batch, ClientLogic, TrainState
@@ -139,7 +140,19 @@ class ClientDataset:
 
 class ClientFailuresError(RuntimeError):
     """Raised when accept_failures=False and client failures occur
-    (base_server.py:443-451)."""
+    (base_server.py:443-451).
+
+    Structured for the postmortem verdict: ``clients`` (failing client
+    indices, slot positions under cohort execution), ``round`` and
+    ``registry_clients`` (cohort rounds only — the slots mapped to
+    registry ids) are attached by the round epilogue before the raise
+    unwinds ``fit()``."""
+
+    def __init__(self, message: str, clients: Sequence[int] = ()):
+        super().__init__(message)
+        self.clients = [int(c) for c in clients]
+        self.round: int | None = None
+        self.registry_clients: list[int] | None = None
 
 
 @dataclasses.dataclass
@@ -168,7 +181,8 @@ class FailurePolicy:
         if failed and not self.accept_failures:
             raise ClientFailuresError(
                 f"The server encountered failures from clients {failed} and "
-                "accept_failures is set to False"
+                "accept_failures is set to False",
+                clients=failed,
             )
         return failed
 
@@ -1953,6 +1967,10 @@ class FederatedSimulation:
     def _fit_loop(self, n_rounds: int) -> list[RoundRecord]:
         obs = self.observability
         obs.start()  # re-arm after a previous fit()'s shutdown (idempotent)
+        flight = obs.flight_recorder if obs.enabled else None
+        if flight is not None:
+            flight.clear()  # the black box records THIS run only
+        self._last_epilogue_round = None  # per-run (RoundConsumer progress)
         mode, mode_reason = self._select_execution_mode(n_rounds)
         self._active_execution_mode = mode
         self._round_program_flops = None  # re-measured per fit() (mode-shaped)
@@ -1976,9 +1994,11 @@ class FederatedSimulation:
             self._async_plan = plan
         try:
             start_round = self._maybe_resume(n_rounds, plan)
-        except BaseException:
+        except BaseException as resume_exc:
             # a failed restore (all generations corrupt, config mismatch)
-            # must still disarm the hooks this fit() armed
+            # still publishes its evidence and disarms the hooks this
+            # fit() armed — a CheckpointCorruptError IS a postmortem
+            self._dump_postmortem(resume_exc)
             obs.shutdown()
             raise
         if obs.watchdog is not None and not self._telemetry_enabled:
@@ -2041,21 +2061,64 @@ class FederatedSimulation:
                     self._introspect_programs(
                         mode, self._rounds_per_dispatch(n_rounds, start_round)
                     )
+        if flight is not None:
+            # run-level provenance for the bundle header ("run" in
+            # ring.msgpack): what was executing when the box was opened
+            facts: dict[str, Any] = {
+                "execution_mode": mode,
+                "execution_mode_reason": mode_reason,
+                "n_rounds": n_rounds,
+                "start_round": start_round,
+                "config_hash": obs.manifest.get("config_hash"),
+            }
+            if self._cohort_active:
+                facts["cohort_slots"] = self.n_clients
+                facts["registry_size"] = self.registry_size
+            if self._async_active:
+                facts["async"] = True
+            flight.set_run_facts(**facts)
         for r in self.reporters:
             r.report({"host_type": "server", "fit_start": time.time(),
                       "num_rounds": n_rounds, "execution_mode": mode,
                       "execution_mode_reason": mode_reason})
+        self._sigterm_round = None
+
+        def _note_sigterm() -> None:
+            # runs INSIDE the signal handler: the round the run was at
+            # when SIGTERM arrived — the teardown drains that follow may
+            # legitimately record later rounds, but the verdict names
+            # this. LOCK-FREE read: the handler can interrupt the very
+            # thread holding the recorder lock (chunked-mode epilogues
+            # record on the main thread) — taking it here would deadlock.
+            if flight is not None:
+                self._sigterm_round = flight.last_round_hint
+
         try:
-            if self._async_active and n_rounds >= 1:
-                self._fit_async(n_rounds, mode, plan, start_round)
-            elif self._cohort_active:
-                # handles n_rounds < 1 itself (graceful no-op) — the dense
-                # pipelined fallback would touch the absent data banks
-                self._fit_cohort(n_rounds, start_round)
-            elif mode == EXEC_CHUNKED:
-                self._fit_chunked(n_rounds, start_round)
-            else:
-                self._fit_pipelined(n_rounds, start_round)
+            # SIGTERM trap (flight recorder armed only): a preemption
+            # becomes a SigtermShutdown raised in the main thread, so the
+            # except below publishes the black box and every finally
+            # (checkpoint flush, consumer close) still runs — then the
+            # process exits with the conventional 143.
+            with (trap_sigterm(on_signal=_note_sigterm)
+                  if flight is not None else contextlib.nullcontext()):
+                if self._async_active and n_rounds >= 1:
+                    self._fit_async(n_rounds, mode, plan, start_round)
+                elif self._cohort_active:
+                    # handles n_rounds < 1 itself (graceful no-op) — the
+                    # dense pipelined fallback would touch the absent banks
+                    self._fit_cohort(n_rounds, start_round)
+                elif mode == EXEC_CHUNKED:
+                    self._fit_chunked(n_rounds, start_round)
+                else:
+                    self._fit_pipelined(n_rounds, start_round)
+        except BaseException as e:
+            # ANY abnormal end — TrainingHealthError/ClientFailuresError/
+            # QuorumError, an unhandled exception, a SIGTERM — publishes a
+            # self-contained postmortem bundle BEFORE obs.shutdown() below
+            # clears the trace/event evidence. Never masks the original
+            # failure.
+            self._dump_postmortem(e)
+            raise
         finally:
             # shutdown (not just export) ALWAYS runs — even when a round
             # raises (ClientFailuresError): it detaches the compile monitor
@@ -2293,6 +2356,58 @@ class FederatedSimulation:
             path=stats.get("path"),
             kind=stats.get("kind", "sync"),
         )
+        flight = obs.flight_recorder
+        if flight is not None:
+            # the bundle's "what to resume from": newest durable generation
+            flight.note_checkpoint(stats)
+
+    def _dump_postmortem(self, exc: BaseException) -> None:
+        """Best-effort postmortem bundle for an abnormal ``fit()`` end
+        (``observability/bundle.py``): classify ``exc`` into a verdict,
+        publish ``postmortem_<ts>/`` under the observability output dir,
+        and flip ``/healthz`` to 503. By the time the exception reaches
+        here the fit paths' ``finally`` blocks have closed the
+        RoundConsumer (draining pending epilogues into the flight ring)
+        and flushed the checkpoint writer — the ring is as complete as the
+        process can make it. NEVER raises: the primary failure propagates
+        untouched."""
+        obs = self.observability
+        if not obs.enabled or obs.output_dir is None:
+            return
+        try:
+            from fl4health_tpu.observability.bundle import (
+                verdict_from_exception,
+            )
+
+            verdict = verdict_from_exception(
+                exc, recorder=obs.flight_recorder
+            )
+            if (verdict.get("kind") == "sigterm"
+                    and getattr(self, "_sigterm_round", None) is not None):
+                # the handler's snapshot wins over the recorder's current
+                # last round: drains during unwind may have run past it
+                verdict["round"] = self._sigterm_round
+            if getattr(self, "_last_epilogue_round", None) is not None:
+                # pipelined runs: which round's epilogue last FINISHED —
+                # evidence beyond it died with the in-flight rounds
+                verdict["epilogues_through_round"] = (
+                    self._last_epilogue_round
+                )
+            path = obs.dump_bundle(verdict)
+            if path:
+                obs.log_event(
+                    "postmortem", path=path,
+                    kind=verdict.get("kind"), round=verdict.get("round"),
+                )
+                logging.getLogger(__name__).warning(
+                    "abnormal end (%s) — postmortem bundle published at %s",
+                    verdict.get("kind"), path,
+                )
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "postmortem bundle dump failed (the primary exception "
+                "propagates)", exc_info=True,
+            )
 
     def _close_ckpt_writer(self, writer) -> None:
         """Close the async checkpoint writer on EVERY exit path and surface
@@ -2510,6 +2625,9 @@ class FederatedSimulation:
             finally:
                 consumer.close()
                 prefetcher.close()
+                # retained for the postmortem verdict: which round's host
+                # epilogue last FINISHED before this run ended
+                self._last_epilogue_round = consumer.last_completed_round
                 self._consumer = None
                 self._prefetcher = None
 
@@ -2741,7 +2859,8 @@ class FederatedSimulation:
                 compile_s_after=compile_s_after,
             )
             if consumer is not None:
-                consumer.submit(functools.partial(self._finish_round, work))
+                consumer.submit_round(
+                    rnd, functools.partial(self._finish_round, work))
                 legacy_state_save = (
                     self.state_checkpointer is not None
                     and not hasattr(self.state_checkpointer,
@@ -2816,7 +2935,20 @@ class FederatedSimulation:
             # before checkpointing a poisoned aggregate when
             # accept_failures=False.
             host_fit_losses = host["per_client_fit_losses"]
-            failed = self.failure_policy.check(host_fit_losses, mask)
+            try:
+                failed = self.failure_policy.check(host_fit_losses, mask)
+            except ClientFailuresError as cf:
+                # verdict facts: the policy doesn't know the round, and
+                # cohort rounds fail by SLOT — map to registry ids here,
+                # while the round's cohort view is still in hand
+                cf.round = rnd
+                if work.cohort_meta is not None:
+                    ids = np.asarray(work.cohort_meta["idx"])
+                    cf.registry_clients = [
+                        int(ids[c]) for c in cf.clients
+                        if 0 <= int(c) < len(ids)
+                    ]
+                raise
             fit_losses = {k: float(v) for k, v in host["fit_losses"].items()}
             fit_metrics = {k: float(v) for k, v in host["fit_metrics"].items()}
             eval_losses = {k: float(v) for k, v in host["eval_losses"].items()}
@@ -2908,6 +3040,11 @@ class FederatedSimulation:
                 telemetry=telemetry_host,
                 async_info=work.async_info,
                 cohort_info=cohort_info,
+                # cohort rounds: the [K] registry ids the slots mapped to,
+                # so the flight ring (and any postmortem ranking built on
+                # it) attributes evidence to REAL clients, not slots
+                registry_ids=(np.asarray(work.cohort_meta["idx"])
+                              if work.cohort_meta is not None else None),
             )
         if quarantine_mask is not None:
             # cohort rounds report quarantine by REGISTRY id, not slot
@@ -3242,6 +3379,9 @@ class FederatedSimulation:
             finally:
                 consumer.close()
                 prefetcher.close()
+                # retained for the postmortem verdict: which round's host
+                # epilogue last FINISHED before this run ended
+                self._last_epilogue_round = consumer.last_completed_round
                 self._consumer = None
                 self._prefetcher = None
                 self._registry_scatter_event = None
@@ -3418,7 +3558,8 @@ class FederatedSimulation:
                 },
             )
             if consumer is not None:
-                consumer.submit(functools.partial(self._finish_round, work))
+                consumer.submit_round(
+                    rnd, functools.partial(self._finish_round, work))
                 if not self.failure_policy.accept_failures:
                     consumer.flush()
             else:
@@ -3536,6 +3677,9 @@ class FederatedSimulation:
             finally:
                 consumer.close()
                 prefetcher.close()
+                # retained for the postmortem verdict: which round's host
+                # epilogue last FINISHED before this run ended
+                self._last_epilogue_round = consumer.last_completed_round
                 self._consumer = None
                 self._prefetcher = None
                 self._async_pending = None
@@ -3631,7 +3775,8 @@ class FederatedSimulation:
                 resume_meta=resume_meta,
             )
             if consumer is not None:
-                consumer.submit(functools.partial(self._finish_round, work))
+                consumer.submit_round(
+                    e, functools.partial(self._finish_round, work))
                 if not self.failure_policy.accept_failures:
                     # the failure screen must be able to terminate BEFORE
                     # the next event mutates state — same rule as sync
@@ -3785,6 +3930,15 @@ class FederatedSimulation:
                 "quarantine", round=rnd, source="strategy",
                 active=active, entered=entered, released=released,
             )
+        flight = obs.flight_recorder
+        if flight is not None:
+            # late-attach the round's quarantine evidence to its flight
+            # entry (this emitter runs right after _record_round_metrics on
+            # both paths); `active` is registry-id-space under cohorts
+            flight.attach(
+                rnd, quarantine=np.asarray(q_np),
+                quarantine_active=list(active),
+            )
 
     def _payload_nbytes(self) -> tuple[int, int]:
         """(broadcast, gather) logical payload bytes per participating client
@@ -3840,6 +3994,7 @@ class FederatedSimulation:
         telemetry: dict | None = None,
         async_info: dict | None = None,
         cohort_info: dict | None = None,
+        registry_ids: np.ndarray | None = None,
     ) -> dict:
         """Per-round gauges/counters + one JSONL ``round`` event; returns the
         summary dict bridged into every reporter. Runs identically on the
@@ -4092,6 +4247,7 @@ class FederatedSimulation:
                     help="measured model FLOPs utilization vs the chip's "
                          "bf16 peak (per chip on a mesh)",
                 ).set(mfu)
+        fault = None
         if self._fault_plan is not None:
             # host mirror of the round's seeded in-graph fault draws — the
             # log reports exactly what the compiled program injected
@@ -4114,6 +4270,34 @@ class FederatedSimulation:
                     len(fault["dropped"]) + len(fault["corrupted"])
                 )
         reg.log_event("round", **summary)
+        flight = self.observability.flight_recorder
+        if flight is not None:
+            # flight-recorder feed: every array here is host data this
+            # epilogue already materialized (the fused transfer / stacked
+            # scan outputs) — recording adds zero device syncs, and the
+            # ring stays O(window x cohort slots) by construction
+            flight.record_round(
+                rnd, summary,
+                fit_loss=rec.fit_losses.get("backward"),
+                eval_loss=rec.eval_losses.get("checkpoint"),
+                mask=mask_np,
+                telemetry=telemetry,
+                registry_ids=registry_ids,
+                fault=fault or None,
+            )
+            reg.counter(
+                "fl_flightrec_rounds_total",
+                help="rounds captured into the flight-recorder ring",
+            ).inc()
+            reg.gauge(
+                "fl_flightrec_ring_bytes",
+                help="host bytes of the flight-recorder ring's array "
+                     "payload (bounded: O(window x cohort slots))",
+            ).set(float(flight.nbytes()))
+            reg.gauge(
+                "fl_flightrec_window",
+                help="flight-recorder ring capacity in rounds",
+            ).set(float(flight.window))
         self.observability.tracer.counter(
             "fl_round_time_s", fit=rec.fit_elapsed_s, eval=rec.eval_elapsed_s
         )

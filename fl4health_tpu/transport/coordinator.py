@@ -62,14 +62,19 @@ class QuorumError(RuntimeError):
     """Raised when fewer silos replied than the round's quorum requires.
 
     Attributes: ``required``, ``succeeded``, ``failures`` (list of
-    ``(silo, reason)``)."""
+    ``(silo, reason)``), and — when the raising path had one — ``report``,
+    the full per-silo :class:`BroadcastReport` (attempt counts, latencies,
+    failure reasons), so a postmortem bundle's ``verdict.json`` can name
+    every silo's outcome instead of just the shortfall."""
 
     def __init__(self, message: str, *, required: int, succeeded: int,
-                 failures: Sequence[tuple[str, str]]):
+                 failures: Sequence[tuple[str, str]],
+                 report: "BroadcastReport | None" = None):
         super().__init__(message)
         self.required = required
         self.succeeded = succeeded
         self.failures = list(failures)
+        self.report = report
 
 
 @dataclasses.dataclass
@@ -299,6 +304,7 @@ def broadcast_round(
             required=required,
             succeeded=len(replies),
             failures=[(f.silo, f.reason or "unknown") for f in failures],
+            report=report,
         )
     return replies
 

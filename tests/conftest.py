@@ -80,6 +80,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "postmortem: flight-recorder / postmortem-bundle lanes "
+        "(observability/flightrec.py + bundle.py — bounded black-box "
+        "capture, abnormal-end bundles, tools/postmortem.py rendering). "
+        "The tier-1-safe smoke subset (bundle round-trips, one SIGTERM "
+        "subprocess drill, recorder on/off bit-identity) runs by default; "
+        "heavier drill variants also carry 'slow'. Select with "
+        "-m postmortem.",
+    )
+    config.addinivalue_line(
+        "markers",
         "bigcohort: cohort-slot registry lanes (server/registry.py "
         "ClientRegistry + CohortConfig). The tier-1-safe smoke subset "
         "(slots=N bit-identity parity, sample_indices/mask coherence, "

@@ -278,3 +278,76 @@ class TestScrapeDuringFit:
         mani = json.loads((tmp_path / "obs" / "manifest.json").read_text())
         assert mani["backend"] == "cpu"
         assert "config_hash" in mani and "execution_mode" in mani
+
+
+@pytest.mark.postmortem
+class TestScrapeUnderCohortSlots:
+    """Concurrent /metrics scrapes during a cohort-slot (CohortConfig)
+    run: the fl_registry_* gauges are live under the slot path and every
+    scrape passes the exposition-format conformance parse (the flight-
+    recorder PR's test-coverage satellite)."""
+
+    def _cohort_sim(self, obs, reporters=()):
+        import numpy as np
+
+        from fl4health_tpu.server.client_manager import FixedFractionManager
+        from fl4health_tpu.server.registry import CohortConfig
+
+        n, k = 6, 3
+        datasets = []
+        for i in range(n):
+            x, y = synthetic_classification(
+                jax.random.PRNGKey(i), 48, (4,), 2
+            )
+            datasets.append(ClientDataset(
+                np.asarray(x[:32]), np.asarray(y[:32]),
+                np.asarray(x[32:]), np.asarray(y[32:]),
+            ))
+        return FederatedSimulation(
+            logic=engine.ClientLogic(
+                engine.from_flax(Mlp(features=(8,), n_outputs=2)),
+                engine.masked_cross_entropy,
+            ),
+            tx=optax.sgd(0.05),
+            strategy=FedAvg(),
+            datasets=datasets,
+            batch_size=8,
+            metrics=MetricManager((efficient.accuracy(),)),
+            local_steps=2,
+            seed=0,
+            cohort=CohortConfig(slots=k),
+            client_manager=FixedFractionManager(n, k / n),
+            observability=obs,
+            reporters=list(reporters),
+        )
+
+    def test_concurrent_scrapes_conform_and_registry_gauges_live(self):
+        obs = Observability(enabled=True, tracer=Tracer(),
+                            registry=MetricsRegistry(), http_port=0)
+        scrapes: list[str] = []
+
+        class ScrapingReporter:
+            # every round's report callback scrapes while fit() is live —
+            # the cohort consumer thread is mid-gather/scatter cycle
+            def report(self, data, round=None, **kw):
+                if round is not None:
+                    scrapes.append(_scrape(obs.scrape_url + "/metrics"))
+
+            def shutdown(self):
+                pass
+
+        sim = self._cohort_sim(obs, reporters=[ScrapingReporter()])
+        history = sim.fit(3)
+        assert len(history) == 3
+        assert len(scrapes) >= 3, "reporter never scraped mid-fit"
+        for text in scrapes:
+            parse_exposition(text)  # EVERY concurrent scrape conforms
+        fams = parse_exposition(scrapes[-1])
+        assert fams["fl_registry_clients"]["type"] == "gauge"
+        assert fams["fl_registry_clients"]["samples"][0][2] == "6"
+        assert fams["fl_registry_cohort_valid"]["samples"][0][2] == "3"
+        assert "fl_registry_dirty_rows" in fams
+        assert fams["fl_registry_staged_bytes_total"]["type"] == "counter"
+        # the flight recorder's gauges ride the same slot-path scrape
+        assert fams["fl_flightrec_window"]["type"] == "gauge"
+        assert float(fams["fl_flightrec_ring_bytes"]["samples"][0][2]) > 0

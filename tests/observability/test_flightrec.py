@@ -1,0 +1,262 @@
+"""Flight recorder (observability/flightrec.py): bounded black-box capture.
+
+The pinned contracts:
+- the ring is bounded: entries never exceed ``window``, and the array
+  payload is O(window x cohort slots) — REGISTRY-SIZE-INVARIANT at fixed
+  K (the acceptance pin);
+- recorder-on (the default) is BIT-IDENTICAL to recorder-off — params and
+  trajectory — on BOTH execution modes (recording only copies host data
+  the epilogues already pulled);
+- the SIGTERM trap converts a mid-fit SIGTERM into a SigtermShutdown
+  (SystemExit 143) without displacing caller-installed handlers.
+"""
+
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.observability import (
+    FlightRecorder,
+    MetricsRegistry,
+    Observability,
+    SigtermShutdown,
+    Tracer,
+    trap_sigterm,
+)
+from fl4health_tpu.server.client_manager import FixedFractionManager
+from fl4health_tpu.server.registry import CohortConfig
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+pytestmark = pytest.mark.postmortem
+
+N_CLASSES = 2
+
+
+def make_datasets(n=2, rows=48, seed0=0):
+    out = []
+    for i in range(n):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(seed0 + i), rows, (4,), N_CLASSES
+        )
+        out.append(ClientDataset(
+            np.asarray(x[:32]), np.asarray(y[:32]),
+            np.asarray(x[32:]), np.asarray(y[32:]),
+        ))
+    return out
+
+
+def make_sim(mode="pipelined", observability=None, n=2, cohort=None,
+             manager=None, datasets=None, seed=0):
+    return FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(8,), n_outputs=N_CLASSES)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=datasets if datasets is not None else make_datasets(n),
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=2,
+        seed=seed,
+        execution_mode=mode,
+        observability=observability,
+        cohort=cohort,
+        client_manager=manager,
+    )
+
+
+def make_obs(flight=True, window=None):
+    return Observability(
+        enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+        sync_device=False, flight_recorder=flight,
+        flightrec_window=window,
+    )
+
+
+def _params_bytes(sim):
+    from flax import serialization
+
+    return serialization.to_bytes(jax.device_get(sim.global_params))
+
+
+class TestRingBounds:
+    def test_window_validation(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(window=0)
+
+    def test_ring_keeps_newest_window_rounds(self):
+        rec = FlightRecorder(window=8)
+        for r in range(1, 101):
+            rec.record_round(r, {"round": r}, mask=np.ones(4))
+        assert rec.rounds == list(range(93, 101))
+        assert len(rec.entries) == 8
+
+    def test_attach_merges_into_existing_round_only(self):
+        rec = FlightRecorder(window=4)
+        rec.record_round(1, {"round": 1})
+        rec.attach(1, quarantine=np.zeros(3))
+        rec.attach(99, quarantine=np.ones(3))  # silently ignored
+        entries = rec.entries
+        assert "quarantine" in entries[0]
+        assert len(entries) == 1
+
+    def test_last_round_prefers_newer_checkpoint_note(self):
+        rec = FlightRecorder(window=4)
+        assert rec.last_round() is None
+        rec.record_round(3, {"round": 3})
+        assert rec.last_round() == 3
+        rec.note_checkpoint({"round": 5, "generation": 2})
+        assert rec.last_round() == 5
+
+    def test_nbytes_counts_array_payload(self):
+        rec = FlightRecorder(window=4)
+        rec.record_round(
+            1, {"round": 1}, mask=np.ones(4, np.float32),
+            telemetry={"train_loss": np.zeros(4, np.float32)},
+        )
+        assert rec.nbytes() == 4 * 4 * 2
+
+
+class TestDefaultOnAndFit:
+    def test_default_observability_constructs_a_recorder(self):
+        obs = make_obs()
+        assert isinstance(obs.flight_recorder, FlightRecorder)
+        obs.shutdown()
+
+    def test_fit_feeds_the_ring_and_metrics(self):
+        obs = make_obs(window=2)
+        sim = make_sim(observability=obs)
+        sim.fit(3)
+        rec = obs.flight_recorder
+        # window=2: only the NEWEST two rounds survive
+        assert rec.rounds == [2, 3]
+        entry = rec.entries[-1]
+        assert entry["summary"]["round"] == 3
+        assert "telemetry" in entry and "mask" in entry
+        assert entry["fit_loss"] is not None
+        snap = obs.registry.snapshot()
+        assert snap["fl_flightrec_rounds_total"] == 3
+        assert snap["fl_flightrec_window"] == 2
+        assert snap["fl_flightrec_ring_bytes"] > 0
+        assert rec.run_facts["execution_mode"]
+        obs.shutdown()
+
+    def test_second_fit_clears_the_previous_runs_ring(self):
+        obs = make_obs()
+        sim = make_sim(observability=obs)
+        sim.fit(2)
+        sim.fit(1)
+        assert obs.flight_recorder.rounds == [1]
+        obs.shutdown()
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("mode", ["pipelined", "chunked"])
+    def test_recorder_on_off_bit_identical(self, mode):
+        """THE acceptance pin: flight recording (default-on) never touches
+        the trajectory — params and per-round losses are BIT-identical to
+        recorder-off on both execution modes."""
+        runs = {}
+        for flight in (True, False):
+            obs = make_obs(flight=flight)
+            sim = make_sim(mode=mode, observability=obs)
+            hist = sim.fit(3)
+            runs[flight] = (
+                _params_bytes(sim),
+                [(r.fit_losses, r.eval_losses) for r in hist],
+            )
+            obs.shutdown()
+        assert runs[True][0] == runs[False][0]
+        assert runs[True][1] == runs[False][1]
+
+
+class TestRegistrySizeInvariance:
+    def test_ring_bytes_invariant_across_registry_sizes_at_fixed_k(self):
+        """THE bounded-memory pin: at fixed K slots, the ring's array
+        payload is IDENTICAL whether the registry holds 6 or 24 clients —
+        O(window x slots), never O(registry)."""
+        sizes = {}
+        for n in (6, 24):
+            obs = make_obs()
+            sim = make_sim(
+                mode="auto", observability=obs, n=n,
+                cohort=CohortConfig(slots=3),
+                manager=FixedFractionManager(n, 3 / n),
+            )
+            sim.fit(3)
+            rec = obs.flight_recorder
+            assert len(rec.entries) == 3
+            # cohort entries carry the [K] registry ids for attribution
+            ids = rec.entries[-1]["registry_ids"]
+            assert ids.shape == (3,)
+            assert int(ids.max()) < n
+            sizes[n] = rec.nbytes()
+            obs.shutdown()
+        assert sizes[6] == sizes[24] > 0
+
+
+class TestSigtermTrap:
+    def test_trap_converts_sigterm_to_shutdown(self):
+        with pytest.raises(SigtermShutdown) as ei:
+            with trap_sigterm() as armed:
+                assert armed
+                signal.raise_signal(signal.SIGTERM)
+        assert ei.value.code == 143
+        # disposition restored
+        assert signal.getsignal(signal.SIGTERM) in (signal.SIG_DFL, None)
+
+    def test_trap_respects_existing_handler(self):
+        sentinel = lambda *a: None  # noqa: E731
+        prev = signal.signal(signal.SIGTERM, sentinel)
+        try:
+            with trap_sigterm() as armed:
+                assert not armed
+            assert signal.getsignal(signal.SIGTERM) is sentinel
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_trap_noop_off_main_thread(self):
+        result = {}
+
+        def worker():
+            with trap_sigterm() as armed:
+                result["armed"] = armed
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert result["armed"] is False
+
+    def test_on_signal_snapshot_runs_before_raise(self):
+        seen = []
+        with pytest.raises(SigtermShutdown):
+            with trap_sigterm(on_signal=lambda: seen.append(True)):
+                signal.raise_signal(signal.SIGTERM)
+        assert seen == [True]
+
+
+class TestSignalSafety:
+    def test_last_round_hint_is_readable_while_lock_is_held(self):
+        """Deadlock regression: a SIGTERM handler interrupts the very
+        thread holding the recorder lock (chunked-mode epilogues record on
+        the main thread) — the handler's read must never acquire it."""
+        rec = FlightRecorder(window=4)
+        rec.record_round(7, {"round": 7})
+        with rec._lock:  # simulate: signal lands mid-record_round
+            assert rec.last_round_hint == 7  # returns, no deadlock
+        rec.note_checkpoint({"round": 9})
+        assert rec.last_round_hint == 9
+        rec.clear()
+        assert rec.last_round_hint is None

@@ -232,3 +232,91 @@ class TestEventLogRollover:
 
         assert MetricsRegistry().max_events == DEFAULT_MAX_EVENTS
         assert DEFAULT_MAX_EVENTS >= 10_000  # thousands of rounds still fit
+
+
+class TestArchiveRollover:
+    """rollover="archive" (flight-recorder PR): evicted segments are
+    gzipped next to the log instead of dropped; default "drop" behavior is
+    untouched (TestEventLogRollover above pins it)."""
+
+    def test_validation(self, tmp_path):
+        import pytest
+
+        with pytest.raises(ValueError):
+            MetricsRegistry(rollover="bogus")
+        with pytest.raises(ValueError):
+            MetricsRegistry(rollover="archive")  # needs archive_path
+        with pytest.raises(ValueError):
+            MetricsRegistry(rollover="archive",
+                            archive_path=str(tmp_path / "m.jsonl"),
+                            max_archives=0)
+
+    def test_evicted_segments_are_gzipped_and_replayable(self, tmp_path):
+        import gzip
+        import json as _json
+
+        base = str(tmp_path / "metrics.jsonl")
+        reg = MetricsRegistry(max_events=10, rollover="archive",
+                              archive_path=base, max_archives=50)
+        for i in range(25):
+            reg.log_event("round", round=i)
+        segs = reg.archive_paths()
+        assert segs, "evictions must produce archive segments"
+        archived = []
+        for seg in segs:
+            assert seg.startswith(base) and seg.endswith(".jsonl.gz")
+            with gzip.open(seg, "rt") as f:
+                archived.extend(_json.loads(l) for l in f if l.strip())
+        in_memory = [e["round"] for e in reg.events]
+        # archived + in-memory = every event, in order, no gaps
+        assert ([e["round"] for e in archived] + in_memory
+                == list(range(25)))
+        snap = reg.snapshot()
+        assert snap["fl_events_archived_total"] == len(archived)
+        assert "fl_events_dropped_total" not in snap
+
+    def test_archive_count_is_bounded(self, tmp_path):
+        base = str(tmp_path / "metrics.jsonl")
+        reg = MetricsRegistry(max_events=4, rollover="archive",
+                              archive_path=base, max_archives=2)
+        for i in range(100):
+            reg.log_event("round", round=i)
+        assert len(reg.archive_paths()) <= 2
+
+    def test_default_drop_still_counts_drops(self):
+        reg = MetricsRegistry(max_events=2)
+        for i in range(5):
+            reg.log_event("e", i=i)
+        snap = reg.snapshot()
+        assert snap["fl_events_dropped_total"] == 3
+        assert reg.archive_paths() == []
+
+    def test_new_registry_resumes_seq_past_existing_segments(self, tmp_path):
+        """Overwrite regression: a fresh registry reusing an archive_path
+        (process restart) must continue the segment numbering, not clobber
+        prior history."""
+        base = str(tmp_path / "metrics.jsonl")
+        reg1 = MetricsRegistry(max_events=4, rollover="archive",
+                               archive_path=base, max_archives=50)
+        for i in range(10):
+            reg1.log_event("round", run=1, round=i)
+        first = set(reg1.archive_paths())
+        assert first
+        reg2 = MetricsRegistry(max_events=4, rollover="archive",
+                               archive_path=base, max_archives=50)
+        for i in range(10):
+            reg2.log_event("round", run=2, round=i)
+        assert first < set(reg2.archive_paths())  # strictly grew
+
+    def test_archive_path_with_glob_metacharacters(self, tmp_path):
+        import os as _os
+
+        d = tmp_path / "run[v4]"
+        _os.makedirs(d)
+        base = str(d / "metrics.jsonl")
+        reg = MetricsRegistry(max_events=4, rollover="archive",
+                              archive_path=base, max_archives=2)
+        for i in range(30):
+            reg.log_event("round", round=i)
+        segs = reg.archive_paths()
+        assert segs and len(segs) <= 2  # discovered AND pruned

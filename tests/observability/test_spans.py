@@ -129,3 +129,132 @@ def test_default_tracer_swap_restores():
     finally:
         set_tracer(prev)
     assert get_tracer() is prev
+
+
+# -- crash-safe streaming (flight-recorder PR) ------------------------------
+
+class TestStreaming:
+    def test_streamed_file_is_json_loadable_after_clean_close(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        tr = Tracer()
+        assert tr.stream_to(path) == path
+        with tr.span("round", round=1):
+            pass
+        tr.instant("marker")
+        tr.close_stream()
+        # a TERMINATED stream is plain json.load-able (bare array format)
+        with open(path) as f:
+            doc = json.load(f)
+        names = {e.get("name") for e in doc if e}
+        assert {"round", "marker"} <= names
+
+    def test_unterminated_stream_loads_via_load_trace(self, tmp_path):
+        from fl4health_tpu.observability.spans import load_trace
+
+        path = str(tmp_path / "trace.json")
+        tr = Tracer()
+        tr.stream_to(path)
+        with tr.span("round", round=1):
+            pass
+        # simulate a kill: drop the handle WITHOUT terminating the array
+        with tr._lock:
+            tr._stream = None
+            tr._stream_path = None
+        with open(path) as f:
+            raw = f.read()
+        assert raw.rstrip().endswith(",")  # really unterminated
+        doc = load_trace(path)
+        assert any(e["name"] == "round" for e in doc["traceEvents"])
+
+    def test_load_trace_tolerates_torn_final_line(self, tmp_path):
+        from fl4health_tpu.observability.spans import load_trace
+
+        path = str(tmp_path / "trace.json")
+        tr = Tracer()
+        tr.stream_to(path)
+        with tr.span("kept"):
+            pass
+        with tr._lock:
+            tr._stream = None
+        with open(path, "a") as f:
+            f.write('{"name": "torn", "ph": "X", "ts"')  # mid-write kill
+        doc = load_trace(path)
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "kept" in names and "torn" not in names
+
+    def test_load_trace_reads_complete_envelope_too(self, tmp_path):
+        from fl4health_tpu.observability.spans import load_trace
+
+        path = str(tmp_path / "trace.json")
+        tr = Tracer()
+        with tr.span("round"):
+            pass
+        tr.export(path)
+        doc = load_trace(path)
+        assert any(e.get("name") == "round" for e in doc["traceEvents"])
+
+    def test_export_over_stream_path_finalizes_the_envelope(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        tr = Tracer()
+        tr.stream_to(path)
+        with tr.span("round"):
+            pass
+        tr.export(path)
+        with open(path) as f:
+            doc = json.load(f)  # the COMPLETE envelope replaced the stream
+        assert doc["traceEvents"]
+        assert tr.stream_path is None
+
+    def test_second_stream_request_is_refused(self, tmp_path):
+        tr = Tracer()
+        a = str(tmp_path / "a.json")
+        assert tr.stream_to(a) == a
+        assert tr.stream_to(a) == a  # idempotent re-arm
+        assert tr.stream_to(str(tmp_path / "b.json")) is None
+        tr.close_stream()
+
+    def test_events_recorded_before_streaming_are_replayed(self, tmp_path):
+        from fl4health_tpu.observability.spans import load_trace
+
+        tr = Tracer()
+        with tr.span("early"):
+            pass
+        path = str(tmp_path / "trace.json")
+        tr.stream_to(path)
+        with tr._lock:
+            tr._stream = None
+        doc = load_trace(path)
+        assert any(e["name"] == "early" for e in doc["traceEvents"])
+
+
+def test_sigkill_mid_run_leaves_loadable_trace(tmp_path):
+    """THE crash-safety pin: a subprocess streaming spans SIGKILLs itself
+    mid-run (no atexit, no flushing beyond the per-event flush) and the
+    trace file on disk STAYS loadable."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+
+    from fl4health_tpu.observability.spans import load_trace
+
+    path = str(tmp_path / "trace.json")
+    script = textwrap.dedent(f"""
+        import os, signal
+        from fl4health_tpu.observability.spans import Tracer
+        tr = Tracer()
+        tr.stream_to({path!r})
+        for i in range(5):
+            with tr.span("round", round=i):
+                pass
+        os.kill(os.getpid(), signal.SIGKILL)
+    """)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    proc = subprocess.run([sys.executable, "-c", script], cwd=repo,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL
+    doc = load_trace(path)
+    rounds = [e for e in doc["traceEvents"] if e.get("name") == "round"]
+    assert len(rounds) == 5  # every pre-kill event survived the kill

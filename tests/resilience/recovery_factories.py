@@ -71,6 +71,33 @@ def sync_chunked_every1(ckpt_dir):
     return _base(ckpt_dir, checkpoint_every=1, execution_mode="chunked")
 
 
+def _flightrec_obs(ckpt_dir):
+    import os
+
+    from fl4health_tpu.observability import (
+        MetricsRegistry,
+        Observability,
+        Tracer,
+    )
+
+    # private tracer/registry + an output dir NEXT TO the checkpoint ring:
+    # the SIGTERM drill asserts a postmortem bundle lands under it
+    return Observability(
+        enabled=True, output_dir=os.path.join(str(ckpt_dir), "obs"),
+        tracer=Tracer(), registry=MetricsRegistry(), sync_device=False,
+    )
+
+
+def sync_pipelined_flightrec(ckpt_dir):
+    return _base(ckpt_dir, checkpoint_every=1, execution_mode="pipelined",
+                 observability=_flightrec_obs(ckpt_dir))
+
+
+def sync_chunked_flightrec(ckpt_dir):
+    return _base(ckpt_dir, checkpoint_every=1, execution_mode="chunked",
+                 observability=_flightrec_obs(ckpt_dir))
+
+
 def _async(ckpt_dir, mode):
     return _base(
         ckpt_dir, checkpoint_every=1, execution_mode=mode,

@@ -773,3 +773,103 @@ def test_cli_output_byte_stable_without_cohort_fields(tmp_path):
         capture_output=True, text=True, check=True,
     ).stdout
     assert "slots" not in out and "registry" not in out
+
+
+# -- postmortem bundles (--bundle, flight-recorder PR) ----------------------
+
+def _bundle(tmp_path):
+    import numpy as np
+
+    from fl4health_tpu.observability.bundle import dump_bundle
+    from fl4health_tpu.observability.flightrec import FlightRecorder
+
+    rec = FlightRecorder(window=4)
+    for r in (1, 2):
+        rec.record_round(
+            r, _round(r), fit_loss=0.5 - 0.1 * r, eval_loss=0.6 - 0.1 * r,
+            mask=np.ones(4, np.float32),
+        )
+    return dump_bundle(
+        str(tmp_path), {"kind": "training_health", "round": 2,
+                        "clients": [1], "message": "halt"},
+        recorder=rec,
+    )
+
+
+def test_cli_bundle_renders_ring_with_flight_columns(tmp_path):
+    bundle = _bundle(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"),
+         "--bundle", bundle],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.splitlines()
+    assert lines[0].startswith("postmortem bundle: ")
+    assert "verdict: training_health, round 2" in lines[0]
+    header = lines[1].split()
+    assert "fit_loss" in header and "eval_loss" in header
+    assert "round" in header
+    assert len([l for l in lines if l and l[0].isspace() or l[:1].isdigit()
+                or l.strip().startswith(("1", "2"))]) >= 2
+
+
+def test_cli_bundle_json_mode(tmp_path):
+    bundle = _bundle(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"),
+         "--bundle", bundle, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["verdict"]["kind"] == "training_health"
+    assert [r["round"] for r in doc["rounds"]] == [1, 2]
+    assert doc["rounds"][0]["fit_loss"] == 0.4
+
+
+def test_cli_bundle_missing_dir_exits_2(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"),
+         "--bundle", str(tmp_path / "nope")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "Traceback" not in proc.stderr
+
+
+def test_cli_without_log_or_bundle_errors(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+
+
+def test_flight_columns_absent_keeps_legacy_table_byte_stable():
+    rounds = [_round(1), _round(2)]
+    header = perf_report.render_table(rounds).splitlines()[0]
+    assert "fit_loss" not in header and "eval_loss" not in header
+
+
+def test_cli_bundle_corrupt_ring_exits_2_without_traceback(tmp_path):
+    bundle = _bundle(tmp_path)
+    ring = Path(bundle) / "ring.msgpack"
+    data = ring.read_bytes()
+    i = len(data) // 2
+    ring.write_bytes(data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:])
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"),
+         "--bundle", bundle],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "Traceback" not in proc.stderr
+    assert "cannot read bundle" in proc.stderr
+    # the full incident-report tool degrades identically
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "postmortem.py"), bundle],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "Traceback" not in proc.stderr

@@ -108,6 +108,15 @@ COHORT_COLUMNS = (
     ("scatter_ms", "scatter_ms", lambda v: f"{v:.1f}"),
 )
 
+# Flight-recorder fields (observability/flightrec.py): the recorded
+# aggregate losses a postmortem ring carries per round. Round events in
+# normal JSONL logs never contain them, so legacy tables stay byte-stable;
+# `--bundle` timelines (and only they) light these columns up.
+FLIGHT_COLUMNS = (
+    ("fit_loss", "fit_loss", lambda v: f"{v:.4g}"),
+    ("eval_loss", "eval_loss", lambda v: f"{v:.4g}"),
+)
+
 
 def merge_checkpoint_fields(rounds: list[dict],
                             ckpt_events: list[dict]) -> list[dict]:
@@ -186,7 +195,7 @@ def active_columns(rounds: list[dict]) -> tuple:
     extra = tuple(
         col for col in (TELEMETRY_COLUMNS + WIRE_COLUMNS + MESH_COLUMNS
                         + PRECISION_COLUMNS + ASYNC_COLUMNS + CKPT_COLUMNS
-                        + COHORT_COLUMNS)
+                        + COHORT_COLUMNS + FLIGHT_COLUMNS)
         if any(col[1] in rec for rec in rounds)
     )
     return COLUMNS + extra
@@ -464,15 +473,69 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
     return summary
 
 
+def render_bundle(bundle_dir: str, as_json: bool = False) -> int:
+    """``--bundle``: render a postmortem bundle's flight ring with the
+    SAME per-round table machinery the JSONL log gets — the quick look
+    before ``tools/postmortem.py``'s full incident report."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:  # script invocation: tools/ is sys.path[0]
+        sys.path.insert(0, repo)
+    from fl4health_tpu.observability.bundle import load_bundle
+
+    try:
+        bundle = load_bundle(bundle_dir)
+    except Exception as e:  # noqa: BLE001 — operator CLI: a corrupt ring
+        # frame (CheckpointCorruptError), torn verdict JSON or missing dir
+        # is a diagnostic, never a traceback
+        print(f"perf_report: cannot read bundle {bundle_dir}: {e}",
+              file=sys.stderr)
+        return 2
+    rows = []
+    for entry in sorted(bundle.get("ring") or [],
+                        key=lambda e: e.get("round", 0)):
+        row = dict(entry.get("summary") or {})
+        row.setdefault("round", entry.get("round"))
+        for k in ("fit_loss", "eval_loss"):
+            if entry.get(k) is not None:
+                row[k] = entry[k]
+        rows.append(row)
+    verdict = bundle.get("verdict") or {}
+    if as_json:
+        print(json.dumps({"verdict": verdict, "rounds": rows}, indent=2,
+                         default=str))
+        return 0
+    kind = verdict.get("kind", "?")
+    head = f"postmortem bundle: {bundle_dir} (verdict: {kind}"
+    if verdict.get("round") is not None:
+        head += f", round {verdict['round']}"
+    print(head + ")")
+    if not rows:
+        print("flight ring is empty (the run died before any round's "
+              "epilogue)", file=sys.stderr)
+        return 1
+    print(render_table(rows))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("log", help="path to metrics.jsonl")
+    ap.add_argument("log", nargs="?", help="path to metrics.jsonl")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
     ap.add_argument("--sweep", action="store_true",
                     help="render only the scenario-sweep leaderboard "
                          "(fl4health_tpu/sweep/ 'sweep' events)")
+    ap.add_argument("--bundle", metavar="DIR",
+                    help="render a postmortem bundle's flight ring "
+                         "(observability/bundle.py postmortem_<ts>/ dir) "
+                         "instead of a JSONL log")
     args = ap.parse_args(argv)
+    if args.bundle:
+        return render_bundle(args.bundle, as_json=args.json)
+    if not args.log:
+        ap.error("a metrics.jsonl path is required (or --bundle DIR)")
     try:
         events = load_events(args.log)  # ONE parse serves every table
         rounds = _sorted_rounds(events.get("round", []))
