@@ -382,6 +382,33 @@ class StateCheckpointer:
                 pass
         self._prune_orphan_tmp()
 
+    def prune_generations_from_round(self, round_idx: int) -> list[str]:
+        """Rollback support (``resilience/supervisor.py``): delete ring
+        generations whose frame ``meta["round"]`` is at or past
+        ``round_idx`` — after an abnormal end at round *r* the newest
+        durable generations may already hold the poisoned state, so a
+        resume must restore a generation that PREDATES the failure.
+        Corrupt frames are pruned too (they are rollback fodder either
+        way); legacy frames with no recorded round are kept — deleting
+        state of unknown vintage is an operator call, not a supervisor's.
+        Returns the deleted paths."""
+        removed: list[str] = []
+        for _gen, path in self.candidate_paths():
+            try:
+                _host, meta, _blob = read_frame(path)
+            except CheckpointCorruptError:
+                meta = {"round": round_idx}  # corrupt: treat as at-fault
+            r = meta.get("round")
+            if r is None or int(r) < int(round_idx):
+                continue
+            try:
+                os.remove(path)
+                removed.append(path)
+            except OSError:
+                logger.warning("could not prune checkpoint generation at "
+                               "%s during rollback", path)
+        return removed
+
     # -- save ------------------------------------------------------------
     def save(self, trees: Mapping[str, Any], host: Mapping[str, Any] | None = None,
              snapshotters: Mapping[str, Snapshotter] | None = None,

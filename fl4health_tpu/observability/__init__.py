@@ -286,6 +286,14 @@ class Observability:
         scrape endpoint stops reporting a dying run healthy."""
         self._unhealthy = str(reason)
 
+    def mark_healthy(self) -> None:
+        """Reset the ``/healthz`` verdict back to 200 ("ok") — the inverse
+        of :meth:`mark_unhealthy`. The recovery supervisor calls this once
+        a self-healed run's probation window passes, so an orchestrator
+        polling the armed scrape endpoint sees the recovery instead of a
+        503 that stays sticky until the next ``start()``."""
+        self._unhealthy = None
+
     def dump_bundle(self, verdict: "dict[str, Any]") -> str | None:
         """Publish a postmortem bundle (``observability/bundle.py``) under
         ``output_dir`` from the flight recorder's ring + the live trace/

@@ -24,7 +24,14 @@ halt. This subsystem is the next step, four pillars:
 - :mod:`~fl4health_tpu.resilience.recovery` — the crash-drill harness
   proving preemption survival: a subprocess ``fit()`` SIGKILLed at a
   seeded point (including mid-checkpoint-write), resumed from the
-  retention ring, and pinned bit-identical to the uninterrupted run.
+  retention ring, and pinned bit-identical to the uninterrupted run;
+- :mod:`~fl4health_tpu.resilience.supervisor` — the self-healing loop:
+  a :class:`RecoverySupervisor` driving a declarative
+  :class:`RecoveryPolicy` escalation ladder (retry -> quarantine ->
+  robustify -> degrade -> halt) over the structured abnormal-end
+  taxonomy, with flight-recorder suspect attribution
+  (:mod:`~fl4health_tpu.resilience.suspects`), checkpoint-ring rollback
+  and ``/healthz``-restoring probation.
 """
 
 from fl4health_tpu.resilience.aggregators import (
@@ -59,9 +66,19 @@ from fl4health_tpu.resilience.recovery import (
 from fl4health_tpu.resilience.retry import (
     CircuitBreaker,
     CircuitOpenError,
+    RetryDeadlineError,
     RetryPolicy,
     call_with_retry,
     classify_failure,
+)
+from fl4health_tpu.resilience.supervisor import (
+    QuorumControl,
+    RecoveryPolicy,
+    RecoverySupervisor,
+)
+from fl4health_tpu.resilience.suspects import (
+    detect_divergence_onset,
+    rank_suspects,
 )
 
 __all__ = [
@@ -87,8 +104,14 @@ __all__ = [
     "TransportFaultPolicy",
     "chaos_handler",
     "RetryPolicy",
+    "RetryDeadlineError",
     "CircuitBreaker",
     "CircuitOpenError",
     "call_with_retry",
     "classify_failure",
+    "QuorumControl",
+    "RecoveryPolicy",
+    "RecoverySupervisor",
+    "rank_suspects",
+    "detect_divergence_onset",
 ]

@@ -59,13 +59,22 @@ class KillPoint:
     file write — the torn-write drill: the temp file dies mid-body and the
     previously published generation must survive untouched.
 
+    ``phase="registry_scatter"`` kills at the moment round ``round``'s
+    cohort-slot rows would scatter back into the host registry
+    (``ClientRegistry.scatter`` on the RoundConsumer thread) — the
+    read-after-write edge of the gather/scatter cycle: the round's
+    checkpoint (which runs AFTER the scatter in the epilogue) never
+    publishes, the scatter gate never releases, and a resume must restore
+    the previous generation's registry rows bit-identically.
+
     ``signal_name`` selects the delivery: ``"SIGKILL"`` (default — no
     atexit, no flushing, eviction fidelity) or ``"SIGTERM"`` — the
     graceful-preemption drill: ``fit()``'s trap converts it into a
     :class:`~fl4health_tpu.observability.flightrec.SigtermShutdown`, the
     flight recorder publishes a postmortem bundle naming the kill round,
-    and the child exits 143 (``mid_write`` stays SIGKILL-only: a handler
-    running mid-torn-write would defeat the torn-write fidelity)."""
+    and the child exits 143 (``mid_write``/``registry_scatter`` stay
+    SIGKILL-only: a handler running mid-torn-write or mid-scatter would
+    let graceful teardown finish the very work the drill interrupts)."""
 
     round: int
     phase: str = "post_save"
@@ -73,9 +82,10 @@ class KillPoint:
     signal_name: str = "SIGKILL"
 
     def __post_init__(self):
-        if self.phase not in ("post_save", "mid_write"):
+        if self.phase not in ("post_save", "mid_write", "registry_scatter"):
             raise ValueError(
-                f"phase must be 'post_save' or 'mid_write'; got {self.phase!r}"
+                "phase must be 'post_save', 'mid_write' or "
+                f"'registry_scatter'; got {self.phase!r}"
             )
         if self.round < 1:
             raise ValueError(f"round must be >= 1; got {self.round}")
@@ -88,8 +98,9 @@ class KillPoint:
                 f"signal_name must be 'SIGKILL' or 'SIGTERM'; "
                 f"got {self.signal_name!r}"
             )
-        if self.phase == "mid_write" and self.signal_name != "SIGKILL":
-            raise ValueError("mid_write drills are SIGKILL-only")
+        if (self.phase in ("mid_write", "registry_scatter")
+                and self.signal_name != "SIGKILL"):
+            raise ValueError(f"{self.phase} drills are SIGKILL-only")
 
     @property
     def signum(self) -> int:
@@ -173,6 +184,37 @@ def install_kill_hook(checkpointer, kill: KillPoint) -> None:
     checkpointer.save = save
 
 
+def install_scatter_kill_hook(sim, kill: KillPoint) -> None:
+    """Arm a ``phase="registry_scatter"`` kill: wrap the cohort-slot
+    registry's ``scatter`` so the ``kill.round``-th scatter of the run
+    SIGKILLs the process at entry — mid-epilogue, BEFORE that round's rows
+    persist, before its checkpoint publishes, and before the producer's
+    scatter gate releases. The drill then proves the resume restores the
+    PREVIOUS generation's registry rows bit-identically (the PR 13
+    gather-gated read-after-write edge)."""
+    if kill.phase != "registry_scatter":
+        raise ValueError(
+            f"install_scatter_kill_hook needs phase='registry_scatter'; "
+            f"got {kill.phase!r}"
+        )
+    registry = getattr(sim, "registry", None)
+    if registry is None:
+        raise RuntimeError(
+            "a registry_scatter KillPoint needs cohort-slot execution "
+            "(FederatedSimulation(cohort=CohortConfig(...)))"
+        )
+    orig_scatter = registry.scatter
+    calls = {"n": 0}
+
+    def scatter(idx, valid, client_rows, strategy_rows=None):
+        calls["n"] += 1
+        if calls["n"] == kill.round:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return orig_scatter(idx, valid, client_rows, strategy_rows)
+
+    registry.scatter = scatter
+
+
 def _load_factory(factory_file: str, factory_name: str):
     spec = importlib.util.spec_from_file_location("_fl4h_drill_factory",
                                                   factory_file)
@@ -206,9 +248,13 @@ def child_main(spec_path: str) -> int:
     sim = factory(spec.get("ckpt_dir"))
     kill = spec.get("kill")
     if kill:
-        if sim.state_checkpointer is None:
-            raise RuntimeError("a KillPoint needs a state_checkpointer")
-        install_kill_hook(sim.state_checkpointer, KillPoint(**kill))
+        kp = KillPoint(**kill)
+        if kp.phase == "registry_scatter":
+            install_scatter_kill_hook(sim, kp)
+        else:
+            if sim.state_checkpointer is None:
+                raise RuntimeError("a KillPoint needs a state_checkpointer")
+            install_kill_hook(sim.state_checkpointer, kp)
     history = sim.fit(int(spec["n_rounds"]))
 
     from flax import serialization

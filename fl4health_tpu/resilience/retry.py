@@ -10,7 +10,8 @@ module holds the host-side resilience primitives the reworked coordinator
   ``decode`` / ``other``), so dead-silo triage reads off the metrics page
   instead of the logs;
 - :class:`RetryPolicy` — bounded attempts with jittered exponential
-  backoff (injectable rng/sleep so tests run in microseconds);
+  backoff plus an optional overall per-silo ``deadline_s`` budget
+  (injectable rng/sleep/clock so tests run in microseconds);
 - :class:`CircuitBreaker` — per-silo closed/open/half-open gate: after
   ``failure_threshold`` consecutive failures the silo is skipped outright
   (no connect timeout paid) until ``reset_after_s`` elapses, then a single
@@ -34,6 +35,7 @@ REASON_TIMEOUT = "timeout"
 REASON_CONNECTION = "connection"
 REASON_DECODE = "decode"
 REASON_CIRCUIT_OPEN = "circuit_open"
+REASON_DEADLINE = "deadline"
 REASON_OTHER = "other"
 
 
@@ -41,15 +43,27 @@ class CircuitOpenError(ConnectionError):
     """Raised instead of dialing when a silo's circuit breaker is open."""
 
 
+class RetryDeadlineError(TimeoutError):
+    """The per-silo retry budget (``RetryPolicy.deadline_s``) ran out
+    before the attempts did — further backoff would push the silo past
+    the round deadline. Carries the last attempt's failure as
+    ``__cause__``; classified as its own ``"deadline"`` reason so a
+    metrics page separates "silo kept failing until the budget died"
+    from a single hung RPC's ``"timeout"``."""
+
+
 def classify_failure(exc: BaseException) -> str:
     """Failure-reason label for ``transport_rpc_failures_total``.
 
-    Order matters: ``socket.timeout`` IS ``TimeoutError`` (and an
-    ``OSError``) since 3.10, and the codec's ``FrameError`` is a
-    ``ValueError`` (checked by family here — importing it would cycle
-    resilience <-> transport) — the most specific family wins."""
+    Order matters: ``RetryDeadlineError`` IS a ``TimeoutError`` (and
+    ``socket.timeout`` IS ``TimeoutError``/``OSError`` since 3.10), and
+    the codec's ``FrameError`` is a ``ValueError`` (checked by family here
+    — importing it would cycle resilience <-> transport) — the most
+    specific family wins."""
     if isinstance(exc, CircuitOpenError):
         return REASON_CIRCUIT_OPEN
+    if isinstance(exc, RetryDeadlineError):
+        return REASON_DEADLINE
     if isinstance(exc, TimeoutError):
         return REASON_TIMEOUT
     if isinstance(exc, (ValueError, KeyError, TypeError)):
@@ -66,7 +80,15 @@ class RetryPolicy:
 
     ``timeout_s`` is the per-attempt RPC timeout the coordinator passes to
     the transport ``call`` (a retry policy without a per-attempt timeout
-    would let one hung silo eat the whole budget on attempt 1)."""
+    would let one hung silo eat the whole budget on attempt 1).
+
+    ``deadline_s`` (optional) is the OVERALL per-silo budget across every
+    attempt AND backoff sleep: jittered-exponential retries must not push
+    a silo past the round deadline, so once the budget is spent — or the
+    next backoff would overshoot it — the attempt loop stops and raises
+    :class:`RetryDeadlineError` (reason label ``"deadline"``) chaining the
+    last real failure. ``None`` (the default) keeps the unbounded legacy
+    behavior."""
 
     max_attempts: int = 3
     base_delay_s: float = 0.05
@@ -74,6 +96,7 @@ class RetryPolicy:
     backoff_factor: float = 2.0
     jitter: float = 0.5
     timeout_s: float = 10.0
+    deadline_s: float | None = None
 
     def __post_init__(self):
         if self.max_attempts < 1:
@@ -82,6 +105,8 @@ class RetryPolicy:
             raise ValueError("jitter must be in [0, 1]")
         if self.base_delay_s < 0 or self.max_delay_s < self.base_delay_s:
             raise ValueError("need 0 <= base_delay_s <= max_delay_s")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("deadline_s must be positive (or None)")
 
     def backoff_s(self, attempt: int, rng: Any = _pyrandom) -> float:
         """Delay before retry ``attempt+1`` (attempt is 0-based). Jitter
@@ -170,6 +195,7 @@ def call_with_retry(
     on_failure: Callable[[BaseException, int, bool], None] | None = None,
     sleep: Callable[[float], None] = time.sleep,
     rng: Any = _pyrandom,
+    clock: Callable[[], float] = time.monotonic,
 ) -> Any:
     """Run ``do_call`` under the retry policy and breaker.
 
@@ -177,8 +203,18 @@ def call_with_retry(
     the coordinator uses it to bump the reason-labeled failure counter and
     the retry counter. ``policy=None`` means exactly one attempt (the
     legacy coordinator behavior). A breaker that refuses admission raises
-    :class:`CircuitOpenError` without consuming an attempt's wire time."""
+    :class:`CircuitOpenError` without consuming an attempt's wire time.
+
+    With ``policy.deadline_s`` set, the overall budget is enforced across
+    attempts and backoff sleeps: when the next backoff would overshoot it
+    (or it is already spent), the loop stops and raises
+    :class:`RetryDeadlineError` chaining the last real failure —
+    ``on_failure`` sees ``will_retry=False`` for that attempt, never a
+    retry promise the deadline then breaks. ``clock`` is injectable so
+    tests never sleep."""
     attempts = policy.max_attempts if policy is not None else 1
+    deadline = policy.deadline_s if policy is not None else None
+    t0 = clock() if deadline is not None else 0.0
     last: BaseException | None = None
     for attempt in range(attempts):
         if breaker is not None and not breaker.allow():
@@ -195,12 +231,24 @@ def call_with_retry(
             if breaker is not None:
                 breaker.record_failure()
             will_retry = attempt + 1 < attempts
+            delay = (policy.backoff_s(attempt, rng)
+                     if will_retry and policy is not None else 0.0)
+            over_deadline = (
+                deadline is not None and will_retry
+                and clock() - t0 + delay > deadline
+            )
+            if over_deadline:
+                will_retry = False
             if on_failure is not None:
                 on_failure(e, attempt, will_retry)
-            if will_retry and policy is not None:
-                delay = policy.backoff_s(attempt, rng)
-                if delay > 0:
-                    sleep(delay)
+            if over_deadline:
+                raise RetryDeadlineError(
+                    f"retry deadline_s={deadline} exhausted after "
+                    f"{attempt + 1} attempt(s) "
+                    f"(last failure: {type(e).__name__}: {e})"
+                ) from e
+            if will_retry and delay > 0:
+                sleep(delay)
             continue
         if breaker is not None:
             breaker.record_success()

@@ -369,6 +369,14 @@ class ClientRegistry:
     def dirty_rows(self) -> int:
         return self._client_store.dirty
 
+    def reset_rows(self) -> None:
+        """Drop every persisted per-client row (state AND strategy): all
+        clients resolve to the bound client-symmetric prototypes again —
+        the registry half of a rollback-to-initial
+        (``FederatedSimulation._reset_to_initial``)."""
+        self._client_store._rows.clear()
+        self._strategy_store._rows.clear()
+
     def sample_x(self) -> Any:
         """Client 0's first training example (model-init probe)."""
         x0, _ = self.source.client_train(0)

@@ -264,6 +264,7 @@ class FederatedSimulation:
         precision: Any = None,
         async_config: Any = None,
         cohort: CohortConfig | None = None,
+        recovery: Any = None,
     ):
         if (local_epochs is None) == (local_steps is None):
             raise ValueError("specify exactly one of local_epochs / local_steps "
@@ -417,6 +418,25 @@ class FederatedSimulation:
                     max_staleness=async_config.max_staleness,
                 )
         self._async_active = async_config is not None
+        # Self-healing recovery (resilience/supervisor.py): recovery=
+        # RecoveryPolicy(...) routes fit() through a RecoverySupervisor
+        # that turns the structured abnormal-end taxonomy (watchdog halt,
+        # client failures, quorum loss, corrupt checkpoints) into
+        # rollback-quarantine-resume per a declarative escalation ladder.
+        # None (the default) keeps fit() exactly the unsupervised loop —
+        # and an armed-but-never-engaged policy is pinned bit-identical
+        # too (the supervisor's hooks are no-ops until it engages).
+        self.recovery_policy = recovery
+        if recovery is not None:
+            from fl4health_tpu.resilience.supervisor import RecoveryPolicy
+
+            if not isinstance(recovery, RecoveryPolicy):
+                raise TypeError(
+                    "recovery must be a RecoveryPolicy (or None); got "
+                    f"{type(recovery).__name__} — pass "
+                    "resilience.supervisor.RecoveryPolicy"
+                )
+        self._recovery_supervisor = None
         # Device-mesh placement (parallel/program.py): mesh=None keeps the
         # single-chip programs (and trajectories) bit-identical; a
         # MeshConfig shards the [C, ...] client axes over the "clients"
@@ -1959,10 +1979,61 @@ class FederatedSimulation:
         return EXEC_CHUNKED, "auto: no per-round host dependencies"
 
     def fit(self, n_rounds: int) -> list[RoundRecord]:
+        if self.recovery_policy is not None:
+            # self-healing mode: the RecoverySupervisor re-enters
+            # _fit_unsupervised after each recoverable abnormal end
+            # (rollback via the checkpoint ring, rung mitigation, resume)
+            if self._recovery_supervisor is None:
+                from fl4health_tpu.resilience.supervisor import (
+                    RecoverySupervisor,
+                )
+
+                self._recovery_supervisor = RecoverySupervisor(
+                    self, self.recovery_policy
+                )
+            return self._recovery_supervisor.run(n_rounds)
+        return self._fit_unsupervised(n_rounds)
+
+    def _fit_unsupervised(self, n_rounds: int) -> list[RoundRecord]:
+        """One fit attempt with no recovery wrapper — the pre-supervisor
+        ``fit()`` body (also the supervisor's per-attempt entry point)."""
         if self.profile_dir is not None:
             with jax.profiler.trace(self.profile_dir):
                 return self._fit_loop(n_rounds)
         return self._fit_loop(n_rounds)
+
+    def _reset_to_initial(self) -> None:
+        """Roll the live training state back to the constructor's
+        seed-derived init — the recovery supervisor's rollback when no
+        durable checkpoint generation predates a failure. ``self.rng`` is
+        never mutated by ``fit()`` (every draw is a pure ``fold_in``), so
+        ``_init_states`` reproduces the fresh states bit-identically."""
+        if self._cohort_active:
+            self.registry.reset_rows()
+        self._init_states()
+        self.history = []
+        self._async_pending = None
+
+    def _apply_recovery_keep(self, mask, rnd: int):
+        """Multiply a round's sampling mask by the recovery supervisor's
+        quarantine keep-mask. A pure pass-through (the exact input object)
+        when no supervisor is attached or nothing is quarantined, so
+        armed-but-never-engaged runs stay bit-identical."""
+        sup = self._recovery_supervisor
+        if sup is None:
+            return mask
+        keep = sup.keep_mask(rnd, self.n_clients)
+        if keep is None:
+            return mask
+        return mask * jnp.asarray(keep, jnp.float32)
+
+    def _note_recovery_round(self, rnd: int) -> None:
+        """Round-epilogue hook (every execution path, after the watchdog
+        passed): drives the supervisor's probation window and quarantine
+        releases. No-op without a supervisor."""
+        sup = self._recovery_supervisor
+        if sup is not None:
+            sup.note_round(rnd)
 
     def _fit_loop(self, n_rounds: int) -> list[RoundRecord]:
         obs = self.observability
@@ -2001,6 +2072,12 @@ class FederatedSimulation:
             self._dump_postmortem(resume_exc)
             obs.shutdown()
             raise
+        if self._recovery_supervisor is not None:
+            # post-restore hook: the supervisor re-applies its pending
+            # mitigations (in-graph quarantine seeding, hoisted-scalar
+            # overrides) onto the freshly restored state and keeps
+            # /healthz at 503 while a recovery is mid-probation
+            self._recovery_supervisor.on_resume(start_round)
         if obs.watchdog is not None and not self._telemetry_enabled:
             logging.getLogger(__name__).warning(
                 "HealthWatchdog attached but in-graph telemetry is off "
@@ -2673,6 +2750,10 @@ class FederatedSimulation:
                     keep = obs.watchdog.quarantine_keep_mask(self.n_clients)
                     if keep is not None:
                         mask = mask * jnp.asarray(keep, jnp.float32)
+                # recovery-supervisor quarantine (resilience/supervisor.py):
+                # suspects a past engagement named stay sampled out until
+                # their release round; a pass-through when idle
+                mask = self._apply_recovery_keep(mask, rnd)
                 batches = (prefetcher.take(rnd) if prefetcher is not None
                            else self._round_batches(rnd))
             if prefetcher is not None and rnd < self._fit_n_rounds:
@@ -3078,6 +3159,9 @@ class FederatedSimulation:
                 rec.fit_losses.get("backward", float("nan")),
                 obs=obs, reporters=self.reporters,
             )
+        # recovery probation: a round only counts healthy once the
+        # watchdog passed it (a halt above skips this)
+        self._note_recovery_round(rnd)
 
     # -- chunked on-device path ----------------------------------------
     def _fit_chunked(self, n_rounds: int, start_round: int = 1) -> None:
@@ -3156,8 +3240,14 @@ class FederatedSimulation:
         em = jnp.asarray(np.stack([p[1] for p in plans]))
         sm = jnp.asarray(np.stack([p[2] for p in plans]))
         mask_stack = jnp.stack([
-            self.client_manager.sample(
-                jax.random.fold_in(self.rng, 2000 + r), r
+            # the supervisor keep-mask is a pure function of (ledger,
+            # round), so computing the whole chunk's masks ahead of the
+            # dispatch sees the same values the per-round path would
+            self._apply_recovery_keep(
+                self.client_manager.sample(
+                    jax.random.fold_in(self.rng, 2000 + r), r
+                ),
+                r,
             )
             for r in rounds
         ])
@@ -3291,6 +3381,8 @@ class FederatedSimulation:
                     rec.fit_losses.get("backward", float("nan")),
                     obs=obs, reporters=self.reporters,
                 )
+            # recovery probation (see _finish_round): healthy rounds only
+            self._note_recovery_round(rnd)
 
     # -- cohort-slot path (server/registry.py) --------------------------
     def _stage_cohort_round(self, rnd: int) -> dict:
@@ -3412,6 +3504,17 @@ class FederatedSimulation:
                 prefetcher.schedule(rnd + 1)
             self._await_registry_scatter()
             idx, valid = staged["idx"], staged["valid"]
+            sup = self._recovery_supervisor
+            if sup is not None:
+                # supervisor quarantine in REGISTRY-id space: a sampled
+                # slot whose id is on the roster is masked out (its row
+                # still gathers/scatters — zero-weight, exactly like an
+                # unsampled client); pass-through while idle
+                drop = sup.quarantined_ids(rnd)
+                if drop:
+                    keep = (~np.isin(np.asarray(idx),
+                                     np.asarray(drop))).astype(np.float32)
+                    staged["mask"] = staged["mask"] * jnp.asarray(keep)
             with obs.span("cohort_gather", round=rnd,
                           valid=valid) as gather_span:
                 g0 = time.perf_counter()

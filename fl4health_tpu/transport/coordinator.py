@@ -16,7 +16,8 @@ per-silo retry/backoff, circuit breakers and quorum semantics layered from
 
 - ``retry=RetryPolicy(...)`` re-dials a failed silo with jittered
   exponential backoff (each attempt bounded by the policy's per-attempt
-  timeout);
+  timeout, the whole per-silo attempt loop by its optional ``deadline_s``
+  budget — retries can never push a silo past the round deadline);
 - ``breakers=`` (a ``dict[str, CircuitBreaker]``, keyed ``"host:port"``)
   skips a silo whose circuit is open without paying its connect timeout;
 - ``quorum=`` proceeds once enough silos replied — the missing silos'
@@ -24,9 +25,10 @@ per-silo retry/backoff, circuit breakers and quorum semantics layered from
   the renormalize-and-continue semantics of partial participation.
 
 Failures land in ``transport_rpc_failures_total`` with a ``reason`` label
-(``timeout`` / ``connection`` / ``decode`` / ``circuit_open`` / ``other``)
-per attempt, and retries in ``transport_rpc_retries_total`` — dead-silo
-triage reads off the metrics page, not the logs.
+(``timeout`` / ``connection`` / ``decode`` / ``circuit_open`` /
+``deadline`` / ``other``) per attempt, and retries in
+``transport_rpc_retries_total`` — dead-silo triage reads off the metrics
+page, not the logs.
 """
 
 from __future__ import annotations
@@ -46,6 +48,7 @@ from fl4health_tpu.observability.registry import get_registry
 from fl4health_tpu.observability.spans import get_tracer
 from fl4health_tpu.resilience.retry import (
     CircuitBreaker,
+    RetryDeadlineError,
     RetryPolicy,
     call_with_retry,
     classify_failure,
@@ -187,6 +190,15 @@ def _silo_round_trip(
         except Exception as e:  # noqa: BLE001 — reported per silo, quorum decides
             result.error = e
             result.reason = classify_failure(e)
+            if isinstance(e, RetryDeadlineError):
+                # the budget death is its own failure event: the
+                # per-attempt counts above carried the underlying wire
+                # reasons, this one records that the retry budget died
+                reg.counter(
+                    "transport_rpc_failures_total",
+                    help="silo round trips that raised, by failure reason",
+                    labels={"silo": silo, "reason": result.reason},
+                ).inc()
             result.elapsed_s = time.perf_counter() - t0
             sp.set(failed=True, reason=result.reason)
             return result

@@ -90,6 +90,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "selfheal: recovery-supervisor lanes (resilience/supervisor.py — "
+        "a RecoveryPolicy escalation ladder turning abnormal ends into "
+        "rollback-quarantine-resume). The tier-1-safe smoke subset "
+        "(policy/ladder units, suspect attribution, one in-process "
+        "self-heal drill per execution mode) runs by default; the full "
+        "drill matrix (SIGKILL of the supervised process, cohort "
+        "variants) also carries 'slow'. Select with -m selfheal.",
+    )
+    config.addinivalue_line(
+        "markers",
         "bigcohort: cohort-slot registry lanes (server/registry.py "
         "ClientRegistry + CohortConfig). The tier-1-safe smoke subset "
         "(slots=N bit-identity parity, sample_indices/mask coherence, "

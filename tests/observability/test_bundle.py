@@ -389,6 +389,24 @@ class TestHealthzGoesUnhealthy:
         assert self._scrape(url) == (200, "ok\n")
         obs.shutdown()
 
+    def test_healthz_recovers_via_mark_healthy_without_restart(
+            self, tmp_path):
+        """Recovery conformance (resilience/supervisor.py probation): the
+        ARMED endpoint flips 503 -> 200 through ``mark_healthy`` alone —
+        a self-healed run scrapes 200 again WITHOUT waiting for the next
+        ``start()`` (the pre-recovery behavior, where the 503 was sticky
+        for the handle's armed lifetime)."""
+        obs = make_obs(tmp_path, http_port=0)
+        url = obs.scrape_url + "/healthz"
+        assert self._scrape(url) == (200, "ok\n")
+        obs.mark_unhealthy("recovering (rung quarantine, attempt 2)")
+        code, body = self._scrape(url)
+        assert code == 503 and "recovering" in body
+        obs.mark_healthy()  # probation passed: the run self-healed
+        assert self._scrape(url) == (200, "ok\n")
+        assert obs.unhealthy_reason is None
+        obs.shutdown()
+
 
 class TestArchivedHistoryRidesAlong:
     def test_bundle_copies_archive_segments_and_loader_replays_them(

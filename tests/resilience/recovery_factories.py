@@ -111,3 +111,66 @@ def async_chunked(ckpt_dir):
 
 def async_pipelined(ckpt_dir):
     return _async(ckpt_dir, "pipelined")
+
+
+def cohort_sampled(ckpt_dir):
+    """Cohort-slot run (6-client registry, 3 slots, fraction sampling) —
+    the registry_scatter kill drill's configuration."""
+    from fl4health_tpu.server.client_manager import FixedFractionManager
+    from fl4health_tpu.server.registry import CohortConfig
+
+    out = []
+    for i in range(6):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(40 + i), 32, (6,), N_CLASSES
+        )
+        x = np.asarray(x)
+        out.append(ClientDataset(x[:24], y[:24], x[24:], y[24:]))
+    return _base(
+        ckpt_dir, checkpoint_every=1, execution_mode="auto",
+        datasets=out, cohort=CohortConfig(slots=3),
+        client_manager=FixedFractionManager(6, 0.5),
+    )
+
+
+def supervised_selfheal(ckpt_dir):
+    """The self-healing drill configuration: probability-1 scale fault on
+    clients (1, 2) of 6 from round 2, a loss-divergence watchdog, and a
+    RecoveryPolicy — fit() rolls back, quarantines the suspects and
+    resumes on its own. The recovery ledger lives next to the checkpoint
+    ring, so a SIGKILL of THIS process resumes with the same quarantine
+    roster armed."""
+    from fl4health_tpu.observability import (
+        HealthPolicy,
+        HealthWatchdog,
+        MetricsRegistry,
+        Observability,
+        Tracer,
+    )
+    from fl4health_tpu.resilience import ClientFault, FaultPlan
+    from fl4health_tpu.resilience.supervisor import RecoveryPolicy
+
+    out = []
+    for i in range(6):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(20 + i), 32, (6,), N_CLASSES
+        )
+        x = np.asarray(x)
+        out.append(ClientDataset(x[:24], y[:24], x[24:], y[24:]))
+    return _base(
+        ckpt_dir, checkpoint_every=1, execution_mode="pipelined",
+        datasets=out,
+        fault_plan=FaultPlan(seed=3, client_faults=(
+            ClientFault(clients=(1, 2), kind="scale", scale=-15.0,
+                        probability=1.0, start_round=2),
+        )),
+        observability=Observability(
+            enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+            sync_device=False,
+            watchdog=HealthWatchdog(HealthPolicy(
+                loss_divergence_window=1, loss_divergence_factor=1.4,
+                on_loss_divergence="halt", on_nonfinite="halt",
+            )),
+        ),
+        recovery=RecoveryPolicy(probation_rounds=3, quarantine_rounds=0),
+    )

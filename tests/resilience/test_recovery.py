@@ -240,3 +240,78 @@ def test_async_sigkill_resumes_mid_plan_bit_identical(tmp_path, factory):
         kill={"round": 2, "phase": "post_save"},
     )
     _assert_bit_identical(straight, resumed, 4)
+
+
+def test_killpoint_registry_scatter_validation():
+    """registry_scatter phase: SIGKILL-only (a handler mid-scatter would
+    let graceful teardown finish the very work the drill interrupts), and
+    the hook refuses non-cohort simulations."""
+    from fl4health_tpu.resilience.recovery import (
+        KillPoint,
+        install_scatter_kill_hook,
+    )
+
+    KillPoint(round=2, phase="registry_scatter")  # valid
+    with pytest.raises(ValueError, match="SIGKILL-only"):
+        KillPoint(round=2, phase="registry_scatter",
+                  signal_name="SIGTERM")
+
+    class _NoRegistry:
+        registry = None
+
+    with pytest.raises(RuntimeError, match="cohort-slot"):
+        install_scatter_kill_hook(
+            _NoRegistry(), KillPoint(round=2, phase="registry_scatter")
+        )
+    with pytest.raises(ValueError, match="registry_scatter"):
+        install_scatter_kill_hook(_NoRegistry(), KillPoint(round=2))
+
+
+@pytest.mark.crash
+@pytest.mark.bigcohort
+@pytest.mark.slow
+def test_sigkill_mid_registry_scatter_resumes_bit_identical(tmp_path):
+    """The cohort kill-matrix drill (PR 13's gather-gated read-after-write
+    edge): SIGKILL at the moment round 2's slot rows would scatter into
+    the host registry — BEFORE that round's rows persist, before its
+    cohort-kind checkpoint publishes. The resume restores round 1's
+    generation (slot states + registry dirty rows) and reproduces the
+    uninterrupted run byte-identically."""
+    straight, resumed = _drill(
+        tmp_path, "cohort_sampled", n_rounds=4,
+        kill={"round": 2, "phase": "registry_scatter"},
+    )
+    _assert_bit_identical(straight, resumed, 4)
+
+
+@pytest.mark.selfheal
+@pytest.mark.crash
+@pytest.mark.slow
+def test_sigkill_of_supervised_process_resumes_self_healed(tmp_path):
+    """THE supervised-process kill drill: the self-healing run (scale
+    fault -> watchdog halt -> rollback -> quarantine -> resume) is
+    SIGKILLed after round 7's checkpoint — after the recovery settled —
+    and a fresh supervised process over the same checkpoint ring + ledger
+    finishes the run BYTE-identically to a supervised arm that was never
+    killed: the quarantine roster survived the eviction in the recovery
+    ledger, the training state in the generation ring."""
+    straight = _run(tmp_path, "straight", "supervised_selfheal", 10,
+                    tmp_path / "straight_ckpt")
+    assert straight.returncode == 0, straight.stderr[-2000:]
+    ckpt_dir = tmp_path / "drill_ckpt"
+    killed = _run(tmp_path, "killed", "supervised_selfheal", 10, ckpt_dir,
+                  kill={"round": 7, "phase": "post_save"})
+    assert killed.returncode == -signal.SIGKILL, (
+        f"expected SIGKILL exit, got {killed.returncode}: "
+        f"{killed.stderr[-2000:]}"
+    )
+    # the ledger survived the kill with the quarantine roster armed
+    import json
+
+    with open(ckpt_dir / "recovery_ledger.json") as f:
+        ledger = json.load(f)
+    assert sorted(int(c) for c in ledger["quarantine"]) == [1, 2]
+    resumed = _run(tmp_path, "resumed", "supervised_selfheal", 10,
+                   ckpt_dir)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    _assert_bit_identical(straight, resumed, 10)

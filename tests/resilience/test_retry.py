@@ -14,6 +14,7 @@ from fl4health_tpu.observability.registry import set_registry
 from fl4health_tpu.resilience import (
     CircuitBreaker,
     CircuitOpenError,
+    RetryDeadlineError,
     RetryPolicy,
     call_with_retry,
     classify_failure,
@@ -133,6 +134,85 @@ class TestCallWithRetry:
         with pytest.raises(CircuitOpenError):
             call_with_retry(lambda: calls.append(1), breaker=br)
         assert calls == []  # never dialed
+
+
+class TestRetryDeadline:
+    """RetryPolicy.deadline_s: the OVERALL per-silo budget — jittered
+    retries can never exceed the round deadline."""
+
+    def test_classify_deadline_label(self):
+        assert classify_failure(RetryDeadlineError()) == "deadline"
+        # RetryDeadlineError IS a TimeoutError — specificity order matters
+        assert isinstance(RetryDeadlineError(), TimeoutError)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="deadline_s"):
+            RetryPolicy(deadline_s=0.0)
+        with pytest.raises(ValueError, match="deadline_s"):
+            RetryPolicy(deadline_s=-1.0)
+        RetryPolicy(deadline_s=None)  # legacy unbounded default
+
+    def test_deadline_stops_retries_before_overshoot(self):
+        # fake clock: each attempt "costs" 1s; deadline 2.5s admits two
+        # attempts (0s, ~1s) and rejects the third's backoff overshoot
+        now = [0.0]
+
+        def clock():
+            return now[0]
+
+        def failing():
+            now[0] += 1.0
+            raise ConnectionError("dead")
+
+        failures = []
+        with pytest.raises(RetryDeadlineError) as ei:
+            call_with_retry(
+                failing,
+                RetryPolicy(max_attempts=10, base_delay_s=1.0,
+                            max_delay_s=1.0, jitter=0.0, deadline_s=2.5),
+                on_failure=lambda e, a, r: failures.append((a, r)),
+                sleep=lambda s: now.__setitem__(0, now[0] + s),
+                clock=clock,
+            )
+        # attempt 0 retried (1s spent + 1s backoff = 2s <= 2.5), attempt 1
+        # did not (3s spent + 1s backoff > 2.5) — and on_failure was told
+        # the truth both times
+        assert failures == [(0, True), (1, False)]
+        # the last real failure rides along as the cause
+        assert isinstance(ei.value.__cause__, ConnectionError)
+
+    def test_no_deadline_keeps_legacy_behavior(self):
+        failures = []
+        with pytest.raises(ConnectionError):
+            call_with_retry(
+                lambda: (_ for _ in ()).throw(ConnectionError("dead")),
+                RetryPolicy(max_attempts=3, base_delay_s=0.0),
+                on_failure=lambda e, a, r: failures.append((a, r)),
+                sleep=lambda s: None,
+            )
+        assert failures == [(0, True), (1, True), (2, False)]
+
+    def test_deadline_reason_reaches_silo_report(self, registry):
+        """End-to-end conformance: a silo that keeps failing until the
+        deadline budget dies reports reason='deadline' in the broadcast
+        report and the reason-labeled failure counter."""
+        dead = LoopbackServer(lambda b: b)
+        dead.close()  # allocated-then-closed: every dial fails fast
+        # base_delay 10s >> deadline 0.5s: the FIRST backoff would
+        # overshoot, so no wall-clock sleeping happens in this test
+        report = broadcast_round_detailed(
+            [(dead.host, dead.port)], {"w": jnp.zeros(2)}, TEMPLATE,
+            timeout=0.5,
+            retry=RetryPolicy(max_attempts=50, base_delay_s=10.0,
+                              max_delay_s=10.0, jitter=0.0,
+                              deadline_s=0.5),
+        )
+        (res,) = report.results
+        assert not res.ok
+        assert res.reason == "deadline"
+        snap = registry.snapshot()
+        key = f'{{reason="deadline",silo="{dead.host}:{dead.port}"}}'
+        assert snap["transport_rpc_failures_total"][key] >= 1.0
 
 
 def _echo_silos(n, offsets=None, delays=None):

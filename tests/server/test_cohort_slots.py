@@ -65,7 +65,7 @@ def make_datasets(n=4, rows=40, seed0=0):
 def make_sim(n=4, cohort=None, mode="auto", manager=None, strategy=None,
              logic_cls=None, compression=None, state_checkpointer=None,
              local_epochs=1, local_steps=None, seed=5, datasets=None,
-             observability=None):
+             observability=None, fault_plan=None):
     model = engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES))
     if logic_cls is not None:
         logic = logic_cls(model, engine.masked_cross_entropy)
@@ -87,6 +87,7 @@ def make_sim(n=4, cohort=None, mode="auto", manager=None, strategy=None,
         compression=compression,
         state_checkpointer=state_checkpointer,
         observability=observability,
+        fault_plan=fault_plan,
     )
 
 
@@ -281,6 +282,71 @@ class TestCohortResume:
         assert_histories_equal(href, b.history)
         assert np.array_equal(flat(ref.global_params),
                               flat(b.global_params))
+
+    def test_quarantine_bookkeeping_survives_cohort_resume(self, tmp_path):
+        """Quarantine persistence across resume (recovery satellite): the
+        in-graph strike counters and ``release_in`` probation countdown
+        ride the cohort-kind frame's strategy rows, so a run interrupted
+        MID-PROBATION releases the offender on the SAME round as the
+        uninterrupted run — and the final quarantine state matches
+        bit-exactly."""
+        from fl4health_tpu.resilience import ClientFault, FaultPlan
+
+        # probability-1 NaN PACKET fault (the chaos layer's poisoned-wire
+        # attack): the quarantine signals screen packets — a NaN-loss
+        # client would already be masked by the finite-loss screen
+        fault = FaultPlan(seed=5, client_faults=(
+            ClientFault(clients=(2,), kind="nan", probability=1.0),
+        ))
+
+        def build(sc=None, obs=None):
+            return make_sim(
+                cohort=CohortConfig(slots=4),
+                strategy=QuarantiningStrategy(FedAvg(), QuarantinePolicy(
+                    strikes_to_quarantine=2, quarantine_rounds=3,
+                )),
+                fault_plan=fault,
+                state_checkpointer=sc, observability=obs,
+            )
+
+        def run_with_events(builder_sc, rounds, start_sc=None):
+            reg = MetricsRegistry()
+            obs = Observability(enabled=True, registry=reg,
+                                sync_device=False, telemetry=False)
+            sim = build(sc=builder_sc, obs=obs)
+            sim.fit(rounds)
+            released = [
+                (e["round"], tuple(e.get("released") or ()))
+                for e in reg.events if e.get("event") == "quarantine"
+                and e.get("released")
+            ]
+            return sim, released
+
+        ref, ref_released = run_with_events(None, 7)
+        # strikes rounds 1-2 -> quarantined at 2 -> probation 3 rounds ->
+        # released (and immediately re-offending) — the drill needs the
+        # release to land inside the run
+        assert ref_released, "policy must produce a release in 7 rounds"
+
+        a = build(SimulationStateCheckpointer(str(tmp_path), "q"))
+        a.fit(3)  # interrupt MID-probation: release_in is counting down
+        q_mid = jax.device_get(a.server_state.quarantine)
+        assert np.asarray(q_mid.quarantined)[2] == 1.0
+        assert 0 < float(np.asarray(q_mid.release_in)[2]) < 3.0
+
+        b, b_released = run_with_events(
+            SimulationStateCheckpointer(str(tmp_path), "q"), 7
+        )
+        # release lands on the SAME round as the uninterrupted run
+        assert b_released == [r for r in ref_released if r[0] > 3]
+        assert_histories_equal(ref.history, b.history)
+        assert np.array_equal(flat(ref.global_params),
+                              flat(b.global_params))
+        # strike counters / probation countdown / dead streaks bit-equal
+        assert np.array_equal(
+            flat(ref.server_state.quarantine),
+            flat(b.server_state.quarantine),
+        )
 
     def test_sync_frame_rejected_by_cohort_run(self, tmp_path):
         dense = make_sim(

@@ -312,6 +312,64 @@ def test_cli_output_byte_stable_without_resilience_events(tmp_path):
     assert "faults" not in doc and "quarantine" not in doc
 
 
+def test_recovery_table_renders_attempts():
+    events = [
+        {"round": 5, "phase": "engage", "attempt": 1, "rung": "retry",
+         "kind": "training_health", "suspects": [1, 2],
+         "resume_round": 3},
+        {"round": 5, "phase": "engage", "attempt": 2, "rung": "quarantine",
+         "kind": "training_health", "suspects": [1, 2],
+         "resume_round": 4},
+        {"round": 8, "phase": "probation_passed", "healthy_rounds": 3},
+    ]
+    table = perf_report.render_recovery_table(events)
+    lines = table.splitlines()
+    assert lines[0].split() == ["round", "phase", "attempt", "rung",
+                                "kind", "suspects", "resume"]
+    assert lines[2].split() == ["5", "engage", "1", "retry",
+                                "training_health", "1,2", "3"]
+    assert lines[3].split()[3] == "quarantine"
+    assert lines[4].split()[1] == "probation_passed"
+
+
+def test_cli_renders_recovery_table_and_json_keys(tmp_path):
+    path = _log_with_events(
+        tmp_path, [_round(1)],
+        [{"event": "recovery", "round": 1, "phase": "engage",
+          "attempt": 1, "rung": "quarantine", "kind": "client_failures",
+          "suspects": [2], "resume_round": 1}],
+    )
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path)],
+        capture_output=True, text=True, check=True,
+    )
+    assert "rung" in out.stdout and "quarantine" in out.stdout
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), str(path),
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert doc["recovery"][0]["suspects"] == [2]
+    assert doc["recovery"][0]["rung"] == "quarantine"
+
+
+def test_cli_output_byte_stable_without_recovery_events(tmp_path):
+    """Legacy logs (no recovery supervisor) render the exact pre-PR shape:
+    no recovery table, no 'recovery' JSON key."""
+    path = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    )
+    assert "rung" not in out.stdout and "recovery" not in out.stdout
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert "recovery" not in doc
+
+
 def test_wire_columns_render_when_fields_present(tmp_path):
     rounds = [_round(1, gather_bytes_wire=512,
                      wire_compression_ratio=13.1),

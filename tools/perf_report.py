@@ -262,6 +262,13 @@ def load_quarantine_events(path: str) -> list[dict]:
     return _sorted_rounds(load_events(path).get("quarantine", []))
 
 
+def load_recovery_events(path: str) -> list[dict]:
+    """The ``recovery`` supervisor records (resilience/supervisor.py:
+    one ``engage`` per ladder attempt, ``probation_passed``/``halt``
+    transitions), sorted by round."""
+    return _sorted_rounds(load_events(path).get("recovery", []))
+
+
 def _render_generic_table(headers, rows_of_cells) -> str:
     rows = [list(headers)] + [list(r) for r in rows_of_cells]
     widths = [max(len(r[i]) for r in rows) for i in range(len(headers))]
@@ -308,6 +315,33 @@ def render_quarantine_table(events: list[dict]) -> str:
                 str(len(rec.get("active") or [])),
                 _ids(rec.get("entered")),
                 _ids(rec.get("released")),
+            ]
+            for rec in events
+        ),
+    )
+
+
+def render_recovery_table(events: list[dict]) -> str:
+    """Recovery-supervisor attempt table: which rung handled which
+    verdict, who was quarantined, and where the resume restarted.
+    Rendered only when a log carries ``recovery`` events, so legacy logs
+    keep their exact output shape."""
+    def cell(rec, key):
+        v = rec.get(key)
+        return str(v) if v is not None else "-"
+
+    return _render_generic_table(
+        ("round", "phase", "attempt", "rung", "kind", "suspects",
+         "resume"),
+        (
+            [
+                cell(rec, "round"),
+                str(rec.get("phase", "-")),
+                cell(rec, "attempt"),
+                str(rec.get("rung") or "-"),
+                str(rec.get("kind") or rec.get("reason") or "-"),
+                _ids(rec.get("suspects")),
+                cell(rec, "resume_round"),
             ]
             for rec in events
         ),
@@ -542,6 +576,7 @@ def main(argv: list[str] | None = None) -> int:
         programs = _latest_programs(events.get("program", []))
         faults = _sorted_rounds(events.get("fault", []))
         quarantine = _sorted_rounds(events.get("quarantine", []))
+        recovery = _sorted_rounds(events.get("recovery", []))
         sweep_cells = _sorted_sweep_cells(events.get("sweep", []))
         sweep_summary = summarize_sweep(events.get("sweep_summary", []))
         checkpoints = _sorted_rounds(events.get("checkpoint", []))
@@ -585,6 +620,8 @@ def main(argv: list[str] | None = None) -> int:
             doc["faults"] = faults
         if quarantine:
             doc["quarantine"] = quarantine
+        if recovery:
+            doc["recovery"] = recovery
         if sweep_cells:
             doc["sweep"] = sweep_cells
             doc["sweep_summary"] = sweep_summary
@@ -605,6 +642,11 @@ def main(argv: list[str] | None = None) -> int:
     if quarantine:
         print()
         print(render_quarantine_table(quarantine))
+    if recovery:
+        # recovery-supervisor runs only: one row per ladder attempt /
+        # probation transition — legacy logs keep the exact old shape
+        print()
+        print(render_recovery_table(recovery))
     if sweep_cells:
         # scenario-sweep runs only: the leaderboard rides along — legacy
         # logs keep the exact old output shape (byte-stable, tested)

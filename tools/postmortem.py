@@ -26,12 +26,9 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
 from typing import Any
-
-import numpy as np
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
@@ -42,7 +39,15 @@ if _TOOLS not in sys.path:
 
 import perf_report  # noqa: E402  (the shared table machinery)
 
-DIVERGENCE_FACTOR = 2.0
+# THE scoring (resilience/suspects.py) — shared with the in-process
+# RecoverySupervisor so the machine quarantines exactly the clients this
+# report would have named
+from fl4health_tpu.resilience.suspects import (  # noqa: E402
+    DIVERGENCE_FACTOR,
+    client_ids_for_entry as _client_ids,
+    detect_divergence_onset,
+    rank_suspects,
+)
 
 
 def ring_round_rows(ring: list[dict]) -> list[dict]:
@@ -58,126 +63,6 @@ def ring_round_rows(ring: list[dict]) -> list[dict]:
             row["eval_loss"] = entry["eval_loss"]
         rows.append(row)
     return sorted(rows, key=lambda r: r.get("round", 0))
-
-
-def detect_divergence_onset(ring: list[dict],
-                            factor: float = DIVERGENCE_FACTOR) -> dict | None:
-    """First recorded round whose training loss exceeded ``factor`` x the
-    best loss seen earlier IN THE RING (the black box only holds the tail,
-    so onset may predate the window — the report says so)."""
-    best = math.inf
-    for entry in sorted(ring, key=lambda e: e.get("round", 0)):
-        loss = entry.get("fit_loss")
-        if loss is None or not math.isfinite(float(loss)):
-            # a non-finite aggregate IS the onset
-            if loss is not None:
-                return {"round": int(entry["round"]), "loss": float(loss),
-                        "best": (None if best is math.inf else best),
-                        "reason": "non-finite aggregate training loss"}
-            continue
-        loss = float(loss)
-        if best is not math.inf and loss > factor * best:
-            return {"round": int(entry["round"]), "loss": loss, "best": best,
-                    "reason": f"loss > {factor}x ring best"}
-        best = min(best, loss)
-    return None
-
-
-def _client_ids(entry: dict) -> np.ndarray:
-    """Registry ids for the entry's per-client vectors (cohort runs store
-    them; dense runs fall back to positional ids)."""
-    ids = entry.get("registry_ids")
-    tele = entry.get("telemetry") or {}
-    n = 0
-    for v in tele.values():
-        v = np.asarray(v)
-        if v.ndim >= 1:
-            n = max(n, v.shape[0])
-    mask = entry.get("mask")
-    if mask is not None:
-        n = max(n, np.asarray(mask).shape[0])
-    if ids is not None:
-        return np.asarray(ids)[:n] if n else np.asarray(ids)
-    return np.arange(n)
-
-
-def rank_suspects(ring: list[dict], top: int = 5) -> list[dict]:
-    """Score every client the ring saw, by REGISTRY id. Signals (each
-    normalized across the participating cohort per round, then summed over
-    the ring): non-finite counts (dominant), grad-norm and update-norm
-    outlier z-scores, quarantine strikes, consumed-update staleness above
-    the round mean. Higher = more suspect."""
-    scores: dict[int, float] = {}
-    evidence: dict[int, list[str]] = {}
-
-    def bump(cid: int, amount: float, why: str | None = None):
-        cid = int(cid)
-        scores[cid] = scores.get(cid, 0.0) + float(amount)
-        if why:
-            evidence.setdefault(cid, []).append(why)
-
-    for entry in sorted(ring, key=lambda e: e.get("round", 0)):
-        rnd = int(entry.get("round", 0))
-        ids = _client_ids(entry)
-        if ids.size == 0:
-            continue
-        mask = entry.get("mask")
-        part = (np.asarray(mask)[:ids.size] > 0 if mask is not None
-                else np.ones(ids.size, bool))
-        tele = entry.get("telemetry") or {}
-
-        nonfinite = np.zeros(ids.size)
-        for key in ("nonfinite_loss", "nonfinite_params",
-                    "nonfinite_eval_loss"):
-            v = tele.get(key)
-            if v is not None:
-                nonfinite[:len(v)] += np.nan_to_num(
-                    np.asarray(v, np.float64)[:ids.size], nan=1.0
-                )
-        for i in np.nonzero((nonfinite > 0) & part)[0]:
-            bump(ids[i], 10.0, f"non-finite state in round {rnd}")
-
-        for key, label in (("grad_norm_mean", "grad norm"),
-                           ("update_norm", "update norm")):
-            v = tele.get(key)
-            if v is None:
-                continue
-            v = np.asarray(v, np.float64)[:ids.size]
-            live = part & np.isfinite(v)
-            if live.sum() >= 3:
-                mu, sd = float(v[live].mean()), float(v[live].std())
-                if sd > 0:
-                    z = (v - mu) / sd
-                    for i in np.nonzero(live & (z > 2.0))[0]:
-                        bump(ids[i], float(z[i]),
-                             f"{label} {v[i]:.3g} is {z[i]:.1f} sigma above "
-                             f"the round-{rnd} cohort mean")
-
-        q = entry.get("quarantine")
-        if q is not None:
-            q = np.asarray(q, np.float64)[:ids.size]
-            for i in np.nonzero(q > 0)[0]:
-                bump(ids[i], 3.0, f"quarantined in round {rnd}")
-        for cid in entry.get("quarantine_active") or []:
-            bump(cid, 1.0)
-
-        stale = tele.get("staleness")
-        if stale is not None:
-            v = np.asarray(stale, np.float64)[:ids.size]
-            live = part & np.isfinite(v)
-            if live.any():
-                mu = float(v[live].mean())
-                for i in np.nonzero(live & (v > mu + 2))[0]:
-                    bump(ids[i], 1.0,
-                         f"staleness {v[i]:.0f} in round {rnd} "
-                         f"(round mean {mu:.1f})")
-
-    ranked = sorted(scores.items(), key=lambda kv: -kv[1])
-    return [
-        {"client": cid, "score": round(s, 3),
-         "evidence": evidence.get(cid, [])[:4]}
-        for cid, s in ranked[:top] if s > 0
-    ]
 
 
 def wire_stats(ring: list[dict]) -> dict:
