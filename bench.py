@@ -563,6 +563,92 @@ def timed_flightrec_overhead(sim) -> dict:
     }
 
 
+def timed_fleet_overhead(sim, timing: bool = True) -> dict:
+    """Fleet-ledger block (fleet-telescope PR acceptance metric): per-round
+    wall of the REAL ``fit()`` driver loop with the per-client lifetime
+    ledger off vs on (the default), plus the ledger's host footprint after
+    a registry-scale synthetic absorb.
+
+    The footprint number is pure host work (no device, no compile) so it
+    always lands — on the CPU fallback only the timing arms come back
+    null. The ledger stores O(participated) records and registry-size-
+    invariant sketches, so ``ledger_bytes_at_N`` tracks the SAMPLED
+    population, not the 100k registry it is drawn from."""
+    import numpy as np
+
+    from fl4health_tpu.observability import (
+        MetricsRegistry,
+        Observability,
+        Tracer,
+    )
+    from fl4health_tpu.observability.fleet import FleetLedger
+
+    synth_rounds, synth_k, synth_registry = 256, 64, 100_000
+    rng = np.random.default_rng(0)
+    ledger = FleetLedger()
+    for rnd in range(1, synth_rounds + 1):
+        ids = rng.choice(synth_registry, size=synth_k, replace=False)
+        ledger.absorb_round(
+            rnd, ids,
+            losses=rng.random(synth_k),
+            staleness_pool=rng.integers(0, 8, synth_k),
+            registry_size=synth_registry,
+        )
+    out: dict = {
+        "ledger_bytes_at_N": int(ledger.nbytes()),
+        "synthetic": {
+            "rounds": synth_rounds,
+            "participants_per_round": synth_k,
+            "registry_size": synth_registry,
+            "clients_seen": len(ledger),
+        },
+        "round_s_plain": None,
+        "round_s_fleet": None,
+        "overhead_pct": None,
+        "rounds": TIMED_ROUNDS,
+    }
+    if not timing:
+        return out
+
+    prev_obs = sim.observability
+    prev_mode = sim.execution_mode
+    # pipelined: the mode whose consumer-thread epilogue hosts the absorb
+    # (the chunked scan would amortize it invisibly)
+    sim.execution_mode = "pipelined"
+
+    def arm(fleet: bool) -> float:
+        obs = Observability(
+            enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+            sync_device=False, flight_recorder=False, fleet_ledger=fleet,
+        )
+        sim.observability = obs
+        try:
+            sim._build_compiled()
+            sim.fit(1)  # warmup: every program fit() touches is compiled
+            t0 = time.perf_counter()
+            sim.fit(TIMED_ROUNDS)
+            return (time.perf_counter() - t0) / TIMED_ROUNDS
+        finally:
+            obs.shutdown()
+
+    try:
+        plain_s = arm(False)
+        fleet_s = arm(True)
+    finally:
+        sim.observability = prev_obs
+        sim.execution_mode = prev_mode
+        sim._build_compiled()
+    out.update(
+        round_s_plain=round(plain_s, 5),
+        round_s_fleet=round(fleet_s, 5),
+        overhead_pct=(
+            round(100.0 * (fleet_s - plain_s) / plain_s, 2)
+            if plain_s > 0 else None
+        ),
+    )
+    return out
+
+
 def timed_resilience_overhead(sim) -> dict:
     """Device cost of Byzantine-robust aggregation (resilience PR
     acceptance metric): per-round time of the compiled fit round under the
@@ -1414,6 +1500,18 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
         and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
     ):
         out["flightrec_overhead"] = timed_flightrec_overhead(sim)
+    # Fleet-ledger host cost + registry-scale footprint (fleet-telescope
+    # PR acceptance metric). FL4HEALTH_BENCH_FLEET=1 forces the full
+    # block, =0 disables it; "auto" always lands the exact host footprint
+    # numbers (pure-host synthetic absorb) but nulls the fit-wall timing
+    # arms on the CPU fallback, like the compression block.
+    want_fl = os.environ.get("FL4HEALTH_BENCH_FLEET", "auto")
+    if want_fl != "0":
+        fl_timing = want_fl == "1" or (
+            want_fl == "auto"
+            and not os.environ.get("FL4HEALTH_BENCH_FORCE_CPU")
+        )
+        out["fleet_overhead"] = timed_fleet_overhead(sim, timing=fl_timing)
     # Robust-aggregator round time vs the plain weighted mean (resilience
     # PR acceptance metric). Same gating shape: FL4HEALTH_BENCH_RESILIENCE
     # =1 forces, =0 disables, "auto" skips only the CPU fallback. Runs

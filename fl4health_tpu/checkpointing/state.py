@@ -563,22 +563,30 @@ class SimulationStateCheckpointer(StateCheckpointer):
 
     def save_simulation_snapshot(
         self, trees, current_round: int, n_clients: int, history,
-        writer=None,
+        writer=None, fleet=None,
     ) -> None:
         """Persist an explicit state snapshot — the pipelined round loop's
         entry point. ``trees`` must be caller-owned copies (host numpy under
         the async pipeline: the live device buffers may be donated into the
         next round before the write runs). With ``writer`` (an
         ``AsyncCheckpointWriter``) the serialize+write happens off-thread;
-        saves stay ordered because the writer is single-worker."""
+        saves stay ordered because the writer is single-worker.
+
+        ``fleet`` (optional): the fleet ledger's JSON snapshot
+        (``observability/fleet.py``), captured at call time so the async
+        writer serializes a stable copy. Stored in the host header only
+        when present — ledger-off frames are byte-identical to legacy."""
+        host = {
+            "kind": "sync",
+            "current_round": current_round,
+            "n_clients": n_clients,
+            "history": list(history),
+        }
+        if fleet is not None:
+            host["fleet"] = fleet
         kwargs = dict(
             trees=dict(trees),
-            host={
-                "kind": "sync",
-                "current_round": current_round,
-                "n_clients": n_clients,
-                "history": list(history),
-            },
+            host=host,
             snapshotters={"history": DataclassListSnapshotter()},
             extra_meta={"round": current_round, "kind": "sync"},
         )
@@ -590,21 +598,26 @@ class SimulationStateCheckpointer(StateCheckpointer):
     def save_async_snapshot(
         self, trees, event: int, n_clients: int, history,
         plan_fingerprint: str, virtual_time_s: float, writer=None,
+        fleet=None,
     ) -> None:
         """Persist a buffered-async snapshot: server state, client stack
         AND the in-flight ``pending`` update buffer, with the event cursor,
         virtual clock, and the fingerprint of the event plan's consumed
-        prefix (``server.async_schedule.plan_fingerprint``)."""
+        prefix (``server.async_schedule.plan_fingerprint``). ``fleet``:
+        see :meth:`save_simulation_snapshot`."""
+        host = {
+            "kind": "async",
+            "current_event": event,
+            "n_clients": n_clients,
+            "history": list(history),
+            "plan_fingerprint": plan_fingerprint,
+            "virtual_time_s": float(virtual_time_s),
+        }
+        if fleet is not None:
+            host["fleet"] = fleet
         kwargs = dict(
             trees=dict(trees),
-            host={
-                "kind": "async",
-                "current_event": event,
-                "n_clients": n_clients,
-                "history": list(history),
-                "plan_fingerprint": plan_fingerprint,
-                "virtual_time_s": float(virtual_time_s),
-            },
+            host=host,
             snapshotters={"history": DataclassListSnapshotter()},
             extra_meta={"round": event, "kind": "async"},
         )
@@ -615,7 +628,7 @@ class SimulationStateCheckpointer(StateCheckpointer):
 
     def save_cohort_snapshot(
         self, trees, current_round: int, slots: int, registry_size: int,
-        registry_rows: dict, history, writer=None,
+        registry_rows: dict, history, writer=None, fleet=None,
     ) -> None:
         """Persist a cohort-slot snapshot: the [slots]-shaped server/client
         state trees PLUS the registry's dirty rows (``ClientRegistry.
@@ -623,7 +636,8 @@ class SimulationStateCheckpointer(StateCheckpointer):
         ``TrainState`` and strategy rows, keyed by the registry ids stored
         in the frame header. ``n_clients`` in the header is the SLOT count
         (the restore template's shape); ``registry_size`` binds the frame
-        to its client population."""
+        to its client population. ``fleet``: see
+        :meth:`save_simulation_snapshot`."""
         trees = dict(trees)
         c_ids = registry_rows.get("client_ids")
         s_ids = registry_rows.get("strategy_ids")
@@ -631,21 +645,24 @@ class SimulationStateCheckpointer(StateCheckpointer):
             trees["registry_client_rows"] = registry_rows["client_rows"]
         if registry_rows.get("strategy_rows") is not None:
             trees["registry_strategy_rows"] = registry_rows["strategy_rows"]
+        host = {
+            "kind": "cohort",
+            "current_round": current_round,
+            "n_clients": slots,
+            "registry_size": registry_size,
+            "registry_client_ids": [
+                int(i) for i in (c_ids if c_ids is not None else ())
+            ],
+            "registry_strategy_ids": [
+                int(i) for i in (s_ids if s_ids is not None else ())
+            ],
+            "history": list(history),
+        }
+        if fleet is not None:
+            host["fleet"] = fleet
         kwargs = dict(
             trees=trees,
-            host={
-                "kind": "cohort",
-                "current_round": current_round,
-                "n_clients": slots,
-                "registry_size": registry_size,
-                "registry_client_ids": [
-                    int(i) for i in (c_ids if c_ids is not None else ())
-                ],
-                "registry_strategy_ids": [
-                    int(i) for i in (s_ids if s_ids is not None else ())
-                ],
-                "history": list(history),
-            },
+            host=host,
             snapshotters={"history": DataclassListSnapshotter()},
             extra_meta={"round": current_round, "kind": "cohort"},
         )
@@ -702,8 +719,17 @@ class SimulationStateCheckpointer(StateCheckpointer):
         sim.history = DataclassListSnapshotter().load(
             header.get("history"), self._history_template()
         )
+        self._adopt_fleet(sim, header)
         self.last_restore_info = info
         return int(header["current_round"]) + 1
+
+    @staticmethod
+    def _adopt_fleet(sim, header: dict) -> None:
+        """Hand the frame's fleet-ledger snapshot (or None for a legacy
+        frame, which clears the ledger) to the simulation — resumed and
+        rolled-back runs re-absorb replayed rounds exactly once."""
+        if hasattr(sim, "adopt_fleet_snapshot"):
+            sim.adopt_fleet_snapshot(header.get("fleet"))
 
     def _history_template(self):
         from fl4health_tpu.server.simulation import RoundRecord
@@ -749,6 +775,7 @@ class SimulationStateCheckpointer(StateCheckpointer):
         sim.history = DataclassListSnapshotter().load(
             header.get("history"), self._history_template()
         )
+        self._adopt_fleet(sim, header)
         self.last_restore_info = info
         return int(header["current_round"]) + 1
 
@@ -801,6 +828,7 @@ class SimulationStateCheckpointer(StateCheckpointer):
         sim.history = DataclassListSnapshotter().load(
             header.get("history"), self._history_template()
         )
+        self._adopt_fleet(sim, header)
         self.last_restore_info = info
         return event + 1
 

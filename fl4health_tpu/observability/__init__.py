@@ -48,6 +48,7 @@ import os
 from typing import Any
 
 from fl4health_tpu.observability.exposition import ScrapeServer
+from fl4health_tpu.observability.fleet import FleetLedger
 from fl4health_tpu.observability.flightrec import (
     DEFAULT_WINDOW,
     FlightRecorder,
@@ -84,9 +85,18 @@ from fl4health_tpu.observability.spans import (
     get_tracer,
     set_tracer,
 )
+from fl4health_tpu.observability.tracectx import (
+    TraceContext,
+    flow_id,
+    traced_handler,
+)
 
 __all__ = [
     "Observability",
+    "FleetLedger",
+    "TraceContext",
+    "flow_id",
+    "traced_handler",
     "FlightRecorder",
     "SigtermShutdown",
     "trap_sigterm",
@@ -169,6 +179,7 @@ class Observability:
         http_host: str = "127.0.0.1",
         flight_recorder: "bool | FlightRecorder" = True,
         flightrec_window: int | None = None,
+        fleet_ledger: "bool | FleetLedger" = True,
     ):
         self.enabled = enabled
         self.output_dir = output_dir
@@ -196,6 +207,16 @@ class Observability:
             )
         else:
             self.flight_recorder = None
+        # Fleet ledger (observability/fleet.py): per-client LIFETIME
+        # records at O(participated) host memory, same always-on/zero-sync
+        # contract as the flight recorder. Rides the checkpoint frames via
+        # the simulation (not here), and backs /fleet + /clients/<id>.
+        if isinstance(fleet_ledger, FleetLedger):
+            self.fleet_ledger: FleetLedger | None = fleet_ledger
+        elif fleet_ledger:
+            self.fleet_ledger = FleetLedger()
+        else:
+            self.fleet_ledger = None
         self._unhealthy: str | None = None
         self.introspector = ProgramIntrospector(self.registry)
         self._manifest: dict[str, Any] = {}
@@ -265,12 +286,21 @@ class Observability:
             if self.http_port is not None and self._scrape_server is None:
                 # live pull endpoint for the armed lifetime of the handle —
                 # a scrape reads host-side floats only (no device work)
+                ledger = self.fleet_ledger
                 self._scrape_server = ScrapeServer(
                     self.registry,
                     manifest_provider=lambda: dict(self._manifest),
                     host=self.http_host,
                     port=self.http_port,
                     health_provider=lambda: self._unhealthy,
+                    fleet_provider=(
+                        (lambda: ledger.summary()) if ledger is not None
+                        else None
+                    ),
+                    client_provider=(
+                        (lambda cid: ledger.get(cid)) if ledger is not None
+                        else None
+                    ),
                 )
         return self
 
@@ -309,6 +339,8 @@ class Observability:
             tracer=self.tracer if self.tracer.enabled else None,
             registry=self.registry,
             manifest=self._manifest or None,
+            fleet=(self.fleet_ledger.snapshot()
+                   if self.fleet_ledger is not None else None),
         )
         self.mark_unhealthy(
             f"{verdict.get('kind', 'exception')}: "

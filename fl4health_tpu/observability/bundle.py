@@ -19,6 +19,8 @@ atomically-renamed directory:
                          history rides along as events.*.jsonl.gz when the
                          registry archives evicted segments)
       metrics.prom       a final Prometheus scrape of the registry
+      fleet.json         the fleet ledger's lifetime snapshot (per-client
+                         records + sketches), when a ledger was armed
       verdict.json       what killed the run: kind, round, clients (REGISTRY
                          ids under cohort-slot execution), check, message,
                          per-silo outcomes for quorum failures, and the
@@ -53,6 +55,7 @@ TRACE_FILE = "trace.json"
 EVENTS_FILE = "events.tail.jsonl"
 METRICS_FILE = "metrics.prom"
 MANIFEST_FILE = "manifest.json"
+FLEET_FILE = "fleet.json"
 
 
 def _jsonable(obj: Any) -> Any:
@@ -183,6 +186,7 @@ def verdict_from_exception(exc: BaseException, recorder=None) -> dict:
 def dump_bundle(out_dir: str, verdict: Mapping[str, Any], *,
                 recorder=None, tracer=None, registry=None,
                 manifest: Mapping[str, Any] | None = None,
+                fleet: Mapping[str, Any] | None = None,
                 timestamp: float | None = None) -> str:
     """Assemble and atomically publish one ``postmortem_<ts>/`` directory
     under ``out_dir``; returns its path. Never raises into the caller's
@@ -221,6 +225,12 @@ def dump_bundle(out_dir: str, verdict: Mapping[str, Any], *,
             with atomic_write(os.path.join(tmp, MANIFEST_FILE)) as f:
                 json.dump(_jsonable(dict(manifest)), f, indent=2,
                           default=str)
+        if fleet:
+            # the fleet ledger's lifetime snapshot (observability/fleet.py)
+            # — repeat-offender evidence for the suspect ranking, beyond
+            # the ring's 16-round window
+            with atomic_write(os.path.join(tmp, FLEET_FILE)) as f:
+                json.dump(_jsonable(dict(fleet)), f, default=str)
         if tracer is not None:
             # a COMPLETE Chrome trace envelope, whatever state the live
             # stream file is in — the bundle's copy always json.load()s
@@ -293,6 +303,11 @@ def load_bundle(path: str) -> dict:
     if os.path.exists(mpath):
         with open(mpath) as f:
             out["manifest"] = json.load(f)
+    fpath = os.path.join(path, FLEET_FILE)
+    out["fleet"] = None
+    if os.path.exists(fpath):
+        with open(fpath) as f:
+            out["fleet"] = json.load(f)
     out["events"] = []
     epath = os.path.join(path, EVENTS_FILE)
     archives = sorted(glob.glob(os.path.join(path, "*.jsonl.gz")))

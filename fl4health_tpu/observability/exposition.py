@@ -16,7 +16,14 @@ mid-run by an actual Prometheus (or ``curl``):
   unhealthy (a watchdog halt or a postmortem bundle dump —
   ``Observability.mark_unhealthy``), with the verdict summary as the
   body, so an orchestrator's health check stops reporting a run healthy
-  mid-``TrainingHealthError`` teardown.
+  mid-``TrainingHealthError`` teardown;
+- ``GET /fleet``    — fleet-ledger summary JSON
+  (``observability/fleet.py``): clients seen, participation skew (gini),
+  loss/staleness/participation-gap distributions from the streaming
+  sketches, quarantine standing, top-k stragglers and suspects;
+- ``GET /clients/<id>`` — one client's lifetime record by REGISTRY id
+  (participation count, last-seen round, EMAs, quarantine strikes, wire
+  bytes), 404 for a client the ledger has never seen.
 
 Zero third-party deps (zero-egress box) and zero cost on the round hot
 path: a scrape reads host-side floats under the registry lock — it never
@@ -48,6 +55,9 @@ class ScrapeServer:
     ``health_provider`` is called per ``/healthz`` request and returns
     None while healthy, or a verdict-summary string once the run halted —
     the endpoint then answers 503 with that summary as the body.
+    ``fleet_provider``/``client_provider`` back ``/fleet`` and
+    ``/clients/<id>``; without them those routes answer 404 like any
+    unknown path (a server without a ledger has no fleet to serve).
     """
 
     def __init__(
@@ -57,10 +67,14 @@ class ScrapeServer:
         host: str = "127.0.0.1",
         port: int = 0,
         health_provider: Callable[[], str | None] | None = None,
+        fleet_provider: Callable[[], dict[str, Any]] | None = None,
+        client_provider: "Callable[[int], dict[str, Any] | None] | None" = None,
     ):
         registry_ref = registry
         provider = manifest_provider
         health = health_provider
+        fleet = fleet_provider
+        client_lookup = client_provider
 
         class Handler(BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes, ctype: str) -> None:
@@ -86,6 +100,29 @@ class ScrapeServer:
                     else:
                         body = f"unhealthy: {verdict}\n".encode("utf-8")
                         self._send(503, body, "text/plain; charset=utf-8")
+                elif path == "/fleet" and fleet is not None:
+                    self._send(
+                        200,
+                        json.dumps(fleet(), default=str).encode(),
+                        "application/json",
+                    )
+                elif (path.startswith("/clients/")
+                      and client_lookup is not None):
+                    raw = path[len("/clients/"):]
+                    try:
+                        cid = int(raw)
+                    except ValueError:
+                        self._send(400, b"client id must be an integer\n",
+                                   "text/plain; charset=utf-8")
+                        return
+                    doc = client_lookup(cid)
+                    if doc is None:
+                        self._send(404, b"unknown client\n",
+                                   "text/plain; charset=utf-8")
+                    else:
+                        self._send(200,
+                                   json.dumps(doc, default=str).encode(),
+                                   "application/json")
                 else:
                     self._send(404, b"not found\n",
                                "text/plain; charset=utf-8")

@@ -134,13 +134,61 @@ class Tracer:
     def __init__(self, enabled: bool = True, process_name: str = "fl4health_tpu"):
         self.enabled = enabled
         self.process_name = process_name
+        # Two clocks sampled back-to-back: event timestamps stay on the
+        # monotonic clock (cheap, never steps backwards), while the wall
+        # anchor lets tools/trace_merge.py place this process's ts=0 on a
+        # cross-process wall-clock axis.
         self._t0_ns = time.perf_counter_ns()
+        self._wall0_ns = time.time_ns()
         self._events: list[dict] = []
         self._lock = threading.Lock()
         self._local = threading.local()
+        self._thread_names: dict[int, str] = {}
         self._stream = None
         self._stream_path: str | None = None
         self._atexit_registered = False
+
+    # -- cross-process metadata ------------------------------------------
+    @property
+    def wall0_ns(self) -> int:
+        """Wall-clock time (``time.time_ns()``) at tracer construction —
+        the instant all event ``ts`` values are relative to."""
+        return self._wall0_ns
+
+    def set_process_name(self, name: str) -> None:
+        """Rename the process lane (e.g. ``coordinator`` vs ``silo:1``)
+        shown in Perfetto. Takes effect in subsequent exports; a live
+        stream gets a fresh ``process_name`` metadata event immediately."""
+        self.process_name = name
+        evt = {
+            "name": "process_name", "ph": "M", "pid": os.getpid(),
+            "tid": 0, "args": {"name": name},
+        }
+        with self._lock:
+            self._stream_event(evt)
+
+    def _clock_sync_event(self) -> dict:
+        # a pinned instant at ts=0 carrying the wall anchor; trace_merge
+        # shifts each process's events by the wall delta between anchors
+        return {
+            "name": "clock_sync", "cat": "__metadata", "ph": "i", "s": "p",
+            "ts": 0.0, "pid": os.getpid(), "tid": 0,
+            "args": {"wall_ns": self._wall0_ns},
+        }
+
+    def _thread_meta_locked(self, tid: int) -> None:
+        # caller holds self._lock; first sighting of a thread emits its
+        # thread_name metadata event so merged timelines label lanes
+        if tid in self._thread_names:
+            return
+        name = threading.current_thread().name
+        self._thread_names[tid] = name
+        evt = {
+            "name": "thread_name", "ph": "M", "pid": os.getpid(),
+            "tid": tid, "args": {"name": name},
+        }
+        self._events.append(evt)
+        self._stream_event(evt)
 
     # -- crash-safe streaming -------------------------------------------
     def stream_to(self, path: str) -> str | None:
@@ -164,6 +212,7 @@ class Tracer:
                 "name": "process_name", "ph": "M", "pid": os.getpid(),
                 "tid": 0, "args": {"name": self.process_name},
             }) + ",\n")
+            self._stream.write(json.dumps(self._clock_sync_event()) + ",\n")
             self._stream.flush()
             # replay whatever was recorded before the stream opened, so a
             # tracer enabled earlier than Observability.start() loses
@@ -228,12 +277,39 @@ class Tracer:
         if not self.enabled:
             return
         ts = (time.perf_counter_ns() - self._t0_ns) / 1000.0
+        tid = threading.get_ident()
         evt = {
             "name": name, "cat": cat, "ph": "i", "s": "t",
-            "ts": ts, "pid": os.getpid(), "tid": threading.get_ident(),
+            "ts": ts, "pid": os.getpid(), "tid": tid,
             "args": dict(args),
         }
         with self._lock:
+            self._thread_meta_locked(tid)
+            self._events.append(evt)
+            self._stream_event(evt)
+
+    def flow(self, ph: str, name: str, flow_id: int,
+             cat: str = "flow", **args: Any) -> None:
+        """A Chrome flow event: ``ph`` is ``"s"`` (start), ``"t"`` (step)
+        or ``"f"`` (end). Events sharing ``flow_id`` are drawn as arrows
+        between the slices that enclose them — across threads in one
+        trace, and across processes once ``tools/trace_merge.py`` has put
+        the traces on a shared clock."""
+        if not self.enabled:
+            return
+        if ph not in ("s", "t", "f"):
+            raise ValueError(f"flow ph must be 's'/'t'/'f', got {ph!r}")
+        ts = (time.perf_counter_ns() - self._t0_ns) / 1000.0
+        tid = threading.get_ident()
+        evt = {
+            "name": name, "cat": cat, "ph": ph, "id": flow_id,
+            "ts": ts, "pid": os.getpid(), "tid": tid,
+            "args": dict(args),
+        }
+        if ph == "f":
+            evt["bp"] = "e"  # bind to the enclosing slice, not the next one
+        with self._lock:
+            self._thread_meta_locked(tid)
             self._events.append(evt)
             self._stream_event(evt)
 
@@ -242,16 +318,19 @@ class Tracer:
         if not self.enabled:
             return
         ts = (time.perf_counter_ns() - self._t0_ns) / 1000.0
+        tid = threading.get_ident()
         evt = {
             "name": name, "cat": "counter", "ph": "C",
-            "ts": ts, "pid": os.getpid(), "tid": threading.get_ident(),
+            "ts": ts, "pid": os.getpid(), "tid": tid,
             "args": {k: float(v) for k, v in series.items()},
         }
         with self._lock:
+            self._thread_meta_locked(tid)
             self._events.append(evt)
             self._stream_event(evt)
 
     def _record(self, name, cat, start_ns, end_ns, depth, args) -> None:
+        tid = threading.get_ident()
         evt = {
             "name": name,
             "cat": cat,
@@ -259,10 +338,11 @@ class Tracer:
             "ts": (start_ns - self._t0_ns) / 1000.0,
             "dur": (end_ns - start_ns) / 1000.0,
             "pid": os.getpid(),
-            "tid": threading.get_ident(),
+            "tid": tid,
             "args": {**args, "depth": depth},
         }
         with self._lock:
+            self._thread_meta_locked(tid)
             self._events.append(evt)
             self._stream_event(evt)
 
@@ -285,7 +365,9 @@ class Tracer:
             "name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
             "args": {"name": self.process_name},
         }
-        return {"traceEvents": [meta, *self.events], "displayTimeUnit": "ms"}
+        sync = self._clock_sync_event()
+        return {"traceEvents": [meta, sync, *self.events],
+                "displayTimeUnit": "ms"}
 
     def export(self, path: str) -> str:
         """Atomically write the trace JSON (a crash mid-dump never leaves a

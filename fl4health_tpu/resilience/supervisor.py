@@ -581,8 +581,14 @@ class RecoverySupervisor:
         (both sources already translate)."""
         from fl4health_tpu.resilience.suspects import rank_suspects
 
+        # fleet-ledger priors (observability/fleet.py): repeat offenders
+        # on the lifetime record outrank first-time suspects with equal
+        # window evidence — quarantine lands on the chronic client first
+        ledger = (getattr(self._obs, "fleet_ledger", None)
+                  if self._obs is not None else None)
         ranked = rank_suspects(self._ring_entries(),
-                               top=max(self.policy.max_suspects * 2, 8))
+                               top=max(self.policy.max_suspects * 2, 8),
+                               ledger=ledger)
         out: list[int] = []
         for c in verdict.get("clients") or []:
             c = int(c)

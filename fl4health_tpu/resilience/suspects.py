@@ -77,13 +77,41 @@ def detect_divergence_onset(ring: list[dict],
     return None
 
 
-def rank_suspects(ring: list[dict], top: int = 5) -> list[dict]:
+def _ledger_records(ledger) -> "dict[int, dict]":
+    """Per-client lifetime docs from a live
+    :class:`~fl4health_tpu.observability.fleet.FleetLedger` or its
+    ``snapshot()`` dict (what a postmortem bundle's ``fleet.json``
+    holds). Tolerant: anything unrecognizable yields no priors."""
+    if ledger is None:
+        return {}
+    snap = ledger.snapshot() if hasattr(ledger, "snapshot") else ledger
+    if not isinstance(snap, dict):
+        return {}
+    out: dict[int, dict] = {}
+    for doc in snap.get("clients") or []:
+        try:
+            out[int(doc["client_id"])] = doc
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
+def rank_suspects(ring: list[dict], top: int = 5,
+                  ledger=None) -> list[dict]:
     """Score every client the ring saw, by REGISTRY id. Signals (each
     normalized across the participating cohort per round, then summed over
     the ring): non-finite counts (dominant), grad-norm and update-norm
     outlier z-scores, quarantine strikes, consumed-update staleness above
     the round mean. Higher = more suspect. Returns
-    ``[{client, score, evidence}, ...]`` most-suspect first."""
+    ``[{client, score, evidence}, ...]`` most-suspect first.
+
+    ``ledger`` (a live fleet ledger or its snapshot dict) adds a bounded
+    repeat-offender prior: a client the WINDOW already implicated whose
+    lifetime record shows prior non-finite rounds / quarantine strikes /
+    injected faults gets up to +5.0, so between two equally-suspicious
+    clients in the ring the one with history ranks first. Lifetime
+    history alone never creates a suspect — the flight window carries the
+    incident evidence, the ledger only breaks ties."""
     scores: dict[int, float] = {}
     evidence: dict[int, list[str]] = {}
 
@@ -157,6 +185,28 @@ def rank_suspects(ring: list[dict], top: int = 5) -> list[dict]:
                     bump(ids[i], 1.0,
                          f"staleness {v[i]:.0f} in round {rnd} "
                          f"(round mean {mu:.1f})")
+
+    records = _ledger_records(ledger)
+    if records:
+        for cid in list(scores):
+            if scores[cid] <= 0:
+                continue
+            doc = records.get(cid)
+            if not doc:
+                continue
+            # lifetime suspect weight on the ledger's own scale
+            # (observability/fleet.py ClientRecord.suspect_score), clamped
+            # so history amplifies window evidence but cannot outvote it
+            lifetime = (4.0 * float(doc.get("nonfinite_rounds") or 0)
+                        + 3.0 * float(doc.get("quarantine_strikes") or 0)
+                        + 2.0 * float(doc.get("fault_rounds") or 0)
+                        + 1.0 * float(doc.get("failed_rounds") or 0))
+            if lifetime > 0:
+                prior = min(5.0, 0.5 * lifetime)
+                bump(cid, prior,
+                     f"repeat offender on the fleet ledger "
+                     f"(lifetime suspect weight {lifetime:.0f} over "
+                     f"{int(doc.get('rounds_participated') or 0)} rounds)")
 
     ranked = sorted(scores.items(), key=lambda kv: -kv[1])
     return [

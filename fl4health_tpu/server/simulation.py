@@ -2013,6 +2013,12 @@ class FederatedSimulation:
         self._init_states()
         self.history = []
         self._async_pending = None
+        # from-scratch rollback: lifetime records of the abandoned
+        # trajectory's rounds must not survive into the replay (they
+        # would double-count participation)
+        ledger = self.observability.fleet_ledger
+        if ledger is not None:
+            ledger.clear()
 
     def _apply_recovery_keep(self, mask, rnd: int):
         """Multiply a round's sampling mask by the recovery supervisor's
@@ -2041,6 +2047,12 @@ class FederatedSimulation:
         flight = obs.flight_recorder if obs.enabled else None
         if flight is not None:
             flight.clear()  # the black box records THIS run only
+        fleet = obs.fleet_ledger if obs.enabled else None
+        if fleet is not None:
+            # fresh fit(): the ledger starts empty; _maybe_resume below
+            # restores the checkpointed as-of state when resuming, so
+            # re-run rounds absorb exactly once
+            fleet.clear()
         self._last_epilogue_round = None  # per-run (RoundConsumer progress)
         mode, mode_reason = self._select_execution_mode(n_rounds)
         self._active_execution_mode = mode
@@ -3069,8 +3081,20 @@ class FederatedSimulation:
             eval_elapsed_s=work.eval_elapsed_s,
         )
         self.history.append(rec)
+        # fleet-ledger absorb BEFORE the state checkpoint below: the saved
+        # frame's ledger must be as-of THIS round, or a resume at rnd+1
+        # would undercount rnd's participation
+        fleet_info = self._fleet_absorb_round(
+            rnd, mask, host_fit_losses, telemetry_host,
+            registry_ids=(np.asarray(work.cohort_meta["idx"])
+                          if work.cohort_meta is not None else None),
+            quarantine_mask=quarantine_mask,
+            failed=failed,
+            async_info=work.async_info,
+        )
         if self.state_checkpointer is not None:
             # per-round durable state (_save_server_state, base_server.py:420)
+            fleet_doc = self._fleet_snapshot_doc()
             with obs.span("checkpoint", round=rnd, mode="state"):
                 if state_trees is not None:
                     if work.resume_meta is not None:
@@ -3085,6 +3109,7 @@ class FederatedSimulation:
                             virtual_time_s=work.resume_meta[
                                 "virtual_time_s"],
                             writer=self._ckpt_writer,
+                            fleet=fleet_doc,
                         )
                     elif work.cohort_meta is not None:
                         # cohort snapshot: slot states + the registry's
@@ -3096,11 +3121,13 @@ class FederatedSimulation:
                             self.registry_size,
                             self.registry.export_rows(),
                             list(self.history), writer=self._ckpt_writer,
+                            fleet=fleet_doc,
                         )
                     else:
                         self.state_checkpointer.save_simulation_snapshot(
                             state_trees, rnd, self.n_clients,
                             list(self.history), writer=self._ckpt_writer,
+                            fleet=fleet_doc,
                         )
                 elif not hasattr(self.state_checkpointer,
                                  "save_simulation_snapshot"):
@@ -3121,6 +3148,7 @@ class FederatedSimulation:
                 telemetry=telemetry_host,
                 async_info=work.async_info,
                 cohort_info=cohort_info,
+                fleet_info=fleet_info,
                 # cohort rounds: the [K] registry ids the slots mapped to,
                 # so the flight ring (and any postmortem ranking built on
                 # it) attributes evidence to REAL clients, not slots
@@ -3212,6 +3240,7 @@ class FederatedSimulation:
                     sc.save_simulation_snapshot(
                         trees, s + k - 1, self.n_clients,
                         list(self.history), writer=writer,
+                        fleet=self._fleet_snapshot_doc(),
                     )
                 s += k
 
@@ -3343,6 +3372,18 @@ class FederatedSimulation:
                     k: np.asarray(v[i])
                     for k, v in telemetry_stack.as_dict().items()
                 }
+            async_info_i = (self._async_event_info(async_plan, rnd - 1)
+                            if async_plan is not None else None)
+            # fleet-ledger absorb BEFORE the chunk boundary's snapshot
+            # (taken after this epilogue returns) — the frame's ledger is
+            # as-of the chunk's last round, matching the pipelined path
+            fleet_info = self._fleet_absorb_round(
+                rnd, masks_np[i], per_fit_i, telemetry_i,
+                quarantine_mask=(np.asarray(quarantine_stack[i])
+                                 if quarantine_stack is not None else None),
+                failed=failed,
+                async_info=async_info_i,
+            )
             obs_summary = None
             if obs.enabled:
                 # the single dispatch's compiles/device time attribute to
@@ -3355,8 +3396,8 @@ class FederatedSimulation:
                     compile_s_after=(compile_s_after if i == 0
                                      else compile_s_before),
                     telemetry=telemetry_i,
-                    async_info=(self._async_event_info(async_plan, rnd - 1)
-                                if async_plan is not None else None),
+                    async_info=async_info_i,
+                    fleet_info=fleet_info,
                 )
             if quarantine_stack is not None:
                 self._emit_quarantine_metrics(
@@ -3980,6 +4021,7 @@ class FederatedSimulation:
                         plan_fingerprint=self._async_prefix_fps[e_done - 1],
                         virtual_time_s=float(plan.event_times[e_done - 1]),
                         writer=writer,
+                        fleet=self._fleet_snapshot_doc(),
                     )
                 s += k
 
@@ -4089,6 +4131,104 @@ class FederatedSimulation:
         self._wire_bytes_cache = estimate_wire_nbytes(up_tree, self.compression)
         return self._wire_bytes_cache
 
+    # -- fleet ledger (observability/fleet.py) ---------------------------
+    def _fleet_absorb_round(
+        self, rnd: int, mask, host_fit_losses, telemetry,
+        *, registry_ids=None, quarantine_mask=None, failed=(),
+        async_info: dict | None = None,
+    ) -> "dict | None":
+        """Fold one completed round into the fleet ledger. Pure host work
+        over arrays this epilogue already materialized (the fused transfer
+        / stacked scan outputs) — zero device syncs, so ledger-on runs
+        stay bit-identical to ledger-off on every execution mode.
+
+        Called BEFORE the round's state checkpoint is written (both the
+        pipelined consumer and the chunked epilogues), so a restored
+        ledger is always as-of its frame's round: a resume or supervisor
+        rollback replays rounds that absorb exactly once — no
+        double-counted participation. Returns the round's fleet facts
+        (merged into the round summary), or None when no ledger is armed.
+        """
+        obs = self.observability
+        ledger = obs.fleet_ledger if obs.enabled else None
+        if ledger is None:
+            return None
+        mask_np = np.asarray(mask)
+        pos = np.nonzero(mask_np > 0)[0]
+        ids_arr = None
+        if registry_ids is not None:
+            # cohort rounds: slots -> the REGISTRY ids they served
+            ids_arr = np.asarray(registry_ids)
+            pos = pos[pos < len(ids_arr)]
+            part_ids = ids_arr[pos].astype(np.int64)
+        else:
+            part_ids = pos.astype(np.int64)
+
+        def _sel(row):
+            if row is None:
+                return None
+            arr = np.asarray(row)
+            if arr.ndim < 1 or (pos.size and pos.max() >= arr.shape[0]):
+                return None
+            return arr[pos]
+
+        def _map_ids(idxs):
+            if ids_arr is None:
+                return [int(c) for c in idxs]
+            return [int(ids_arr[int(c)]) for c in idxs
+                    if 0 <= int(c) < len(ids_arr)]
+
+        q_in = q_out = None
+        if quarantine_mask is not None:
+            q = np.asarray(quarantine_mask)
+            q_in = _map_ids(np.nonzero(q > 0)[0])
+            q_out = _map_ids(np.nonzero(q <= 0)[0])
+        fault_ids: list[int] = []
+        if self._fault_plan is not None:
+            # same seeded host mirror _record_round_metrics logs — a pure
+            # recomputation, so absorbing here cannot skew the fault event
+            try:
+                fault = self._fault_plan.summarize_round(rnd, self.n_clients)
+            except Exception:
+                fault = None
+            if fault:
+                fault_ids = _map_ids(sorted(
+                    set(fault["dropped"]) | set(fault["corrupted"])
+                ))
+        down, up = self._payload_nbytes()
+        return ledger.absorb_round(
+            rnd, part_ids,
+            losses=_sel((host_fit_losses or {}).get("backward")),
+            update_norms=_sel((telemetry or {}).get("update_norm")),
+            nonfinite=_sel((telemetry or {}).get("nonfinite")),
+            staleness_pool=(async_info or {}).get("_staleness_values"),
+            failed_ids=_map_ids(failed or ()),
+            quarantined_ids=q_in,
+            unquarantined_ids=q_out,
+            fault_ids=fault_ids,
+            bytes_down_per_client=down,
+            bytes_up_per_client=up,
+            registry_size=(self.registry_size if self._cohort_active
+                           else self.n_clients),
+        )
+
+    def _fleet_snapshot_doc(self) -> "dict | None":
+        """The ledger's JSON snapshot for a checkpoint frame's host header
+        — None when no ledger is armed, so legacy frames are unchanged."""
+        obs = self.observability
+        if obs.enabled and obs.fleet_ledger is not None:
+            return obs.fleet_ledger.snapshot()
+        return None
+
+    def adopt_fleet_snapshot(self, doc: "dict | None") -> None:
+        """Checkpoint-resume hook (checkpointing/state.py loaders): adopt
+        the frame's fleet-ledger state. A legacy frame (no ``fleet`` key)
+        clears the ledger — lifetime history older than the durable record
+        is better absent than wrong."""
+        ledger = self.observability.fleet_ledger
+        if ledger is not None:
+            ledger.restore(doc)
+
     def _record_round_metrics(
         self, rnd: int, rec: RoundRecord, mask, host_fit_losses, failed,
         compiles_before: float, compile_s_before: float, device_wait_s: float,
@@ -4097,6 +4237,7 @@ class FederatedSimulation:
         telemetry: dict | None = None,
         async_info: dict | None = None,
         cohort_info: dict | None = None,
+        fleet_info: dict | None = None,
         registry_ids: np.ndarray | None = None,
     ) -> dict:
         """Per-round gauges/counters + one JSONL ``round`` event; returns the
@@ -4239,6 +4380,40 @@ class FederatedSimulation:
                 help="host bytes staged into slot tensors per round "
                      "(train + val batches)",
             ).inc(int(cohort_info["staged_bytes"]))
+        if fleet_info is not None:
+            # fleet-ledger attribution (absent with the ledger off, so
+            # legacy perf_report tables stay byte-stable): new-client
+            # count, participation skew and the lifetime straggler tail
+            summary.update({k: v for k, v in fleet_info.items()
+                            if v is not None})
+            ledger = self.observability.fleet_ledger
+            reg.gauge(
+                "fl_fleet_clients_seen",
+                help="clients with a fleet-ledger lifetime record (ledger "
+                     "host memory is O(this), not O(registry))",
+            ).set(float(len(ledger)))
+            reg.counter(
+                "fl_fleet_new_clients_total",
+                help="first-ever participations absorbed by the fleet "
+                     "ledger",
+            ).inc(int(fleet_info.get("participants_new") or 0))
+            if fleet_info.get("participation_gini") is not None:
+                reg.gauge(
+                    "fl_fleet_participation_gini",
+                    help="participation skew over seen clients (0 = even, "
+                         "->1 = a few clients do everything)",
+                ).set(float(fleet_info["participation_gini"]))
+            if fleet_info.get("straggler_p99") is not None:
+                reg.gauge(
+                    "fl_fleet_straggler_p99",
+                    help="p99 of the lifetime participation-gap "
+                         "distribution, in rounds (sketched)",
+                ).set(float(fleet_info["straggler_p99"]))
+            reg.gauge(
+                "fl_fleet_ledger_bytes",
+                help="approximate host bytes held by the fleet ledger + "
+                     "its sketches (registry-size-invariant)",
+            ).set(float(ledger.nbytes()))
         if self._precision_active:
             # precision attribution (absent on f32 logs, so legacy
             # perf_report tables stay byte-stable): the dtype that produced

@@ -103,8 +103,14 @@ def _match_template_paths(
     return template_paths, treedef
 
 
-def encode(tree: PyTree) -> bytes:
-    """Dense pytree -> one wire frame."""
+def encode(tree: PyTree, trace: "dict[str, Any] | None" = None) -> bytes:
+    """Dense pytree -> one wire frame.
+
+    ``trace`` (a ``TraceContext.to_header()`` dict) rides in the JSON
+    header under a ``"trace"`` key so silo handlers can correlate spans
+    across processes (observability/tracectx.py). ``decode`` reads only
+    ``meta["leaves"]``, so traced frames decode everywhere; without a
+    trace the frame bytes are exactly what they always were."""
     entries = _paths_and_leaves(tree)
     meta, chunks = [], []
     for path, arr in entries:
@@ -115,10 +121,27 @@ def encode(tree: PyTree) -> bytes:
         # describe the payload bytes, not the caller's original layout.
         meta.append({"path": path, "shape": list(arr.shape), "dtype": str(data.dtype)})
         chunks.append(data.tobytes())
-    header = json.dumps({"leaves": meta}).encode("utf-8")
+    head: dict[str, Any] = {"leaves": meta}
+    if trace is not None:
+        head["trace"] = trace
+    header = json.dumps(head).encode("utf-8")
     frame = get_framing().frame(header, b"".join(chunks), flags=0)
     _account("encoded", len(frame), "dense")
     return frame
+
+
+def frame_trace(data: bytes) -> "dict[str, Any] | None":
+    """Extract the ``"trace"`` header dict from any codec frame (dense,
+    COO, or compressed), or None for untraced/unparseable input. Never
+    raises — the silo-side traced handler calls this on raw request
+    bytes before it knows the frame is well-formed."""
+    try:
+        header, _, _ = get_framing().unframe(data)
+        doc = json.loads(header.decode("utf-8"))
+    except Exception:
+        return None
+    trace = doc.get("trace") if isinstance(doc, dict) else None
+    return trace if isinstance(trace, dict) else None
 
 
 def _rebuild_nested(items: list[tuple[str, np.ndarray]]) -> dict:

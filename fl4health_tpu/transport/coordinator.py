@@ -46,6 +46,7 @@ import numpy as np
 
 from fl4health_tpu.observability.registry import get_registry
 from fl4health_tpu.observability.spans import get_tracer
+from fl4health_tpu.observability.tracectx import TraceContext, flow_id
 from fl4health_tpu.resilience.retry import (
     CircuitBreaker,
     RetryDeadlineError,
@@ -139,13 +140,20 @@ def _silo_round_trip(
     retry: RetryPolicy | None,
     breaker: CircuitBreaker | None,
     decoder: Any = None,
+    trace: TraceContext | None = None,
 ) -> SiloResult:
     """One silo's full round trip (runs on a fan-out worker thread).
 
     ``decoder`` overrides the default dense-template decode — e.g.
     ``lambda raw: decode_compressed(raw, like=template)`` when silos reply
     with COMPRESSED frames (transport/codec.py), so compressed exchange
-    rides the same retry/breaker/metrics machinery as dense frames."""
+    rides the same retry/breaker/metrics machinery as dense frames.
+
+    ``trace`` stamps the rpc span with the round's trace context and, on
+    a successful reply, closes the round's flow arrow (``"f"``) inside
+    this span — the far end of the broadcast's ``"s"`` and the silo
+    handler's ``"t"`` once ``tools/trace_merge.py`` has aligned the
+    per-process traces."""
     reg, tracer = get_registry(), get_tracer()
     silo = f"{host}:{port}"
     hist = reg.histogram(
@@ -180,9 +188,11 @@ def _silo_round_trip(
                 labels={"silo": silo},
             ).inc()
 
+    span_args: dict[str, Any] = {"silo": silo, "request_bytes": len(frame)}
+    if trace is not None:
+        span_args.update(trace_id=trace.trace_id, round=trace.round)
     t0 = time.perf_counter()
-    with tracer.span("rpc", cat="transport", silo=silo,
-                     request_bytes=len(frame)) as sp:
+    with tracer.span("rpc", cat="transport", **span_args) as sp:
         try:
             reply, raw_len = call_with_retry(
                 do_call, policy=retry, breaker=breaker, on_failure=on_failure
@@ -208,6 +218,10 @@ def _silo_round_trip(
         # (dead-silo visibility lives in the failure counter above)
         hist.observe(result.elapsed_s)
         sp.set(reply_bytes=raw_len)
+        if trace is not None:
+            tracer.flow("f", "rpc_flow",
+                        flow_id(trace.trace_id, trace.round),
+                        round=trace.round, silo=silo)
     result.reply = reply
     return result
 
@@ -223,6 +237,7 @@ def broadcast_round_detailed(
     max_workers: int | None = None,
     fail_fast: bool = False,
     decoder: Any = None,
+    trace: TraceContext | None = None,
 ) -> BroadcastReport:
     """Concurrent fan-out: encode ONCE (the frame is identical for every
     silo), dial every silo in parallel, decode each reply against
@@ -235,8 +250,31 @@ def broadcast_round_detailed(
     not-yet-dialed silos are cancelled (their results are absent from the
     report); in-flight round trips finish on their worker threads but the
     caller stops waiting. Without a quorum the round is doomed the moment
-    one silo fails, so there is nothing to wait for."""
-    frame = encode(global_params)
+    one silo fails, so there is nothing to wait for.
+
+    Tracing: with the process tracer enabled, a trace context (``trace``,
+    or a fresh one) rides in the frame header and a flow-start event
+    (``"s"``) is emitted here, which silo-side ``traced_handler`` spans
+    (``"t"``) and each reply's ``"f"`` continue — one arrowed
+    broadcast → silo → reply flow per round in the merged timeline. The
+    frame is encoded once for all silos, so the flow id is per ROUND, not
+    per silo: Perfetto fans one start out to every silo's step, which is
+    the actual fan-out topology."""
+    tracer = get_tracer()
+    ctx = trace
+    if ctx is None and tracer.enabled:
+        ctx = TraceContext.fresh(round=0)
+    with tracer.span("broadcast_encode", cat="transport",
+                     silos=len(silos),
+                     **({"trace_id": ctx.trace_id, "round": ctx.round}
+                        if ctx is not None else {})):
+        frame = encode(
+            global_params,
+            trace=ctx.to_header() if ctx is not None else None,
+        )
+        if ctx is not None:
+            tracer.flow("s", "rpc_flow", flow_id(ctx.trace_id, ctx.round),
+                        round=ctx.round, silos=len(silos))
     if not silos:
         return BroadcastReport(results=[])
     workers = max_workers or min(len(silos), 32)
@@ -245,7 +283,7 @@ def broadcast_round_detailed(
         breaker = (breakers or {}).get(f"{host}:{port}")
         return _silo_round_trip(
             i, host, port, frame, reply_template, timeout, retry, breaker,
-            decoder=decoder,
+            decoder=decoder, trace=ctx,
         )
 
     pool = ThreadPoolExecutor(max_workers=workers)
@@ -276,6 +314,7 @@ def broadcast_round(
     quorum: int | float | None = None,
     breakers: Mapping[str, CircuitBreaker] | None = None,
     max_workers: int | None = None,
+    trace: TraceContext | None = None,
 ) -> list[dict[str, Any]]:
     """Send the global params to every silo concurrently and decode each
     reply against ``reply_template``; returns the successful replies in
@@ -303,6 +342,7 @@ def broadcast_round(
         # no quorum = the round cannot survive any failure, so stop waiting
         # the moment one is known (legacy fail-fast profile)
         fail_fast=quorum is None,
+        trace=trace,
     )
     failures = report.failures
     if quorum is None and failures:
@@ -405,26 +445,46 @@ class SiloUpdateBuffer:
         silos: Sequence[tuple[str, int]],
         global_params: Any,
         version: int,
+        trace: TraceContext | None = None,
     ) -> None:
         """Ship ``global_params`` (encoded ONCE) to ``silos`` without
-        waiting; each reply joins the arrival queue tagged ``version``."""
+        waiting; each reply joins the arrival queue tagged ``version``.
+
+        With the process tracer enabled, the dispatch carries a trace
+        context (``round`` = the server version) and emits the flow-start
+        event, exactly like the synchronous broadcast — stale replies'
+        ``"f"`` arrows land rounds later, which is the staleness made
+        visible."""
         if self._closed:
             raise RuntimeError("SiloUpdateBuffer is closed")
         if not silos:
             return
-        frame = encode(global_params)
+        tracer = get_tracer()
+        ctx = trace
+        if ctx is None and tracer.enabled:
+            ctx = TraceContext.fresh(round=version)
+        with tracer.span("dispatch_encode", cat="transport",
+                         silos=len(silos), version=version):
+            frame = encode(
+                global_params,
+                trace=ctx.to_header() if ctx is not None else None,
+            )
+            if ctx is not None:
+                tracer.flow("s", "rpc_flow",
+                            flow_id(ctx.trace_id, ctx.round),
+                            round=ctx.round, silos=len(silos))
         with self._lock:
             self._in_flight += len(silos)
         for i, (host, port) in enumerate(silos):
-            self._pool.submit(self._one, i, host, port, frame, version)
+            self._pool.submit(self._one, i, host, port, frame, version, ctx)
 
     def _one(self, index: int, host: str, port: int, frame: bytes,
-             version: int) -> None:
+             version: int, trace: TraceContext | None = None) -> None:
         breaker = self._breakers.get(f"{host}:{port}")
         try:
             result = _silo_round_trip(
                 index, host, port, frame, self._template, self._timeout,
-                self._retry, breaker, decoder=self._decoder,
+                self._retry, breaker, decoder=self._decoder, trace=trace,
             )
         except BaseException as e:  # noqa: BLE001 — a worker must never die silently
             result = SiloResult(silo=f"{host}:{port}", index=index, error=e,

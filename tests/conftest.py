@@ -107,6 +107,17 @@ def pytest_configure(config):
         "million-client property sweeps and registry-growth benches also "
         "carry 'slow'. Select with -m bigcohort.",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: fleet-telescope lanes (observability/fleet.py per-client "
+        "lifetime ledger + streaming sketches, /fleet + /clients/<id> "
+        "endpoints, cross-silo trace propagation and tools/trace_merge). "
+        "The tier-1-safe smoke subset (ledger-on bit-identity per "
+        "execution mode, O(participated) memory pins, checkpoint-resume "
+        "and rollback survival, live endpoint conformance) runs by "
+        "default; registry-scale property sweeps also carry 'slow'. "
+        "Select with -m fleet.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -351,3 +351,92 @@ class TestScrapeUnderCohortSlots:
         # the flight recorder's gauges ride the same slot-path scrape
         assert fams["fl_flightrec_window"]["type"] == "gauge"
         assert float(fams["fl_flightrec_ring_bytes"]["samples"][0][2]) > 0
+
+
+@pytest.mark.fleet
+class TestFleetEndpoints:
+    """/fleet and /clients/<id> conformance (the fleet-telescope PR's
+    endpoint satellite): route-level contract against a hand-fed server,
+    then the real thing against a LIVE mid-fit scrape."""
+
+    def test_routes_contract(self, registry):
+        from fl4health_tpu.observability.fleet import FleetLedger
+
+        ledger = FleetLedger()
+        ledger.absorb_round(1, [0, 2], losses=[0.5, 0.7], registry_size=4)
+        srv = ScrapeServer(
+            registry, port=0,
+            fleet_provider=lambda: ledger.summary(),
+            client_provider=lambda cid: ledger.get(cid),
+        )
+        try:
+            fleet = json.loads(_scrape(srv.url + "/fleet"))
+            assert fleet["rounds_absorbed"] == 1
+            assert fleet["clients_seen"] == 2
+            assert fleet["registry_size"] == 4
+            doc = json.loads(_scrape(srv.url + "/clients/2"))
+            assert doc["client_id"] == 2
+            assert doc["rounds_participated"] == 1
+            # never-seen client -> 404; non-integer id -> 400
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _scrape(srv.url + "/clients/3")
+            assert err.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _scrape(srv.url + "/clients/banana")
+            assert err.value.code == 400
+        finally:
+            srv.close()
+
+    def test_no_ledger_means_404(self, registry):
+        srv = ScrapeServer(registry, port=0)  # no fleet/client providers
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _scrape(srv.url + "/fleet")
+            assert err.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _scrape(srv.url + "/clients/0")
+            assert err.value.code == 404
+        finally:
+            srv.close()
+
+    def test_live_mid_fit_fleet_scrape(self):
+        obs = Observability(enabled=True, tracer=Tracer(),
+                            registry=MetricsRegistry(), http_port=0)
+        assert obs.fleet_ledger is not None  # always-on default
+        scrapes: dict = {}
+
+        class ScrapingReporter:
+            # scrapes from the round-report callback — fit() is live
+            def report(self, data, round=None, **kw):
+                if round is not None:
+                    scrapes["fleet"] = json.loads(
+                        _scrape(obs.scrape_url + "/fleet"))
+                    scrapes["client0"] = json.loads(
+                        _scrape(obs.scrape_url + "/clients/0"))
+                    scrapes["metrics"] = _scrape(obs.scrape_url + "/metrics")
+
+            def shutdown(self):
+                pass
+
+        sim = TestScrapeDuringFit._sim(
+            TestScrapeDuringFit(), observability=obs,
+            reporters=[ScrapingReporter()],
+        )
+        history = sim.fit(2)
+        assert len(history) == 2
+        assert scrapes, "reporter never scraped mid-fit"
+        fleet = scrapes["fleet"]
+        assert fleet["rounds_absorbed"] >= 1
+        assert fleet["clients_seen"] == 2
+        assert fleet["never_sampled"] == 0
+        assert 0.0 <= (fleet["participation"]["gini"] or 0.0) <= 1.0
+        assert fleet["ledger_bytes"] > 0
+        client0 = scrapes["client0"]
+        assert client0["client_id"] == 0
+        assert client0["rounds_participated"] >= 1
+        assert "suspect_score" in client0 and "straggler_score" in client0
+        # the fl_fleet_* families ride the same scrape
+        fams = parse_exposition(scrapes["metrics"])
+        assert fams["fl_fleet_clients_seen"]["type"] == "gauge"
+        assert fams["fl_fleet_new_clients_total"]["type"] == "counter"
+        assert float(fams["fl_fleet_ledger_bytes"]["samples"][0][2]) > 0

@@ -1,0 +1,339 @@
+"""Fleet ledger (observability/fleet.py): registry-scale lifetime records.
+
+The pinned contracts:
+- ledger-on (the default) is BIT-IDENTICAL to ledger-off — params and
+  trajectory — on pipelined, chunked, AND cohort execution (the ledger
+  only folds host data the epilogues already pulled);
+- memory is O(participated), REGISTRY-SIZE-INVARIANT at fixed cohort K;
+- the ledger rides the checkpoint frames: a kill-and-resume run absorbs
+  every round exactly once (no double-counted participation), and a
+  from-scratch rollback clears the abandoned trajectory's records.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from fl4health_tpu.checkpointing.state import SimulationStateCheckpointer
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import Mlp
+from fl4health_tpu.observability import (
+    MetricsRegistry,
+    Observability,
+    Tracer,
+)
+from fl4health_tpu.observability.fleet import ClientRecord, FleetLedger
+from fl4health_tpu.server.client_manager import FixedFractionManager
+from fl4health_tpu.server.registry import CohortConfig
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+pytestmark = pytest.mark.fleet
+
+N_CLASSES = 2
+
+
+def make_datasets(n=2, rows=48, seed0=0):
+    out = []
+    for i in range(n):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(seed0 + i), rows, (4,), N_CLASSES
+        )
+        out.append(ClientDataset(
+            np.asarray(x[:32]), np.asarray(y[:32]),
+            np.asarray(x[32:]), np.asarray(y[32:]),
+        ))
+    return out
+
+
+def make_sim(mode="pipelined", observability=None, n=2, cohort=None,
+             manager=None, datasets=None, seed=0, state_dir=None):
+    kwargs = {}
+    if state_dir is not None:
+        kwargs["state_checkpointer"] = SimulationStateCheckpointer(
+            str(state_dir)
+        )
+    return FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(8,), n_outputs=N_CLASSES)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=datasets if datasets is not None else make_datasets(n),
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=2,
+        seed=seed,
+        execution_mode=mode,
+        observability=observability,
+        cohort=cohort,
+        client_manager=manager,
+        **kwargs,
+    )
+
+
+def make_obs(fleet=True):
+    return Observability(
+        enabled=True, tracer=Tracer(), registry=MetricsRegistry(),
+        sync_device=False, flight_recorder=False, fleet_ledger=fleet,
+    )
+
+
+def _params_bytes(sim):
+    from flax import serialization
+
+    return serialization.to_bytes(jax.device_get(sim.global_params))
+
+
+class TestLedgerUnit:
+    def test_absorb_tracks_lifetime_records(self):
+        led = FleetLedger()
+        facts = led.absorb_round(
+            1, [0, 2], losses=[0.5, 0.7], update_norms=[1.0, 2.0],
+            staleness=[0.0, 3.0], bytes_down_per_client=100,
+            bytes_up_per_client=200, registry_size=10,
+        )
+        assert facts["participants_new"] == 2
+        led.absorb_round(2, [2], losses=[0.6], registry_size=10)
+        assert len(led) == 2
+        doc = led.get(2)
+        assert doc["rounds_participated"] == 2
+        assert doc["first_seen_round"] == 1
+        assert doc["last_seen_round"] == 2
+        # EMA of 0.7 then 0.6 at alpha=0.2
+        assert doc["loss_ema"] == pytest.approx(0.8 * 0.7 + 0.2 * 0.6)
+        assert doc["bytes_down"] == 100 and doc["bytes_up"] == 200
+        assert doc["staleness_max"] == 3.0
+        assert led.get(1) is None
+
+    def test_numpy_arrays_accepted_everywhere(self):
+        """Regression: the simulation hands numpy id arrays into every
+        iterable slot; ``x or ()`` idioms choke on arrays."""
+        led = FleetLedger()
+        ids = np.array([3, 5, 9])
+        led.absorb_round(
+            1, ids,
+            losses=np.array([0.1, 0.2, 0.3]),
+            staleness_pool=np.array([1.0, 2.0]),
+            failed_ids=np.array([5]),
+            quarantined_ids=np.array([9]),
+            fault_ids=np.array([3]),
+            registry_size=100,
+        )
+        led.absorb_round(2, ids, unquarantined_ids=np.array([9]))
+        assert led.get(5)["failed_rounds"] == 1
+        assert led.get(3)["fault_rounds"] == 1
+        assert led.get(9)["quarantine_strikes"] == 1
+        assert led.get(9)["quarantine_releases"] == 1
+        assert not led.get(9)["quarantined"]
+
+    def test_quarantine_strike_counts_transitions_not_rounds(self):
+        led = FleetLedger()
+        for rnd in (1, 2, 3):
+            led.absorb_round(rnd, [0], quarantined_ids=[0])
+        assert led.get(0)["quarantine_strikes"] == 1  # held, not re-struck
+        led.absorb_round(4, [0], unquarantined_ids=[0])
+        led.absorb_round(5, [0], quarantined_ids=[0])
+        assert led.get(0)["quarantine_strikes"] == 2
+
+    def test_suspect_and_straggler_rankings(self):
+        led = FleetLedger()
+        led.absorb_round(1, [0, 1], nonfinite=[0.0, 1.0])
+        led.absorb_round(9, [0], losses=[0.1])
+        assert led.top_suspects()[0]["client"] == 1
+        # client 1 silent since round 1 -> top straggler
+        assert led.top_stragglers()[0]["client"] == 1
+        assert led.get(1)["suspect_score"] == 4.0  # one nonfinite round
+
+    def test_memory_is_registry_size_invariant(self):
+        """THE bounded-memory pin: identical participation absorbed
+        against a 1e3 vs 1e8 registry costs IDENTICAL bytes."""
+        sizes = {}
+        for reg in (1_000, 100_000_000):
+            led = FleetLedger()
+            for rnd in range(20):
+                ids = range(rnd * 8, rnd * 8 + 8)
+                led.absorb_round(
+                    rnd, list(ids),
+                    losses=[0.1] * 8, registry_size=reg,
+                )
+            sizes[reg] = led.nbytes()
+            assert len(led) == 160
+            assert led.summary()["never_sampled"] == reg - 160
+        assert sizes[1_000] == sizes[100_000_000]
+
+    def test_snapshot_restore_round_trip_and_clear(self):
+        led = FleetLedger()
+        for rnd in range(5):
+            led.absorb_round(
+                rnd, [rnd % 3, 3], losses=[0.5, 0.4],
+                staleness=[1.0, 0.0], registry_size=8,
+            )
+        doc = json.loads(json.dumps(led.snapshot()))  # JSON-safe pin
+        back = FleetLedger()
+        back.restore(doc)
+        assert back.snapshot() == led.snapshot()
+        assert back.rounds_absorbed == 5 and len(back) == 4
+        # restored ledger keeps absorbing without double counting
+        before = back.get(3)["rounds_participated"]
+        back.absorb_round(5, [3])
+        assert back.get(3)["rounds_participated"] == before + 1
+        back.clear()
+        assert len(back) == 0 and back.rounds_absorbed == 0
+        # legacy frame (no fleet key) clears too
+        led.restore(None)
+        assert len(led) == 0
+
+    def test_record_doc_round_trip(self):
+        rec = ClientRecord(7)
+        rec.rounds_participated = 3
+        rec.loss_ema = 0.25
+        back = ClientRecord.from_doc(rec.to_doc())
+        assert back.to_doc() == rec.to_doc()
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("mode", ["pipelined", "chunked"])
+    def test_ledger_on_off_bit_identical(self, mode):
+        """THE acceptance pin: the fleet ledger (default-on) never touches
+        the trajectory on either execution mode."""
+        runs = {}
+        for fleet in (True, False):
+            obs = make_obs(fleet=fleet)
+            sim = make_sim(mode=mode, observability=obs)
+            hist = sim.fit(3)
+            runs[fleet] = (
+                _params_bytes(sim),
+                [(r.fit_losses, r.eval_losses) for r in hist],
+            )
+            obs.shutdown()
+        assert runs[True][0] == runs[False][0]
+        assert runs[True][1] == runs[False][1]
+
+    def test_ledger_on_off_bit_identical_cohort(self):
+        """Same pin under cohort-slot execution (slot -> registry id
+        mapping feeds the ledger numpy id arrays)."""
+        runs = {}
+        for fleet in (True, False):
+            obs = make_obs(fleet=fleet)
+            sim = make_sim(
+                mode="auto", observability=obs, n=6,
+                cohort=CohortConfig(slots=3),
+                manager=FixedFractionManager(6, 0.5),
+            )
+            hist = sim.fit(3)
+            runs[fleet] = (
+                _params_bytes(sim),
+                [(r.fit_losses, r.eval_losses) for r in hist],
+            )
+            obs.shutdown()
+        assert runs[True][0] == runs[False][0]
+        assert runs[True][1] == runs[False][1]
+
+
+class TestFitFeedsLedger:
+    def test_full_participation_counts(self):
+        obs = make_obs()
+        sim = make_sim(observability=obs)
+        sim.fit(3)
+        led = obs.fleet_ledger
+        assert led.rounds_absorbed == 3
+        assert len(led) == 2
+        for cid in (0, 1):
+            doc = led.get(cid)
+            assert doc["rounds_participated"] == 3
+            assert doc["loss_ema"] is not None
+            assert doc["bytes_up"] > 0
+        s = led.summary()
+        assert s["registry_size"] == 2 and s["never_sampled"] == 0
+        assert s["participation"]["gini"] == pytest.approx(0.0)
+        snap = obs.registry.snapshot()
+        assert snap["fl_fleet_clients_seen"] == 2
+        assert snap["fl_fleet_new_clients_total"] == 2
+        assert snap["fl_fleet_ledger_bytes"] > 0
+        obs.shutdown()
+
+    def test_second_fit_starts_a_fresh_ledger(self):
+        obs = make_obs()
+        sim = make_sim(observability=obs)
+        sim.fit(2)
+        sim.fit(1)
+        assert obs.fleet_ledger.rounds_absorbed == 1
+        obs.shutdown()
+
+    def test_cohort_ledger_uses_registry_ids(self):
+        obs = make_obs()
+        sim = make_sim(
+            mode="auto", observability=obs, n=6,
+            cohort=CohortConfig(slots=3),
+            manager=FixedFractionManager(6, 0.5),
+        )
+        sim.fit(4)
+        led = obs.fleet_ledger
+        assert led.rounds_absorbed == 4
+        # records keyed by REGISTRY id (0..5), never slot index beyond K
+        assert all(0 <= cid < 6
+                   for cid in (d["client_id"] for d in
+                               led.snapshot()["clients"]))
+        assert led.summary()["registry_size"] == 6
+        # 3 of 6 sampled per round: someone is never/late sampled or
+        # participation is uneven enough for a positive gini over 4 rounds
+        assert len(led) <= 6
+        obs.shutdown()
+
+
+class TestDurability:
+    def test_resume_absorbs_each_round_exactly_once(self, tmp_path):
+        """Kill-and-resume: the restored ledger is as-of its frame's
+        round; replayed rounds absorb exactly once."""
+        obs1 = make_obs()
+        sim1 = make_sim(observability=obs1, state_dir=tmp_path / "s")
+        sim1.fit(2)
+        obs1.shutdown()
+        # "kill": rebuild from scratch, resume from disk, run to 4
+        obs2 = make_obs()
+        sim2 = make_sim(observability=obs2, state_dir=tmp_path / "s")
+        sim2.fit(4)
+        led = obs2.fleet_ledger
+        assert led.rounds_absorbed == 4
+        assert led.last_round == 4
+        for cid in (0, 1):
+            assert led.get(cid)["rounds_participated"] == 4
+        # and the resumed trajectory matches an uninterrupted one
+        straight_obs = make_obs()
+        straight = make_sim(observability=straight_obs)
+        straight.fit(4)
+        assert _params_bytes(sim2) == _params_bytes(straight)
+        straight_obs.shutdown()
+        obs2.shutdown()
+
+    def test_rollback_clears_abandoned_trajectory(self):
+        obs = make_obs()
+        sim = make_sim(observability=obs)
+        sim.fit(3)
+        assert len(obs.fleet_ledger) == 2
+        sim._reset_to_initial()
+        assert len(obs.fleet_ledger) == 0
+        assert obs.fleet_ledger.rounds_absorbed == 0
+        obs.shutdown()
+
+    def test_adopt_fleet_snapshot_restores_and_legacy_clears(self):
+        obs = make_obs()
+        sim = make_sim(observability=obs)
+        sim.fit(2)
+        doc = sim._fleet_snapshot_doc()
+        assert doc is not None and doc["rounds_absorbed"] == 2
+        sim.adopt_fleet_snapshot(None)  # legacy frame: no fleet key
+        assert len(obs.fleet_ledger) == 0
+        sim.adopt_fleet_snapshot(doc)
+        assert obs.fleet_ledger.rounds_absorbed == 2
+        assert len(obs.fleet_ledger) == 2
+        obs.shutdown()

@@ -1,0 +1,172 @@
+"""Streaming sketches (observability/sketches.py): the fleet ledger's
+registry-size-invariant distribution store.
+
+Pinned contracts:
+- QuantileSketch is DETERMINISTIC: identical streams produce identical
+  internal state (bit-identity of snapshot), so ledger-on runs stay
+  reproducible and checkpoint round-trips are exact;
+- stored() is bounded ~O(k log(n/k)) regardless of stream length — the
+  registry-size-invariance pin;
+- quantile() stays within rank-error tolerance of the exact quantile;
+- snapshot()/restore() is a lossless JSON-safe round trip;
+- FixedHistogram keeps exact counts with le-bucket semantics and refuses
+  to merge mismatched bounds.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from fl4health_tpu.observability.sketches import (
+    FixedHistogram,
+    QuantileSketch,
+    gini,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+class TestQuantileSketch:
+    def test_empty(self):
+        sk = QuantileSketch()
+        assert sk.quantile(0.5) is None
+        assert sk.min is None and sk.max is None
+        assert sk.summary() == {"count": 0}
+
+    def test_exact_below_capacity(self):
+        sk = QuantileSketch(k=64)
+        for v in [3.0, 1.0, 2.0]:
+            sk.add(v)
+        # under k values nothing has compacted: quantiles are exact
+        assert sk.quantile(0.0) == 1.0
+        assert sk.quantile(1.0) == 3.0
+        assert sk.min == 1.0 and sk.max == 3.0
+
+    def test_nan_skipped(self):
+        sk = QuantileSketch(k=16)
+        sk.add(float("nan"))
+        sk.extend([1.0, float("nan"), 2.0])
+        assert sk.summary()["count"] == 2
+
+    def test_quantile_accuracy_large_stream(self):
+        rng = np.random.default_rng(0)
+        vals = rng.normal(size=20_000)
+        sk = QuantileSketch(k=128)
+        sk.extend(vals)
+        exact = np.quantile(vals, [0.1, 0.5, 0.9, 0.99])
+        for q, e in zip([0.1, 0.5, 0.9, 0.99], exact):
+            got = sk.quantile(q)
+            # rank-error tolerance: the estimate's true rank must be
+            # within a few percent of the requested rank
+            rank = float(np.mean(vals <= got))
+            assert abs(rank - q) < 0.05, (q, got, e, rank)
+
+    def test_memory_bound_sublinear(self):
+        k = 128
+        sk = QuantileSketch(k=k)
+        rng = np.random.default_rng(1)
+        sk.extend(rng.random(200_000))
+        n = 200_000
+        bound = k * (math.ceil(math.log2(max(2, n / k))) + 2)
+        assert sk.stored() <= bound
+        # and a 10x shorter stream is not 10x smaller storage — sketch,
+        # not buffer
+        small = QuantileSketch(k=k)
+        small.extend(rng.random(20_000))
+        assert sk.stored() < 4 * small.stored()
+
+    def test_deterministic_bit_identical_for_identical_streams(self):
+        rng = np.random.default_rng(2)
+        vals = list(rng.random(5_000))
+        a, b = QuantileSketch(k=32), QuantileSketch(k=32)
+        a.extend(vals)
+        b.extend(vals)
+        assert json.dumps(a.snapshot(), sort_keys=True) == \
+            json.dumps(b.snapshot(), sort_keys=True)
+
+    def test_snapshot_restore_round_trip(self):
+        sk = QuantileSketch(k=16)
+        sk.extend(np.arange(1000, dtype=float))
+        doc = json.loads(json.dumps(sk.snapshot()))  # JSON-safe pin
+        back = QuantileSketch.restore(doc)
+        assert back.summary() == sk.summary()
+        for q in (0.05, 0.5, 0.95):
+            assert back.quantile(q) == sk.quantile(q)
+        # restored sketch keeps absorbing
+        back.add(1e9)
+        assert back.max == 1e9
+
+    def test_merge_covers_both_streams(self):
+        a, b = QuantileSketch(k=32), QuantileSketch(k=32)
+        a.extend(np.full(500, 1.0))
+        b.extend(np.full(500, 100.0))
+        a.merge(b)
+        s = a.summary()
+        assert s["count"] == 1000
+        assert s["min"] == 1.0 and s["max"] == 100.0
+        mid = a.quantile(0.5)
+        assert mid in (1.0, 100.0)
+
+    def test_k_floor(self):
+        with pytest.raises(ValueError):
+            QuantileSketch(k=1)
+        sk = QuantileSketch(k=8)  # the minimum
+        sk.extend(range(100))
+        assert sk.quantile(0.5) is not None
+
+
+class TestFixedHistogram:
+    def test_le_bucket_semantics_exact_counts(self):
+        h = FixedHistogram((0, 1, 2))
+        for v in (0.0, 0.5, 1.0, 1.5, 99.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 5
+        # quantile returns the upper edge of the covering bucket
+        assert h.quantile(0.0) == 0.0
+        assert h.quantile(0.99) == float("inf")  # overflow bucket
+
+    def test_nan_skipped(self):
+        h = FixedHistogram((0, 1))
+        h.observe(float("nan"))
+        assert h.summary()["count"] == 0
+
+    def test_bounds_must_ascend(self):
+        with pytest.raises(ValueError):
+            FixedHistogram((1, 1))
+        with pytest.raises(ValueError):
+            FixedHistogram(())
+
+    def test_merge_requires_identical_bounds(self):
+        a = FixedHistogram((0, 1))
+        b = FixedHistogram((0, 2))
+        with pytest.raises(ValueError):
+            a.merge(b)
+        c = FixedHistogram((0, 1))
+        c.observe(0.5)
+        a.observe(0.0)
+        a.merge(c)
+        assert a.summary()["count"] == 2
+
+    def test_snapshot_restore_round_trip(self):
+        h = FixedHistogram((0, 1, 2, 4))
+        for v in (0.5, 3.0, 100.0):
+            h.observe(v)
+        back = FixedHistogram.restore(json.loads(json.dumps(h.snapshot())))
+        assert back.summary() == h.summary()
+        assert back.quantile(0.5) == h.quantile(0.5)
+
+
+class TestGini:
+    def test_edges(self):
+        assert gini([]) is None
+        assert gini([0, 0]) == 0.0
+        assert gini([5, 5, 5]) == pytest.approx(0.0)
+
+    def test_inequality_orders(self):
+        even = gini([10, 10, 10, 10])
+        skew = gini([37, 1, 1, 1])
+        assert skew > even
+        assert 0.0 <= skew <= 1.0

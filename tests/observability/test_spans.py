@@ -60,7 +60,11 @@ def test_export_round_trip(tmp_path):
     for field in ("ts", "dur", "pid", "tid"):
         assert field in complete[0]
     assert complete[0]["args"]["round"] == 3
-    assert [e for e in events if e["ph"] == "i"][0]["name"] == "marker"
+    instants = [e for e in events if e["ph"] == "i"]
+    # the clock_sync anchor (for trace_merge alignment) precedes user instants
+    assert instants[0]["name"] == "clock_sync"
+    assert "wall_ns" in instants[0]["args"]
+    assert instants[1]["name"] == "marker"
     assert [e for e in events if e["ph"] == "C"][0]["args"] == {
         "up": 10.0, "down": 20.0,
     }

@@ -931,3 +931,61 @@ def test_cli_bundle_corrupt_ring_exits_2_without_traceback(tmp_path):
     )
     assert proc.returncode == 2
     assert "Traceback" not in proc.stderr
+
+
+# -- fleet-ledger columns (observability/fleet.py PR) ------------------------
+
+def test_fleet_columns_render_when_present():
+    rounds = [_round(1, participants_new=4, participation_gini=0.0,
+                     straggler_p99=0.0),
+              _round(2, participants_new=0, participation_gini=0.25,
+                     straggler_p99=3.0)]
+    table = perf_report.render_table(rounds)
+    header = table.splitlines()[0].split()
+    for col in ("new_clients", "gini", "strag_p99"):
+        assert col in header
+    assert "0.250" in table.splitlines()[3]
+    assert all(len(line) == len(table.splitlines()[0])
+               for line in table.splitlines())
+
+
+def test_fleet_columns_absent_keeps_legacy_table_byte_stable():
+    rounds = [_round(1), _round(2)]
+    header = perf_report.render_table(rounds).splitlines()[0]
+    assert "new_clients" not in header and "gini" not in header
+
+
+def test_fleet_summary_last_value_semantics():
+    # gini / straggler_p99 are LIFETIME stats: the summary reports the
+    # LAST round's value (current state), while new-client counts sum
+    rounds = [_round(1, participants_new=4, participation_gini=0.0,
+                     straggler_p99=1.0),
+              _round(2, participants_new=2, participation_gini=0.1234567,
+                     straggler_p99=2.5)]
+    s = perf_report.fleet_summary(rounds)
+    assert s == {"fleet_new_clients": 6, "participation_gini": 0.1235,
+                 "straggler_p99": 2.5}
+    assert perf_report.fleet_summary([_round(1)]) is None
+
+
+def test_json_mode_carries_fleet_key(tmp_path):
+    path = _log(tmp_path, [
+        _round(1, participants_new=3, participation_gini=0.0),
+        _round(2, participants_new=1, participation_gini=0.2),
+    ])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    )
+    doc = json.loads(out.stdout)
+    assert doc["fleet"]["fleet_new_clients"] == 4
+    assert doc["summary"]["fleet_new_clients"] == 4
+    # legacy logs carry no fleet key at all
+    legacy = _log(tmp_path, [_round(1)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), legacy,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    )
+    assert "fleet" not in json.loads(out.stdout)

@@ -1,0 +1,219 @@
+"""tools/trace_merge.py: stitch per-process Chrome traces onto one
+wall-clock axis with flow arrows surviving the process boundary.
+
+The acceptance pin lives in TestTwoProcessRun: a REAL two-process
+cross-silo round trip (coordinator here, silo in a subprocess) exports
+two trace files that the CLI merges into one loadable Perfetto timeline
+whose s/t/f flow triple shares one id across two distinct pids.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import trace_merge  # noqa: E402
+
+pytestmark = pytest.mark.fleet
+
+
+def _trace(pid, wall_ns, events, process_name=None):
+    out = [{
+        "name": "clock_sync", "cat": "__metadata", "ph": "i", "s": "p",
+        "ts": 0.0, "pid": pid, "tid": 0, "args": {"wall_ns": wall_ns},
+    }]
+    if process_name is not None:
+        out.insert(0, {"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": process_name}})
+    for e in events:
+        out.append({"pid": pid, "tid": 0, **e})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+class TestMergeTraces:
+    def test_wall_clock_shift_aligns_later_trace(self):
+        a = _trace(1, 1_000_000_000_000, [
+            {"name": "x", "ph": "X", "ts": 10.0, "dur": 5.0}])
+        b = _trace(2, 1_000_002_000_000, [  # started 2ms later
+            {"name": "y", "ph": "X", "ts": 10.0, "dur": 5.0}])
+        merged = trace_merge.merge_traces([a, b])
+        by_name = {e["name"]: e for e in merged["traceEvents"]
+                   if e["name"] in ("x", "y")}
+        assert by_name["x"]["ts"] == 10.0
+        assert by_name["y"]["ts"] == 2000.0 + 10.0  # +2ms in us
+
+    def test_colliding_pids_get_distinct_lanes(self):
+        a = _trace(1, 0, [{"name": "x", "ph": "X", "ts": 0.0, "dur": 1.0}],
+                   process_name="coordinator")
+        b = _trace(1, 0, [{"name": "y", "ph": "X", "ts": 0.0, "dur": 1.0}],
+                   process_name="silo:0")
+        merged = trace_merge.merge_traces([a, b])
+        pids = {e["name"]: e["pid"] for e in merged["traceEvents"]
+                if e["name"] in ("x", "y")}
+        assert pids["x"] != pids["y"]
+        # process_name metadata followed its pid through the remap
+        lanes = {e["pid"]: e["args"]["name"]
+                 for e in merged["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert lanes[pids["x"]] == "coordinator"
+        assert lanes[pids["y"]] == "silo:0"
+
+    def test_anchorless_trace_merges_with_zero_shift(self, capsys):
+        a = _trace(1, 5_000_000_000, [])
+        b = {"traceEvents": [
+            {"name": "z", "ph": "X", "ts": 3.0, "dur": 1.0, "pid": 9,
+             "tid": 0}]}
+        merged = trace_merge.merge_traces([a, b], labels=["a", "legacy"])
+        z = next(e for e in merged["traceEvents"] if e["name"] == "z")
+        assert z["ts"] == 3.0
+        assert "legacy" in capsys.readouterr().err
+        # fallback lane label for the process_name-less input
+        assert any(e.get("name") == "process_name"
+                   and e["args"]["name"] == "legacy"
+                   for e in merged["traceEvents"])
+
+    def test_metadata_sorts_first(self):
+        a = _trace(1, 0, [{"name": "x", "ph": "X", "ts": 1.0, "dur": 1.0}],
+                   process_name="p")
+        merged = trace_merge.merge_traces([a])
+        phases = [e.get("ph") for e in merged["traceEvents"]]
+        assert phases[0] == "M"
+
+    def test_flow_events_untouched_but_shifted(self):
+        a = _trace(1, 0, [
+            {"name": "rpc_flow", "ph": "s", "id": 42, "ts": 1.0}])
+        b = _trace(2, 1_000, [  # 1us later
+            {"name": "rpc_flow", "ph": "f", "bp": "e", "id": 42, "ts": 1.0}])
+        merged = trace_merge.merge_traces([a, b])
+        flows = [e for e in merged["traceEvents"]
+                 if e["name"] == "rpc_flow"]
+        assert {e["id"] for e in flows} == {42}
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        finish = next(e for e in flows if e["ph"] == "f")
+        assert finish["bp"] == "e"
+        assert finish["ts"] == pytest.approx(2.0)
+
+
+_SILO_SCRIPT = textwrap.dedent("""
+    import os, sys, threading, time
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, sys.argv[3])
+
+    import jax.numpy as jnp
+
+    from fl4health_tpu.observability.spans import Tracer, set_tracer
+    from fl4health_tpu.observability.tracectx import traced_handler
+    from fl4health_tpu.transport import LoopbackServer, decode, encode
+
+    tracer = Tracer(enabled=True, process_name="silo:0")
+    set_tracer(tracer)
+    done = threading.Event()
+
+    def silo(frame):
+        params = decode(frame, like={"w": jnp.zeros(2)})
+        reply = encode({"params": {"w": params["w"] + 1.0},
+                        "n": jnp.asarray(1.0)})
+        done.set()
+        return reply
+
+    server = LoopbackServer(traced_handler(silo))
+    with open(sys.argv[1], "w") as f:  # publish the bound port
+        f.write(str(server.port))
+    if not done.wait(60):
+        sys.exit(3)
+    time.sleep(0.3)  # let the reply finish sending
+    server.close()
+    tracer.export(sys.argv[2])
+""")
+
+
+class TestTwoProcessRun:
+    def test_cross_silo_traces_merge_into_one_timeline(self, tmp_path):
+        """THE acceptance pin: coordinator (this process) + silo (a real
+        subprocess) each export a trace; the trace_merge CLI produces one
+        loadable timeline where the round's flow events cross the process
+        boundary (same flow id, two distinct pids)."""
+        import jax.numpy as jnp
+
+        from fl4health_tpu.observability.spans import Tracer, set_tracer
+        from fl4health_tpu.observability.tracectx import (
+            TraceContext,
+            flow_id,
+        )
+        from fl4health_tpu.transport import broadcast_round
+
+        port_file = tmp_path / "port"
+        silo_trace = tmp_path / "silo_trace.json"
+        coord_trace = tmp_path / "coord_trace.json"
+        script = tmp_path / "silo.py"
+        script.write_text(_SILO_SCRIPT)
+        proc = subprocess.Popen(
+            [sys.executable, str(script), str(port_file), str(silo_trace),
+             str(REPO)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        tracer = Tracer(enabled=True, process_name="coordinator")
+        prev = set_tracer(tracer)
+        try:
+            deadline = 120
+            while not port_file.exists() and deadline > 0:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        "silo died: " + proc.stderr.read().decode())
+                import time
+                time.sleep(0.25)
+                deadline -= 0.25
+            port = int(port_file.read_text())
+            ctx = TraceContext.fresh(round=3)
+            replies = broadcast_round(
+                [("127.0.0.1", port)],
+                {"w": jnp.asarray([1.0, 2.0])},
+                {"params": {"w": jnp.zeros(2)}, "n": jnp.zeros(())},
+                trace=ctx,
+            )
+            assert len(replies) == 1
+            tracer.export(str(coord_trace))
+        finally:
+            set_tracer(prev)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+        assert proc.returncode == 0, proc.stderr.read().decode()
+
+        merged_path = tmp_path / "merged.json"
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "trace_merge.py"),
+             str(coord_trace), str(silo_trace), "-o", str(merged_path)],
+            capture_output=True, text=True, cwd=str(REPO),
+        )
+        assert out.returncode == 0, out.stderr
+        assert "flow events" in out.stdout
+
+        doc = json.loads(merged_path.read_text())  # loadable timeline
+        events = doc["traceEvents"]
+        fid = flow_id(ctx.trace_id, 3)
+        flows = [e for e in events
+                 if e.get("name") == "rpc_flow" and e.get("id") == fid]
+        assert sorted(e["ph"] for e in flows) == ["f", "s", "t"]
+        # the flow CROSSES the process boundary: coordinator's s/f and the
+        # silo's t live on distinct pid lanes
+        step_pid = next(e["pid"] for e in flows if e["ph"] == "t")
+        start_pid = next(e["pid"] for e in flows if e["ph"] == "s")
+        assert step_pid != start_pid
+        lanes = {e["pid"]: e["args"]["name"] for e in events
+                 if e.get("name") == "process_name"}
+        assert lanes[start_pid] == "coordinator"
+        assert lanes[step_pid] == "silo:0"
+        # both processes carried a clock anchor into the merge
+        assert sum(1 for e in events if e.get("name") == "clock_sync") == 2
+        # the silo's handler span is stamped with the coordinator's trace
+        silo_span = next(e for e in events if e.get("name") == "silo_handle")
+        assert silo_span["args"]["trace_id"] == ctx.trace_id

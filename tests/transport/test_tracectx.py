@@ -1,0 +1,188 @@
+"""Cross-silo trace context (observability/tracectx.py + codec "trace"
+header): correlated coordinator/silo spans and Chrome flow events.
+
+Pinned contracts:
+- byte-stability: ``encode(tree)`` without a trace emits EXACTLY the
+  legacy frames, and traced frames decode to the identical pytree;
+- ``frame_trace`` / ``TraceContext.from_header`` are tolerant — absent
+  or malformed headers yield None, never an exception;
+- ``flow_id`` is a deterministic positive 63-bit int per (trace, round);
+- a traced loopback round trip emits the full s/t/f flow triple sharing
+  one id, with the silo span stamped by the coordinator's trace id.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fl4health_tpu.observability.spans import Tracer, set_tracer
+from fl4health_tpu.observability.tracectx import (
+    TraceContext,
+    flow_id,
+    new_trace_id,
+    traced_handler,
+)
+from fl4health_tpu.transport import (
+    LoopbackServer,
+    broadcast_round,
+    decode,
+    encode,
+)
+from fl4health_tpu.transport.codec import frame_trace
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture
+def private_tracer():
+    tracer = Tracer(enabled=True, process_name="test")
+    prev = set_tracer(tracer)
+    yield tracer
+    set_tracer(prev)
+
+
+class TestTraceContext:
+    def test_fresh_child_and_header_round_trip(self):
+        ctx = TraceContext.fresh(round=7)
+        assert len(ctx.trace_id) == 16
+        child = ctx.child()
+        assert child.trace_id == ctx.trace_id
+        assert child.span_id != ctx.span_id
+        assert child.round == 7
+        back = TraceContext.from_header(ctx.to_header())
+        assert back == ctx
+
+    def test_from_header_tolerates_garbage(self):
+        assert TraceContext.from_header(None) is None
+        assert TraceContext.from_header({}) is None
+        assert TraceContext.from_header({"trace_id": "x"}) is None
+        assert TraceContext.from_header(
+            {"trace_id": "a", "span_id": "b", "round": "banana"}
+        ) is None
+        assert TraceContext.from_header("not-a-mapping") is None
+
+    def test_flow_id_deterministic_positive(self):
+        a = flow_id("abc", 3)
+        assert a == flow_id("abc", 3)
+        assert a != flow_id("abc", 4)
+        assert a != flow_id("abd", 3)
+        assert 0 < a < 2 ** 63
+
+    def test_trace_ids_unique(self):
+        assert len({new_trace_id() for _ in range(64)}) == 64
+
+
+class TestCodecTraceHeader:
+    TREE = {"w": jnp.asarray([1.0, 2.0])}
+
+    def test_untraced_frames_byte_stable(self):
+        assert encode(self.TREE) == encode(self.TREE, trace=None)
+        assert frame_trace(encode(self.TREE)) is None
+
+    def test_traced_header_round_trips_and_payload_identical(self):
+        ctx = TraceContext.fresh(round=5)
+        plain = encode(self.TREE)
+        traced = encode(self.TREE, trace=ctx.to_header())
+        assert traced != plain  # the header really travels
+        assert TraceContext.from_header(frame_trace(traced)) == ctx
+        like = {"w": jnp.zeros(2)}
+        np.testing.assert_array_equal(
+            np.asarray(decode(traced, like=like)["w"]),
+            np.asarray(decode(plain, like=like)["w"]),
+        )
+
+    def test_frame_trace_never_raises(self):
+        assert frame_trace(b"") is None
+        assert frame_trace(b"garbage not a frame") is None
+
+
+class TestTracedHandler:
+    def test_untraced_frame_passes_through(self, private_tracer):
+        handler = traced_handler(lambda b: b + b"!")
+        assert handler(b"abc") == b"abc!"
+        assert private_tracer.events == []
+
+    def test_disabled_tracer_passes_through(self):
+        tracer = Tracer(enabled=False)
+        prev = set_tracer(tracer)
+        try:
+            frame = encode({"w": jnp.zeros(1)},
+                           trace=TraceContext.fresh(1).to_header())
+            handler = traced_handler(lambda b: b"ok")
+            assert handler(frame) == b"ok"
+            assert tracer.events == []
+        finally:
+            set_tracer(prev)
+
+    def test_traced_frame_emits_stamped_span_and_flow_step(
+        self, private_tracer
+    ):
+        ctx = TraceContext.fresh(round=9)
+        frame = encode({"w": jnp.zeros(1)}, trace=ctx.to_header())
+        handler = traced_handler(lambda b: b"reply", name="silo_handle")
+        assert handler(frame) == b"reply"
+        by_name = {e["name"]: e for e in private_tracer.events}
+        span = by_name["silo_handle"]
+        assert span["args"]["trace_id"] == ctx.trace_id
+        assert span["args"]["parent_span"] == ctx.span_id
+        assert span["args"]["round"] == 9
+        assert span["args"]["reply_bytes"] == len(b"reply")
+        step = by_name["rpc_flow"]
+        assert step["ph"] == "t"
+        assert step["id"] == flow_id(ctx.trace_id, 9)
+
+
+class TestLoopbackFlow:
+    def test_broadcast_emits_full_flow_triple(self, private_tracer):
+        """One traced round trip in one process: broadcast start ("s"),
+        silo handler step ("t"), reply finish ("f") all share the round's
+        deterministic flow id."""
+        def silo(frame: bytes) -> bytes:
+            params = decode(frame, like={"w": jnp.zeros(2)})
+            return encode({"params": {"w": params["w"] + 1.0},
+                           "n": jnp.asarray(1.0)})
+
+        ctx = TraceContext.fresh(round=7)
+        server = LoopbackServer(traced_handler(silo))
+        try:
+            replies = broadcast_round(
+                [(server.host, server.port)],
+                {"w": jnp.asarray([1.0, 2.0])},
+                {"params": {"w": jnp.zeros(2)}, "n": jnp.zeros(())},
+                trace=ctx,
+            )
+        finally:
+            server.close()
+        np.testing.assert_allclose(
+            np.asarray(replies[0]["params"]["w"]), [2.0, 3.0]
+        )
+        flows = [e for e in private_tracer.events
+                 if e["name"] == "rpc_flow"]
+        assert sorted(e["ph"] for e in flows) == ["f", "s", "t"]
+        assert {e["id"] for e in flows} == {flow_id(ctx.trace_id, 7)}
+        finish = next(e for e in flows if e["ph"] == "f")
+        assert finish.get("bp") == "e"  # binds to the enclosing slice
+        names = {e["name"] for e in private_tracer.events}
+        assert {"broadcast_encode", "rpc", "silo_handle"} <= names
+
+    def test_tracer_off_means_no_trace_on_wire(self):
+        """With the process tracer disabled (the default), broadcast
+        frames carry no trace header — byte-stable legacy wire."""
+        seen = {}
+
+        def silo(frame: bytes) -> bytes:
+            seen["trace"] = frame_trace(frame)
+            params = decode(frame, like={"w": jnp.zeros(1)})
+            return encode({"params": {"w": params["w"]},
+                           "n": jnp.asarray(1.0)})
+
+        server = LoopbackServer(silo)
+        try:
+            broadcast_round(
+                [(server.host, server.port)],
+                {"w": jnp.asarray([1.0])},
+                {"params": {"w": jnp.zeros(1)}, "n": jnp.zeros(())},
+            )
+        finally:
+            server.close()
+        assert seen["trace"] is None

@@ -108,6 +108,17 @@ COHORT_COLUMNS = (
     ("scatter_ms", "scatter_ms", lambda v: f"{v:.1f}"),
 )
 
+# Fleet-ledger fields (observability/fleet.py): first-time participants,
+# lifetime participation skew (gini over the ledger's per-client counts)
+# and the p99 straggler score of the round. Optional like the telemetry
+# columns — ledger-off logs keep their exact old table shape (byte-stable,
+# tested).
+FLEET_COLUMNS = (
+    ("new_clients", "participants_new", lambda v: str(int(v))),
+    ("gini", "participation_gini", lambda v: f"{v:.3f}"),
+    ("strag_p99", "straggler_p99", lambda v: f"{v:.1f}"),
+)
+
 # Flight-recorder fields (observability/flightrec.py): the recorded
 # aggregate losses a postmortem ring carries per round. Round events in
 # normal JSONL logs never contain them, so legacy tables stay byte-stable;
@@ -195,7 +206,7 @@ def active_columns(rounds: list[dict]) -> tuple:
     extra = tuple(
         col for col in (TELEMETRY_COLUMNS + WIRE_COLUMNS + MESH_COLUMNS
                         + PRECISION_COLUMNS + ASYNC_COLUMNS + CKPT_COLUMNS
-                        + COHORT_COLUMNS + FLIGHT_COLUMNS)
+                        + COHORT_COLUMNS + FLEET_COLUMNS + FLIGHT_COLUMNS)
         if any(col[1] in rec for rec in rounds)
     )
     return COLUMNS + extra
@@ -504,7 +515,35 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
                 if "scatter_ms" in r]
         if scat:
             summary["scatter_ms_mean"] = round(sum(scat) / len(scat), 3)
+    fleet = fleet_summary(rounds)
+    if fleet:
+        # fleet-ledger runs only — legacy summaries stay byte-stable
+        summary.update(fleet)
     return summary
+
+
+def fleet_summary(rounds: list[dict]) -> "dict[str, Any] | None":
+    """Fleet-ledger aggregates over the round events, or None when the
+    log never carried a fleet field (ledger off / pre-ledger log). The
+    gini and straggler numbers are LIFETIME statistics, so the last
+    round's value is the run's current state (not a mean)."""
+    if not any("participants_new" in r or "participation_gini" in r
+               for r in rounds):
+        return None
+    out: dict[str, Any] = {
+        "fleet_new_clients": int(sum(
+            float(r.get("participants_new", 0)) for r in rounds
+        )),
+    }
+    ginis = [float(r["participation_gini"]) for r in rounds
+             if r.get("participation_gini") is not None]
+    if ginis:
+        out["participation_gini"] = round(ginis[-1], 4)
+    strag = [float(r["straggler_p99"]) for r in rounds
+             if r.get("straggler_p99") is not None]
+    if strag:
+        out["straggler_p99"] = round(strag[-1], 2)
+    return out
 
 
 def render_bundle(bundle_dir: str, as_json: bool = False) -> int:
@@ -627,6 +666,10 @@ def main(argv: list[str] | None = None) -> int:
             doc["sweep_summary"] = sweep_summary
         if checkpoints:
             doc["checkpoints"] = checkpoints
+        fleet = fleet_summary(rounds)
+        if fleet:
+            # fleet-ledger runs only — legacy JSON keeps its exact shape
+            doc["fleet"] = fleet
         print(json.dumps(doc, indent=2))
         return 0
     print(render_table(rounds))

@@ -90,6 +90,7 @@ def build_report(bundle: dict) -> dict:
     ring = bundle.get("ring") or []
     verdict = bundle.get("verdict") or {}
     header = bundle.get("ring_header") or {}
+    fleet = bundle.get("fleet")
     report: dict[str, Any] = {
         "bundle": bundle.get("path"),
         "verdict": verdict,
@@ -98,9 +99,22 @@ def build_report(bundle: dict) -> dict:
         "rounds_recorded": [int(e.get("round", 0)) for e in ring],
         "timeline": ring_round_rows(ring),
         "divergence_onset": detect_divergence_onset(ring),
-        "suspects": rank_suspects(ring),
+        # fleet.json priors make repeat offenders outrank first-timers
+        # with equal window evidence (absent on pre-ledger bundles)
+        "suspects": rank_suspects(ring, ledger=fleet),
         "wire": wire_stats(ring),
     }
+    if fleet:
+        clients = fleet.get("clients") or []
+        part = [int(c.get("rounds_participated") or 0) for c in clients]
+        report["fleet"] = {
+            "rounds_absorbed": fleet.get("rounds_absorbed"),
+            "clients_seen": len(clients),
+            "registry_size": fleet.get("registry_size"),
+            "quarantined_now": sum(
+                1 for c in clients if c.get("quarantined")),
+            "max_rounds_participated": max(part) if part else 0,
+        }
     ck = header.get("checkpoint") or verdict.get("resume") or {}
     if ck:
         report["resume_from"] = {
@@ -184,6 +198,17 @@ def render_text(report: dict) -> str:
             lines.append(f"  client {s['client']}  score {s['score']}")
             for e in s["evidence"]:
                 lines.append(f"    - {e}")
+    fleet = report.get("fleet")
+    if fleet:
+        lines.append("")
+        lines.append(
+            "fleet ledger: "
+            f"{fleet.get('clients_seen')} client(s) seen over "
+            f"{fleet.get('rounds_absorbed')} round(s)"
+            + (f" (registry {fleet['registry_size']})"
+               if fleet.get("registry_size") else "")
+            + f", {fleet.get('quarantined_now', 0)} quarantined at death"
+        )
     wire = report.get("wire") or {}
     if wire.get("gather_bytes"):
         lines.append("")

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome traces into one cross-silo Perfetto timeline.
+
+A cross-silo run produces one trace file per process — the coordinator's
+and each silo's (``Tracer.stream_to`` / ``Tracer.export``). Each trace's
+timestamps are microseconds since ITS OWN tracer's construction on a
+monotonic clock, so loading them separately shows disjoint timelines and
+loading them naively together overlays unrelated instants.
+
+This tool stitches them onto one axis:
+
+1. every trace carries a ``clock_sync`` instant at ts=0 whose
+   ``args.wall_ns`` is the wall clock at tracer construction
+   (``observability/spans.py``); the earliest anchor becomes the merged
+   origin and every other trace's events shift right by the wall delta;
+2. colliding pids (containers often all see pid 1; a forked silo can
+   reuse the coordinator's pid) are remapped per input file so each
+   process keeps its own lane — ``process_name`` metadata survives the
+   remap, so lanes read "coordinator" / "silo:1", not raw numbers;
+3. flow events (``ph`` s/t/f, emitted by ``transport/coordinator.py``
+   and ``observability/tracectx.traced_handler`` with a shared
+   deterministic id per round) are left untouched: once the traces share
+   a clock, Perfetto draws the broadcast → silo handler → reply arrows
+   ACROSS the process boundary.
+
+Usage::
+
+    python tools/trace_merge.py coord/trace.json silo*/trace.json \
+        -o merged_trace.json
+
+Traces without a ``clock_sync`` anchor (pre-fleet-telescope files) merge
+with zero shift and a warning — still loadable, just not aligned.
+Stdlib only (zero-egress box).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from fl4health_tpu.observability.spans import load_trace  # noqa: E402
+
+
+def _anchor_ns(events: "list[dict[str, Any]]") -> int | None:
+    """The wall-clock anchor (ns) a trace's ts=0 corresponds to, from its
+    ``clock_sync`` instant; None for a pre-anchor trace."""
+    for evt in events:
+        if evt.get("name") == "clock_sync":
+            try:
+                return int(evt["args"]["wall_ns"])
+            except (KeyError, TypeError, ValueError):
+                return None
+    return None
+
+
+def merge_traces(
+    docs: "list[dict[str, Any]]",
+    labels: "list[str] | None" = None,
+) -> "dict[str, Any]":
+    """Merge loaded trace envelopes (``{"traceEvents": [...]}``) into one.
+
+    Pure function over already-loaded documents so tests and the
+    postmortem tooling can merge in-memory traces; the CLI wraps it with
+    :func:`~fl4health_tpu.observability.spans.load_trace`. ``labels``
+    (defaults to ``trace<i>``) name inputs in warnings and in the
+    fallback lane name when a trace never set a ``process_name``.
+    """
+    labels = labels or [f"trace{i}" for i in range(len(docs))]
+    per_input: list[tuple[str, list[dict], int | None]] = []
+    for label, doc in zip(labels, docs):
+        events = [e for e in doc.get("traceEvents", []) if e]
+        per_input.append((label, events, _anchor_ns(events)))
+
+    anchors = [a for _, _, a in per_input if a is not None]
+    base_ns = min(anchors) if anchors else 0
+
+    merged: list[dict] = []
+    used_pids: set[int] = set()
+    next_free = 1_000_000  # far above real pid ranges: remaps are obvious
+    for label, events, anchor in per_input:
+        if anchor is None and anchors:
+            print(f"trace_merge: {label}: no clock_sync anchor — merged "
+                  f"with zero shift (timestamps not aligned)",
+                  file=sys.stderr)
+        shift_us = ((anchor - base_ns) / 1000.0) if anchor is not None else 0.0
+
+        # one pid remap per input file: a pid may legitimately repeat
+        # WITHIN a file (threads), never across files (distinct processes)
+        pid_map: dict[int, int] = {}
+
+        def remap(pid: int) -> int:
+            nonlocal next_free
+            if pid not in pid_map:
+                if pid in used_pids:
+                    new = next_free
+                    next_free += 1
+                else:
+                    new = pid
+                pid_map[pid] = new
+                used_pids.add(new)
+            return pid_map[pid]
+
+        saw_process_name = False
+        for evt in events:
+            out = dict(evt)
+            if "pid" in out:
+                try:
+                    out["pid"] = remap(int(out["pid"]))
+                except (TypeError, ValueError):
+                    pass
+            if "ts" in out:
+                try:
+                    out["ts"] = float(out["ts"]) + shift_us
+                except (TypeError, ValueError):
+                    pass
+            if out.get("name") == "process_name" and out.get("ph") == "M":
+                saw_process_name = True
+            merged.append(out)
+        if not saw_process_name and pid_map:
+            # label the lane with the input name so the merged view never
+            # shows a bare remapped number
+            merged.append({
+                "name": "process_name", "ph": "M",
+                "pid": next(iter(pid_map.values())), "tid": 0,
+                "args": {"name": label},
+            })
+
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="merge per-process Chrome traces onto one wall-clock "
+                    "axis (flow arrows survive across processes)")
+    parser.add_argument("traces", nargs="+",
+                        help="per-process trace.json files "
+                             "(streamed or exported; torn tails tolerated)")
+    parser.add_argument("-o", "--out", default="merged_trace.json",
+                        help="output path (default: %(default)s)")
+    args = parser.parse_args(argv)
+
+    docs = [load_trace(path) for path in args.traces]
+    doc = merge_traces(docs, labels=list(args.traces))
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    n = len(doc["traceEvents"])
+    flows = sum(1 for e in doc["traceEvents"] if e.get("ph") in ("s", "t", "f"))
+    print(f"{args.out}: {n} events from {len(args.traces)} traces "
+          f"({flows} flow events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
