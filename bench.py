@@ -1143,6 +1143,136 @@ def timed_cohort_block(timing: bool = True) -> dict:
     }
 
 
+def timed_cohort_chunk_block(timing: bool = True) -> dict:
+    """Chunked-cohort dispatch-amortization block (the O(rounds/R)
+    host-barrier PR metric): run the SAME subsampled cohort fit pipelined
+    (R=1 host-drawn baseline) and chunked at R in {1, 8, 32} rounds per
+    dispatch, and report MEASURED host round-trips per round via the
+    ``fl_cohort_host_roundtrips_total`` counter plus dispatch and compile
+    counts — all exact on any backend. Wall time is the only timing
+    field, nulled on the CPU fallback. The arms' final params are
+    compared bitwise (the parity claim rides the artifact, not just the
+    test suite). Knobs: FL4HEALTH_BENCH_COHORT_CHUNK_ROUNDS (32),
+    FL4HEALTH_BENCH_COHORT_CHUNK_REGISTRY (256),
+    FL4HEALTH_BENCH_COHORT_CHUNK_SLOTS (16)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+
+    from fl4health_tpu.checkpointing.state import SimulationStateCheckpointer
+    from fl4health_tpu.clients import engine as client_engine
+    from fl4health_tpu.datasets.registry_presets import (
+        dirichlet_registry_source,
+    )
+    from fl4health_tpu.datasets.synthetic import synthetic_classification
+    from fl4health_tpu.metrics.base import MetricManager
+    from fl4health_tpu.models.cnn import Mlp
+    from fl4health_tpu.observability import Observability
+    from fl4health_tpu.observability.registry import MetricsRegistry
+    from fl4health_tpu.server.client_manager import FixedFractionManager
+    from fl4health_tpu.server.registry import CohortConfig
+    from fl4health_tpu.server.simulation import FederatedSimulation
+    from fl4health_tpu.strategies.fedavg import FedAvg
+
+    n_classes = 5
+    rounds = max(
+        int(os.environ.get("FL4HEALTH_BENCH_COHORT_CHUNK_ROUNDS", 32)), 2
+    )
+    n = int(os.environ.get("FL4HEALTH_BENCH_COHORT_CHUNK_REGISTRY", 256))
+    slots = int(os.environ.get("FL4HEALTH_BENCH_COHORT_CHUNK_SLOTS", 16))
+    x, y = synthetic_classification(
+        jax.random.PRNGKey(0), 2048, (16,), n_classes
+    )
+    x, y = np.asarray(x), np.asarray(y)
+
+    def run(mode, r, ckpt_dir):
+        reg = MetricsRegistry()  # PRIVATE: the default registry is
+        # process-global and would smear counters across arms
+        obs = Observability(enabled=True, registry=reg)
+        sim = FederatedSimulation(
+            logic=client_engine.ClientLogic(
+                client_engine.from_flax(
+                    Mlp(features=(32,), n_outputs=n_classes)
+                ),
+                client_engine.masked_cross_entropy,
+            ),
+            tx=optax.sgd(0.05),
+            strategy=FedAvg(),
+            datasets=dirichlet_registry_source(x, y, n, beta=0.5, seed=7),
+            batch_size=16,
+            metrics=MetricManager(()),
+            local_steps=2,
+            seed=5,
+            cohort=CohortConfig(slots=slots),
+            client_manager=FixedFractionManager(n, slots / n),
+            execution_mode=mode,
+            observability=obs,
+            # checkpoint_every IS the chunk length R: boundaries force one
+            # dispatch per R rounds; R == rounds runs the whole fit as one
+            # scan with no checkpointer at all
+            state_checkpointer=(
+                None if r >= rounds else SimulationStateCheckpointer(
+                    ckpt_dir, checkpoint_every=r, keep=1
+                )
+            ),
+        )
+        t0 = time.perf_counter()
+        sim.fit(rounds)
+        wall = time.perf_counter() - t0
+        events = [e for e in reg.events if e["event"] == "round"]
+        trips = reg.counter("fl_cohort_host_roundtrips_total").value
+        return {
+            "mode": mode,
+            "rounds_per_dispatch": r,
+            "rounds": rounds,
+            # the measured O(rounds/R) claim — exact on any backend
+            "host_roundtrips_total": int(trips),
+            "host_roundtrips_per_round": round(trips / rounds, 4),
+            "dispatches": int(trips),
+            "compiles_total": int(
+                sum(e.get("compiles", 0) for e in events)
+            ),
+            "cohort_draw": (
+                events[-1].get("cohort_draw") if events else None
+            ),
+            "wall_s_total": round(wall, 3) if timing else None,
+        }, np.asarray(
+            jax.flatten_util.ravel_pytree(jax.device_get(sim.global_params))[0]
+        )
+
+    arms, params = [], []
+    with tempfile.TemporaryDirectory() as td:
+        arm, p = run("pipelined", 1, os.path.join(td, "pipelined"))
+        arms.append(arm)
+        params.append(p)
+        for r in (1, 8, 32):
+            r = min(r, rounds)
+            arm, p = run("chunked", r, os.path.join(td, f"chunk_{r}"))
+            arms.append(arm)
+            params.append(p)
+    base = arms[0]
+    chunked_max = arms[-1]
+    return {
+        "registry_size": n,
+        "cohort_slots": slots,
+        "rounds": rounds,
+        "arms": arms,
+        # every arm must land on the pipelined baseline's params BITWISE —
+        # the parity discipline the chunk lengths ride on
+        "params_bitwise_identical": all(
+            np.array_equal(params[0], p) for p in params[1:]
+        ),
+        # the acceptance ratio: host round-trips per round must shrink by
+        # >= R/2 at the largest chunk length
+        "roundtrip_reduction_at_max_r": round(
+            base["host_roundtrips_total"]
+            / max(chunked_max["host_roundtrips_total"], 1), 3
+        ),
+    }
+
+
 def timed_async_block(timing: bool = True) -> dict:
     """Buffered-async block (the tail-independence PR acceptance metric):
     sync-vs-async round CADENCE and final loss under one fixed straggler
@@ -1992,6 +2122,11 @@ def run_cohort_artifact() -> None:
         "data_provenance": "synthetic",
         "cohort": block,
     }
+    if os.environ.get("FL4HEALTH_BENCH_COHORT_CHUNK") == "1":
+        # opt-in chunked-dispatch arm (PR 17): dispatch/compile counts and
+        # the measured host-roundtrip counter are exact on any backend;
+        # only the wall numbers are timing-gated like everything else
+        record["cohort_chunked"] = timed_cohort_chunk_block(timing=timing)
     if fallback:
         record["note"] = (
             "Program-identity facts (flops/peak-HBM equal across registry "

@@ -637,7 +637,17 @@ class SimulationStateCheckpointer(StateCheckpointer):
         in the frame header. ``n_clients`` in the header is the SLOT count
         (the restore template's shape); ``registry_size`` binds the frame
         to its client population. ``fleet``: see
-        :meth:`save_simulation_snapshot`."""
+        :meth:`save_simulation_snapshot`.
+
+        Both cohort dispatch routes write this same frame: the pipelined
+        path at its per-round cadence, the chunked path at chunk
+        boundaries (the chunk length IS ``checkpoint_every``, so every
+        due round is a boundary and the window has already been scattered
+        back into the registry when the snapshot is taken). A frame is
+        therefore route-agnostic — a run saved pipelined may resume
+        chunked and vice versa, and the resumed trajectory stays
+        bit-identical because both routes draw round ``r``'s cohort from
+        the same ``fold_in(seed, 2000+r)`` stream."""
         trees = dict(trees)
         c_ids = registry_rows.get("client_ids")
         s_ids = registry_rows.get("strategy_ids")

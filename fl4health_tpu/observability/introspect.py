@@ -80,6 +80,11 @@ class ProgramReport:
     cache_misses: int = 0
     # a multi-round scan program executes this many rounds per dispatch
     rounds_per_dispatch: int = 1
+    # cohort-draw site of a registry program ("in_graph" for the chunked
+    # cohort scan); None on dense / host-drawn programs (omitted from
+    # as_dict/events like ``mesh``, so legacy program records keep their
+    # exact shape)
+    cohort_draw: str | None = None
     # mesh/sharding descriptor (parallel.program.RoundProgramBuilder
     # .descriptor()) when the program was built for a device mesh; None on
     # single-chip builds (and omitted from as_dict/events, so legacy
@@ -127,6 +132,8 @@ class ProgramReport:
             del d["mesh"]
         if d.get("precision") is None:
             del d["precision"]
+        if d.get("cohort_draw") is None:
+            del d["cohort_draw"]
         d["peak_hbm_bytes"] = self.peak_hbm_bytes
         d["cache_hit"] = self.cache_hit
         roof = self.roofline()
@@ -206,7 +213,9 @@ class ProgramIntrospector:
     def introspect_jit(self, name: str, jitted: Any, args: tuple,
                        rounds_per_dispatch: int = 1,
                        mesh: dict | None = None,
-                       precision: dict | None = None) -> ProgramReport | None:
+                       precision: dict | None = None,
+                       cohort_draw: str | None = None
+                       ) -> ProgramReport | None:
         """AOT-lower and compile ``jitted`` against (abstracted) ``args``
         and record the report. The compile goes through XLA's normal
         ``compile_or_get_cached`` path, so with the persistent compilation
@@ -232,6 +241,7 @@ class ProgramIntrospector:
                     self.registry.counter(_CACHE_MISSES).value - misses0
                 ),
                 rounds_per_dispatch=rounds_per_dispatch,
+                cohort_draw=cohort_draw,
                 mesh=mesh,
                 precision=precision,
                 **analyze_compiled(

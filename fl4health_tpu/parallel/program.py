@@ -162,7 +162,15 @@ class RoundProgramBuilder:
         return self.named(P(CLIENTS_AXIS))
 
     def stacked_client_sharding(self) -> NamedSharding | None:
-        """[rounds, C, ...] chunk inputs: clients on axis 1."""
+        """[rounds, C, ...] chunk inputs: clients on axis 1.
+
+        The cohort chunked route's window trees ([W, ...] registry rows,
+        W = min(N, R*K)) deliberately do NOT get a sharding helper: W is
+        not a multiple of the device count in general, and the in-graph
+        searchsorted gather/scatter against the window would resolve to
+        cross-device collectives per scan step. That is why mesh + cohort
+        demotes to the pipelined path (simulation._chunk_ineligibility)
+        instead of running a sharded window exchange."""
         return self.named(P(None, CLIENTS_AXIS))
 
     def replicated(self) -> NamedSharding | None:

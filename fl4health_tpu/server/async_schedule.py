@@ -50,7 +50,9 @@ import numpy as np
 __all__ = [
     "AsyncConfig",
     "AsyncEventPlan",
+    "RegistryEventPlan",
     "build_event_plan",
+    "build_registry_event_plan",
     "plan_fingerprint",
     "plan_prefix_fingerprints",
     "staleness_discount",
@@ -153,6 +155,33 @@ class AsyncEventPlan:
         }
 
 
+@dataclasses.dataclass(frozen=True)
+class RegistryEventPlan(AsyncEventPlan):
+    """An :class:`AsyncEventPlan` whose ``C`` axis is COHORT SLOTS over a
+    client registry rather than a fixed dense cohort (server/registry.py).
+
+    The virtual-clock process is identical — slots draw compute times,
+    fill the buffer, restart on consume — but each slot is OCCUPIED by a
+    registry client, and a consumed slot hands its seat to a fresh client
+    drawn deterministically from the currently-unseated pool. ``slot_ids``
+    row ``e`` is the occupancy the restart wave of event ``e`` trains
+    under (row 0 = the initial occupancy the prologue trains under), so
+    the host stages event ``e``'s restart batches for ``slot_ids[e]`` and
+    scatters the evicted occupants' rows back to the registry.
+
+    With ``slots == registry_size`` the unseated pool is empty, occupancy
+    is the identity forever, and the plan degenerates to the plain
+    :class:`AsyncEventPlan` over the full registry — which is how the
+    async-over-registry vs sync parity smoke pins the composition.
+
+    slot_ids: [E+1, K] int64 — registry id seated in each slot per wave.
+    """
+
+    slot_ids: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0, 0), np.int64)
+    )
+
+
 def plan_prefix_fingerprints(plan: AsyncEventPlan) -> list[str]:
     """Per-event prefix digests of a static event plan: entry ``e-1`` is a
     short hash over events ``1..e``'s arrivals, staleness and virtual
@@ -166,10 +195,19 @@ def plan_prefix_fingerprints(plan: AsyncEventPlan) -> list[str]:
     arrivals = np.ascontiguousarray(plan.arrivals, np.float32)
     staleness = np.ascontiguousarray(plan.staleness, np.float32)
     times = np.ascontiguousarray(plan.event_times, np.float64)
+    slot_ids = getattr(plan, "slot_ids", None)
+    if slot_ids is not None and slot_ids.size:
+        slot_ids = np.ascontiguousarray(slot_ids, np.int64)
+    else:
+        slot_ids = None
     for e in range(plan.n_events):
         h.update(arrivals[e].tobytes())
         h.update(staleness[e].tobytes())
         h.update(times[e].tobytes())
+        if slot_ids is not None:
+            # registry plans fold the post-event occupancy too: a resume
+            # must splice into the same SEATING, not just the same cadence
+            h.update(slot_ids[e + 1].tobytes())
         out.append(h.copy().hexdigest()[:16])
     return out
 
@@ -270,6 +308,57 @@ def build_event_plan(
             heapq.heappush(heap, (t_event + times[e + 1, c], c))
     return AsyncEventPlan(
         arrivals=arrivals, staleness=staleness, event_times=event_times
+    )
+
+
+def build_registry_event_plan(
+    config: AsyncConfig,
+    n_events: int,
+    slots: int,
+    registry_size: int,
+    fault_plan=None,
+) -> RegistryEventPlan:
+    """Resolve the buffered-async process over a client REGISTRY: the
+    slot-level schedule is exactly :func:`build_event_plan` (same seeds,
+    same heap, same cadence — a slot is the unit that draws compute time
+    and fills the buffer), plus a deterministic occupancy ledger mapping
+    each slot to the registry client seated in it per restart wave.
+
+    Seating rule: slots start occupied by registry ids ``0..K-1``; when a
+    slot's update is consumed at event ``e`` it hands the seat to the
+    lowest-index draw from the unseated pool (seeded per event by
+    ``default_rng([seed, 104729, e])``, without replacement across that
+    event's consumed slots, in ascending slot order). When the pool is
+    empty (``slots == registry_size``) every occupant keeps its seat and
+    the plan degenerates to the dense one. Staleness bookkeeping is
+    per-SLOT: the new occupant pulls the fresh server version at the swap,
+    so discounting semantics are unchanged."""
+    if slots > registry_size:
+        raise ValueError(
+            f"cohort slots ({slots}) exceed the registry "
+            f"({registry_size} clients): every seat needs an occupant"
+        )
+    base = build_event_plan(config, n_events, slots, fault_plan)
+    slot_ids = np.zeros((n_events + 1, slots), np.int64)
+    occ = np.arange(slots, dtype=np.int64)
+    seated = np.zeros((registry_size,), bool)
+    seated[occ] = True
+    slot_ids[0] = occ
+    for e in range(n_events):
+        consumed = np.nonzero(base.arrivals[e] > 0)[0]
+        pool = np.nonzero(~seated)[0]
+        if pool.size:
+            rng = np.random.default_rng([config.seed, 104729, e])
+            take = min(pool.size, consumed.size)
+            drawn = rng.choice(pool, size=take, replace=False)
+            for s, new_id in zip(consumed[:take], drawn):
+                seated[occ[s]] = False
+                seated[new_id] = True
+                occ[s] = new_id
+        slot_ids[e + 1] = occ
+    return RegistryEventPlan(
+        arrivals=base.arrivals, staleness=base.staleness,
+        event_times=base.event_times, slot_ids=slot_ids,
     )
 
 

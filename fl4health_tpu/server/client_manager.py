@@ -50,6 +50,24 @@ def _fraction_floor(fraction: float, n: int) -> int:
     return int(math.floor(fraction * n + 1e-9))
 
 
+def _pack_ids_in_graph(ids_sorted: jax.Array, valid: jax.Array,
+                       slots: int) -> jax.Array:
+    """In-graph mirror of ``_pack_indices``' padding rule: keep the first
+    ``valid`` ascending ids, pad the rest with the first valid id (empty
+    draws pad with 0). Traced ``valid`` means overflow cannot raise here —
+    the host-side mirror (which stages every round's data) is the raising
+    authority, and the chunk puller asserts both draws agree."""
+    ids_sorted = ids_sorted.astype(jnp.int32)
+    first = jnp.where(valid > 0, ids_sorted[0], 0).astype(jnp.int32)
+    if ids_sorted.shape[0] < slots:
+        ids_sorted = jnp.concatenate([
+            ids_sorted,
+            jnp.zeros((slots - ids_sorted.shape[0],), jnp.int32),
+        ])
+    keep = jnp.arange(slots, dtype=jnp.int32) < valid
+    return jnp.where(keep, ids_sorted[:slots], first)
+
+
 def _pack_indices(chosen: np.ndarray, slots: int,
                   scheme: str) -> tuple[np.ndarray, int]:
     """Pack a drawn id set into the fixed ``[slots]`` plan: ascending ids
@@ -100,6 +118,15 @@ class ClientManager:
             np.nonzero(mask > 0)[0], slots, type(self).__name__
         )
 
+    # In-graph cohort draw (the chunked-cohort scan's sampling primitive).
+    # Managers that can express their draw as a pure jit-traceable function
+    # of (rng, round) override ``draw_cohort(rng, round_idx, slots) ->
+    # ([slots] int32 ascending ids, int32 valid)`` pinned BIT-IDENTICAL to
+    # ``sample_indices`` under the same (rng, round, slots). The base class
+    # deliberately does not define it: stateful or exotic managers without
+    # a pure draw demote cohort runs to the pipelined path (the simulation
+    # checks ``getattr(manager, "draw_cohort", None)``).
+
     def sample_all(self) -> jax.Array:
         return jnp.ones((self.n_clients,), jnp.float32)
 
@@ -117,6 +144,18 @@ class FullParticipationManager(ClientManager):
             np.arange(self.n_clients, dtype=np.int32), slots,
             type(self).__name__,
         )
+
+    def draw_cohort(self, rng, round_idx, slots):
+        # deterministic and rng-free like the host view; overflow is a
+        # STATIC fact here (n and slots are both trace-time constants)
+        if self.n_clients > slots:
+            raise CohortOverflowError(
+                f"FullParticipationManager needs slots >= n_clients "
+                f"({self.n_clients}); got slots={slots}"
+            )
+        sl = jnp.arange(slots, dtype=jnp.int32)
+        ids = jnp.where(sl < self.n_clients, sl, 0)
+        return ids, jnp.asarray(self.n_clients, jnp.int32)
 
 
 class FixedFractionManager(ClientManager):
@@ -164,6 +203,28 @@ class FixedFractionManager(ClientManager):
             chosen = np.argpartition(u, self.k)[: self.k]
         return _pack_indices(chosen, slots, type(self).__name__)
 
+    def draw_cohort(self, rng, round_idx, slots):
+        # in-graph mirror of the index view: the k clients with the
+        # SMALLEST uniforms, from the SAME per-client uniform bits (jax
+        # PRNG output is jit-invariant), so ids match sample_indices'
+        # argpartition set exactly — the k-smallest set of distinct floats
+        # is unique. k is static, so overflow raises at trace time.
+        if self.k > slots:
+            raise CohortOverflowError(
+                f"FixedFractionManager draws k={self.k} clients but the "
+                f"cohort has only {slots} slots"
+            )
+        rng = jax.random.fold_in(rng, round_idx)
+        if self.k >= self.n_clients:
+            chosen = jnp.arange(self.n_clients, dtype=jnp.int32)
+        else:
+            u = jax.random.uniform(rng, (self.n_clients,))
+            chosen = jnp.sort(jnp.argsort(u)[: self.k]).astype(jnp.int32)
+        return (
+            _pack_ids_in_graph(chosen, jnp.asarray(self.k, jnp.int32), slots),
+            jnp.asarray(self.k, jnp.int32),
+        )
+
 
 class PoissonSamplingManager(ClientManager):
     """Independent Bernoulli(fraction) per client — matches the DP accounting
@@ -209,6 +270,27 @@ class PoissonSamplingManager(ClientManager):
         return _pack_indices(
             np.nonzero(mask)[0], slots, type(self).__name__
         )
+
+    def draw_cohort(self, rng, round_idx, slots):
+        # the bucket-shaped Poisson-under-padding draw: same per-client
+        # uniform bits as the host views, selected ids sorted to the front
+        # via a sentinel-keyed sort. ``valid`` is data-dependent, so an
+        # overflowing draw clamps here instead of raising — the host
+        # mirror staging the same round's data raises CohortOverflowError
+        # first, and the chunk puller's draw-parity assert backstops it.
+        n = self.n_clients
+        rng = jax.random.fold_in(rng, round_idx)
+        u = jax.random.uniform(rng, (n,))
+        mask = u < self.fraction
+        if self.min_clients > 0:
+            threshold = jnp.sort(u)[self.min_clients - 1]
+            mask = mask | (u <= threshold)
+        key = jnp.where(mask, jnp.arange(n, dtype=jnp.int32), n)
+        ids_sorted = jnp.sort(key)
+        valid = jnp.minimum(
+            jnp.sum(mask).astype(jnp.int32), jnp.asarray(slots, jnp.int32)
+        )
+        return _pack_ids_in_graph(ids_sorted, valid, slots), valid
 
 
 class FixedSamplingManager(ClientManager):

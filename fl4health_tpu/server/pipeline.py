@@ -151,6 +151,30 @@ class RoundPrefetcher:
 
         self._pending = (round_idx, self._pool.submit(build))
 
+    def schedule_chunk(self, start_round: int, k: int) -> None:
+        """Cohort chunked route: stage chunk ``[start_round,
+        start_round+k)``'s sampled draws, stacked slot tensors and window
+        ids on the worker thread while the previous chunk's device work
+        runs — the double-buffered half of the in-graph window exchange.
+        Window STATE rows are deliberately absent: they have a
+        read-after-write dependency on the previous chunk's registry
+        scatter, so the driver gathers them on its own thread after it."""
+        sim = self._sim
+        self._pending = (
+            ("chunk", start_round),
+            self._pool.submit(sim._stage_cohort_chunk, start_round, k),
+        )
+
+    def take_chunk(self, start_round: int, k: int):
+        """Staged chunk tensors from :meth:`schedule_chunk`; synchronous
+        staging on a miss (first chunk, or a resume realigned the
+        boundaries)."""
+        sim = self._sim
+        pending, self._pending = self._pending, None
+        if pending is not None and pending[0] == ("chunk", start_round):
+            return pending[1].result()
+        return sim._stage_cohort_chunk(start_round, k)
+
     def take(self, round_idx: int):
         sim = self._sim
         pending, self._pending = self._pending, None

@@ -437,6 +437,14 @@ class ClientRegistry:
         fresh = fresh.replace(rng=self._default_rng_rows(idx))
         return self._client_store.gather(idx, fresh)
 
+    @property
+    def has_strategy_rows(self) -> bool:
+        """Whether the bound strategy carries per-client server rows
+        (SCAFFOLD variates, EF residuals, ...) that ride the slot/window
+        exchange. Static at bind time — the compiled cohort chunk
+        specializes on it."""
+        return self._has_strategy_rows
+
     def gather_strategy_rows(self, idx: np.ndarray) -> Any | None:
         if not self._has_strategy_rows:
             return None
@@ -529,6 +537,68 @@ class ClientRegistry:
             "staged_bytes": staged_bytes,
         }
 
+    # -- chunked staging (R rounds per dispatch over the registry) -------
+    def chunk_window(self, idx_list: Sequence[np.ndarray],
+                     valid_list: Sequence[int], slots: int,
+                     n_rounds: int) -> tuple[np.ndarray, int]:
+        """The chunk's device-staged registry window: the sorted-unique
+        union of every round's VALID sampled ids, padded to the fixed
+        width ``W = min(N, n_rounds * slots)`` with the sentinel id ``N``.
+
+        Sorted-ascending real ids first means ``searchsorted(window, id)``
+        resolves every real id (and every pad slot, which repeats a real
+        id) to a real window row in-graph; sentinel rows exist only to
+        keep the window shape a function of (N, K, R) — they are never
+        gathered (no cohort id maps to them) and never scattered (the
+        in-graph scatter drops pad destinations)."""
+        chosen = [
+            np.asarray(idx, np.int64)[: int(v)]
+            for idx, v in zip(idx_list, valid_list)
+        ]
+        real = (np.unique(np.concatenate(chosen)) if any(
+            c.size for c in chosen
+        ) else np.zeros((0,), np.int64))
+        w = min(self.n_clients, int(n_rounds) * int(slots))
+        if real.size > w:  # cannot happen: union of R draws of <= K ids
+            raise ValueError(
+                f"chunk window overflow: {real.size} unique ids > {w}"
+            )
+        out = np.full((w,), self.n_clients, np.int64)
+        out[: real.size] = real
+        return out, int(real.size)
+
+    def gather_window(self, window_ids: np.ndarray) -> tuple[Any, Any | None]:
+        """``[W, ...]`` host row trees for a chunk window (client
+        ``TrainState`` rows + strategy rows or None). Sentinel entries
+        resolve to fresh prototype rows — present for shape stability,
+        never addressed by the compiled chunk."""
+        return (self.gather_client_states(window_ids),
+                self.gather_strategy_rows(window_ids))
+
+    def stage_chunk(self, draws: Sequence[tuple[np.ndarray, int]],
+                    base_entropy, start_round: int) -> dict:
+        """Stack R rounds' ``stage_round`` tensors along a leading round
+        axis (``batches [R, K, S, B, ...]``, ``mask [R, K]``, ...) for one
+        chunked dispatch. Pure numpy like ``stage_round`` — safe on the
+        prefetcher's worker thread."""
+        rounds = [
+            self.stage_round(idx, valid, base_entropy, start_round + i)
+            for i, (idx, valid) in enumerate(draws)
+        ]
+        stack_trees = lambda key: jax.tree_util.tree_map(  # noqa: E731
+            lambda *ls: np.stack(ls), *[r[key] for r in rounds]
+        )
+        return {
+            "idx": np.stack([r["idx"] for r in rounds]),
+            "valid": np.asarray([r["valid"] for r in rounds], np.int32),
+            "mask": np.stack([r["mask"] for r in rounds]),
+            "sample_counts": np.stack([r["sample_counts"] for r in rounds]),
+            "val_counts": np.stack([r["val_counts"] for r in rounds]),
+            "batches": stack_trees("batches"),
+            "val_batches": stack_trees("val_batches"),
+            "staged_bytes": sum(r["staged_bytes"] for r in rounds),
+        }
+
     # -- abstract shapes (introspection: no staging, no device work) -----
     def _abstract_batch(self, steps: int, k: int, x_ex, y_ex) -> Batch:
         b = self.batch_size
@@ -559,6 +629,29 @@ class ClientRegistry:
             "mask": f32(slots),
             "sample_counts": f32(slots),
             "val_counts": f32(slots),
+        }
+
+    def abstract_chunk_args(self, slots: int, n_rounds: int) -> dict:
+        """Stacked ``[R, ...]`` ShapeDtypeStructs of one chunked
+        dispatch's per-round inputs plus the window-id shape — what the
+        introspector lowers the cohort chunk scan against. Like
+        :meth:`abstract_round_args`, nothing here mentions the registry
+        size beyond the ``min(N, R*K)`` window cap: at ``N >= R*K`` the
+        chunk program's cost/footprint is a function of (K, R, budgets)
+        only."""
+        aa = self.abstract_round_args(slots)
+        k = int(n_rounds)
+        stack = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+            lambda s: jax.ShapeDtypeStruct((k,) + s.shape, s.dtype), tree
+        )
+        w = min(self.n_clients, k * int(slots))
+        return {
+            "batches": stack(aa["batches"]),
+            "val_batches": stack(aa["val_batches"]),
+            "mask": stack(aa["mask"]),
+            "sample_counts": stack(aa["sample_counts"]),
+            "val_counts": stack(aa["val_counts"]),
+            "window_ids": jax.ShapeDtypeStruct((w,), np.int32),
         }
 
     # -- checkpointing ---------------------------------------------------

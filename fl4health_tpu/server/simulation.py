@@ -227,6 +227,11 @@ class _RoundWork:
     # count, staging wall and the scatter-completion event the producer
     # gates the next state gather on (None on dense rounds)
     cohort_meta: dict | None = None
+    # pre-built cohort summary for rounds whose registry exchange the
+    # PRODUCER already performed (async-over-registry events) — the
+    # consumer then has no ``_registry_rows`` to scatter but still reports
+    # the cohort facts
+    cohort_info: dict | None = None
 
 
 class FederatedSimulation:
@@ -360,16 +365,6 @@ class FederatedSimulation:
         # execution paths. None (the default) builds the exact synchronous
         # programs — trajectories bit-identical to pre-async builds.
         self.async_config = async_config
-        if async_config is not None and self._cohort_active:
-            # buffered-async derives participation from the arrival
-            # schedule over the WHOLE cohort; cohort-slot execution exists
-            # to sample cohorts out of a larger registry — the two
-            # participation models are mutually exclusive by construction
-            raise ValueError(
-                "cohort=CohortConfig(...) is not composable with "
-                "async_config: buffered-async participation is derived "
-                "from the arrival schedule, not sampled from a registry"
-            )
         if async_config is not None:
             from fl4health_tpu.server.async_schedule import AsyncConfig
 
@@ -379,7 +374,19 @@ class FederatedSimulation:
                     f"{type(async_config).__name__} — a duck-typed config "
                     "would silently train synchronously"
                 )
-            if async_config.buffer_size > len(datasets):
+            if self._cohort_active:
+                # FedBuff over the registry: K slots hold seated registry
+                # clients, so the buffer fills from the SLOTS, and the
+                # static seating plan needs an occupant per seat
+                if async_config.buffer_size > self.cohort_config.slots:
+                    raise ValueError(
+                        f"async_config.buffer_size="
+                        f"{async_config.buffer_size} exceeds the cohort "
+                        f"slots ({self.cohort_config.slots}): the buffer "
+                        "fills from the seated slots, so it could never "
+                        "fill"
+                    )
+            elif async_config.buffer_size > len(datasets):
                 raise ValueError(
                     f"async_config.buffer_size={async_config.buffer_size} "
                     f"exceeds the cohort ({len(datasets)} clients): the "
@@ -490,7 +497,11 @@ class FederatedSimulation:
                     "the sampling manager must be built over the registry"
                 )
             if (isinstance(self.client_manager, FullParticipationManager)
-                    and self.cohort_config.slots < self.registry_size):
+                    and self.cohort_config.slots < self.registry_size
+                    and not self._async_active):
+                # (buffered-async over the registry seats K of N clients
+                # per the occupancy plan — full participation there means
+                # "every SEATED slot", so slots < N is the normal shape)
                 raise ValueError(
                     f"full participation needs slots >= registry size "
                     f"({self.registry_size}); got slots="
@@ -595,6 +606,22 @@ class FederatedSimulation:
                     "pre-aggregation moment inside a fused buffer-fill "
                     "event (state checkpointing — resume — composes; use "
                     "state_checkpointer)"
+                )
+            if self._cohort_active and self.mesh_config is not None:
+                raise ValueError(
+                    "async_config + cohort=CohortConfig(...) does not yet "
+                    "compose with mesh: the per-event occupancy swap "
+                    "restages seated rows host-side, which would fight the "
+                    "mesh's sharded staging; run the composition unsharded "
+                    "or drop one of the two"
+                )
+            if self._cohort_active and self.state_checkpointer is not None:
+                raise ValueError(
+                    "async_config + cohort=CohortConfig(...) does not yet "
+                    "compose with state checkpointing: a resume would need "
+                    "a frame persisting BOTH the pending update buffer and "
+                    "the registry's dirty rows + seating cursor, and no "
+                    "such combined frame format exists yet"
                 )
             sc = self.state_checkpointer
             if sc is not None and not (
@@ -1026,6 +1053,9 @@ class FederatedSimulation:
             )
         self._chunked_fit = None  # compiled lazily by make_chunked_fit
         self._chunked_fit_eval = None  # compiled lazily (fit()'s chunked route)
+        # cohort chunked-scan program (in-graph draw + window exchange),
+        # compiled lazily by _make_cohort_chunk — cohort runs only
+        self._cohort_chunk_jit = None
         # Buffered-async programs (compiled lazily by _make_async_programs /
         # _make_async_chunked — only ever built when async_config is set,
         # so a synchronous simulation compiles exactly the pre-async set)
@@ -1581,6 +1611,11 @@ class FederatedSimulation:
         )
         n_clients = self.n_clients
         sample_counts = self.sample_counts
+        # over the registry, a slot's sample count is a property of its
+        # OCCUPANT — and aggregation consumes packets trained under a
+        # possibly-evicted occupant, so the counts must ride the pending
+        # buffer with the packet instead of being a closure constant
+        cohort_active = self._cohort_active
         async_mask = getattr(strategy, "async_aggregation_mask", None)
         if async_mask is not None:
             import inspect
@@ -1616,11 +1651,13 @@ class FederatedSimulation:
                          if self.observability.enabled else None)
 
         def train_wave(server_state, client_states, batches, train_mask,
-                       round_idx, val_batches):
+                       round_idx, val_batches, wave_counts=None):
             """One training wave on data plan ``round_idx``: pull the
             current payload, locally train the masked clients, corrupt the
             wire packets with the SAME seeded round draws the sync path
-            uses. Returns (new client stack, this wave's pending pieces)."""
+            uses. Returns (new client stack, this wave's pending pieces).
+            ``wave_counts`` (registry occupancy only) pins the per-slot
+            sample counts the wave trained under into the pending buffer."""
             payload = strategy.client_payload(server_state, round_idx)
             vmapped = jax.vmap(client_fit, in_axes=(0, None, 0, 0, 0))(
                 client_states, payload, batches, train_mask, val_batches
@@ -1638,6 +1675,10 @@ class FederatedSimulation:
                 )
             pending = {"packets": packets, "losses": losses,
                        "metrics": metrics}
+            if cohort_active:
+                pending["sample_counts"] = (
+                    sample_counts if wave_counts is None else wave_counts
+                )
             if collect_telemetry:
                 pending["telem"] = client_telem
             return new_states, pending
@@ -1652,17 +1693,19 @@ class FederatedSimulation:
 
             return jax.tree_util.tree_map(sel, new, old)
 
-        def async_prologue(server_state, client_states, batches, val_batches):
+        def async_prologue(server_state, client_states, batches, val_batches,
+                           wave_counts=None):
             ones = jnp.ones((n_clients,), jnp.float32)
             return train_wave(
                 server_state, client_states, batches, ones,
-                jnp.asarray(1, jnp.int32), val_batches,
+                jnp.asarray(1, jnp.int32), val_batches, wave_counts,
             )
 
         def async_event(server_state, client_states, pending, batches_next,
                         arrivals, staleness, event_idx, val_batches,
                         val_counts, staleness_exponent,
-                        test_batches=None, test_counts=None):
+                        test_batches=None, test_counts=None,
+                        wave_counts=None):
             # -- consume: staleness-discounted aggregation of the buffer --
             # staleness_exponent is a TRACED scalar input (fed from the
             # live strategy attribute at each dispatch), so an exponent
@@ -1682,22 +1725,27 @@ class FederatedSimulation:
                 pending["losses"].get("backward", jnp.zeros_like(arr))
             )
             agg_mask = disc_mask * finite.astype(disc_mask.dtype)
+            # the counts the buffered packets TRAINED under (they rode the
+            # pending buffer on the registry path — occupancy may have
+            # changed since); the dense path's closure constant otherwise
+            counts = (pending["sample_counts"] if cohort_active
+                      else sample_counts)
             results = FitResults(
                 packets=pending["packets"],
-                sample_counts=sample_counts,
+                sample_counts=counts,
                 train_losses=pending["losses"],
                 train_metrics=pending["metrics"],
                 mask=agg_mask,
             )
             new_server = strategy.aggregate(server_state, results, event_idx)
-            w = results.mask * sample_counts
+            w = results.mask * counts
             agg_losses = {
                 k: jnp.sum(jnp.where(results.mask > 0, v, 0.0) * w)
                 / jnp.maximum(jnp.sum(w), 1.0)
                 for k, v in pending["losses"].items()
             }
             agg_metrics = aggregate_metrics(
-                pending["metrics"], sample_counts, results.mask
+                pending["metrics"], counts, results.mask
             )
             round_telemetry = None
             if collect_telemetry:
@@ -1770,7 +1818,7 @@ class FederatedSimulation:
             # index stream a synchronous round event_idx+1 would use
             client_states, fresh = train_wave(
                 new_server, client_states, batches_next, arrivals,
-                event_idx + 1, val_batches,
+                event_idx + 1, val_batches, wave_counts,
             )
             pending = merge_pending(pending, fresh, arrivals)
             return new_server, client_states, pending, out
@@ -1912,9 +1960,25 @@ class FederatedSimulation:
         (None = eligible). Anything that needs the host between rounds
         forces the pipelined per-round path."""
         if self._cohort_active:
-            return ("cohort-slot execution stages each round's sampled "
-                    "cohort from the host registry (per-round gather/"
-                    "scatter)")
+            # cohort-slot runs chunk too (the in-graph draw + window
+            # exchange replace the per-round host gather/scatter) — only
+            # the combinations that genuinely need the host between
+            # sampled rounds still demote:
+            if self._async_active:
+                return ("buffered-async over the registry swaps slot "
+                        "occupants host-side per event (pipelined "
+                        "per-event path)")
+            if getattr(self.client_manager, "draw_cohort", None) is None:
+                return (f"{type(self.client_manager).__name__} provides no "
+                        "in-graph draw_cohort; the cohort draw must run on "
+                        "the host every round")
+            if self.recovery_policy is not None:
+                return ("recovery supervision refreshes the quarantine "
+                        "keep-mask against the live registry every round")
+            if self.mesh_config is not None:
+                return ("mesh + cohort stages each round's slot tensors "
+                        "with sharded per-round device_put; the chunk's "
+                        "window exchange is unsharded")
         if self.train_data_provider is not None:
             return "train_data_provider needs a host data refresh every round"
         if self.model_checkpointers:
@@ -2069,11 +2133,24 @@ class FederatedSimulation:
         # consumed prefix against it.
         plan = None
         if self._async_active and n_rounds >= 1:
-            from fl4health_tpu.server.async_schedule import build_event_plan
-
-            plan = build_event_plan(
-                self.async_config, n_rounds, self.n_clients, self._fault_plan
+            from fl4health_tpu.server.async_schedule import (
+                build_event_plan,
+                build_registry_event_plan,
             )
+
+            if self._cohort_active:
+                # FedBuff over the registry: the slot-level schedule plus
+                # the deterministic seating ledger (who occupies each slot
+                # per restart wave)
+                plan = build_registry_event_plan(
+                    self.async_config, n_rounds, self.n_clients,
+                    self.registry_size, self._fault_plan,
+                )
+            else:
+                plan = build_event_plan(
+                    self.async_config, n_rounds, self.n_clients,
+                    self._fault_plan,
+                )
             self._async_plan = plan
         try:
             start_round = self._maybe_resume(n_rounds, plan)
@@ -2193,9 +2270,13 @@ class FederatedSimulation:
                 if self._async_active and n_rounds >= 1:
                     self._fit_async(n_rounds, mode, plan, start_round)
                 elif self._cohort_active:
-                    # handles n_rounds < 1 itself (graceful no-op) — the
-                    # dense pipelined fallback would touch the absent banks
-                    self._fit_cohort(n_rounds, start_round)
+                    # both routes handle n_rounds < 1 themselves (graceful
+                    # no-op) — the dense pipelined fallback would touch
+                    # the absent banks
+                    if mode == EXEC_CHUNKED:
+                        self._fit_cohort_chunked(n_rounds, start_round)
+                    else:
+                        self._fit_cohort(n_rounds, start_round)
                 elif mode == EXEC_CHUNKED:
                     self._fit_chunked(n_rounds, start_round)
                 else:
@@ -2594,6 +2675,36 @@ class FederatedSimulation:
                 self._round_program_flops = intro.round_flops(
                     (fit_name, eval_name)
                 )
+                if mode == EXEC_CHUNKED:
+                    # the chunk scan program too: its report carries the
+                    # per-dispatch facts (rounds_per_dispatch, the
+                    # in-graph draw site) the O(rounds/R) claim quotes
+                    kd = self._rounds_per_dispatch(n_rounds)
+                    ca = self.registry.abstract_chunk_args(
+                        self.n_clients, kd
+                    )
+                    w = ca["window_ids"].shape[0]
+                    as_window = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                        lambda a: jax.ShapeDtypeStruct(
+                            (w,) + jnp.shape(a)[1:], jnp.result_type(a)
+                        ), t,
+                    )
+                    w_client = as_window(self.client_states)
+                    w_srows = (
+                        as_window(self.strategy.state_rows(
+                            self.server_state
+                        ))
+                        if self.registry.has_strategy_rows else {}
+                    )
+                    intro.introspect_jit(
+                        "fit_cohort_chunk", self._make_cohort_chunk(),
+                        (self.server_state, self.client_states, w_client,
+                         w_srows, self.rng, ca["window_ids"],
+                         ca["batches"], ca["mask"], ca["sample_counts"],
+                         ca["val_batches"], ca["val_counts"], r),
+                        rounds_per_dispatch=kd, cohort_draw="in_graph",
+                        mesh=mesh_desc, precision=prec_desc,
+                    )
                 intro.hbm_headroom_bytes()
                 return
             val_batches, val_counts = self._val_batches()
@@ -2990,7 +3101,7 @@ class FederatedSimulation:
         state_trees = host.pop("_state_trees", None)
         quarantine_mask = host.pop("_quarantine", None)
         registry_rows = host.pop("_registry_rows", None)
-        cohort_info = None
+        cohort_info = work.cohort_info
         if registry_rows is not None:
             # cohort-slot rounds: the updated rows came down on the SAME
             # fused pull; scatter them under their registry ids, then
@@ -3017,6 +3128,11 @@ class FederatedSimulation:
                 "gather_ms": round(meta["gather_ms"], 3),
                 "scatter_ms": round(scatter_ms, 3),
                 "staged_bytes": meta["staged_bytes"],
+                # host-barrier accounting: how many rounds this dispatch
+                # amortized (1 on the per-round path) and where the cohort
+                # draw ran — the O(rounds/R) claim, measured per round
+                "rounds_per_dispatch": meta.get("rounds_per_dispatch", 1),
+                "cohort_draw": meta.get("cohort_draw", "host"),
             }
         telemetry_obj = host.pop("telemetry", None)
         telemetry_host = (
@@ -3317,13 +3433,18 @@ class FederatedSimulation:
         compiles_after: float | None, compile_s_after: float | None,
         per_round_s: float, device_wait_round: float,
         async_plan=None, start_round: int = 1,
+        cohort_infos=None, registry_ids=None,
     ) -> None:
         """Per-round host epilogue over a chunked dispatch's stacked
         outputs: failure screen, RoundRecords, metrics/reports, watchdog —
-        shared by the synchronous chunked route and the buffered-async
+        shared by the synchronous chunked route, the buffered-async
         chunked route (``async_plan`` adds per-event staleness/cadence
-        facts to each round's metrics). ``start_round`` offsets the round
-        numbering for non-initial chunks (checkpoint boundaries, resume)."""
+        facts to each round's metrics) and the cohort chunked route
+        (``cohort_infos``: per-round cohort summary dicts;
+        ``registry_ids``: [R, K] slot->registry-id map so failures,
+        fleet absorption and quarantine name REAL clients).
+        ``start_round`` offsets the round numbering for non-initial
+        chunks (checkpoint boundaries, resume)."""
         obs = self.observability
         telemetry_stack = stacked.get("telemetry")
         quarantine_stack = stacked.get("quarantine")
@@ -3332,6 +3453,8 @@ class FederatedSimulation:
             per_fit_i = {
                 k: v[i] for k, v in stacked["per_client_fit_losses"].items()
             }
+            ids_i = (np.asarray(registry_ids[i])
+                     if registry_ids is not None else None)
             # logs per-round failures; cannot terminate (eligibility
             # guarantees accept_failures=True on this path)
             failed = self.failure_policy.check(per_fit_i, masks_np[i])
@@ -3379,6 +3502,7 @@ class FederatedSimulation:
             # as-of the chunk's last round, matching the pipelined path
             fleet_info = self._fleet_absorb_round(
                 rnd, masks_np[i], per_fit_i, telemetry_i,
+                registry_ids=ids_i,
                 quarantine_mask=(np.asarray(quarantine_stack[i])
                                  if quarantine_stack is not None else None),
                 failed=failed,
@@ -3397,11 +3521,14 @@ class FederatedSimulation:
                                      else compile_s_before),
                     telemetry=telemetry_i,
                     async_info=async_info_i,
+                    cohort_info=(cohort_infos[i]
+                                 if cohort_infos is not None else None),
                     fleet_info=fleet_info,
+                    registry_ids=ids_i,
                 )
             if quarantine_stack is not None:
                 self._emit_quarantine_metrics(
-                    rnd, np.asarray(quarantine_stack[i])
+                    rnd, np.asarray(quarantine_stack[i]), ids=ids_i
                 )
             for rep in self.reporters:
                 payload = {
@@ -3426,6 +3553,21 @@ class FederatedSimulation:
             self._note_recovery_round(rnd)
 
     # -- cohort-slot path (server/registry.py) --------------------------
+    def _count_cohort_roundtrip(self) -> None:
+        """One host round-trip against the registry — a cohort draw +
+        row gather/scatter + program dispatch paid on the host. The
+        pipelined path pays one per ROUND; the chunked path one per
+        R-round dispatch; async-over-registry one per buffer-fill event.
+        ``fl_cohort_host_roundtrips_total`` is the measured side of the
+        chunked path's O(rounds/R) host-barrier claim."""
+        obs = self.observability
+        if obs.enabled:
+            obs.registry.counter(
+                "fl_cohort_host_roundtrips_total",
+                help="host round-trips paid against the client registry "
+                     "(one per dispatch: cohort draw + gather/scatter)",
+            ).inc()
+
     def _stage_cohort_round(self, rnd: int) -> dict:
         """One round's slot tensors, staged: sample the cohort ids from
         the dense path's exact PRNG stream (``fold_in(rng, 2000+round)``),
@@ -3699,8 +3841,11 @@ class FederatedSimulation:
                     "gather_ms": gather_ms,
                     "staged_bytes": staged["staged_bytes"],
                     "scatter_event": scatter_event,
+                    "rounds_per_dispatch": 1,
+                    "cohort_draw": "host",
                 },
             )
+            self._count_cohort_roundtrip()
             if consumer is not None:
                 consumer.submit_round(
                     rnd, functools.partial(self._finish_round, work))
@@ -3708,6 +3853,344 @@ class FederatedSimulation:
                     consumer.flush()
             else:
                 self._finish_round(work)
+
+    # -- cohort chunked route (in-graph draw + window exchange) ---------
+    def _make_cohort_chunk(self):
+        """Compile the cohort chunked scan: R federated rounds per
+        dispatch over the virtualized registry, with ZERO host touches
+        between rounds. Each scan step (1) draws the round's cohort ids
+        IN-GRAPH via the manager's ``draw_cohort`` — a pure function of
+        ``fold_in(seed, 2000+round)``, bit-identical to the host sampler
+        the pipelined path runs — (2) resolves the ids against the
+        device-staged registry WINDOW (``searchsorted`` over the sorted
+        window ids; pad slots repeat a real id, so every slot gathers a
+        real row), (3) runs the exact slot ``fit_round``/``eval_round``
+        sequence of one pipelined cohort round, and (4) scatters the
+        post-eval rows (client states + strategy rows) back into the
+        window (pad destinations drop). The window is the chunk's
+        double-buffered stand-in for the host registry: rows enter it
+        once per chunk and leave once per chunk, so host round-trips
+        shrink from O(rounds) to O(rounds/R).
+
+        The scan outputs carry each round's drawn ids/valid count so the
+        driver can assert in-graph/host draw parity at the pull — the
+        window was built from the HOST mirror's draws, and any divergence
+        would silently corrupt the exchange."""
+        if self._cohort_chunk_jit is not None:
+            return self._cohort_chunk_jit
+        telemetry_on = self._telemetry_enabled
+        fit_round = (self._fit_round_fn_t if telemetry_on
+                     else self._fit_round_fn)
+        eval_round = (self._eval_round_fn_t if telemetry_on
+                      else self._eval_round_fn)
+        quarantine_fn = (getattr(self.strategy, "quarantine_mask", None)
+                         if self.observability.enabled else None)
+        strategy = self.strategy
+        draw = self.client_manager.draw_cohort
+        slots = self.n_clients
+        has_srows = self.registry.has_strategy_rows
+
+        def chunk(server_state, client_states, w_client, w_srows,
+                  base_rng, window_ids, batches, masks, sample_counts,
+                  val_batches, val_counts, start_round):
+            w = window_ids.shape[0]
+
+            def body(carry, per_round):
+                server_state, client_states, w_client, w_srows, r = carry
+                batches_r, mask_r, sc_r, vb_r, vc_r = per_round
+                ids, valid = draw(
+                    jax.random.fold_in(base_rng, 2000 + r), r, slots
+                )
+                pos = jnp.searchsorted(window_ids, ids).astype(jnp.int32)
+                client_states = jax.tree_util.tree_map(
+                    lambda t: t[pos], w_client
+                )
+                if has_srows:
+                    server_state = strategy.scatter_state_rows(
+                        server_state,
+                        jax.tree_util.tree_map(lambda t: t[pos], w_srows),
+                    )
+                fit_outs = fit_round(
+                    server_state, client_states, batches_r, mask_r, r,
+                    vb_r, sc_r,
+                )
+                round_telemetry = None
+                if telemetry_on:
+                    (server_state, client_states, fit_losses, fit_metrics,
+                     per_fit, round_telemetry) = fit_outs
+                else:
+                    (server_state, client_states, fit_losses, fit_metrics,
+                     per_fit) = fit_outs
+                ev_outs = eval_round(
+                    server_state, client_states, vb_r, vc_r
+                )
+                if telemetry_on:
+                    (client_states, ev_losses, ev_metrics, _pl, _pm,
+                     ev_nonfinite) = ev_outs
+                    round_telemetry = round_telemetry.replace(
+                        nonfinite_eval_loss=ev_nonfinite
+                    )
+                else:
+                    client_states, ev_losses, ev_metrics, _pl, _pm = ev_outs
+                out = {
+                    "fit_losses": fit_losses,
+                    "fit_metrics": fit_metrics,
+                    "per_client_fit_losses": per_fit,
+                    "eval_losses": ev_losses,
+                    "eval_metrics": ev_metrics,
+                    "cohort_ids": ids,
+                    "cohort_valid": valid,
+                }
+                if round_telemetry is not None:
+                    out["telemetry"] = round_telemetry
+                if quarantine_fn is not None:
+                    out["quarantine"] = quarantine_fn(server_state)
+                # write-back: post-eval rows land at their window position;
+                # pad slots (>= valid) target index w — dropped, exactly
+                # like an unsampled client on the pipelined path
+                dest = jnp.where(
+                    jnp.arange(slots, dtype=jnp.int32) < valid, pos, w
+                )
+                w_client = jax.tree_util.tree_map(
+                    lambda wt, c: wt.at[dest].set(c, mode="drop"),
+                    w_client, client_states,
+                )
+                if has_srows:
+                    w_srows = jax.tree_util.tree_map(
+                        lambda wt, c: wt.at[dest].set(c, mode="drop"),
+                        w_srows, strategy.state_rows(server_state),
+                    )
+                return (server_state, client_states, w_client, w_srows,
+                        r + 1), out
+
+            (server_state, client_states, w_client, w_srows, _), outs = (
+                jax.lax.scan(
+                    body,
+                    (server_state, client_states, w_client, w_srows,
+                     start_round),
+                    (batches, masks, sample_counts, val_batches,
+                     val_counts),
+                )
+            )
+            return server_state, client_states, w_client, w_srows, outs
+
+        # donate the carried states AND the window trees: the caller
+        # replaces all four with the scan outputs, so XLA updates the
+        # large [W, ...] window buffers in place (mesh never reaches this
+        # path — mesh+cohort demotes to pipelined)
+        self._cohort_chunk_jit = self._program_builder.jit(
+            chunk, donate=(0, 1, 2, 3)
+        )
+        return self._cohort_chunk_jit
+
+    def _stage_cohort_chunk(self, start_round: int, k: int) -> dict:
+        """One chunk's host staging: sample rounds ``[start_round,
+        start_round+k)`` from the dense path's exact PRNG stream (the HOST
+        mirror of the in-graph draw — it also fails fast on sampler
+        overflow, before any device work), stack their slot tensors, build
+        the chunk window and ``device_put`` the lot. Pure function of
+        (rng, rounds, registry data) — safe on the prefetcher's worker
+        thread, overlapping the previous chunk's device work. Window
+        STATE rows are absent here (read-after-write on the previous
+        chunk's scatter — the driver gathers them)."""
+        draws = []
+        for i in range(k):
+            r = start_round + i
+            idx, valid = self.client_manager.sample_indices(
+                jax.random.fold_in(self.rng, 2000 + r), r, self.n_clients
+            )
+            draws.append((np.asarray(idx), int(valid)))
+        t0 = time.perf_counter()
+        with self.observability.span(
+            "cohort_stage_chunk", start_round=start_round, rounds=k
+        ) as sp:
+            staged = self.registry.stage_chunk(
+                draws, self._base_entropy, start_round
+            )
+            window_ids, w_real = self.registry.chunk_window(
+                [d[0] for d in draws], [d[1] for d in draws],
+                self.n_clients, k,
+            )
+            staged["window_ids"] = window_ids
+            staged["w_real"] = w_real
+            staged["mask_np"] = staged["mask"]
+            staged["batches"] = jax.device_put(staged["batches"])
+            staged["val_batches"] = jax.device_put(staged["val_batches"])
+            staged["mask"] = jnp.asarray(staged["mask"])
+            staged["sample_counts"] = jnp.asarray(staged["sample_counts"])
+            staged["val_counts"] = jnp.asarray(staged["val_counts"])
+            # int32 on device: draw_cohort ids are int32, and searchsorted
+            # wants one dtype on both sides
+            staged["window_ids_dev"] = jnp.asarray(
+                window_ids.astype(np.int32)
+            )
+            staged["stage_ms"] = (time.perf_counter() - t0) * 1e3
+            sp.set(stage_ms=round(staged["stage_ms"], 3),
+                   staged_bytes=staged["staged_bytes"],
+                   window=len(window_ids), window_real=w_real)
+        return staged
+
+    def _fit_cohort_chunked(self, n_rounds: int, start_round: int = 1
+                            ) -> None:
+        """fit()'s cohort chunked route: ``checkpoint_every``-round (or
+        whole-run) chunks dispatch over the registry window while the
+        prefetcher stages the NEXT chunk's draws + slot tensors behind the
+        device work. Chunk boundaries keep the PR 12 semantics: the window
+        rows scatter back into the registry first, then the cohort
+        snapshot (slot states + registry dirty rows) persists exactly as
+        the pipelined consumer would have written it."""
+        obs = self.observability
+        if start_round > n_rounds:
+            return
+        sc = self.state_checkpointer
+        chunk_ckpt = sc is not None
+        self._fit_n_rounds = n_rounds
+        self.server_state, self.client_states = _dedupe_donated(
+            self.server_state, self.client_states
+        )
+        prefetcher = self._prefetcher = RoundPrefetcher(self)
+        try:
+            with self._ckpt_writer_scope(chunk_ckpt) as writer:
+                s = start_round
+                prefetcher.schedule_chunk(
+                    s, self._rounds_per_dispatch(n_rounds, s)
+                )
+                while s <= n_rounds:
+                    k = self._rounds_per_dispatch(n_rounds, s)
+                    staged = prefetcher.take_chunk(s, k)
+                    if s + k <= n_rounds:
+                        # chunk c+1's draws/staging overlap chunk c's
+                        # device work; only the window ROW gather waits
+                        # for c's boundary scatter (in _run_cohort_chunk)
+                        prefetcher.schedule_chunk(
+                            s + k,
+                            self._rounds_per_dispatch(n_rounds, s + k),
+                        )
+                    with obs.span("cohort_chunk", start_round=s, rounds=k):
+                        self._run_cohort_chunk(s, k, staged)
+                    if chunk_ckpt:
+                        trees = jax.device_get({
+                            "server_state": self.server_state,
+                            "client_states": self.client_states,
+                        })
+                        sc.save_cohort_snapshot(
+                            trees, s + k - 1, self.n_clients,
+                            self.registry_size, self.registry.export_rows(),
+                            list(self.history), writer=writer,
+                            fleet=self._fleet_snapshot_doc(),
+                        )
+                    s += k
+        finally:
+            prefetcher.close()
+            self._prefetcher = None
+
+    def _run_cohort_chunk(self, start_round: int, k: int,
+                          staged: dict) -> None:
+        """Dispatch one cohort chunk and run its host epilogue: window
+        row gather (after the previous chunk's scatter — same-thread, so
+        the ordering is structural), ONE compiled scan over k rounds, the
+        in-graph/host draw-parity check, the boundary scatter back into
+        the registry, then the shared chunked epilogue with per-round
+        cohort facts."""
+        obs = self.observability
+        compiles_before = compile_s_before = 0.0
+        if obs.enabled:
+            compiles_before = obs.registry.counter(
+                "jax_backend_compiles_total").value
+            compile_s_before = obs.registry.counter(
+                "jax_backend_compiles_seconds_total").value
+        t_start = time.time()
+        chunked = self._make_cohort_chunk()
+        with obs.span("cohort_gather", start_round=start_round,
+                      window=int(staged["w_real"])) as gather_span:
+            g0 = time.perf_counter()
+            w_client_h, w_srows_h = self.registry.gather_window(
+                staged["window_ids"]
+            )
+            w_client = jax.device_put(w_client_h)
+            w_srows = (jax.device_put(w_srows_h)
+                       if w_srows_h is not None else {})
+            gather_ms = (time.perf_counter() - g0) * 1e3
+            gather_span.set(gather_ms=gather_ms)
+        self.server_state, self.client_states = _dedupe_donated(
+            self.server_state, self.client_states
+        )
+        args = [self.server_state, self.client_states, w_client, w_srows,
+                self.rng, staged["window_ids_dev"], staged["batches"],
+                staged["mask"], staged["sample_counts"],
+                staged["val_batches"], staged["val_counts"],
+                jnp.asarray(start_round, jnp.int32)]
+        with obs.span("fit_cohort_chunk", cat="fit", rounds=k,
+                      start_round=start_round) as chunk_span:
+            (self.server_state, self.client_states, w_client, w_srows,
+             outs) = chunked(*args)
+            _, device_wait_total = obs.fence(
+                (outs["fit_losses"], outs["eval_losses"])
+            )
+            stacked = jax.device_get(outs)  # the chunk's ONE fused pull
+            rows_back = jax.device_get((w_client, w_srows))
+            if obs.enabled:
+                chunk_span.set(device_wait_s=device_wait_total)
+        self._count_cohort_roundtrip()
+        # in-graph/host draw parity: the window was built from the host
+        # mirror's draws; a divergent in-graph draw would gather/scatter
+        # the WRONG rows — fail loudly, never train through it
+        ids_host = np.asarray(staged["idx"])
+        valid_host = np.asarray(staged["valid"], np.int64)
+        ids_dev = np.asarray(stacked.pop("cohort_ids"), np.int64)
+        valid_dev = np.asarray(stacked.pop("cohort_valid"), np.int64)
+        if not (np.array_equal(ids_dev, np.asarray(ids_host, np.int64))
+                and np.array_equal(valid_dev, valid_host)):
+            raise RuntimeError(
+                "in-graph cohort draw diverged from the host sampler for "
+                f"rounds [{start_round}, {start_round + k}): the "
+                f"{type(self.client_manager).__name__}.draw_cohort "
+                "contract (bit-identical to sample_indices) is broken — "
+                "the chunk's window exchange cannot be trusted"
+            )
+        with obs.span("registry_scatter", start_round=start_round,
+                      valid=int(staged["w_real"])) as sc_span:
+            s0 = time.perf_counter()
+            wc_back, ws_back = rows_back
+            self.registry.scatter(
+                staged["window_ids"], int(staged["w_real"]), wc_back,
+                ws_back if w_srows_h is not None else None,
+            )
+            scatter_ms = (time.perf_counter() - s0) * 1e3
+            sc_span.set(scatter_ms=scatter_ms)
+        compiles_after = compile_s_after = None
+        if obs.enabled:
+            compiles_after = obs.registry.counter(
+                "jax_backend_compiles_total").value
+            compile_s_after = obs.registry.counter(
+                "jax_backend_compiles_seconds_total").value
+        per_round_s = (time.time() - t_start) / max(k, 1)
+        device_wait_round = device_wait_total / max(k, 1)
+        # per-round cohort facts: walls amortize over the chunk; the
+        # rounds_per_dispatch/cohort_draw pair is what the perf report's
+        # host-barrier columns read
+        cohort_infos = [
+            {
+                "cohort_slots": self.n_clients,
+                "cohort_valid": int(valid_host[i]),
+                "registry_size": self.registry_size,
+                "registry_dirty_rows": self.registry.dirty_rows,
+                "stage_ms": round(staged["stage_ms"] / k, 3),
+                "gather_ms": round(gather_ms / k, 3),
+                "scatter_ms": round(scatter_ms / k, 3),
+                "staged_bytes": int(staged["staged_bytes"] // k),
+                "rounds_per_dispatch": k,
+                "cohort_draw": "in_graph",
+            }
+            for i in range(k)
+        ]
+        self._chunked_epilogue(
+            k, stacked, np.asarray(staged["mask_np"]),
+            compiles_before, compile_s_before, compiles_after,
+            compile_s_after, per_round_s, device_wait_round,
+            start_round=start_round,
+            cohort_infos=cohort_infos, registry_ids=ids_host,
+        )
 
     # -- buffered-async path (server/async_schedule.py) -----------------
     @staticmethod
@@ -3753,7 +4236,12 @@ class FederatedSimulation:
             )
 
             self._async_prefix_fps = plan_prefix_fingerprints(plan)
-        if mode == EXEC_CHUNKED:
+        if self._cohort_active:
+            # FedBuff over the registry: per-event occupancy swaps are
+            # host work, so this composition is pipelined-only (the
+            # chunked route demotes at _chunk_ineligibility)
+            self._fit_async_registry(n_rounds, plan, start_event)
+        elif mode == EXEC_CHUNKED:
             self._fit_async_chunked(n_rounds, plan, start_event)
         else:
             self._fit_async_pipelined(n_rounds, plan, start_event)
@@ -4024,6 +4512,238 @@ class FederatedSimulation:
                         fleet=self._fleet_snapshot_doc(),
                     )
                 s += k
+
+    # -- buffered-async over the registry (FedBuff x cohort slots) -------
+    def _fit_async_registry(self, n_rounds: int, plan,
+                            start_event: int = 1) -> None:
+        """FedBuff over the virtualized registry: the K buffer slots are
+        SEATS, and the static :class:`RegistryEventPlan` decides which
+        registry client occupies each seat at every buffer-fill event.
+        When event *e* consumes a seat's update, the evicted occupant's
+        persistent row scatters back into the host registry and the
+        incoming occupant's row gathers in — O(K) host work per event, so
+        the compiled event program never sees the registry size. The
+        occupants' sample counts ride the pending buffer with their
+        packets (``_build_async_fns``), so aggregation always weights a
+        packet by the counts it TRAINED under, even after its seat was
+        reassigned.
+
+        Degenerate parity case (pinned by tests): ``K == N`` with
+        FullParticipation seats every client forever — the plan's swaps
+        are identities, the staged data plans match the dense ones, and
+        the run is bit-identical to dense buffered-async fit()."""
+        obs = self.observability
+        prologue_jit, _ = self._make_async_programs()
+        slots = self.n_clients
+        self._fit_n_rounds = n_rounds
+        self.server_state, self.client_states = _dedupe_donated(
+            self.server_state, self.client_states
+        )
+        occ = np.asarray(plan.slot_ids[start_event - 1])
+        with obs.span("cohort_gather", round=0, valid=slots):
+            # seat the initial occupancy: persistent rows in
+            self.client_states = jax.device_put(
+                self.registry.gather_client_states(occ)
+            )
+            if self.registry.has_strategy_rows:
+                self.server_state = self.strategy.scatter_state_rows(
+                    self.server_state,
+                    jax.device_put(self.registry.gather_strategy_rows(occ)),
+                )
+        with self._ckpt_writer_scope(
+            bool(self.model_checkpointers), attach_model_ckpts=True,
+        ):
+            consumer = self._consumer = RoundConsumer(
+                maxsize=self.pipeline_depth
+            )
+            try:
+                # the prologue trains every seat's occupant on data plan 1
+                with obs.span("async_prologue", cat="fit"):
+                    staged = self.registry.stage_round(
+                        occ, slots, self._base_entropy, 1
+                    )
+                    (self.client_states,
+                     self._async_pending) = prologue_jit(
+                        self.server_state, self.client_states,
+                        jax.device_put(staged["batches"]),
+                        jax.device_put(staged["val_batches"]),
+                        jnp.asarray(staged["sample_counts"]),
+                    )
+                self._count_cohort_roundtrip()
+                for e in range(start_event, n_rounds + 1):
+                    consumer.raise_pending()
+                    with obs.maybe_profile(e):
+                        occ = self._run_async_registry_event(e, plan, occ)
+                consumer.flush()
+                # end of plan: every seat's live row persists — the
+                # registry is the durable store, seats are transient
+                rows = jax.device_get(self.client_states)
+                srows = None
+                if self.registry.has_strategy_rows:
+                    srows = jax.device_get(
+                        self.strategy.state_rows(self.server_state)
+                    )
+                self.registry.scatter(occ, slots, rows, srows)
+            finally:
+                consumer.close()
+                self._last_epilogue_round = consumer.last_completed_round
+                self._consumer = None
+                self._async_pending = None
+
+    def _run_async_registry_event(self, e: int, plan,
+                                  occ_prev: np.ndarray) -> np.ndarray:
+        """Producer half of one buffer-fill event over the registry:
+        swap the consumed seats' occupants (scatter evicted rows, gather
+        incoming rows), stage the restart wave's data for the new
+        occupancy, dispatch the fused consume->eval->restart program, and
+        hand the epilogue to the consumer with the PRE-swap occupancy —
+        the consumed packets belong to the evicted occupants. Returns the
+        post-swap occupancy for the next event."""
+        obs = self.observability
+        consumer = self._consumer
+        _, event_jit = self._make_async_programs()
+        slots = self.n_clients
+        compiles_before = compile_s_before = 0.0
+        if obs.enabled:
+            compiles_before = obs.registry.counter(
+                "jax_backend_compiles_total").value
+            compile_s_before = obs.registry.counter(
+                "jax_backend_compiles_seconds_total").value
+        t0 = time.time()
+        with obs.span("round", round=e, kind="async_event"):
+            occ_next = np.asarray(plan.slot_ids[e])
+            changed = np.nonzero(occ_prev != occ_next)[0]
+            gather_ms = scatter_ms = 0.0
+            if changed.size:
+                with obs.span("registry_swap", round=e,
+                              swapped=int(changed.size)) as swap_span:
+                    s0 = time.perf_counter()
+                    ch = jnp.asarray(changed)
+                    has_srows = self.registry.has_strategy_rows
+                    # evict: the consumed seats' occupants persist their
+                    # rows under their OLD registry ids
+                    out_rows = jax.device_get(jax.tree_util.tree_map(
+                        lambda t: t[ch], self.client_states
+                    ))
+                    out_srows = None
+                    srows_live = (self.strategy.state_rows(self.server_state)
+                                  if has_srows else None)
+                    if has_srows:
+                        out_srows = jax.device_get(jax.tree_util.tree_map(
+                            lambda t: t[ch], srows_live
+                        ))
+                    self.registry.scatter(
+                        occ_prev[changed], int(changed.size), out_rows,
+                        out_srows,
+                    )
+                    scatter_ms = (time.perf_counter() - s0) * 1e3
+                    # seat: the incoming occupants' rows replace them
+                    g0 = time.perf_counter()
+                    in_rows = jax.device_put(
+                        self.registry.gather_client_states(occ_next[changed])
+                    )
+                    self.client_states = jax.tree_util.tree_map(
+                        lambda t, n: t.at[ch].set(n),
+                        self.client_states, in_rows,
+                    )
+                    if has_srows:
+                        in_srows = jax.device_put(
+                            self.registry.gather_strategy_rows(
+                                occ_next[changed]
+                            )
+                        )
+                        self.server_state = self.strategy.scatter_state_rows(
+                            self.server_state,
+                            jax.tree_util.tree_map(
+                                lambda t, n: t.at[ch].set(n),
+                                srows_live, in_srows,
+                            ),
+                        )
+                    gather_ms = (time.perf_counter() - g0) * 1e3
+                    swap_span.set(scatter_ms=scatter_ms,
+                                  gather_ms=gather_ms)
+            # restart data for the NEW occupancy on data plan e+1; its
+            # val batches/counts also feed this event's eval (the eval
+            # runs on the post-swap stack)
+            st0 = time.perf_counter()
+            staged = self.registry.stage_round(
+                occ_next, slots, self._base_entropy, e + 1
+            )
+            batches_next = jax.device_put(staged["batches"])
+            val_batches = jax.device_put(staged["val_batches"])
+            val_counts = jnp.asarray(staged["val_counts"])
+            wave_counts = jnp.asarray(staged["sample_counts"])
+            stage_ms = (time.perf_counter() - st0) * 1e3
+            args = [self.server_state, self.client_states,
+                    self._async_pending, batches_next,
+                    jnp.asarray(plan.arrivals[e - 1]),
+                    jnp.asarray(plan.staleness[e - 1]),
+                    jnp.asarray(e, jnp.int32), val_batches, val_counts,
+                    self._staleness_exponent_input(),
+                    None, None,  # no held-out test stacks in cohort mode
+                    wave_counts]
+            with obs.span("async_event", round=e) as ev_span:
+                (self.server_state, self.client_states, self._async_pending,
+                 out) = event_jit(*args)
+                _, device_wait_s = obs.fence(
+                    (out["fit_losses"], out["eval_losses"])
+                )
+                ev_span.set(device_wait_s=device_wait_s)
+            self._count_cohort_roundtrip()
+            compiles_after = compile_s_after = None
+            if obs.enabled:
+                compiles_after = obs.registry.counter(
+                    "jax_backend_compiles_total").value
+                compile_s_after = obs.registry.counter(
+                    "jax_backend_compiles_seconds_total").value
+            device_results = {
+                "mask": plan.arrivals[e - 1],
+                "fit_losses": out["fit_losses"],
+                "fit_metrics": out["fit_metrics"],
+                "per_client_fit_losses": out["per_client_fit_losses"],
+                "eval_losses": out["eval_losses"],
+                "eval_metrics": out["eval_metrics"],
+            }
+            if "telemetry" in out:
+                device_results["telemetry"] = out["telemetry"]
+            if "quarantine" in out:
+                device_results["_quarantine"] = out["quarantine"]
+            work = _RoundWork(
+                round=e,
+                device_results=device_results,
+                fit_elapsed_s=time.time() - t0,
+                eval_elapsed_s=0.0,
+                device_wait_s=device_wait_s,
+                compiles_before=compiles_before,
+                compile_s_before=compile_s_before,
+                compiles_after=compiles_after,
+                compile_s_after=compile_s_after,
+                async_info=self._async_event_info(plan, e - 1),
+                # attribution is by the PRE-swap occupancy: seat s's
+                # consumed packet was trained by the occupant seated when
+                # s last restarted, who held the seat until this swap
+                cohort_meta={"idx": occ_prev},
+                cohort_info={
+                    "cohort_slots": slots,
+                    "cohort_valid": slots,
+                    "registry_size": self.registry_size,
+                    "registry_dirty_rows": self.registry.dirty_rows,
+                    "stage_ms": round(stage_ms, 3),
+                    "gather_ms": round(gather_ms, 3),
+                    "scatter_ms": round(scatter_ms, 3),
+                    "staged_bytes": staged["staged_bytes"],
+                    "rounds_per_dispatch": 1,
+                    "cohort_draw": "event_plan",
+                },
+            )
+            if consumer is not None:
+                consumer.submit_round(
+                    e, functools.partial(self._finish_round, work))
+                if not self.failure_policy.accept_failures:
+                    consumer.flush()
+            else:
+                self._finish_round(work)
+        return occ_next
 
     def _emit_quarantine_metrics(self, rnd: int, q_np: np.ndarray,
                                  ids: np.ndarray | None = None) -> None:

@@ -22,6 +22,16 @@ With every arrival at staleness 0 the discount is exactly 1.0 and the mask
 is bit-identical to the synchronous one — the simulation's
 ``async == sync`` pin (K = cohort, no stragglers) holds through this
 wrapper by construction.
+
+The same mask-folding carries FedBuff over the client REGISTRY
+(``async_config + CohortConfig``): there the ``C`` axis is cohort slots
+seated from a ``RegistryEventPlan``, per-slot sample counts become a
+traced event input (the seated occupant's count rides the pending
+buffer), and occupancy swaps happen host-side between events — all
+outside the strategy, so this wrapper needs no registry awareness. Its
+state-passthrough design is what lets per-client inner rows (EF
+residuals, quarantine strikes) gather/scatter through the registry's
+``Strategy.state_rows`` hooks unchanged.
 """
 
 from __future__ import annotations

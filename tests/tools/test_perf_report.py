@@ -833,6 +833,50 @@ def test_cli_output_byte_stable_without_cohort_fields(tmp_path):
     assert "slots" not in out and "registry" not in out
 
 
+def test_chunked_cohort_columns_render_when_fields_present():
+    rounds = [
+        _round(1, cohort_slots=8, cohort_valid=8, registry_size=500,
+               rounds_per_dispatch=8, cohort_draw="in_graph"),
+        _round(2, cohort_slots=8, cohort_valid=7, registry_size=500,
+               rounds_per_dispatch=8, cohort_draw="in_graph"),
+    ]
+    table = perf_report.render_table(rounds)
+    head = table.splitlines()[0]
+    assert "rpd" in head and "draw" in head
+    assert "in_graph" in table
+
+
+def test_chunked_cohort_summary_keys():
+    rounds = [
+        _round(1, cohort_slots=4, cohort_valid=4, registry_size=64,
+               rounds_per_dispatch=1, cohort_draw="host"),
+        _round(2, cohort_slots=4, cohort_valid=4, registry_size=64,
+               rounds_per_dispatch=32, cohort_draw="in_graph"),
+    ]
+    s = perf_report.summarize(rounds)
+    assert s["rounds_per_dispatch"] == 32
+    # mixed draw sites surface as a sorted list; a uniform log collapses
+    # to the single string
+    assert s["cohort_draw"] == ["host", "in_graph"]
+    uniform = perf_report.summarize([rounds[1]])
+    assert uniform["cohort_draw"] == "in_graph"
+
+
+def test_chunk_fields_absent_keeps_pipelined_cohort_table_byte_stable():
+    """A PR-13-era pipelined-cohort log (cohort fields but no chunk
+    fields) must not grow rpd/draw columns or summary keys."""
+    rounds = [
+        _round(1, cohort_slots=8, cohort_valid=8, registry_size=500,
+               stage_ms=10.0, scatter_ms=2.0),
+        _round(2, cohort_slots=8, cohort_valid=7, registry_size=500,
+               stage_ms=14.0, scatter_ms=4.0),
+    ]
+    head = perf_report.render_table(rounds).splitlines()[0]
+    assert "rpd" not in head and "draw" not in head
+    s = perf_report.summarize(rounds)
+    assert "rounds_per_dispatch" not in s and "cohort_draw" not in s
+
+
 # -- postmortem bundles (--bundle, flight-recorder PR) ----------------------
 
 def _bundle(tmp_path):

@@ -106,6 +106,13 @@ COHORT_COLUMNS = (
     ("registry", "registry_size", lambda v: str(int(v))),
     ("stage_ms", "stage_ms", lambda v: f"{v:.1f}"),
     ("scatter_ms", "scatter_ms", lambda v: f"{v:.1f}"),
+    # chunked-cohort execution facts (PR 17): how many rounds each device
+    # dispatch covered and where the round's cohort ids were drawn ("host"
+    # for the pipelined mirror, "in_graph" for the chunked scan,
+    # "event_plan" for async-over-registry). Absent from pre-chunk logs,
+    # so those tables stay byte-stable.
+    ("rpd", "rounds_per_dispatch", lambda v: str(int(v))),
+    ("draw", "cohort_draw", str),
 )
 
 # Fleet-ledger fields (observability/fleet.py): first-time participants,
@@ -515,6 +522,18 @@ def summarize(rounds: list[dict]) -> dict[str, Any]:
                 if "scatter_ms" in r]
         if scat:
             summary["scatter_ms_mean"] = round(sum(scat) / len(scat), 3)
+        if any("rounds_per_dispatch" in r for r in rounds):
+            # chunked-cohort runs only — the chunk size R the run amortized
+            # its host round-trips over, and the draw sites it mixed
+            summary["rounds_per_dispatch"] = int(max(
+                float(r.get("rounds_per_dispatch", 0)) for r in rounds
+            ))
+            draws = sorted({str(r["cohort_draw"]) for r in rounds
+                            if "cohort_draw" in r})
+            if draws:
+                summary["cohort_draw"] = (
+                    draws[0] if len(draws) == 1 else draws
+                )
     fleet = fleet_summary(rounds)
     if fleet:
         # fleet-ledger runs only — legacy summaries stay byte-stable
