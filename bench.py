@@ -106,6 +106,46 @@ def _provenance() -> tuple[str, str]:
     return d.platform, getattr(d, "device_kind", "unknown")
 
 
+def _git_rev() -> str | None:
+    """Current commit (+'-dirty' when the tree has changes); None outside
+    a git checkout — absence, never a placeholder a diff could match."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
+            capture_output=True, text=True, timeout=10, check=True,
+        ).stdout.strip()
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=repo,
+            capture_output=True, text=True, timeout=10, check=True,
+        ).stdout.strip()
+        return rev + ("-dirty" if dirty else "")
+    except Exception:
+        return None
+
+
+def provenance_block() -> dict:
+    """The ``provenance`` block every bench artifact carries so a
+    CPU-fallback number can never masquerade as a TPU capture: backend +
+    device kind, jax/jaxlib versions, git rev, and the explicit
+    ``cpu_fallback`` flag ``tools/bench_gate.py`` cross-checks against the
+    artifact's metric name."""
+    import jax
+    import jaxlib
+
+    platform, device_kind = _provenance()
+    return {
+        "backend": platform,
+        "device_kind": device_kind,
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib.__version__,
+        "git_rev": _git_rev(),
+        "cpu_fallback": platform == "cpu",
+    }
+
+
 def _bench_dtype():
     """bf16 on any accelerator (the MXU-native path), fp32 on CPU (bf16 is
     emulated there); FL4HEALTH_BENCH_DTYPE=float32|bfloat16 overrides. Gate
@@ -132,10 +172,18 @@ def analytic_transformer_round_flops(
     r5: 1.29% cost-model vs 8.8% analytic on the same run). Per token per
     layer forward: 8d^2 (QKV+O) + 4Td (QK^T + PV) + 4*d*d_ff (MLP);
     embedding gather and the tiny classifier head are ignored.
+
+    Thin wrapper over the single shared numerator rule in
+    ``fl4health_tpu/observability/flops.py`` — the same convention
+    ``hloscan``'s shape-based dot counter and ``tools/flash_crossover.py``
+    use, so no two tools can disagree about the same model.
     """
-    per_tok_fwd = (8.0 * d * d + 4.0 * seq * d + 4.0 * d * d_ff) * n_layers
-    tokens_per_round = seq * BATCH * LOCAL_STEPS * n_clients
-    return 3.0 * per_tok_fwd * tokens_per_round
+    from fl4health_tpu.observability import flops as flops_rules
+
+    return flops_rules.transformer_round_flops(
+        d, d_ff, n_layers, seq, n_clients, batch=BATCH,
+        local_steps=LOCAL_STEPS,
+    )
 
 
 def _headline_conv_impl() -> str:
@@ -330,6 +378,17 @@ def compile_fit_round(sim):
         compile_seconds=compile_s,
         **analyze_compiled(compiled),
     )
+    if os.environ.get("FL4HEALTH_BENCH_STAGE_ATTRIBUTION") == "1":
+        # opt-in per-stage rows for the artifact (the introspector does
+        # this automatically inside fit(); bench builds its report from
+        # the AOT executable directly, so run the hloscan walk here)
+        from fl4health_tpu.observability import hloscan
+        from fl4health_tpu.observability import stages as stage_attr
+
+        if stage_attr.enabled():
+            report.stages = hloscan.analyze_compiled(
+                compiled, device_kind=report.device_kind
+            )
     return compiled, report
 
 
@@ -1583,7 +1642,15 @@ def _measure_config(model_kind: str, with_eager: bool) -> dict:
             if hbm_total is not None and prog.peak_hbm_bytes is not None
             else None
         ),
+        "provenance": provenance_block(),
     }
+    # Opt-in per-stage roofline attribution (observability/hloscan.py):
+    # the compiled fit_round's flops/bytes split across fl_stage:: scopes.
+    # Null (never []) when attribution is off or the HLO walk declined —
+    # the ledger lane is tools/roofline_report.py; this embeds the same
+    # rows for artifact-only archaeology.
+    if os.environ.get("FL4HEALTH_BENCH_STAGE_ATTRIBUTION") == "1":
+        out["stage_attribution"] = prog.stages
     # Only meaningful against a real accelerator measurement: the bridge on
     # a CPU-fallback number would "model" nothing.
     if peak and achieved_flops:
@@ -1851,7 +1918,13 @@ def run_measurement() -> None:
         # survivability PR metric (host-I/O latencies always measured;
         # the resume-overhead fit arm null on the CPU fallback)
         "recovery": cifar.get("recovery"),
+        # backend/device/version/git-rev facts tools/bench_gate.py
+        # cross-checks against the metric name (a cpu_fallback number can
+        # never masquerade as a TPU capture)
+        "provenance": cifar["provenance"],
     }
+    if "stage_attribution" in cifar:  # FL4HEALTH_BENCH_STAGE_ATTRIBUTION=1
+        record["stage_attribution"] = cifar["stage_attribution"]
     if fallback_note:
         record["note"] = fallback_note
     print(json.dumps(record))
@@ -1958,6 +2031,7 @@ def run_multichip_artifact() -> None:
         "program_introspection": {p["name"]: p for p in programs},
         "manifest": manifest,
         "data_provenance": "synthetic",
+        "provenance": provenance_block(),
         "forced_host_devices": bool(
             os.environ.get("FL4HEALTH_MULTICHIP_CHILD")
         ),
@@ -1993,6 +2067,7 @@ def run_precision_artifact() -> None:
         "platform": platform,
         "device_kind": device_kind,
         "data_provenance": "synthetic",
+        "provenance": provenance_block(),
         "model_dtype": "float32",
         "precision": block,
     }
@@ -2033,6 +2108,7 @@ def run_async_artifact() -> None:
         "platform": platform,
         "device_kind": device_kind,
         "data_provenance": "synthetic",
+        "provenance": provenance_block(),
         "async": block,
     }
     if fallback:
@@ -2076,6 +2152,7 @@ def run_sweep_artifact() -> None:
         "platform": platform,
         "device_kind": device_kind,
         "data_provenance": "synthetic",
+        "provenance": provenance_block(),
         "sweep": block,
     }
     if fallback and not timing:
@@ -2120,6 +2197,7 @@ def run_cohort_artifact() -> None:
         "platform": platform,
         "device_kind": device_kind,
         "data_provenance": "synthetic",
+        "provenance": provenance_block(),
         "cohort": block,
     }
     if os.environ.get("FL4HEALTH_BENCH_COHORT_CHUNK") == "1":

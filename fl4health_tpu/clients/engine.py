@@ -33,6 +33,7 @@ from fl4health_tpu.core.types import Params, PRNGKey, PyTree
 from fl4health_tpu.losses.containers import LossMeter
 from fl4health_tpu.precision import policy as precision_policy
 from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.observability import stages as stage_attr
 from fl4health_tpu.observability.registry import get_registry
 from fl4health_tpu.observability.spans import get_tracer
 
@@ -626,7 +627,7 @@ def make_local_train(
                               precision=precision)
     meter_proto = LossMeter.create(loss_keys)
 
-    def train(state: TrainState, ctx: Any, batches: Batch):
+    def _train(state: TrainState, ctx: Any, batches: Batch):
         def body(carry, batch):
             st, meter, mstate, acc = carry
             st, out = step_fn(st, ctx, batch)
@@ -648,6 +649,10 @@ def make_local_train(
         if collect_telemetry:
             return (*outs, telemetry_acc_finalize(acc, n_steps))
         return outs
+
+    def train(state: TrainState, ctx: Any, batches: Batch):
+        with stage_attr.stage("local_train"):
+            return _train(state, ctx, batches)
 
     return train
 

@@ -41,6 +41,7 @@ import numpy as np
 
 from fl4health_tpu.compression.config import QUANT_LEVELS, CompressionConfig
 from fl4health_tpu.core.types import PyTree
+from fl4health_tpu.observability import stages as stage_attr
 
 
 # ---------------------------------------------------------------------------
@@ -207,47 +208,51 @@ def compress_update(
     # 2. rotation (per leaf, fixed seeded Rademacher + orthonormal FWHT)
     signs = None
     if config.rotation:
-        signs = [
-            _rotation_signs(config.seed, i, _next_pow2(sizes[i]))
-            for i in range(len(flats))
-        ]
-        flats = [rotate_leaf(v, s) for v, s in zip(flats, signs)]
+        with stage_attr.stage("rotation"):
+            signs = [
+                _rotation_signs(config.seed, i, _next_pow2(sizes[i]))
+                for i in range(len(flats))
+            ]
+            flats = [rotate_leaf(v, s) for v, s in zip(flats, signs)]
 
     # 3. global magnitude top-k over the concatenated update
     if config.topk_fraction is not None:
-        n_sel = sum(v.shape[0] for v in flats)  # padded sizes under rotation
-        k = topk_count(n_total, config.topk_fraction)
-        k_eff = None
-        if topk_fraction_eff is not None:
-            # same arithmetic as the static topk_count, in-graph: round()
-            # matches Python round's half-to-even, clamps keep >=1 slot
-            k_eff = jnp.clip(
-                jnp.round(topk_fraction_eff * n_total).astype(jnp.int32),
-                1, min(k, n_sel),
-            )
-        mask = topk_mask(jnp.concatenate(flats), min(k, n_sel), k_eff)
-        out, off = [], 0
-        for v in flats:
-            out.append(v * mask[off: off + v.shape[0]])
-            off += v.shape[0]
-        flats = out
+        with stage_attr.stage("topk"):
+            n_sel = sum(v.shape[0] for v in flats)  # padded under rotation
+            k = topk_count(n_total, config.topk_fraction)
+            k_eff = None
+            if topk_fraction_eff is not None:
+                # same arithmetic as the static topk_count, in-graph: round()
+                # matches Python round's half-to-even, clamps keep >=1 slot
+                k_eff = jnp.clip(
+                    jnp.round(topk_fraction_eff * n_total).astype(jnp.int32),
+                    1, min(k, n_sel),
+                )
+            mask = topk_mask(jnp.concatenate(flats), min(k, n_sel), k_eff)
+            out, off = [], 0
+            for v in flats:
+                out.append(v * mask[off: off + v.shape[0]])
+                off += v.shape[0]
+            flats = out
 
     # 4. stochastic quantization, one scale per leaf
     if config.quant_bits is not None:
-        out = []
-        for i, v in enumerate(flats):
-            q, scale = stochastic_quantize_leaf(
-                v, config.quant_bits, jax.random.fold_in(key, i)
-            )
-            out.append(dequantize_leaf(q, scale))
-        flats = out
+        with stage_attr.stage("quantize"):
+            out = []
+            for i, v in enumerate(flats):
+                q, scale = stochastic_quantize_leaf(
+                    v, config.quant_bits, jax.random.fold_in(key, i)
+                )
+                out.append(dequantize_leaf(q, scale))
+            flats = out
 
     # 5. decode back to the original domain
     if config.rotation:
-        flats = [
-            unrotate_leaf(v, s, n)
-            for v, s, n in zip(flats, signs, sizes)
-        ]
+        with stage_attr.stage("rotation"):
+            flats = [
+                unrotate_leaf(v, s, n)
+                for v, s, n in zip(flats, signs, sizes)
+            ]
 
     # integer leaves round rather than truncate toward zero (parity with
     # the wire decoder's rule in transport/codec.py); `flats` becomes the

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from fl4health_tpu.core.types import Params
+from fl4health_tpu.observability import stages as stage_attr
 
 _LANE = 128
 
@@ -156,23 +157,24 @@ def fused_clipped_masked_sum(
     Leaf sums come back f32 regardless of input dtype (the XLA path promotes
     via the f32 mask multiply, and DP noise must be added at full precision).
     """
-    leaves, treedef = jax.tree_util.tree_flatten(per_example_grads)
-    mats = [leaf.reshape(leaf.shape[0], -1) for leaf in leaves]
+    with stage_attr.stage("dp_clip"):
+        leaves, treedef = jax.tree_util.tree_flatten(per_example_grads)
+        mats = [leaf.reshape(leaf.shape[0], -1) for leaf in leaves]
 
-    sq = sum(
-        per_example_sq_norms(m, tile=tile, interpret=interpret) for m in mats
-    )
-    norms = jnp.sqrt(jnp.maximum(sq, 0.0))
-    factor = jnp.minimum(1.0, clipping_bound / jnp.maximum(norms, 1e-12))
-    scale = factor * example_mask.astype(jnp.float32)
-
-    sums = [
-        scaled_masked_sum(m, scale, tile=tile, interpret=interpret).reshape(
-            leaf.shape[1:]
+        sq = sum(
+            per_example_sq_norms(m, tile=tile, interpret=interpret)
+            for m in mats
         )
-        for leaf, m in zip(leaves, mats)
-    ]
-    out = jax.tree_util.tree_unflatten(treedef, sums)
+        norms = jnp.sqrt(jnp.maximum(sq, 0.0))
+        factor = jnp.minimum(1.0, clipping_bound / jnp.maximum(norms, 1e-12))
+        scale = factor * example_mask.astype(jnp.float32)
+
+        sums = [
+            scaled_masked_sum(m, scale, tile=tile, interpret=interpret)
+            .reshape(leaf.shape[1:])
+            for leaf, m in zip(leaves, mats)
+        ]
+        out = jax.tree_util.tree_unflatten(treedef, sums)
     if return_norms:
         return out, norms
     return out

@@ -1,0 +1,489 @@
+"""Per-stage cost attribution from optimized-HLO text.
+
+``compiled.cost_analysis()`` answers "how much work is the whole program" —
+never "which spine stage is the work in". This module walks the compiled
+executable's optimized-HLO text (``compiled.as_text()``, the same
+build-time artifact the ``ProgramIntrospector`` hook already produces — no
+device work), attributes each op's flops/bytes to the ``fl_stage::`` scope
+on its ``op_name`` metadata path (observability/stages.py), and classifies
+each stage against the device roofline (observability/device_specs.py).
+
+Counting mirrors XLA's ``HloCostAnalysis`` conventions (validated against
+live ``cost_analysis()`` totals):
+
+- dot: ``2 * prod(result dims) * prod(contracting dims)`` — the single
+  analytic numerator rule (observability/flops.py);
+- convolution: ``2 * prod(output) * prod(kernel) / output_features``;
+- reduce: one flop per reduced-away element (input elems − output elems);
+- elementwise: one flop per output element, except transcendentals
+  (exp/log/tanh/sqrt/...) which land in ``transcendentals``, not flops;
+- bytes per op: operand bytes + result bytes; inside a fusion computation
+  only the fusion's *boundary* operands/result count (the fused
+  intermediates never touch HBM);
+- ``to_apply`` reduction regions are not counted separately (their work is
+  the reduce op's); while bodies count ONCE, trip-count-independent —
+  exactly like ``cost_analysis`` on a scanned round program;
+- a custom call (Pallas kernel) is a black box: 0 flops (the analytic
+  numerator stays the honest one — see introspect.py's caveat), boundary
+  bytes, and a per-stage ``custom_calls`` tally so the ledger shows where
+  the cost model is blind.
+
+Per-stage sums plus the ``_unattributed`` remainder equal this module's
+own program totals *by construction*; :func:`conservation` then pins those
+totals against the whole-program ``cost_analysis()`` numbers within
+:data:`FLOPS_RTOL`/:data:`BYTES_RTOL` — the contract that no stage's cost
+silently fell off the ledger.
+
+Fusion headroom per stage: the gap between per-op bytes (every op reading
+and writing HBM — the unfused worst case) and unique-buffer bytes (each
+distinct buffer touched once — the perfectly-fused floor). A conservative
+upper bound on what further fusion of that stage could save, and the
+number ``tools/roofline_report.py`` ranks stages by.
+
+Parsing is pure string work on the HLO text — importable without jax, so
+CLI tools can re-analyze dumped programs on any box.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from math import prod
+from typing import Any, Iterable
+
+from fl4health_tpu.observability import device_specs, flops as flops_rules
+from fl4health_tpu.observability.stages import SPINE_STAGES, UNATTRIBUTED, stage_of
+
+logger = logging.getLogger(__name__)
+
+# Conservation tolerances vs whole-program cost_analysis() totals. FLOPs
+# reconcile tightly (same dot/reduce/elementwise rules); bytes are looser
+# because XLA's buffer-level accounting sees layout/aliasing decisions the
+# text walk approximates. Pinned by tests/observability/test_stage_attribution.py
+# on the 4-client CIFAR CNN round programs.
+FLOPS_RTOL = 0.15
+BYTES_RTOL = 0.60
+
+_ELEM_BYTES = {
+    "pred": 1, "s4": 0.5, "u4": 0.5, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# One flop per output element (HloCostAnalysis's default elementwise rate).
+_ELEMENTWISE = frozenset((
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "compare", "select", "and", "or", "xor", "not", "negate", "abs",
+    "sign", "floor", "ceil", "round-nearest-afz", "round-nearest-even",
+    "clamp", "convert", "remainder", "shift-left", "shift-right-logical",
+    "shift-right-arithmetic", "is-finite", "popcnt", "clz",
+    "stochastic-convert",
+))
+
+# Counted in the separate transcendentals bucket, mirroring cost_analysis.
+_TRANSCENDENTAL = frozenset((
+    "exponential", "exponential-minus-one", "log", "log-plus-one",
+    "logistic", "tanh", "sqrt", "rsqrt", "cbrt", "power", "sine", "cosine",
+    "tan", "atan2", "erf",
+))
+
+# Zero work, zero bytes: bookkeeping ops that allocate/alias, never move.
+_FREE = frozenset((
+    "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
+    "after-all", "opt-barrier", "partition-id", "replica-id",
+))
+
+# Region/control ops whose data motion is accounted inside their called
+# computations (counted separately) — charging their full carry at the
+# callsite would double-count every loop-carried buffer.
+_CONTROL = frozenset(("while", "conditional", "call", "fusion"))
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,<=\s]*)\]")
+_METADATA_RE = re.compile(r"\s*,?\s*metadata=\{[^{}]*\}")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_ASSIGN_RE = re.compile(r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.*)$")
+_SCALAR_TYPE_RE = re.compile(r"^[a-zA-Z0-9]+\[[^\]]*\](?:\{[^{}]*\})?")
+_OPCODE_RE = re.compile(r"^\s*(?P<opcode>[a-zA-Z][\w\-]*)\((?P<rest>.*)$")
+_COMP_RE = re.compile(r"^\s*(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s+\(.*->.*\{\s*$")
+_REF_RE = re.compile(r"%([\w.\-]+)")
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+_WINDOW_SIZE_RE = re.compile(r"size=([0-9x]+)")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,\s]*)\}")
+_DIM_LABELS_RE = re.compile(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)")
+
+
+def _shapes(segment: str) -> list[tuple[str, tuple[int, ...]]]:
+    """All ``dtype[d0,d1,...]`` shape tokens in a text segment."""
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(segment):
+        if dtype not in _ELEM_BYTES:
+            continue
+        parsed = tuple(
+            int(d.replace("<=", "").strip())
+            for d in dims.split(",") if d.strip()
+        )
+        out.append((dtype, parsed))
+    return out
+
+
+def _nbytes(shapes: Iterable[tuple[str, tuple[int, ...]]]) -> float:
+    return float(sum(_ELEM_BYTES[dt] * prod(dims) for dt, dims in shapes))
+
+
+def _elems(shapes: Iterable[tuple[str, tuple[int, ...]]]) -> int:
+    return int(sum(prod(dims) for _, dims in shapes))
+
+
+def _split_operands(rest: str) -> tuple[str, str]:
+    """Split ``rest`` (text after the opcode's ``(``) into the operand
+    segment and the trailing attributes, honoring nested parens (tuple-
+    shaped operands)."""
+    depth = 1
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return rest[:i], rest[i + 1:]
+    return rest, ""
+
+
+def _split_result_type(rest: str) -> tuple[str, str] | None:
+    """Split an instruction's text after ``=`` into (result type, rest).
+    Tuple types need paren matching — big tuples carry ``/*index=N*/``
+    comments and can nest, so no single regex is safe."""
+    if rest.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    return rest[: i + 1], rest[i + 1:]
+        return None
+    m = _SCALAR_TYPE_RE.match(rest)
+    if not m:
+        return None
+    return m.group(0), rest[m.end():]
+
+
+class _Op:
+    __slots__ = ("name", "opcode", "result_shapes", "operand_segments",
+                 "operand_shapes", "operand_names", "attrs", "op_name")
+
+    def __init__(self, name, opcode, result_shapes, operand_shapes,
+                 operand_names, attrs, op_name):
+        self.name = name
+        self.opcode = opcode
+        self.result_shapes = result_shapes
+        self.operand_shapes = operand_shapes
+        self.operand_names = operand_names
+        self.attrs = attrs
+        self.op_name = op_name
+
+
+def _parse_computations(text: str) -> tuple[dict[str, list[_Op]], str | None]:
+    """HLO text -> {computation name: ops}, plus the ENTRY computation's
+    name."""
+    comps: dict[str, list[_Op]] = {}
+    entry: str | None = None
+    current: list[_Op] | None = None
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith(("HloModule", "//", "}")):
+            if stripped.startswith("}"):
+                current = None
+            continue
+        header = _COMP_RE.match(line)
+        if header and " = " not in line.split("->")[0]:
+            name = header.group("name")
+            comps[name] = []
+            current = comps[name]
+            if header.group("entry"):
+                entry = name
+            continue
+        if current is None:
+            continue
+        op_name = None
+        meta = _OPNAME_RE.search(line)
+        if meta:
+            op_name = meta.group(1)
+        clean = _METADATA_RE.sub("", line)
+        m = _ASSIGN_RE.match(clean)
+        if not m:
+            continue
+        split = _split_result_type(m.group("rest"))
+        if split is None:
+            continue
+        rtype, after = split
+        mo = _OPCODE_RE.match(after)
+        if not mo:
+            continue
+        operands, attrs = _split_operands(mo.group("rest"))
+        current.append(_Op(
+            name=m.group("name"),
+            opcode=mo.group("opcode"),
+            result_shapes=_shapes(rtype),
+            operand_shapes=_shapes(operands),
+            operand_names=_OPERAND_NAME_RE.findall(operands),
+            attrs=attrs,
+            op_name=op_name,
+        ))
+    return comps, entry
+
+
+def _op_flops(op: _Op) -> tuple[float, float]:
+    """(flops, transcendentals) of one HLO op, per the cost-model rules."""
+    out_elems = _elems(op.result_shapes)
+    if op.opcode == "dot":
+        contract = _CONTRACT_RE.search(op.attrs)
+        if not contract or not op.operand_shapes:
+            return 0.0, 0.0
+        lhs = op.operand_shapes[0][1]
+        dims = [int(d) for d in contract.group(1).split(",") if d.strip()]
+        contracted = [lhs[d] for d in dims if d < len(lhs)]
+        # result may be tuple-free single shape; use all result elems
+        return flops_rules.dot_flops((out_elems,), contracted), 0.0
+    if op.opcode == "convolution":
+        labels = _DIM_LABELS_RE.search(op.attrs)
+        if not labels or len(op.operand_shapes) < 2:
+            return 0.0, 0.0
+        kernel_labels = labels.group(2)
+        kernel = op.operand_shapes[1][1]
+        o_idx = kernel_labels.find("o")
+        out_features = kernel[o_idx] if 0 <= o_idx < len(kernel) else 1
+        return 2.0 * out_elems * prod(kernel) / max(out_features, 1), 0.0
+    if op.opcode == "reduce":
+        # variadic reduce: operands are (inputs..., init values...); init
+        # values are scalars, so input elems dominate — subtract outputs.
+        in_elems = sum(
+            prod(dims) for _, dims in op.operand_shapes if prod(dims) > 1
+        )
+        return float(max(in_elems - out_elems, 0)), 0.0
+    if op.opcode == "reduce-window":
+        size = _WINDOW_SIZE_RE.search(op.attrs)
+        window = prod(int(x) for x in size.group(1).split("x")) if size else 1
+        return float(out_elems * max(window - 1, 0)), 0.0
+    if op.opcode in _TRANSCENDENTAL:
+        return 0.0, float(out_elems)
+    if op.opcode in _ELEMENTWISE:
+        return float(out_elems), 0.0
+    return 0.0, 0.0
+
+
+def _op_bytes(op: _Op) -> float:
+    return _nbytes(op.operand_shapes) + _nbytes(op.result_shapes)
+
+
+def _classify_computations(
+    comps: dict[str, list[_Op]], entry: str | None
+) -> tuple[set[str], set[str], dict[str, str | None]]:
+    """-> (countable computations, fusion computations, fusion -> callsite
+    stage). ``to_apply`` regions are excluded; while/conditional/call
+    bodies count once."""
+    fusion: set[str] = set()
+    control: set[str] = set()
+    applied: set[str] = set()
+    fusion_stage: dict[str, str | None] = {}
+    for ops in comps.values():
+        for op in ops:
+            attrs = op.attrs
+            if op.opcode == "fusion":
+                m = re.search(r"calls=([^,]+)", attrs)
+                if m:
+                    for ref in _REF_RE.findall(m.group(1)):
+                        fusion.add(ref)
+                        fusion_stage.setdefault(ref, stage_of(op.op_name))
+                continue
+            for key in ("body=", "condition=", "branch_computations=",
+                        "calls=", "called_computations="):
+                idx = attrs.find(key)
+                if idx < 0:
+                    continue
+                seg = attrs[idx + len(key):]
+                seg = seg.split("}", 1)[0] if seg.startswith("{") else seg.split(",", 1)[0]
+                control.update(_REF_RE.findall(seg))
+            m = re.search(r"to_apply=%?([\w.\-]+)", attrs)
+            if m:
+                if op.opcode == "call":
+                    # ``call`` names its target via to_apply, but the
+                    # target is OUTLINED REAL CODE (XLA:CPU's parallel
+                    # task assigner hoists heavy convolutions into such
+                    # calls) — counted once like a while body, unlike the
+                    # per-element apply lambdas of reduce/scatter/sort.
+                    control.add(m.group(1))
+                else:
+                    applied.add(m.group(1))
+    countable = {entry} if entry else set()
+    # while/conditional/call bodies count once; fusion computations are
+    # walked from their callsite instead; apply-lambda-only regions (the
+    # reduce/scatter/sort combiners) never count
+    countable |= control - fusion - (applied - control)
+    return countable, fusion, fusion_stage
+
+
+class _StageAcc:
+    __slots__ = ("flops", "transcendentals", "bytes", "ops", "custom_calls",
+                 "buffers")
+
+    def __init__(self):
+        self.flops = 0.0
+        self.transcendentals = 0.0
+        self.bytes = 0.0
+        self.ops = 0
+        self.custom_calls = 0
+        self.buffers: dict[tuple[str, str], float] = {}
+
+
+def analyze_text(
+    text: str,
+    device_kind: str | None = None,
+    n_partitions: int = 1,
+) -> list[dict[str, Any]]:
+    """Attribute an optimized-HLO module's per-op costs to ``fl_stage::``
+    stages. Returns one row per stage (spine order, then extras, then
+    ``_unattributed`` last); rows follow the repo's None-means-unknown
+    discipline — roofline keys appear only when classifiable."""
+    comps, entry = _parse_computations(text)
+    countable, fusion_comps, fusion_stage = _classify_computations(comps, entry)
+    scale = float(max(n_partitions, 1))
+    accs: dict[str, _StageAcc] = {}
+
+    def acc(stage: str | None) -> _StageAcc:
+        key = stage or UNATTRIBUTED
+        if key not in accs:
+            accs[key] = _StageAcc()
+        return accs[key]
+
+    def walk(comp: str, in_fusion: bool, fallback: str | None) -> None:
+        for op in comps.get(comp, ()):
+            if op.opcode in _FREE:
+                continue
+            stage = stage_of(op.op_name) or fallback
+            a = acc(stage)
+            f, t = _op_flops(op)
+            a.flops += f
+            a.transcendentals += t
+            a.ops += 1
+            if op.opcode == "custom-call":
+                a.custom_calls += 1
+            if not in_fusion and op.opcode not in _CONTROL:
+                a.bytes += _op_bytes(op)
+                for nm, shp in zip(op.operand_names, op.operand_shapes):
+                    a.buffers[(comp, nm)] = _nbytes([shp])
+                a.buffers[(comp, op.name)] = _nbytes(op.result_shapes)
+            elif op.opcode == "fusion":
+                # fused intermediates never reach HBM: only the fusion's
+                # boundary operands/result move bytes
+                a.bytes += _op_bytes(op)
+                for nm, shp in zip(op.operand_names, op.operand_shapes):
+                    a.buffers[(comp, nm)] = _nbytes([shp])
+                a.buffers[(comp, op.name)] = _nbytes(op.result_shapes)
+                m = re.search(r"calls=([^,]+)", op.attrs)
+                for ref in _REF_RE.findall(m.group(1)) if m else ():
+                    walk(ref, True, stage_of(op.op_name) or fallback)
+
+    for comp in comps:
+        if comp in countable and comp not in fusion_comps:
+            walk(comp, False, None)
+
+    rows = []
+    for stage_name, a in accs.items():
+        unique = sum(a.buffers.values())
+        headroom = max(a.bytes - unique, 0.0)
+        row: dict[str, Any] = {
+            "stage": stage_name,
+            "flops": a.flops * scale,
+            "transcendentals": a.transcendentals * scale,
+            "bytes_accessed": a.bytes * scale,
+            "ops": a.ops,
+            "custom_calls": a.custom_calls,
+            "fusion_headroom_bytes": headroom * scale,
+            "fusion_headroom_frac": (headroom / a.bytes) if a.bytes > 0 else None,
+        }
+        roof = device_specs.roofline(
+            row["flops"], row["bytes_accessed"], device_kind or ""
+        )
+        if roof:
+            row.update(roof)
+            if "compute_bound" in roof:
+                row["bound"] = "compute" if roof["compute_bound"] else "hbm"
+        rows.append(row)
+
+    def order(row: dict[str, Any]) -> tuple[int, str]:
+        s = row["stage"]
+        if s in SPINE_STAGES:
+            return (0, f"{SPINE_STAGES.index(s):02d}")
+        if s == UNATTRIBUTED:
+            return (2, s)
+        return (1, s)
+
+    rows.sort(key=order)
+    return rows
+
+
+def analyze_compiled(
+    compiled: Any,
+    device_kind: str | None = None,
+    n_partitions: int = 1,
+) -> list[dict[str, Any]] | None:
+    """Stage rows for a jax compiled executable, or None when the backend
+    exposes no HLO text (never an exception — this runs inside
+    ``introspect_jit``, which must not take down a run)."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        logger.debug("compiled.as_text() unavailable", exc_info=True)
+        return None
+    if not text or "ENTRY" not in text:
+        return None
+    try:
+        return analyze_text(text, device_kind=device_kind,
+                            n_partitions=n_partitions)
+    except Exception:
+        logger.warning("HLO stage scan failed", exc_info=True)
+        return None
+
+
+def totals(stages: list[dict[str, Any]]) -> dict[str, float]:
+    """This module's own program totals (stage sums + _unattributed —
+    exact by construction)."""
+    return {
+        "flops": sum(s["flops"] for s in stages),
+        "transcendentals": sum(s["transcendentals"] for s in stages),
+        "bytes_accessed": sum(s["bytes_accessed"] for s in stages),
+    }
+
+
+def conservation(
+    stages: list[dict[str, Any]],
+    program_flops: float | None,
+    program_bytes: float | None,
+    flops_rtol: float = FLOPS_RTOL,
+    bytes_rtol: float = BYTES_RTOL,
+) -> dict[str, Any]:
+    """Reconcile per-stage sums with whole-program ``cost_analysis()``
+    totals. Relative errors are None when the program total is unknown
+    (no cost model on this backend) — absence, never a fake zero."""
+    own = totals(stages)
+
+    def rel(mine: float, theirs: float | None) -> float | None:
+        if theirs is None:
+            return None
+        denom = max(abs(theirs), 1.0)
+        return abs(mine - theirs) / denom
+
+    flops_err = rel(own["flops"], program_flops)
+    bytes_err = rel(own["bytes_accessed"], program_bytes)
+    checked = [e <= t for e, t in ((flops_err, flops_rtol),
+                                   (bytes_err, bytes_rtol)) if e is not None]
+    return {
+        "flops_rel_err": flops_err,
+        "bytes_rel_err": bytes_err,
+        "ok": all(checked) if checked else None,
+    }

@@ -40,7 +40,8 @@ import logging
 import time
 from typing import Any
 
-from fl4health_tpu.observability import device_specs
+from fl4health_tpu.observability import device_specs, hloscan
+from fl4health_tpu.observability import stages as stage_attr
 from fl4health_tpu.observability.registry import MetricsRegistry
 
 logger = logging.getLogger(__name__)
@@ -95,6 +96,11 @@ class ProgramReport:
     # None on f32 builds (omitted from as_dict/events like ``mesh``) — the
     # dtype a program's flops/MFU numbers are attributable to
     precision: dict | None = None
+    # per-stage cost attribution rows (observability/hloscan.py) when
+    # fl_stage attribution is enabled and the backend exposes HLO text;
+    # None otherwise (omitted from as_dict/events like ``mesh``, keeping
+    # attribution-off program records byte-identical to legacy)
+    stages: list | None = None
 
     @property
     def peak_hbm_bytes(self) -> int | None:
@@ -134,6 +140,8 @@ class ProgramReport:
             del d["precision"]
         if d.get("cohort_draw") is None:
             del d["cohort_draw"]
+        if d.get("stages") is None:
+            del d["stages"]
         d["peak_hbm_bytes"] = self.peak_hbm_bytes
         d["cache_hit"] = self.cache_hit
         roof = self.roofline()
@@ -249,6 +257,12 @@ class ProgramIntrospector:
                     n_partitions=int((mesh or {}).get("n_devices", 1)),
                 ),
             )
+            if stage_attr.enabled():
+                report.stages = hloscan.analyze_compiled(
+                    compiled,
+                    device_kind=report.device_kind,
+                    n_partitions=int((mesh or {}).get("n_devices", 1)),
+                )
         except Exception:
             logger.warning("program introspection failed for %r", name,
                            exc_info=True)
@@ -281,6 +295,26 @@ class ProgramIntrospector:
         for gname, ghelp, value in gauges:
             if value is not None:
                 reg.gauge(gname, help=ghelp, labels=labels).set(float(value))
+        for row in report.stages or ():
+            slabels = {"program": report.name, "stage": row["stage"]}
+            reg.gauge(
+                "fl_stage_flops",
+                help="HLO-attributed FLOPs of one spine stage per dispatch",
+                labels=slabels,
+            ).set(float(row["flops"]))
+            reg.gauge(
+                "fl_stage_bytes",
+                help="HLO-attributed HBM bytes of one spine stage per dispatch",
+                labels=slabels,
+            ).set(float(row["bytes_accessed"]))
+            if "bound" in row:
+                # only when the device roofline is known — never fabricated
+                reg.gauge(
+                    "fl_stage_bound",
+                    help="1 = stage is compute-bound on this chip, 0 = HBM-bound",
+                    labels=slabels,
+                ).set(1.0 if row["bound"] == "compute" else 0.0)
+            reg.log_event("stage", program=report.name, **row)
         reg.log_event("program", **report.as_dict())
         return report
 

@@ -1,0 +1,129 @@
+"""Stage naming for the aggregation spine — ``fl_stage::<name>`` scopes.
+
+ROADMAP item 5 gates every fused-kernel investment on profiles showing
+*which* stage of the clip -> quantize -> top-k -> robust-aggregate ->
+server-update spine XLA leaves on the table. Whole-program
+``cost_analysis()`` (observability/introspect.py) cannot answer that; this
+module gives each spine stage a name that survives into the compiled
+program, so ``observability/hloscan.py`` can attribute per-op flops/bytes
+back to it and ``tools/roofline_report.py`` can rank stages by fusion
+headroom.
+
+Mechanism: :func:`stage` wraps a code region in ``jax.named_scope`` with
+the ``fl_stage::`` prefix. Named scopes are **metadata only** — they land
+in each HLO op's ``op_name`` path and in XProf trace op names, and change
+neither the math nor XLA's optimization decisions, so attribution-on
+trajectories stay bit-identical to attribution-off on every execution mode
+(pinned by tests/observability/test_stage_attribution.py). Autodiff and
+``vmap``/``scan`` transforms preserve the name stack, so a stage's
+backward-pass ops attribute to the same stage as its forward ops.
+
+The canonical spine stages (:data:`SPINE_STAGES`):
+
+- ``local_train``   — the engine's train-step scan (clients/engine.py)
+- ``dp_clip``       — fused per-example clip+reduce (kernels/dp_clip.py)
+- ``rotation``      — randomized-Hadamard encode/decode (compression/codecs.py)
+- ``topk``          — global magnitude top-k selection (compression/codecs.py)
+- ``quantize``      — stochastic uniform quantization (compression/codecs.py)
+- ``robust_aggregate`` — Byzantine-robust combinators (resilience/aggregators.py)
+- ``server_update`` — the strategy's aggregate/server step, broken out
+  explicitly since it is what cross-replica weight-update sharding
+  optimizes (Xu et al., arXiv:2004.13336)
+- ``cohort_exchange`` — the in-graph cohort gather/scatter of the chunked
+  registry window (server/simulation.py)
+
+Toggle: attribution defaults ON (zero runtime cost). Set
+``FL4HEALTH_STAGE_ATTRIBUTION=0`` in the environment, call
+:func:`set_enabled`, or use the :func:`disabled` context manager to turn
+the scopes (and hloscan's per-stage reports) off; the off path is the
+byte-exact legacy program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+from typing import Iterator
+
+# The marker hloscan greps for in HLO op_name metadata paths and
+# roofline_report greps for in XProf trace op names. "::" cannot appear in
+# a user module/function name the way "/" separators do, so the prefix
+# never collides with ordinary scope components.
+STAGE_PREFIX = "fl_stage::"
+
+# Canonical spine stage names, in pipeline order (the order the roofline
+# ledger lists them when headrooms tie).
+SPINE_STAGES = (
+    "local_train",
+    "dp_clip",
+    "rotation",
+    "topk",
+    "quantize",
+    "robust_aggregate",
+    "server_update",
+    "cohort_exchange",
+)
+
+# Ops outside any fl_stage scope attribute here (still real work — the
+# conservation check needs them on the ledger, never silently dropped).
+UNATTRIBUTED = "_unattributed"
+
+_STAGE_RE = re.compile(re.escape(STAGE_PREFIX) + r"([A-Za-z0-9_.\-]+)")
+
+_enabled = os.environ.get("FL4HEALTH_STAGE_ATTRIBUTION", "1") != "0"
+
+
+def enabled() -> bool:
+    """True when stage scopes are being applied (process-wide toggle)."""
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Flip stage attribution process-wide. Affects programs traced AFTER
+    the call — already-compiled programs keep whatever metadata they were
+    traced with."""
+    global _enabled
+    _enabled = bool(on)
+
+
+@contextlib.contextmanager
+def disabled() -> Iterator[None]:
+    """Temporarily trace without stage scopes (the bit-identity tests'
+    off arm)."""
+    global _enabled
+    prev = _enabled
+    _enabled = False
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+@contextlib.contextmanager
+def stage(name: str) -> Iterator[None]:
+    """Scope a traced code region as spine stage ``name``.
+
+    A no-op (and zero-overhead at run time either way — named scopes are
+    trace-time metadata) when attribution is disabled. ``jax`` is imported
+    lazily so tools can import this module's parsing helpers without a
+    backend."""
+    if not _enabled:
+        yield
+        return
+    import jax
+
+    with jax.named_scope(STAGE_PREFIX + name):
+        yield
+
+
+def stage_of(op_name: str | None) -> str | None:
+    """The spine stage an HLO/trace ``op_name`` path belongs to, or None.
+
+    Takes the LAST ``fl_stage::`` component on the path — scopes nest
+    (``server_update`` wraps ``robust_aggregate`` wraps nothing), and the
+    innermost name is the most specific attribution."""
+    if not op_name:
+        return None
+    hits = _STAGE_RE.findall(op_name)
+    return hits[-1] if hits else None

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from fl4health_tpu.core.aggregate import effective_weights, weighted_mean
 from fl4health_tpu.core.types import Params, PyTree, StackedParams
+from fl4health_tpu.observability import stages as stage_attr
 from fl4health_tpu.strategies.base import FitResults, Strategy
 from fl4health_tpu.strategies.fedavg import FedAvgState
 
@@ -285,31 +286,32 @@ class RobustFedAvg(Strategy):
     def aggregate(
         self, server_state: FedAvgState, results: FitResults, round_idx
     ) -> FedAvgState:
-        stacked, mask = results.packets, results.mask
-        if self.method == "median":
-            new = coordinate_median(stacked, mask)
-            ok = jnp.sum(mask) > 0
-        elif self.method == "trimmed_mean":
-            new = trimmed_mean(stacked, mask, self.trim_fraction)
-            ok = jnp.sum(mask) > 0
-        elif self.method == "norm_bounded":
-            new = norm_bounded_mean(
-                stacked,
+        with stage_attr.stage("robust_aggregate"):
+            stacked, mask = results.packets, results.mask
+            if self.method == "median":
+                new = coordinate_median(stacked, mask)
+                ok = jnp.sum(mask) > 0
+            elif self.method == "trimmed_mean":
+                new = trimmed_mean(stacked, mask, self.trim_fraction)
+                ok = jnp.sum(mask) > 0
+            elif self.method == "norm_bounded":
+                new = norm_bounded_mean(
+                    stacked,
+                    server_state.params,
+                    results.sample_counts,
+                    mask,
+                    self.max_update_norm,
+                    self.weighted_aggregation,
+                )
+                ok = jnp.sum(mask) > 0
+            else:  # krum / multi_krum
+                m = 1 if self.method == "krum" else self.multi_krum_m
+                w = krum_weights(stacked, mask, self.num_byzantine, m)
+                new = weighted_mean(stacked, w)
+                ok = jnp.sum(w) > 0
+            new_params = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n.astype(o.dtype), o),
+                new,
                 server_state.params,
-                results.sample_counts,
-                mask,
-                self.max_update_norm,
-                self.weighted_aggregation,
             )
-            ok = jnp.sum(mask) > 0
-        else:  # krum / multi_krum
-            m = 1 if self.method == "krum" else self.multi_krum_m
-            w = krum_weights(stacked, mask, self.num_byzantine, m)
-            new = weighted_mean(stacked, w)
-            ok = jnp.sum(w) > 0
-        new_params = jax.tree_util.tree_map(
-            lambda n, o: jnp.where(ok, n.astype(o.dtype), o),
-            new,
-            server_state.params,
-        )
-        return server_state.replace(params=new_params)
+            return server_state.replace(params=new_params)

@@ -38,6 +38,7 @@ from fl4health_tpu.checkpointing.checkpointer import CheckpointMode
 from fl4health_tpu.clients import engine
 from fl4health_tpu.observability import Observability
 from fl4health_tpu.observability import device_specs
+from fl4health_tpu.observability import stages as stage_attr
 from fl4health_tpu.observability import telemetry as telem
 from fl4health_tpu.observability.flightrec import trap_sigterm
 from fl4health_tpu.observability.manifest import config_hash, run_manifest
@@ -1249,7 +1250,10 @@ class FederatedSimulation:
                 train_metrics=metrics,
                 mask=agg_mask,
             )
-            new_server_state = strategy.aggregate(server_state, results, round_idx)
+            with stage_attr.stage("server_update"):
+                new_server_state = strategy.aggregate(
+                    server_state, results, round_idx
+                )
             w = results.mask * sample_counts
             agg_losses = {
                 # where() not multiply: an excluded client's NaN loss must not
@@ -3901,15 +3905,18 @@ class FederatedSimulation:
                 ids, valid = draw(
                     jax.random.fold_in(base_rng, 2000 + r), r, slots
                 )
-                pos = jnp.searchsorted(window_ids, ids).astype(jnp.int32)
-                client_states = jax.tree_util.tree_map(
-                    lambda t: t[pos], w_client
-                )
-                if has_srows:
-                    server_state = strategy.scatter_state_rows(
-                        server_state,
-                        jax.tree_util.tree_map(lambda t: t[pos], w_srows),
+                with stage_attr.stage("cohort_exchange"):
+                    pos = jnp.searchsorted(window_ids, ids).astype(jnp.int32)
+                    client_states = jax.tree_util.tree_map(
+                        lambda t: t[pos], w_client
                     )
+                    if has_srows:
+                        server_state = strategy.scatter_state_rows(
+                            server_state,
+                            jax.tree_util.tree_map(
+                                lambda t: t[pos], w_srows
+                            ),
+                        )
                 fit_outs = fit_round(
                     server_state, client_states, batches_r, mask_r, r,
                     vb_r, sc_r,
@@ -3948,18 +3955,19 @@ class FederatedSimulation:
                 # write-back: post-eval rows land at their window position;
                 # pad slots (>= valid) target index w — dropped, exactly
                 # like an unsampled client on the pipelined path
-                dest = jnp.where(
-                    jnp.arange(slots, dtype=jnp.int32) < valid, pos, w
-                )
-                w_client = jax.tree_util.tree_map(
-                    lambda wt, c: wt.at[dest].set(c, mode="drop"),
-                    w_client, client_states,
-                )
-                if has_srows:
-                    w_srows = jax.tree_util.tree_map(
-                        lambda wt, c: wt.at[dest].set(c, mode="drop"),
-                        w_srows, strategy.state_rows(server_state),
+                with stage_attr.stage("cohort_exchange"):
+                    dest = jnp.where(
+                        jnp.arange(slots, dtype=jnp.int32) < valid, pos, w
                     )
+                    w_client = jax.tree_util.tree_map(
+                        lambda wt, c: wt.at[dest].set(c, mode="drop"),
+                        w_client, client_states,
+                    )
+                    if has_srows:
+                        w_srows = jax.tree_util.tree_map(
+                            lambda wt, c: wt.at[dest].set(c, mode="drop"),
+                            w_srows, strategy.state_rows(server_state),
+                        )
                 return (server_state, client_states, w_client, w_srows,
                         r + 1), out
 

@@ -118,6 +118,17 @@ def pytest_configure(config):
         "default; registry-scale property sweeps also carry 'slow'. "
         "Select with -m fleet.",
     )
+    config.addinivalue_line(
+        "markers",
+        "roofline: stage-attribution / roofline-ledger lanes "
+        "(observability/stages.py named-scope markers + hloscan.py "
+        "HLO-walk attribution, tools/roofline_report.py + "
+        "tools/bench_gate.py). The tier-1-safe smoke subset (attribution "
+        "on/off bit-identity per execution mode, hloscan conservation "
+        "pins against cost_analysis, gate pass/regression fixtures) runs "
+        "by default; heavier conservation sweeps also carry 'slow'. "
+        "Select with -m roofline.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,249 @@
+"""HLO-walk stage attribution units (observability/hloscan.py).
+
+The parser pins: tuple result types carrying ``/*index=N*/`` comments
+(the big-scan-state regression that silently dropped the while body),
+exact dot counting against XLA's own cost model, conservation on live
+programs, and the None-never-0.0 roofline discipline for unknown chips.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fl4health_tpu.observability import hloscan
+from fl4health_tpu.observability.stages import stage_of
+
+pytestmark = pytest.mark.roofline
+
+
+class TestStageOf:
+    def test_basic(self):
+        assert stage_of("jit(f)/fl_stage::dp_clip/add") == "dp_clip"
+
+    def test_innermost_wins(self):
+        path = "jit(f)/fl_stage::server_update/fl_stage::robust_aggregate/x"
+        assert stage_of(path) == "robust_aggregate"
+
+    def test_none_without_marker(self):
+        assert stage_of("jit(f)/transpose/add") is None
+        assert stage_of(None) is None
+        assert stage_of("") is None
+
+
+class TestResultTypeParsing:
+    def test_scalar_array_type(self):
+        head, rest = hloscan._split_result_type(
+            "f32[4,8]{1,0} add(f32[4,8] %a, f32[4,8] %b)"
+        )
+        assert head.startswith("f32[4,8]")
+        assert rest.lstrip().startswith("add(")
+
+    def test_tuple_type_with_index_comments(self):
+        # the regression: big scan states print /*index=N*/ comments
+        # (which contain '=') inside the tuple result type — a naive
+        # "[^=]*" match truncates here and the while body goes uncounted
+        rest = ("(f32[2]{0}, /*index=1*/f32[3,4]{1,0}, /*index=2*/s32[]) "
+                "while(%tuple.1), condition=%cond, body=%body")
+        head, tail = hloscan._split_result_type(rest)
+        assert head.endswith(")")
+        assert "/*index=2*/" in head
+        assert tail.lstrip().startswith("while(")
+
+    def test_while_body_counted_via_tuple_type(self):
+        text = """\
+HloModule m
+
+%body (p: (f32[4,4], s32[])) -> (f32[4,4], s32[]) {
+  %p = (f32[4,4]{1,0}, s32[]) parameter(0)
+  %g0 = f32[4,4]{1,0} get-tuple-element((f32[4,4]{1,0}, s32[]) %p), index=0
+  %g1 = s32[] get-tuple-element((f32[4,4]{1,0}, s32[]) %p), index=1
+  %m = f32[4,4]{1,0} multiply(f32[4,4]{1,0} %g0, f32[4,4]{1,0} %g0)
+  %one = s32[] constant(1)
+  %n = s32[] add(s32[] %g1, s32[] %one)
+  ROOT %t = (f32[4,4]{1,0}, s32[]) tuple(f32[4,4]{1,0} %m, s32[] %n)
+}
+
+%cond (p: (f32[4,4], s32[])) -> pred[] {
+  %p = (f32[4,4]{1,0}, s32[]) parameter(0)
+  %g1 = s32[] get-tuple-element((f32[4,4]{1,0}, s32[]) %p), index=1
+  %lim = s32[] constant(3)
+  ROOT %lt = pred[] compare(s32[] %g1, s32[] %lim), direction=LT
+}
+
+ENTRY %main (a: f32[4,4]) -> f32[4,4] {
+  %a = f32[4,4]{1,0} parameter(0)
+  %zero = s32[] constant(0)
+  %init = (f32[4,4]{1,0}, s32[]) tuple(f32[4,4]{1,0} %a, s32[] %zero)
+  %w = (f32[4,4]{1,0}, /*index=1*/s32[]) while((f32[4,4]{1,0}, s32[]) %init), condition=%cond, body=%body
+  ROOT %out = f32[4,4]{1,0} get-tuple-element((f32[4,4]{1,0}, s32[]) %w), index=0
+}
+"""
+        stages = hloscan.analyze_text(text, device_kind="unknown")
+        total = hloscan.totals(stages)
+        # the multiply (16 elems) + add (1) + compare (1) in the while
+        # body must be counted exactly once
+        assert total["flops"] >= 16.0
+
+    def test_call_to_apply_target_counted_apply_lambda_not(self):
+        # XLA:CPU's parallel task assigner outlines heavy ops into `call`
+        # targets named via to_apply= — real code, counted once. The
+        # reduce combiner named via to_apply= stays excluded.
+        text = """\
+HloModule m
+
+%outlined (p: f32[8,8]) -> f32[8,8] {
+  %p = f32[8,8]{1,0} parameter(0)
+  ROOT %m = f32[8,8]{1,0} multiply(f32[8,8]{1,0} %p, f32[8,8]{1,0} %p)
+}
+
+%combiner (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (x: f32[8,8]) -> f32[] {
+  %x = f32[8,8]{1,0} parameter(0)
+  %c = f32[8,8]{1,0} call(f32[8,8]{1,0} %x), to_apply=%outlined
+  %zero = f32[] constant(0)
+  ROOT %r = f32[] reduce(f32[8,8]{1,0} %c, f32[] %zero), dimensions={0,1}, to_apply=%combiner
+}
+"""
+        stages = hloscan.analyze_text(text, device_kind="unknown")
+        total = hloscan.totals(stages)
+        # outlined multiply: 64 flops; reduce: 64 - 1 = 63; the combiner
+        # body itself (1 add) must NOT be separately counted
+        assert total["flops"] == 64.0 + 63.0
+
+
+class TestStageAttributionFromMetadata:
+    def test_op_name_scope_attributes_to_stage(self):
+        text = """\
+HloModule m
+
+ENTRY %main (a: f32[4,4]) -> f32[4,4] {
+  %a = f32[4,4]{1,0} parameter(0)
+  %m = f32[4,4]{1,0} multiply(f32[4,4]{1,0} %a, f32[4,4]{1,0} %a), metadata={op_name="jit(f)/fl_stage::dp_clip/mul"}
+  ROOT %s = f32[4,4]{1,0} add(f32[4,4]{1,0} %m, f32[4,4]{1,0} %a)
+}
+"""
+        stages = hloscan.analyze_text(text, device_kind="unknown")
+        by = {r["stage"]: r for r in stages}
+        assert by["dp_clip"]["flops"] == 16.0
+        assert by[hloscan.UNATTRIBUTED]["flops"] == 16.0
+
+    def test_spine_order_unattributed_last(self):
+        text = """\
+HloModule m
+
+ENTRY %main (a: f32[2,2]) -> f32[2,2] {
+  %a = f32[2,2]{1,0} parameter(0)
+  %q = f32[2,2]{1,0} multiply(f32[2,2]{1,0} %a, f32[2,2]{1,0} %a), metadata={op_name="x/fl_stage::quantize/m"}
+  %c = f32[2,2]{1,0} add(f32[2,2]{1,0} %q, f32[2,2]{1,0} %a), metadata={op_name="x/fl_stage::dp_clip/a"}
+  ROOT %s = f32[2,2]{1,0} subtract(f32[2,2]{1,0} %c, f32[2,2]{1,0} %a)
+}
+"""
+        stages = hloscan.analyze_text(text, device_kind="unknown")
+        names = [r["stage"] for r in stages]
+        assert names == ["dp_clip", "quantize", hloscan.UNATTRIBUTED]
+
+
+class TestLivePrograms:
+    def test_dot_flops_exact_vs_cost_analysis(self):
+        @jax.jit
+        def f(a, b):
+            return a @ b
+
+        a = jnp.zeros((16, 32), jnp.float32)
+        b = jnp.zeros((32, 8), jnp.float32)
+        compiled = f.lower(a, b).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        stages = hloscan.analyze_compiled(compiled)
+        assert stages is not None
+        total = hloscan.totals(stages)
+        assert total["flops"] == ca["flops"] == 2.0 * 16 * 32 * 8
+
+    def test_conservation_on_small_program(self):
+        @jax.jit
+        def f(a, b):
+            return jnp.tanh(a @ b).sum()
+
+        a = jnp.zeros((8, 16), jnp.float32)
+        b = jnp.zeros((16, 4), jnp.float32)
+        compiled = f.lower(a, b).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        stages = hloscan.analyze_compiled(compiled)
+        cons = hloscan.conservation(
+            stages, ca.get("flops"), ca.get("bytes accessed")
+        )
+        assert cons["ok"], cons
+        # tanh lands in transcendentals, never inflating the flops lane
+        assert hloscan.totals(stages)["transcendentals"] >= 32
+
+    def test_unknown_device_kind_reports_no_bound(self):
+        @jax.jit
+        def f(a):
+            return a * a
+
+        compiled = f.lower(jnp.zeros((8, 8))).compile()
+        stages = hloscan.analyze_compiled(
+            compiled, device_kind="mystery-chip-9000"
+        )
+        for row in stages:
+            assert "bound" not in row
+            assert "ridge_flops_per_byte" not in row
+            # intensity is real arithmetic, so it may appear — but an
+            # unknown chip must never get a fabricated classification
+            assert "compute_bound" not in row
+
+    def test_known_device_kind_classifies(self):
+        @jax.jit
+        def f(a, b):
+            return a @ b
+
+        a = jnp.zeros((64, 64), jnp.float32)
+        compiled = f.lower(a, a).compile()
+        stages = hloscan.analyze_compiled(compiled, device_kind="TPU v4")
+        rows = [r for r in stages if r.get("flops")]
+        assert rows
+        for row in rows:
+            assert row["bound"] in ("compute", "hbm")
+            assert row["ridge_flops_per_byte"] > 0
+
+    def test_analyze_compiled_defensive_on_garbage(self):
+        class Broken:
+            def as_text(self):
+                raise RuntimeError("no text on this backend")
+
+        assert hloscan.analyze_compiled(Broken()) is None
+
+        class NoHlo:
+            def as_text(self):
+                return "not an hlo module"
+
+        assert hloscan.analyze_compiled(NoHlo()) is None
+
+
+class TestConservationHelper:
+    @staticmethod
+    def _row(**kw):
+        base = {"stage": "x", "flops": 10.0, "transcendentals": 0.0,
+                "bytes_accessed": 10.0}
+        base.update(kw)
+        return base
+
+    def test_none_program_totals_give_none_errs(self):
+        cons = hloscan.conservation([self._row()], None, None)
+        assert cons["flops_rel_err"] is None
+        assert cons["bytes_rel_err"] is None
+        assert cons["ok"] is None
+
+    def test_out_of_tolerance_flags(self):
+        cons = hloscan.conservation(
+            [self._row(flops=1.0, bytes_accessed=1.0)], 1e9, 1e9
+        )
+        assert cons["ok"] is False

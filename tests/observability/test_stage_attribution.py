@@ -1,0 +1,233 @@
+"""Stage attribution (observability/stages.py + hloscan.py).
+
+The pinned contracts of the roofline ledger:
+
+- the ``fl_stage::`` named-scope markers are METADATA-ONLY — training is
+  bit-identical with attribution on vs off (params AND trajectories) on
+  every execution mode, including a cohort-slot run;
+- the HLO-walk attribution conserves against XLA's whole-program
+  ``cost_analysis`` within the pinned tolerances on the 4-client CIFAR
+  CNN config (the bench headline architecture) for ``fit_round`` and
+  ``fit_cohort_chunk``;
+- the spine stages actually land: ``local_train`` / ``server_update`` /
+  ``cohort_exchange`` rows appear where those seams execute, and the
+  ``fl_stage_*`` gauges + ``stage`` events reach the registry;
+- attribution-off runs keep their exact record shape (no ``stages`` key,
+  no stage events) — legacy logs stay byte-stable.
+"""
+
+import contextlib
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from fl4health_tpu.clients import engine
+from fl4health_tpu.datasets.synthetic import synthetic_classification
+from fl4health_tpu.metrics import efficient
+from fl4health_tpu.metrics.base import MetricManager
+from fl4health_tpu.models.cnn import CifarNet, Mlp
+from fl4health_tpu.observability import (
+    MetricsRegistry,
+    Observability,
+    Tracer,
+)
+from fl4health_tpu.observability import hloscan
+from fl4health_tpu.observability import stages as stage_attr
+from fl4health_tpu.server.registry import CohortConfig
+from fl4health_tpu.server.simulation import ClientDataset, FederatedSimulation
+from fl4health_tpu.strategies.fedavg import FedAvg
+
+pytestmark = pytest.mark.roofline
+
+N_CLASSES = 3
+
+
+def _mlp_sim(n=3, observability=None, cohort=None, mode="auto"):
+    datasets = []
+    for i in range(n):
+        x, y = synthetic_classification(
+            jax.random.PRNGKey(i), 40, (6,), N_CLASSES
+        )
+        datasets.append(ClientDataset(x[:32], y[:32], x[32:], y[32:]))
+    return FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(Mlp(features=(12,), n_outputs=N_CLASSES)),
+            engine.masked_cross_entropy,
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=datasets,
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_epochs=1,
+        seed=5,
+        observability=observability,
+        cohort=cohort,
+        execution_mode=mode,
+    )
+
+
+def _cifar_sim(observability, cohort=None, mode="auto"):
+    """The 4-client CIFAR CNN config (the bench headline architecture,
+    shrunk to 16 train rows/client so the CPU fit stays seconds)."""
+    datasets = []
+    for i in range(4):
+        x = np.random.RandomState(i).randn(24, 32, 32, 3).astype("float32")
+        y = np.random.RandomState(100 + i).randint(
+            0, 10, size=(24,)
+        ).astype("int32")
+        datasets.append(ClientDataset(x[:16], y[:16], x[16:], y[16:]))
+    return FederatedSimulation(
+        logic=engine.ClientLogic(
+            engine.from_flax(CifarNet()), engine.masked_cross_entropy
+        ),
+        tx=optax.sgd(0.05),
+        strategy=FedAvg(),
+        datasets=datasets,
+        batch_size=8,
+        metrics=MetricManager((efficient.accuracy(),)),
+        local_steps=2,
+        seed=0,
+        observability=observability,
+        cohort=cohort,
+        execution_mode=mode,
+    )
+
+
+def _obs(tmp_path, tag):
+    return Observability(
+        enabled=True,
+        output_dir=str(tmp_path / f"obs_{tag}"),
+        tracer=Tracer(),
+        registry=MetricsRegistry(),
+    )
+
+
+def _flat(tree):
+    return np.asarray(jax.flatten_util.ravel_pytree(jax.device_get(tree))[0])
+
+
+def _run(tmp_path, tag, attribution_on, rounds=3, **kwargs):
+    ctx = (contextlib.nullcontext() if attribution_on
+           else stage_attr.disabled())
+    with ctx:
+        sim = _mlp_sim(observability=_obs(tmp_path, tag), **kwargs)
+        history = sim.fit(rounds)
+    params = _flat(sim.strategy.global_params(sim.server_state))
+    losses = np.asarray(
+        [h.eval_losses["checkpoint"] for h in history], dtype=np.float64
+    )
+    return params, losses
+
+
+class TestBitIdentity:
+    """Attribution on vs off: params AND trajectories bitwise equal —
+    named scopes must never change what XLA computes."""
+
+    def test_pipelined(self, tmp_path):
+        pa, la = _run(tmp_path, "pipe_on", True, mode="pipelined")
+        pb, lb = _run(tmp_path, "pipe_off", False, mode="pipelined")
+        np.testing.assert_array_equal(pa, pb)
+        np.testing.assert_array_equal(la, lb)
+
+    def test_chunked(self, tmp_path):
+        pa, la = _run(tmp_path, "chunk_on", True, mode="chunked")
+        pb, lb = _run(tmp_path, "chunk_off", False, mode="chunked")
+        np.testing.assert_array_equal(pa, pb)
+        np.testing.assert_array_equal(la, lb)
+
+    def test_cohort_chunked(self, tmp_path):
+        kw = dict(cohort=CohortConfig(slots=3), mode="chunked")
+        pa, la = _run(tmp_path, "co_on", True, **kw)
+        pb, lb = _run(tmp_path, "co_off", False, **kw)
+        np.testing.assert_array_equal(pa, pb)
+        np.testing.assert_array_equal(la, lb)
+
+
+class TestAttributionRecords:
+    def test_stages_rows_gauges_and_events_land(self, tmp_path):
+        obs = _obs(tmp_path, "rows")
+        sim = _mlp_sim(observability=obs, mode="pipelined")
+        sim.fit(2)
+        reports = obs.introspector.reports
+        fit = reports.get("fit_round_t") or reports["fit_round"]
+        assert fit.stages, "fit_round must carry attribution rows"
+        by_stage = {r["stage"]: r for r in fit.stages}
+        assert "local_train" in by_stage
+        assert "server_update" in by_stage
+        assert by_stage["local_train"]["flops"] > 0
+        # conservation against the whole-program cost analysis
+        cons = hloscan.conservation(fit.stages, fit.flops,
+                                    fit.bytes_accessed)
+        assert cons["ok"], cons
+        # gauges + events reached the registry
+        text = obs.registry.to_prometheus()
+        assert "fl_stage_flops" in text
+        assert 'stage="local_train"' in text
+        # fit() exported (and drained) the event log itself — read the
+        # metrics.jsonl it wrote
+        with open(tmp_path / "obs_rows" / "metrics.jsonl") as f:
+            events = [json.loads(line) for line in f]
+        stage_events = [e for e in events if e.get("event") == "stage"]
+        assert any(e["stage"] == "local_train" for e in stage_events)
+        # a stage event carries the full row (program + cost fields)
+        row = stage_events[0]
+        for key in ("program", "stage", "flops", "bytes_accessed"):
+            assert key in row
+
+    def test_cohort_exchange_stage_lands_on_cohort_chunk(self, tmp_path):
+        obs = _obs(tmp_path, "cochunk")
+        sim = _mlp_sim(observability=obs, cohort=CohortConfig(slots=3),
+                       mode="chunked")
+        sim.fit(2)
+        chunk = obs.introspector.reports["fit_cohort_chunk"]
+        assert chunk.stages
+        names = {r["stage"] for r in chunk.stages}
+        assert "cohort_exchange" in names
+        assert "local_train" in names
+
+    def test_attribution_off_keeps_record_shape(self, tmp_path):
+        with stage_attr.disabled():
+            obs = _obs(tmp_path, "off")
+            sim = _mlp_sim(observability=obs, mode="pipelined")
+            sim.fit(2)
+            reports = obs.introspector.reports
+            fit = reports.get("fit_round_t") or reports["fit_round"]
+            assert fit.stages is None
+            # legacy record shape: no "stages" key, no stage events
+            assert "stages" not in fit.as_dict()
+        with open(tmp_path / "obs_off" / "metrics.jsonl") as f:
+            events = [json.loads(line) for line in f]
+        assert not [e for e in events if e.get("event") == "stage"]
+        assert "fl_stage_flops" not in obs.registry.to_prometheus()
+
+
+class TestConservationCifar:
+    """The acceptance pin: hloscan's per-stage sum reconciles with XLA's
+    whole-program cost analysis on the 4-client CIFAR CNN config, for
+    both the per-round program and the cohort chunk scan."""
+
+    def test_fit_round_and_fit_cohort_chunk_conserve(self, tmp_path):
+        obs = _obs(tmp_path, "cifar")
+        sim = _cifar_sim(obs, cohort=CohortConfig(slots=4), mode="chunked")
+        sim.fit(2)
+        reports = obs.introspector.reports
+        fit_name = ("fit_round_t" if "fit_round_t" in reports
+                    else "fit_round")
+        for name in (fit_name, "fit_cohort_chunk"):
+            rep = reports[name]
+            assert rep.stages, f"{name} must carry attribution rows"
+            assert {r["stage"] for r in rep.stages} >= {
+                "local_train", "server_update"
+            }
+            cons = hloscan.conservation(rep.stages, rep.flops,
+                                        rep.bytes_accessed)
+            assert cons["ok"], (name, cons)
+            assert cons["flops_rel_err"] <= hloscan.FLOPS_RTOL
+            assert cons["bytes_rel_err"] <= hloscan.BYTES_RTOL
+        chunk = reports["fit_cohort_chunk"]
+        assert {r["stage"] for r in chunk.stages} >= {"cohort_exchange"}

@@ -1,0 +1,178 @@
+"""tools/bench_gate.py: the bench regression tripwire.
+
+The acceptance pins: the gate passes the repo's CURRENT recorded
+artifacts unchanged, and flags a synthetic 20% regression injected into
+the cohort scaling artifact (0.855 -> 1.026 crosses the hard 1.0 band).
+Plus the band units: boolean invariants, the roundtrip floor,
+metric/provenance consistency, and the TPU-vs-eager-torch anchor floor
+(never applied to cpu_fallback captures).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import bench_gate  # noqa: E402
+
+pytestmark = pytest.mark.roofline
+
+COHORT_ARTIFACT = REPO / "BENCH_cohort_cpu_fallback_20260806_221130.json"
+ANCHOR = {"eager_torch_cifar_cnn_steps_per_sec": 16.0}
+
+
+def _cohort_record() -> dict:
+    with open(COHORT_ARTIFACT) as f:
+        return json.load(f)
+
+
+class TestCurrentArtifactsPass:
+    def test_recorded_cohort_artifact_passes(self):
+        assert bench_gate.check_artifact(_cohort_record(), ANCHOR) == []
+
+    def test_all_repo_artifacts_gate_green(self):
+        paths = sorted(str(p) for p in REPO.glob("BENCH_*.json"))
+        assert paths, "repo must carry recorded bench artifacts"
+        rc, results = bench_gate.gate(paths, ANCHOR)
+        assert rc == 0, results
+        # the gate actually gated something — not all-skip vacuous green
+        assert any(r["status"] == "pass" for r in results)
+        assert not [r for r in results if r["status"] == "regression"]
+
+
+class TestInjectedRegression:
+    def test_20pct_cohort_ratio_regression_flagged(self, tmp_path):
+        record = _cohort_record()
+        ratio = record["cohort"]["round_time_ratio_maxN_vs_minN"]
+        record["cohort"]["round_time_ratio_maxN_vs_minN"] = ratio * 1.2
+        path = tmp_path / "BENCH_cohort_regressed.json"
+        path.write_text(json.dumps(record))
+        rc, results = bench_gate.gate([str(path)], ANCHOR)
+        assert rc == 1
+        (res,) = results
+        assert res["status"] == "regression"
+        assert any("round_time_ratio_maxN_vs_minN" in f
+                   for f in res["failures"])
+
+    def test_bool_invariant_false_is_a_regression(self):
+        record = _cohort_record()
+        record["cohort_chunked"]["params_bitwise_identical"] = False
+        fails = bench_gate.check_artifact(record, ANCHOR)
+        assert any("params_bitwise_identical" in f for f in fails)
+
+    def test_roundtrip_reduction_below_floor_flagged(self):
+        record = _cohort_record()
+        record["cohort_chunked"]["roundtrip_reduction_at_max_r"] = 8.0
+        fails = bench_gate.check_artifact(record, ANCHOR)
+        assert any("roundtrip_reduction_at_max_r" in f for f in fails)
+
+
+class TestConsistencyBands:
+    def test_cpu_fallback_metric_with_tpu_backend_flagged(self):
+        record = {
+            "metric": "fedavg_cifar_cnn_local_steps_per_sec_cpu_fallback",
+            "provenance": {"backend": "tpu", "cpu_fallback": False},
+        }
+        fails = bench_gate.check_artifact(record, ANCHOR)
+        assert any("cpu_fallback" in f for f in fails)
+
+    def test_provenance_self_disagreement_flagged(self):
+        record = {"metric": "anything",
+                  "provenance": {"backend": "cpu", "cpu_fallback": False}}
+        fails = bench_gate.check_artifact(record, ANCHOR)
+        assert any("disagrees" in f for f in fails)
+
+    def test_cpu_cifar_headline_without_suffix_flagged(self):
+        record = {"metric": "fedavg_cifar_cnn_local_steps_per_sec",
+                  "provenance": {"backend": "cpu", "cpu_fallback": True}}
+        fails = bench_gate.check_artifact(record, ANCHOR)
+        assert any("masquerading" in f for f in fails)
+
+
+class TestTpuAnchorFloor:
+    def _tpu_record(self, value) -> dict:
+        return {
+            "metric": "fedavg_cifar_cnn_local_steps_per_sec",
+            "value": value,
+            "provenance": {"backend": "tpu", "cpu_fallback": False},
+        }
+
+    def test_tpu_headline_below_eager_torch_floor_fails(self):
+        fails = bench_gate.check_artifact(self._tpu_record(12.0), ANCHOR)
+        assert any("eager-torch floor" in f for f in fails)
+
+    def test_tpu_headline_above_floor_passes(self):
+        assert bench_gate.check_artifact(self._tpu_record(250.0),
+                                         ANCHOR) == []
+
+    def test_no_anchor_means_no_fabricated_floor(self):
+        # missing anchor file -> the floor check is skipped, not invented
+        assert bench_gate.check_artifact(self._tpu_record(0.001),
+                                         None) == []
+
+    def test_cpu_fallback_capture_exempt_from_floor(self):
+        record = {
+            "metric": "fedavg_cifar_cnn_local_steps_per_sec_cpu_fallback",
+            "value": 0.5,
+            "provenance": {"backend": "cpu", "cpu_fallback": True},
+        }
+        assert bench_gate.check_artifact(record, ANCHOR) == []
+
+
+class TestGateIo:
+    def test_no_metric_artifact_skipped_not_failed(self, tmp_path):
+        path = tmp_path / "BENCH_runner_shell.json"
+        path.write_text(json.dumps({"config": {"rounds": 3}}))
+        rc, results = bench_gate.gate([str(path)], ANCHOR)
+        assert rc == 0
+        assert results[0]["status"] == "skipped"
+
+    def test_corrupt_artifact_exits_2(self, tmp_path):
+        path = tmp_path / "BENCH_torn.json"
+        path.write_text('{"metric": "x", "val')
+        rc, results = bench_gate.gate([str(path)], ANCHOR)
+        assert rc == 2
+        assert results[0]["status"] == "unreadable"
+
+    def test_regression_wins_over_pass_never_over_unreadable(self, tmp_path):
+        bad = tmp_path / "BENCH_bad.json"
+        bad.write_text("{torn")
+        regressed = _cohort_record()
+        regressed["cohort"]["round_time_ratio_maxN_vs_minN"] = 2.0
+        reg = tmp_path / "BENCH_reg.json"
+        reg.write_text(json.dumps(regressed))
+        rc, _ = bench_gate.gate([str(reg), str(bad)], ANCHOR)
+        assert rc == 2
+
+
+class TestCli:
+    def test_main_json_on_repo_artifacts_exits_0(self):
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "bench_gate.py"),
+             "--json"],
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert doc["exit"] == 0
+        statuses = {r["status"] for r in doc["results"]}
+        assert "pass" in statuses
+
+    def test_main_nonzero_on_injected_regression(self, tmp_path):
+        record = _cohort_record()
+        record["cohort"]["round_time_ratio_maxN_vs_minN"] *= 1.2
+        path = tmp_path / "BENCH_cohort_regressed.json"
+        path.write_text(json.dumps(record))
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "bench_gate.py"),
+             str(path)],
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 1
+        assert "FAIL" in out.stdout
+        assert "round_time_ratio_maxN_vs_minN" in out.stdout

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Bench regression gate: hold BENCH_* artifacts to the recorded bands.
+
+The bench artifacts are the repo's performance ledger; this CLI is the
+tripwire that makes a regression loud BEFORE it lands as "the new
+normal". It checks every artifact it is given (default: all
+``BENCH_*.json`` in the repo root) against bands derived from
+``BASELINE.json`` and ``A100_BAND_ANCHOR.json`` plus the artifacts' own
+recorded invariants:
+
+- **boolean invariants** — ``program_flops_identical``,
+  ``program_peak_hbm_identical`` and ``params_bitwise_identical`` are
+  semantic claims (O(K) program identity across registry sizes; chunked
+  dispatch bit-identical to pipelined). Wherever one appears in an
+  artifact it must be ``true``; ``false`` is a correctness regression,
+  not a speed one.
+- **cohort scaling band** — ``round_time_ratio_maxN_vs_minN`` must stay
+  <= 1.0 (+ a small measurement-jitter allowance): round wall at 100k
+  registered clients must not grow over the 1k-registry arm, the
+  O(sampled-cohort)-not-O(registry) claim.
+- **chunked-dispatch floor** — ``roundtrip_reduction_at_max_r`` >= 32.0,
+  the single-dispatch-per-fit fact the chunked-scan PR measured.
+- **metric/provenance consistency** — a metric named ``*_cpu_fallback``
+  must come from a cpu backend and vice versa, and the ``provenance``
+  block (bench.py writes one into every new artifact) must agree with
+  itself; a CPU-fallback number must never masquerade as a TPU capture.
+- **TPU anchor floor** — a real-TPU cifar headline must beat the
+  A100-anchor's measured eager-torch steps/s
+  (``eager_torch_cifar_cnn_steps_per_sec``); anything below it means the
+  compiled TPU path lost to single-box eager PyTorch.
+
+Artifacts without a top-level ``metric`` (runner-shell wrappers like
+``BENCH_r0*.json``, raw config records) are structural, not measurement
+claims — they are skipped, not failed.
+
+    python tools/bench_gate.py                      # gate all BENCH_*.json
+    python tools/bench_gate.py BENCH_cohort_*.json  # gate specific files
+    python tools/bench_gate.py --json               # machine-readable
+
+Exit codes: 0 all gated artifacts pass, 1 at least one regression,
+2 unreadable artifact/baseline (with a diagnostic, never a traceback).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Cohort wall-ratio band: the O(K) claim is that the round wall at the
+# largest registry is NO SLOWER than at the smallest — the measured
+# headroom (currently 0.855 on the recorded artifact) IS the jitter
+# allowance, so the band is a hard 1.0 (a 20% regression on the recorded
+# ratio lands at 1.026 and trips; see tests/tools/test_bench_gate.py).
+ROUND_TIME_RATIO_MAX = 1.0
+# Single-dispatch-per-fit floor measured by the chunked-scan PR: 32
+# rounds in one dispatch -> 32x fewer host roundtrips.
+ROUNDTRIP_REDUCTION_FLOOR = 32.0
+
+# Keys whose value is a semantic invariant wherever it appears.
+_BOOL_INVARIANTS = (
+    "program_flops_identical",
+    "program_peak_hbm_identical",
+    "params_bitwise_identical",
+)
+
+
+def _walk(obj: Any, path: str = "$"):
+    """Yield (path, key, value) for every dict entry, depth-first."""
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield f"{path}.{k}", k, v
+            yield from _walk(v, f"{path}.{k}")
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            yield from _walk(v, f"{path}[{i}]")
+
+
+def check_artifact(record: dict, anchor: dict | None) -> list[str]:
+    """Pure band check: the list of regression descriptions (empty =
+    pass). ``anchor`` is A100_BAND_ANCHOR.json's dict (None when
+    missing — the TPU floor check is then skipped, not fabricated)."""
+    fails: list[str] = []
+    metric = record.get("metric")
+
+    # boolean invariants, wherever they appear
+    for path, key, value in _walk(record):
+        if key in _BOOL_INVARIANTS and value is not None and value is not True:
+            fails.append(f"{path} = {value!r} (invariant must hold)")
+        if key == "round_time_ratio_maxN_vs_minN" and value is not None:
+            if float(value) > ROUND_TIME_RATIO_MAX:
+                fails.append(
+                    f"{path} = {value} > {ROUND_TIME_RATIO_MAX} — round "
+                    "wall grows with registry size (O(registry) smell)"
+                )
+        if key == "roundtrip_reduction_at_max_r" and value is not None:
+            if float(value) < ROUNDTRIP_REDUCTION_FLOOR:
+                fails.append(
+                    f"{path} = {value} < {ROUNDTRIP_REDUCTION_FLOOR} — "
+                    "chunked dispatch no longer amortizes host roundtrips"
+                )
+
+    # metric-name / platform consistency
+    platform = record.get("platform")
+    prov = record.get("provenance") or {}
+    backend = prov.get("backend", platform)
+    if metric and "cpu_fallback" in metric:
+        if backend is not None and backend != "cpu":
+            fails.append(
+                f"metric {metric!r} says cpu_fallback but backend is "
+                f"{backend!r}"
+            )
+    if prov:
+        want = prov.get("backend") == "cpu"
+        if prov.get("cpu_fallback") is not None \
+                and bool(prov["cpu_fallback"]) != want:
+            fails.append(
+                f"provenance.cpu_fallback = {prov['cpu_fallback']!r} "
+                f"disagrees with provenance.backend = {prov.get('backend')!r}"
+            )
+        if metric and backend == "cpu" and "cpu_fallback" not in metric \
+                and "cifar" in metric:
+            fails.append(
+                f"cpu-backend cifar headline {metric!r} lacks the "
+                "_cpu_fallback suffix — fallback masquerading as a capture"
+            )
+
+    # TPU anchor floor: a real-TPU cifar headline must beat eager torch
+    # on the anchor box. Only with a real anchor number — never invented.
+    floor = (anchor or {}).get("eager_torch_cifar_cnn_steps_per_sec")
+    if (
+        floor is not None
+        and metric
+        and "cpu_fallback" not in metric
+        and metric.startswith("fedavg_cifar_cnn")
+        and (backend == "tpu" or platform == "tpu")
+        and record.get("value") is not None
+    ):
+        if float(record["value"]) < float(floor):
+            fails.append(
+                f"value {record['value']} local_steps/s/chip < anchor "
+                f"eager-torch floor {floor} — compiled TPU path lost to "
+                "single-box eager PyTorch"
+            )
+    return fails
+
+
+def gate(paths: list[str], anchor: dict | None) -> tuple[int, list[dict]]:
+    """Gate every artifact; returns (exit_code, per-artifact results)."""
+    results: list[dict] = []
+    rc = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            results.append({"artifact": path, "status": "unreadable",
+                            "detail": str(e)})
+            rc = 2
+            continue
+        if not isinstance(record, dict) or "metric" not in record:
+            # runner-shell wrappers / raw config records: structural,
+            # not measurement claims — skip, don't fail
+            results.append({"artifact": path, "status": "skipped",
+                            "detail": "no top-level 'metric'"})
+            continue
+        fails = check_artifact(record, anchor)
+        if fails:
+            results.append({"artifact": path, "status": "regression",
+                            "failures": fails})
+            if rc != 2:
+                rc = 1
+        else:
+            results.append({"artifact": path, "status": "pass"})
+    return rc, results
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("artifacts", nargs="*",
+                    help="artifact JSON paths (default: BENCH_*.json in "
+                         "the repo root)")
+    ap.add_argument("--anchor",
+                    default=os.path.join(_REPO, "A100_BAND_ANCHOR.json"),
+                    help="anchor-band file (default: repo A100_BAND_ANCHOR"
+                         ".json)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable results")
+    args = ap.parse_args(argv)
+
+    paths = args.artifacts or sorted(glob.glob(os.path.join(_REPO,
+                                                            "BENCH_*.json")))
+    if not paths:
+        print("bench_gate: no artifacts to gate", file=sys.stderr)
+        return 2
+    anchor = None
+    if os.path.exists(args.anchor):
+        try:
+            with open(args.anchor) as f:
+                anchor = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_gate: cannot read anchor {args.anchor}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    rc, results = gate(paths, anchor)
+    if args.json:
+        print(json.dumps({"exit": rc, "results": results}, indent=2))
+        return rc
+    for r in results:
+        tag = {"pass": "PASS", "skipped": "SKIP",
+               "regression": "FAIL", "unreadable": "ERROR"}[r["status"]]
+        line = f"{tag:5s} {os.path.basename(r['artifact'])}"
+        if r.get("detail"):
+            line += f"  ({r['detail']})"
+        print(line)
+        for f_ in r.get("failures", []):
+            print(f"        - {f_}")
+    n_fail = sum(1 for r in results if r["status"] == "regression")
+    n_err = sum(1 for r in results if r["status"] == "unreadable")
+    n_pass = sum(1 for r in results if r["status"] == "pass")
+    print(f"bench_gate: {n_pass} pass, {n_fail} regression, {n_err} "
+          f"unreadable, "
+          f"{sum(1 for r in results if r['status'] == 'skipped')} skipped")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,14 @@ box where the tensorboard profile plugin can't be installed.
 
     python tools/trace_top_ops.py [trace.json.gz] [--top 15]
 
+Also exports :func:`stage_durations` — measured per-stage device time by
+grouping trace ops on the ``fl_stage::`` scope marker (observability/
+stages.py) — which ``tools/roofline_report.py`` consumes to put real
+milliseconds next to the analytic roofline ledger.
+
+Exit codes follow the bundle-CLI convention: 0 ok, 1 no trace found,
+2 unreadable/corrupt/torn trace (with a diagnostic, never a traceback).
+
 No reference counterpart (SURVEY §5: the reference has no profiling);
 companion to the capture pipeline in tools/tpu_watch.py.
 """
@@ -22,6 +30,14 @@ import sys
 from collections import defaultdict
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # script invocation: tools/ is sys.path[0]
+    sys.path.insert(0, REPO)
+
+from fl4health_tpu.observability.stages import stage_of  # noqa: E402
+
+
+class TraceError(Exception):
+    """Trace file missing structure / undecodable — CLI exit 2."""
 
 
 def find_latest_trace() -> str | None:
@@ -32,9 +48,44 @@ def find_latest_trace() -> str | None:
 
 
 def load(path: str) -> dict:
+    """Read a Chrome-trace JSON (optionally gzipped). Raises
+    :class:`TraceError` with a diagnostic on gzip corruption, torn/invalid
+    JSON, or a JSON document that is not a trace object."""
     opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rt") as f:
-        return json.load(f)
+    try:
+        with opener(path, "rt") as f:
+            trace = json.load(f)
+    except (OSError, EOFError, UnicodeDecodeError) as e:
+        raise TraceError(f"cannot read trace {path}: {e}") from e
+    except json.JSONDecodeError as e:
+        raise TraceError(
+            f"corrupt/torn trace {path}: invalid JSON at char {e.pos} "
+            f"({e.msg})"
+        ) from e
+    if not isinstance(trace, dict):
+        raise TraceError(
+            f"corrupt trace {path}: top level is "
+            f"{type(trace).__name__}, expected a Chrome-trace object"
+        )
+    return trace
+
+
+def stage_durations(trace: dict) -> dict[str, float]:
+    """Aggregate complete-event (``ph == "X"``) durations (us) by the
+    ``fl_stage::`` stage on the op name — XLA propagates the named-scope
+    path into trace op names, so this is measured device time per spine
+    stage. Ops outside any stage are excluded (whole-lane totals live in
+    :func:`summarize`); empty dict when the capture has no staged ops."""
+    out: dict[str, float] = defaultdict(float)
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        name = e.get("name", "")
+        args = e.get("args") or {}
+        stage = stage_of(name) or stage_of(str(args.get("long_name", "")))
+        if stage:
+            out[stage] += float(e["dur"])
+    return dict(out)
 
 
 def summarize(trace: dict, top: int = 15) -> list[str]:
@@ -80,12 +131,25 @@ def main() -> int:
     if "--top" in sys.argv:
         top = int(sys.argv[sys.argv.index("--top") + 1])
     path = args[0] if args else find_latest_trace()
-    if not path or not os.path.exists(path):
+    if not path:
         print("no trace found (run tools/tpu_trace.py first)", file=sys.stderr)
         return 1
+    if not os.path.exists(path):
+        print(f"trace not found: {path}", file=sys.stderr)
+        return 2
+    try:
+        trace = load(path)
+    except TraceError as e:
+        print(str(e), file=sys.stderr)
+        return 2
     print(f"# {path}")
-    for line in summarize(load(path), top):
+    for line in summarize(trace, top):
         print(line)
+    stages = stage_durations(trace)
+    if stages:
+        print("== fl_stage device time ==")
+        for name, dur in sorted(stages.items(), key=lambda kv: -kv[1]):
+            print(f"  {dur / 1e3:9.2f} ms  {name}")
     return 0
 
 
