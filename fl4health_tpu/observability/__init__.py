@@ -47,8 +47,11 @@ from __future__ import annotations
 import os
 from typing import Any
 
+from fl4health_tpu.observability.adminplane import AdminPlane, AdminRejection
 from fl4health_tpu.observability.exposition import ScrapeServer
 from fl4health_tpu.observability.fleet import FleetLedger
+from fl4health_tpu.observability.slo import SLOEngine, SLOPolicy
+from fl4health_tpu.observability.timeseries import RoundTimeSeries
 from fl4health_tpu.observability.flightrec import (
     DEFAULT_WINDOW,
     FlightRecorder,
@@ -93,6 +96,11 @@ from fl4health_tpu.observability.tracectx import (
 
 __all__ = [
     "Observability",
+    "AdminPlane",
+    "AdminRejection",
+    "SLOPolicy",
+    "SLOEngine",
+    "RoundTimeSeries",
     "FleetLedger",
     "TraceContext",
     "flow_id",
@@ -161,6 +169,16 @@ class Observability:
     an OS-assigned port, readable from ``scrape_url``. The endpoint binds
     loopback by default — set ``http_host="0.0.0.0"`` for a remote
     Prometheus to reach it.
+
+    The operations plane (both OFF by default): ``slo`` takes an
+    :class:`~fl4health_tpu.observability.slo.SLOPolicy` and evaluates it
+    each round in the epilogue (``fl_slo_*`` gauges, ``slo`` JSONL events,
+    the ``degraded`` healthz state); ``admin_token`` arms the
+    :class:`~fl4health_tpu.observability.adminplane.AdminPlane` behind
+    ``POST /admin/scalars`` (shared-secret header auth) for live,
+    journaled retunes of the hoisted scalars. Either one also arms the
+    bounded :class:`~fl4health_tpu.observability.timeseries.RoundTimeSeries`
+    (``ops_window`` rounds) that computes the serving KPIs.
     """
 
     def __init__(
@@ -180,6 +198,9 @@ class Observability:
         flight_recorder: "bool | FlightRecorder" = True,
         flightrec_window: int | None = None,
         fleet_ledger: "bool | FleetLedger" = True,
+        slo: "SLOPolicy | None" = None,
+        admin_token: str | None = None,
+        ops_window: int = 256,
     ):
         self.enabled = enabled
         self.output_dir = output_dir
@@ -217,7 +238,23 @@ class Observability:
             self.fleet_ledger = FleetLedger()
         else:
             self.fleet_ledger = None
+        # Operations plane (PR 19): OFF unless an SLOPolicy or admin token
+        # arms it. Host-side only — fed from epilogue summaries the run
+        # already pulled, so arming it cannot add a device sync, and the
+        # off path is bit-identical by construction.
+        self.slo: "SLOEngine | None" = (
+            SLOEngine(slo, self.registry) if slo is not None else None
+        )
+        self.admin: "AdminPlane | None" = (
+            AdminPlane(admin_token, self.registry)
+            if admin_token is not None else None
+        )
+        self.timeseries: "RoundTimeSeries | None" = (
+            RoundTimeSeries(window=ops_window)
+            if (self.slo is not None or self.admin is not None) else None
+        )
         self._unhealthy: str | None = None
+        self._degraded: str | None = None
         self.introspector = ProgramIntrospector(self.registry)
         self._manifest: dict[str, Any] = {}
         self._scrape_server: ScrapeServer | None = None
@@ -266,6 +303,7 @@ class Observability:
         Idempotent; no-op when disabled."""
         if self.enabled:
             self._unhealthy = None  # per-run: a fresh fit() is healthy
+            self._degraded = None
             if self.watchdog is not None:
                 self.watchdog.reset()
             if not self.tracer.enabled:
@@ -301,6 +339,12 @@ class Observability:
                         (lambda cid: ledger.get(cid)) if ledger is not None
                         else None
                     ),
+                    degraded_provider=lambda: self._degraded,
+                    slo_provider=(
+                        (lambda: self.slo.standing())
+                        if self.slo is not None else None
+                    ),
+                    admin_plane=self.admin,
                 )
         return self
 
@@ -323,6 +367,20 @@ class Observability:
         polling the armed scrape endpoint sees the recovery instead of a
         503 that stays sticky until the next ``start()``."""
         self._unhealthy = None
+
+    @property
+    def degraded_slo(self) -> str | None:
+        """Name of the SLO objective standing in breach, else None."""
+        return self._degraded
+
+    def mark_degraded(self, slo_name: str) -> None:
+        """Flip ``/healthz`` to 200 ``degraded: <slo>`` — the limping state
+        between healthy and the 503 a halt raises. Dead beats limping:
+        a 503 verdict always wins over this channel."""
+        self._degraded = str(slo_name)
+
+    def clear_degraded(self) -> None:
+        self._degraded = None
 
     def dump_bundle(self, verdict: "dict[str, Any]") -> str | None:
         """Publish a postmortem bundle (``observability/bundle.py``) under
@@ -375,10 +433,38 @@ class Observability:
     def log_event(self, event: str, **fields: Any) -> dict | None:
         if not self.enabled:
             return None
-        return self.registry.log_event(event, **fields)
+        rec = self.registry.log_event(event, **fields)
+        if event == "recovery" and self.timeseries is not None:
+            # the supervisor's self-heal ladder routes through here — fold
+            # engage/probation_passed/halt into the MTTR KPI
+            self.timeseries.note_recovery(fields.get("phase"),
+                                          ts=rec.get("ts"))
+        return rec
 
     def snapshot(self) -> dict:
         return self.registry.snapshot()
+
+    # -- operations plane ------------------------------------------------
+    def observe_round_kpis(self, rnd: int, summary: "dict[str, Any]", *,
+                           fit_loss: float | None = None,
+                           eval_loss: float | None = None):
+        """Feed one epilogue round summary to the ops plane: refresh the
+        KPI time-series, evaluate the SLO policy, and drive the degraded
+        healthz channel. No-op (returns None) when the plane is unarmed —
+        the default path stays byte-for-byte untouched."""
+        ts = self.timeseries
+        if not self.enabled or ts is None:
+            return None
+        kpis = ts.observe_round(summary, fit_loss=fit_loss,
+                                eval_loss=eval_loss)
+        if self.slo is None:
+            return kpis
+        verdict = self.slo.evaluate(rnd, kpis)
+        if verdict["degraded_slo"] is not None:
+            self.mark_degraded(verdict["degraded_slo"])
+        else:
+            self.clear_degraded()
+        return verdict
 
     # -- JAX hooks -------------------------------------------------------
     def fence(self, tree: Any) -> tuple[Any, float]:

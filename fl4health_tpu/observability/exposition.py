@@ -12,18 +12,29 @@ mid-run by an actual Prometheus (or ``curl``):
 - ``GET /manifest`` — the run manifest JSON
   (``observability/manifest.py``): versions, backend, device kind/count,
   execution mode + reason, donation gating, config hash;
-- ``GET /healthz``  — liveness probe. Goes **503** once the run is marked
-  unhealthy (a watchdog halt or a postmortem bundle dump —
-  ``Observability.mark_unhealthy``), with the verdict summary as the
-  body, so an orchestrator's health check stops reporting a run healthy
-  mid-``TrainingHealthError`` teardown;
-- ``GET /fleet``    — fleet-ledger summary JSON
-  (``observability/fleet.py``): clients seen, participation skew (gini),
-  loss/staleness/participation-gap distributions from the streaming
-  sketches, quarantine standing, top-k stragglers and suspects;
-- ``GET /clients/<id>`` — one client's lifetime record by REGISTRY id
-  (participation count, last-seen round, EMAs, quarantine strikes, wire
-  bytes), 404 for a client the ledger has never seen.
+- ``GET /healthz``  — liveness probe with THREE answers: 200 ``ok``, 200
+  ``degraded: <slo>`` while an SLO objective stands in breach
+  (``observability/slo.py`` — the run is limping, not dead), and **503**
+  once the run is marked unhealthy (a watchdog halt or a postmortem
+  bundle dump — ``Observability.mark_unhealthy``) so an orchestrator's
+  health check can distinguish all three;
+- ``GET /fleet``    — fleet-ledger summary JSON (``observability/fleet.py``);
+- ``GET /clients/<id>`` — one client's lifetime record by REGISTRY id,
+  404 for a client the ledger has never seen;
+- ``GET /admin/slo`` — current SLO standing (policy, per-objective burn
+  rates, KPIs) when an SLO engine is armed;
+- ``POST /admin/scalars`` — the admin plane (``observability/
+  adminplane.py``): live retunes of PR 11 hoisted scalars. OFF by
+  default; armed only by ``Observability(admin_token=...)`` and guarded
+  by that shared secret in the ``X-Admin-Token`` header. The handler
+  thread only validates + enqueues; the round loop applies at the next
+  boundary.
+
+Protocol hygiene (scrapers are not polite): every GET route answers
+``HEAD`` too; unsupported methods on known routes answer 405 with an
+``Allow`` header (not the stdlib 501 path); disconnecting scrapers
+(``BrokenPipeError``/``ConnectionResetError``) are swallowed so a flaky
+Prometheus cannot spam stderr.
 
 Zero third-party deps (zero-egress box) and zero cost on the round hot
 path: a scrape reads host-side floats under the registry lock — it never
@@ -37,6 +48,7 @@ runs on daemon threads and is torn down by ``Observability.shutdown()``.
 from __future__ import annotations
 
 import json
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
@@ -44,6 +56,20 @@ from typing import Any, Callable
 from fl4health_tpu.observability.registry import MetricsRegistry
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_DISCONNECTS = (BrokenPipeError, ConnectionResetError)
+
+
+class _QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """Swallows client-disconnect errors instead of printing tracebacks."""
+
+    daemon_threads = True
+
+    def handle_error(self, request, client_address):  # noqa: D102
+        exc = sys.exc_info()[1]
+        if isinstance(exc, _DISCONNECTS):
+            return
+        super().handle_error(request, client_address)
 
 
 class ScrapeServer:
@@ -55,9 +81,14 @@ class ScrapeServer:
     ``health_provider`` is called per ``/healthz`` request and returns
     None while healthy, or a verdict-summary string once the run halted —
     the endpoint then answers 503 with that summary as the body.
-    ``fleet_provider``/``client_provider`` back ``/fleet`` and
+    ``degraded_provider`` returns the name of a breaching SLO (or None);
+    it only matters while ``health_provider`` says alive — dead beats
+    limping. ``fleet_provider``/``client_provider`` back ``/fleet`` and
     ``/clients/<id>``; without them those routes answer 404 like any
     unknown path (a server without a ledger has no fleet to serve).
+    ``slo_provider`` backs ``GET /admin/slo``; ``admin_plane`` (an
+    ``adminplane.AdminPlane``) backs ``POST /admin/scalars`` — both 404
+    when unarmed, so the default surface is exactly the read-only one.
     """
 
     def __init__(
@@ -69,69 +100,155 @@ class ScrapeServer:
         health_provider: Callable[[], str | None] | None = None,
         fleet_provider: Callable[[], dict[str, Any]] | None = None,
         client_provider: "Callable[[int], dict[str, Any] | None] | None" = None,
+        degraded_provider: Callable[[], str | None] | None = None,
+        slo_provider: Callable[[], dict[str, Any]] | None = None,
+        admin_plane=None,
     ):
         registry_ref = registry
         provider = manifest_provider
         health = health_provider
+        degraded = degraded_provider
         fleet = fleet_provider
         client_lookup = client_provider
+        slo = slo_provider
+        admin = admin_plane
 
         class Handler(BaseHTTPRequestHandler):
-            def _send(self, code: int, body: bytes, ctype: str) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            def _send(self, code: int, body: bytes, ctype: str,
+                      include_body: bool = True,
+                      extra_headers: dict[str, str] | None = None) -> None:
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    for k, v in (extra_headers or {}).items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    if include_body:
+                        self.wfile.write(body)
+                except _DISCONNECTS:
+                    pass  # scraper hung up mid-response; nothing to salvage
 
-            def do_GET(self):  # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0]
+            def _send_json(self, code: int, doc: Any,
+                           include_body: bool = True) -> None:
+                self._send(code, json.dumps(doc, default=str).encode(),
+                           "application/json", include_body)
+
+            # -------------------------------------------------- GET routing
+            def _get_response(self, path: str):
+                """(code, body, ctype) for a GET-able path, else None."""
                 if path in ("/metrics", "/"):
                     body = registry_ref.to_prometheus().encode("utf-8")
-                    self._send(200, body, PROM_CONTENT_TYPE)
-                elif path == "/manifest":
+                    return 200, body, PROM_CONTENT_TYPE
+                if path == "/manifest":
                     mani = provider() if provider is not None else {}
-                    self._send(200, json.dumps(mani, default=str).encode(),
-                               "application/json")
-                elif path == "/healthz":
+                    return (200, json.dumps(mani, default=str).encode(),
+                            "application/json")
+                if path == "/healthz":
                     verdict = health() if health is not None else None
-                    if verdict is None:
-                        self._send(200, b"ok\n", "text/plain; charset=utf-8")
-                    else:
-                        body = f"unhealthy: {verdict}\n".encode("utf-8")
-                        self._send(503, body, "text/plain; charset=utf-8")
-                elif path == "/fleet" and fleet is not None:
-                    self._send(
-                        200,
-                        json.dumps(fleet(), default=str).encode(),
-                        "application/json",
-                    )
-                elif (path.startswith("/clients/")
-                      and client_lookup is not None):
+                    if verdict is not None:
+                        return (503, f"unhealthy: {verdict}\n".encode(),
+                                "text/plain; charset=utf-8")
+                    limping = degraded() if degraded is not None else None
+                    if limping is not None:
+                        return (200, f"degraded: {limping}\n".encode(),
+                                "text/plain; charset=utf-8")
+                    return 200, b"ok\n", "text/plain; charset=utf-8"
+                if path == "/fleet" and fleet is not None:
+                    return (200, json.dumps(fleet(), default=str).encode(),
+                            "application/json")
+                if path == "/admin/slo" and slo is not None:
+                    return (200, json.dumps(slo(), default=str).encode(),
+                            "application/json")
+                if path.startswith("/clients/") and client_lookup is not None:
                     raw = path[len("/clients/"):]
                     try:
                         cid = int(raw)
                     except ValueError:
-                        self._send(400, b"client id must be an integer\n",
-                                   "text/plain; charset=utf-8")
-                        return
+                        return (400, b"client id must be an integer\n",
+                                "text/plain; charset=utf-8")
                     doc = client_lookup(cid)
                     if doc is None:
-                        self._send(404, b"unknown client\n",
-                                   "text/plain; charset=utf-8")
+                        return (404, b"unknown client\n",
+                                "text/plain; charset=utf-8")
+                    return (200, json.dumps(doc, default=str).encode(),
+                            "application/json")
+                return None
+
+            def _is_known(self, path: str) -> bool:
+                return (self._get_response(path) is not None
+                        or (path == "/admin/scalars" and admin is not None))
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                self._answer_read(include_body=True)
+
+            def do_HEAD(self):  # noqa: N802
+                self._answer_read(include_body=False)
+
+            def _answer_read(self, include_body: bool) -> None:
+                path = self.path.split("?", 1)[0]
+                resp = self._get_response(path)
+                if resp is not None:
+                    code, body, ctype = resp
+                    self._send(code, body, ctype, include_body)
+                elif path == "/admin/scalars" and admin is not None:
+                    self._send(405, b"method not allowed\n",
+                               "text/plain; charset=utf-8", include_body,
+                               {"Allow": "POST"})
+                else:
+                    self._send(404, b"not found\n",
+                               "text/plain; charset=utf-8", include_body)
+
+            # ------------------------------------------------------- admin
+            def do_POST(self):  # noqa: N802
+                path = self.path.split("?", 1)[0]
+                if path != "/admin/scalars" or admin is None:
+                    if self._is_known(path):
+                        self._send(405, b"method not allowed\n",
+                                   "text/plain; charset=utf-8",
+                                   extra_headers={"Allow": "GET, HEAD"})
                     else:
-                        self._send(200,
-                                   json.dumps(doc, default=str).encode(),
-                                   "application/json")
+                        self._send(404, b"not found\n",
+                                   "text/plain; charset=utf-8")
+                    return
+                from fl4health_tpu.observability.adminplane import (
+                    AdminRejection,
+                )
+                try:
+                    admin.authorize(self.headers.get(admin.AUTH_HEADER))
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length > 0 else b""
+                    try:
+                        scalars = json.loads(raw.decode("utf-8") or "null")
+                    except (ValueError, UnicodeDecodeError):
+                        raise AdminRejection(
+                            400, "bad_request",
+                            "body must be valid JSON") from None
+                    self._send_json(200, admin.submit(scalars))
+                except AdminRejection as rej:
+                    self._send_json(rej.status, rej.doc())
+
+            # ------------------------------------------- other verbs -> 405
+            def _reject_method(self):
+                path = self.path.split("?", 1)[0]
+                if self._is_known(path):
+                    allow = ("POST" if path == "/admin/scalars"
+                             else "GET, HEAD")
+                    self._send(405, b"method not allowed\n",
+                               "text/plain; charset=utf-8",
+                               extra_headers={"Allow": allow})
                 else:
                     self._send(404, b"not found\n",
                                "text/plain; charset=utf-8")
 
+            do_PUT = _reject_method    # noqa: N815
+            do_DELETE = _reject_method  # noqa: N815
+            do_PATCH = _reject_method  # noqa: N815
+
             def log_message(self, *args):  # no stderr spam per scrape
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = _QuietThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="fl4h-scrape", daemon=True

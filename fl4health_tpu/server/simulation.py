@@ -2044,6 +2044,15 @@ class FederatedSimulation:
             return EXEC_CHUNKED, "forced by execution_mode='chunked'"
         if why:
             return EXEC_PIPELINED, why
+        if self.observability.enabled and self.observability.admin is not None:
+            # the admin plane retunes at per-round host boundaries; a
+            # chunked dispatch has none. Only the AUTO path demotes —
+            # forcing 'chunked' with an armed plane stays legal, and the
+            # endpoint rejects submits with a structured mid_chunk error.
+            return EXEC_PIPELINED, (
+                "admin retune endpoint armed (live scalar rebinds apply "
+                "at per-round boundaries)"
+            )
         return EXEC_CHUNKED, "auto: no per-round host dependencies"
 
     def fit(self, n_rounds: int) -> list[RoundRecord]:
@@ -2100,6 +2109,48 @@ class FederatedSimulation:
         if keep is None:
             return mask
         return mask * jnp.asarray(keep, jnp.float32)
+
+    def _apply_admin_retunes(self, rnd: int) -> None:
+        """Round-boundary hook (producer thread, every pipelined path):
+        drain the admin plane's pending/scheduled retunes and rebind them
+        on the live run — state-kind scalars through the same
+        ``apply_state_scalars`` the sweep uses (a server-state leaf swap:
+        zero recompiles), live-attr scalars (async staleness exponent) via
+        setattr picked up by the next dispatch. A no-op without an armed
+        plane, so the default path stays bit-identical."""
+        obs = self.observability
+        admin = obs.admin if obs.enabled else None
+        if admin is None:
+            return
+        values = admin.drain(rnd)
+        if not values:
+            return
+        from fl4health_tpu.sweep import hoisting
+
+        try:
+            state_vals = {
+                n: v for n, v in values.items()
+                if hoisting.binding(n).kind == "state"
+            }
+            if state_vals:
+                self.server_state = hoisting.apply_state_scalars(
+                    self.strategy, self.server_state, state_vals
+                )
+            for name, value in values.items():
+                if name not in state_vals:
+                    b = hoisting.binding(name)
+                    setattr(b.find(self.strategy), b.attr, float(value))
+        except Exception:
+            # submit() validated against this strategy chain, so this is a
+            # race (e.g. strategy swapped between submit and drain) — a bad
+            # retune must not kill a training run
+            logging.getLogger(__name__).warning(
+                "admin retune %r failed to apply at round %d",
+                values, rnd, exc_info=True,
+            )
+            return
+        admin.note_applied(rnd, values)
+        obs.update_manifest({"admin": admin.descriptor()})
 
     def _note_recovery_round(self, rnd: int) -> None:
         """Round-epilogue hook (every execution path, after the watchdog
@@ -2177,6 +2228,14 @@ class FederatedSimulation:
                 "(Observability(enabled=%s, telemetry=%s)) — no health "
                 "checks will run.", obs.enabled, obs.telemetry,
             )
+        if obs.enabled and obs.admin is not None:
+            # arm the admin plane against THIS run: validation needs the
+            # live strategy chain + execution mode (a chunked run rejects
+            # submits with a structured mid_chunk error), and the manifest
+            # must disclose the plane from round 0 for replayability
+            obs.admin.bind_run(self.strategy, mode,
+                               async_active=self._async_active)
+            obs.update_manifest({"admin": obs.admin.descriptor()})
         if obs.enabled:
             obs.log_event("execution_mode", mode=mode, reason=mode_reason)
             if self._program_builder.mesh is not None:
@@ -2862,6 +2921,10 @@ class FederatedSimulation:
                     fresh = self.train_data_provider(rnd)
                     if fresh is not None:
                         self.set_train_data(*fresh)
+                # admin-plane retunes land HERE — a per-round host boundary
+                # before anything reads server_state, after the provider (so
+                # a submit issued synchronously from it applies this round)
+                self._apply_admin_retunes(rnd)
                 mask = self.client_manager.sample(
                     jax.random.fold_in(self.rng, 2000 + rnd), rnd
                 )
@@ -3680,6 +3743,9 @@ class FederatedSimulation:
             compile_s_before = obs.registry.counter(
                 "jax_backend_compiles_seconds_total").value
         t0 = time.time()
+        # per-round host boundary: admin retunes rebind server_state before
+        # this round's programs read it (data staging has no dependency)
+        self._apply_admin_retunes(rnd)
         with obs.span("round", round=rnd, kind="cohort"):
             with obs.span("configure_fit", round=rnd):
                 staged = (prefetcher.take(rnd) if prefetcher is not None
@@ -4341,6 +4407,10 @@ class FederatedSimulation:
             compile_s_before = obs.registry.counter(
                 "jax_backend_compiles_seconds_total").value
         t0 = time.time()
+        # per-event host boundary: state-kind retunes rebind server_state;
+        # a staleness_exponent setattr lands via the live dispatch input
+        # (_staleness_exponent_input) this very event
+        self._apply_admin_retunes(e)
         with obs.span("round", round=e, kind="async_event"):
             arrivals = jnp.asarray(plan.arrivals[e - 1])
             staleness = jnp.asarray(plan.staleness[e - 1])
@@ -4618,6 +4688,8 @@ class FederatedSimulation:
             compile_s_before = obs.registry.counter(
                 "jax_backend_compiles_seconds_total").value
         t0 = time.time()
+        # same per-event admin boundary as the dense async path
+        self._apply_admin_retunes(e)
         with obs.span("round", round=e, kind="async_event"):
             occ_next = np.asarray(plan.slot_ids[e])
             changed = np.nonzero(occ_prev != occ_next)[0]
@@ -5306,6 +5378,15 @@ class FederatedSimulation:
             ).set(float(flight.window))
         self.observability.tracer.counter(
             "fl_round_time_s", fit=rec.fit_elapsed_s, eval=rec.eval_elapsed_s
+        )
+        # operations plane (armed via Observability(slo=/admin_token=)):
+        # fold this summary into the serving-KPI time-series and evaluate
+        # the SLO policy — same host floats as above, zero extra syncs; a
+        # shared no-op when unarmed
+        self.observability.observe_round_kpis(
+            rnd, summary,
+            fit_loss=rec.fit_losses.get("backward"),
+            eval_loss=rec.eval_losses.get("checkpoint"),
         )
         return summary
 

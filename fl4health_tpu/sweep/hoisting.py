@@ -319,6 +319,36 @@ def applicable_scalars(strategy) -> list[str]:
     return [n for n, b in SCALAR_BINDINGS.items() if b.applies(strategy)]
 
 
+#: attr-kind scalars that standalone round programs already read as live
+#: dispatch inputs (no retrace needed) — currently only FedBuff's staleness
+#: exponent, which async dispatch feeds per event.
+LIVE_ATTR_SCALARS = ("staleness_exponent",)
+
+
+def live_rebind_kind(strategy, name: str, *, async_active: bool = False) -> str:
+    """How (whether) the admin plane can rebind ``name`` on a LIVE run.
+
+    - ``"state"`` — a server-state leaf; ``apply_state_scalars`` rebinds it
+      at a round boundary with zero recompiles.
+    - ``"live_attr"`` — an attr the compiled program already takes as a
+      dispatch input (async staleness exponent); a plain ``setattr`` lands
+      at the next dispatch.
+    - ``"static"`` — an attr-kind scalar baked into the trace as a constant
+      outside a sweep cell; a live rebind would silently not take effect.
+    - ``"inapplicable"`` — no owner in this strategy chain.
+
+    Unknown names raise ``KeyError`` (via :func:`binding`).
+    """
+    b = binding(name)
+    if not b.applies(strategy):
+        return "inapplicable"
+    if b.kind == "state":
+        return "state"
+    if name in LIVE_ATTR_SCALARS and async_active:
+        return "live_attr"
+    return "static"
+
+
 def apply_state_scalars(strategy, server_state, values: dict[str, float]):
     """Rebind state-kind scalars on a freshly-initialized server state —
     the sweep's per-cell override for hyperparameters that live as state

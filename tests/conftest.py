@@ -129,6 +129,16 @@ def pytest_configure(config):
         "by default; heavier conservation sweeps also carry 'slow'. "
         "Select with -m roofline.",
     )
+    config.addinivalue_line(
+        "markers",
+        "ops: operations-plane lanes (observability/timeseries.py round "
+        "KPI time-series + slo.py burn-rate SLO engine, adminplane.py "
+        "live retune endpoint, tools/run_diff.py drift diffing). The "
+        "tier-1-safe smoke subset (ops-plane-off bit-identity per "
+        "execution mode, the live retune drill at zero recompiles, "
+        "endpoint conformance, run_diff exit-code trio) runs by default; "
+        "heavier variants also carry 'slow'. Select with -m ops.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,168 @@
+"""SLO engine (observability/slo.py): declarative objectives + burn-rate
+standing over the round KPI time-series.
+
+The pinned contracts:
+- ``SLOPolicy`` rejects nonsense at construction (the run must fail fast,
+  not misjudge itself for hours);
+- burn-rate semantics follow the SRE multi-window idiom: short-window
+  burn >= 1 is ``warn``, short AND long >= 1 is ``breach``, and an absent
+  signal (None KPI) is SKIPPED, never counted as a pass or a fail;
+- ``slo`` JSONL events fire on standing TRANSITIONS only — a healthy run
+  logs nothing, a steady breach logs twice (enter + exit), not per round.
+"""
+
+import pytest
+
+from fl4health_tpu.observability import MetricsRegistry
+from fl4health_tpu.observability.slo import (
+    SLO_OBJECTIVES,
+    SLOEngine,
+    SLOPolicy,
+)
+
+pytestmark = pytest.mark.ops
+
+
+def kpis(**over):
+    base = {"rounds_per_hour": 100.0, "eval_loss": 0.5,
+            "bytes_per_client": 1000.0, "mttr_s": None, "mttr_open_s": None,
+            "straggler_p99": 1.0}
+    base.update(over)
+    return base
+
+
+class TestPolicy:
+    def test_validation_fails_fast(self):
+        with pytest.raises(ValueError):
+            SLOPolicy(error_budget=0.0)
+        with pytest.raises(ValueError):
+            SLOPolicy(error_budget=1.5)
+        with pytest.raises(ValueError):
+            SLOPolicy(short_window=5, long_window=3)
+        with pytest.raises(ValueError):
+            SLOPolicy(short_window=0)
+        with pytest.raises(ValueError):
+            SLOPolicy(stall_rounds=0)
+        with pytest.raises(ValueError):
+            SLOPolicy(min_rounds_per_hour=-1.0)
+
+    def test_objectives_armed_in_severity_order(self):
+        p = SLOPolicy(max_straggler_p99=5.0, min_rounds_per_hour=10.0)
+        assert p.objectives() == ("round_cadence", "straggler_p99")
+        assert SLOPolicy().objectives() == ()
+        assert set(SLOPolicy(
+            min_rounds_per_hour=1, max_eval_loss=1, stall_rounds=1,
+            max_bytes_per_client=1, max_mttr_s=1, max_straggler_p99=1,
+        ).objectives()) == set(SLO_OBJECTIVES)
+
+    def test_describe_is_json_safe(self):
+        import json
+        json.dumps(SLOPolicy(min_rounds_per_hour=10.0).describe())
+
+
+class TestBurnRate:
+    def policy(self, **over):
+        kw = dict(min_rounds_per_hour=60.0, error_budget=0.5,
+                  short_window=2, long_window=4)
+        kw.update(over)
+        return SLOPolicy(**kw)
+
+    def test_warn_then_breach_then_recover(self):
+        eng = SLOEngine(self.policy())
+        # healthy rounds: ok
+        for rnd in (1, 2):
+            v = eng.evaluate(rnd, kpis(rounds_per_hour=100.0))
+            assert v["state"] == "ok"
+        # first violation saturates the short window (1 of last 2 at
+        # budget 0.5) but not yet the long one -> warn, don't page
+        v = eng.evaluate(3, kpis(rounds_per_hour=10.0))
+        assert v["objectives"]["round_cadence"]["standing"] == "warn"
+        assert v["state"] == "warn" and v["degraded_slo"] is None
+        # sustained violation: the long window catches up -> breach,
+        # degraded names the objective
+        v = eng.evaluate(4, kpis(rounds_per_hour=10.0))
+        assert v["objectives"]["round_cadence"]["standing"] == "breach"
+        assert v["state"] == "breach"
+        assert v["degraded_slo"] == "round_cadence"
+        assert eng.degraded_slo == "round_cadence"
+        # one clean round does NOT clear a standing breach (both windows
+        # still burning) — no flapping on a single good round
+        v = eng.evaluate(5, kpis(rounds_per_hour=100.0))
+        assert v["state"] == "breach"
+        # sustained recovery drains the short window -> ok
+        v = eng.evaluate(6, kpis(rounds_per_hour=100.0))
+        assert v["objectives"]["round_cadence"]["standing"] == "ok"
+        for rnd in (7, 8):
+            v = eng.evaluate(rnd, kpis(rounds_per_hour=100.0))
+        assert v["state"] == "ok" and eng.degraded_slo is None
+
+    def test_absent_signal_is_skipped_not_judged(self):
+        eng = SLOEngine(self.policy())
+        for rnd in range(1, 6):
+            v = eng.evaluate(rnd, kpis(rounds_per_hour=None))
+        obj = v["objectives"]["round_cadence"]
+        assert obj["violated"] is None
+        assert obj["burn_short"] == 0.0 and obj["standing"] == "ok"
+
+    def test_eval_stall_tracks_best_with_min_delta(self):
+        eng = SLOEngine(SLOPolicy(stall_rounds=2, stall_min_delta=0.05,
+                                  error_budget=0.5, short_window=1,
+                                  long_window=1))
+        assert eng.evaluate(1, kpis(eval_loss=1.0))["state"] == "ok"
+        # 0.98 is within min_delta of the best: NOT an improvement
+        eng.evaluate(2, kpis(eval_loss=0.98))
+        v = eng.evaluate(3, kpis(eval_loss=0.97))
+        assert v["objectives"]["eval_stall"]["violated"] is True
+        # a real improvement resets the stall counter
+        v = eng.evaluate(4, kpis(eval_loss=0.5))
+        assert v["objectives"]["eval_stall"]["violated"] is False
+
+    def test_mttr_judges_open_incidents_too(self):
+        eng = SLOEngine(SLOPolicy(max_mttr_s=60.0, error_budget=1.0,
+                                  short_window=1, long_window=1))
+        # no incident ever -> skipped
+        v = eng.evaluate(1, kpis())
+        assert v["objectives"]["mttr"]["violated"] is None
+        # an incident open longer than the target violates NOW, not after
+        # it eventually closes
+        v = eng.evaluate(2, kpis(mttr_open_s=120.0))
+        assert v["objectives"]["mttr"]["violated"] is True
+        v = eng.evaluate(3, kpis(mttr_s=30.0))
+        assert v["objectives"]["mttr"]["violated"] is False
+
+
+class TestEventsAndGauges:
+    def test_transition_only_events_and_gauges(self):
+        reg = MetricsRegistry()
+        eng = SLOEngine(SLOPolicy(max_eval_loss=1.0, error_budget=1.0,
+                                  short_window=1, long_window=1), reg)
+        for rnd in range(1, 4):
+            eng.evaluate(rnd, kpis(eval_loss=0.5))
+        assert [e for e in reg.events if e["event"] == "slo"] == []
+        # enter breach: exactly ONE event despite three breaching rounds
+        for rnd in range(4, 7):
+            eng.evaluate(rnd, kpis(eval_loss=2.0))
+        events = [e for e in reg.events if e["event"] == "slo"]
+        assert len(events) == 1
+        assert events[0]["slo"] == "eval_loss"
+        assert events[0]["standing"] == "breach"
+        assert events[0]["round"] == 4
+        # exit: one more
+        eng.evaluate(7, kpis(eval_loss=0.5))
+        events = [e for e in reg.events if e["event"] == "slo"]
+        assert len(events) == 2 and events[1]["standing"] == "ok"
+        snap = reg.snapshot()
+        assert snap["fl_slo_burn_rate"]['{slo="eval_loss",window="short"}'] == 0.0
+        assert snap["fl_slo_violations"]['{slo="eval_loss"}'] == 3.0
+        assert snap["fl_slo_degraded"] == 0.0
+
+    def test_standing_document_shape(self):
+        eng = SLOEngine(SLOPolicy(max_eval_loss=1.0))
+        doc = eng.standing()
+        assert doc["state"] == "ok" and doc["round"] is None
+        assert doc["objectives_armed"] == ["eval_loss"]
+        eng.evaluate(1, kpis(eval_loss=0.5))
+        doc = eng.standing()
+        assert doc["round"] == 1
+        assert doc["kpis"]["eval_loss"] == 0.5
+        assert doc["policy"]["max_eval_loss"] == 1.0

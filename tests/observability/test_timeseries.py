@@ -1,0 +1,177 @@
+"""Round KPI time-series (observability/timeseries.py): the serving-KPI
+layer under the SLO engine.
+
+The pinned contracts:
+- KPIs are exact functions of the fed summaries under an injected clock
+  (rounds/hour, bytes/client, straggler trend — no wall-clock flake);
+- MTTR counts engage -> probation_passed wall time, one incident at a
+  time (re-engages escalate the SAME incident), halts close unrepaired;
+- memory is O(window): the point deque is bounded and ``nbytes`` cannot
+  grow with run length.
+"""
+
+import threading
+
+import pytest
+
+from fl4health_tpu.observability.timeseries import RoundTimeSeries
+
+pytestmark = pytest.mark.ops
+
+
+class FakeClock:
+    def __init__(self, t0=1000.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def summary(rnd, fit_s=8.0, eval_s=2.0, participants=2, gather=150.0,
+            broadcast=50.0, **extra):
+    doc = {"round": rnd, "fit_s": fit_s, "eval_s": eval_s,
+           "participants": participants, "gather_bytes": gather,
+           "broadcast_bytes": broadcast}
+    doc.update(extra)
+    return doc
+
+
+class TestKpis:
+    def test_empty_series_is_all_none(self):
+        ts = RoundTimeSeries(window=8, clock=FakeClock())
+        k = ts.kpis()
+        assert k["rounds_seen"] == 0
+        for key in ("rounds_per_hour", "bytes_per_client", "eval_loss",
+                    "mttr_s", "straggler_p99"):
+            assert k[key] is None
+
+    def test_window_must_hold_a_rate(self):
+        with pytest.raises(ValueError):
+            RoundTimeSeries(window=1)
+
+    def test_rate_bytes_and_losses_are_exact(self):
+        clock = FakeClock()
+        ts = RoundTimeSeries(window=8, clock=clock)
+        for rnd in range(1, 4):
+            k = ts.observe_round(summary(rnd), fit_loss=0.5 - 0.1 * rnd,
+                                 eval_loss=0.4)
+            clock.advance(10.0)
+        # 3 points spanning 20s -> 2 rounds / 20s = 360 rounds/hour
+        assert k["rounds_per_hour"] == pytest.approx(360.0)
+        # last round: (150 + 50) wire bytes over 2 participants
+        assert k["bytes_per_client"] == pytest.approx(100.0)
+        assert k["fit_loss"] == pytest.approx(0.2)
+        assert k["eval_loss"] == pytest.approx(0.4)
+        assert k["rounds_seen"] == 3
+        # wall = fit_s + eval_s = 10s every round
+        assert k["round_s_p50"] == pytest.approx(10.0)
+
+    def test_wire_prefers_post_compression_bytes(self):
+        ts = RoundTimeSeries(window=4, clock=FakeClock())
+        k = ts.observe_round(summary(1, gather=1000.0, broadcast=0.0,
+                                     gather_bytes_wire=125.0,
+                                     participants=1))
+        assert k["bytes_per_client"] == pytest.approx(125.0)
+
+    def test_straggler_trend_reads_fleet_summary(self):
+        clock = FakeClock()
+        ts = RoundTimeSeries(window=8, clock=clock)
+        for p99 in (1.0, 2.0, 4.0):
+            k = ts.observe_round(summary(1, fleet={"straggler_p99": p99}))
+            clock.advance(1.0)
+        assert k["straggler_p99"] == pytest.approx(4.0)
+        assert k["straggler_p99_trend"] == pytest.approx(3.0)
+        # a round without the fleet block does not poison the tail read
+        k = ts.observe_round(summary(4))
+        assert k["straggler_p99"] == pytest.approx(4.0)
+
+
+class TestMttr:
+    def test_engage_to_probation_is_one_incident(self):
+        clock = FakeClock()
+        ts = RoundTimeSeries(window=8, clock=clock)
+        ts.note_recovery("engage")
+        clock.advance(30.0)
+        ts.note_recovery("engage")  # rung escalation, same outage
+        clock.advance(30.0)
+        ts.note_recovery("probation_passed")
+        k = ts.kpis()
+        assert k["mttr_s"] == pytest.approx(60.0)
+        assert k["recoveries"] == 1 and k["halts"] == 0
+        assert k["mttr_open_s"] is None
+
+    def test_open_incident_ages_and_halt_closes_unrepaired(self):
+        clock = FakeClock()
+        ts = RoundTimeSeries(window=8, clock=clock)
+        ts.note_recovery("engage")
+        clock.advance(45.0)
+        assert ts.kpis()["mttr_open_s"] == pytest.approx(45.0)
+        ts.note_recovery("halt")
+        k = ts.kpis()
+        assert k["mttr_open_s"] is None
+        assert k["mttr_s"] is None  # nothing repaired
+        assert k["halts"] == 1
+
+    def test_probation_without_engage_is_ignored(self):
+        ts = RoundTimeSeries(window=8, clock=FakeClock())
+        ts.note_recovery("probation_passed")
+        assert ts.kpis()["recoveries"] == 0
+
+
+class TestBoundedMemory:
+    def test_nbytes_bounded_in_run_length(self):
+        """The bounded-memory pin: the point deque is O(window) exactly;
+        only the lifetime KLL sketch may grow, and it grows O(log n) —
+        10x the rounds must cost well under 2x the bytes."""
+        clock = FakeClock()
+        sizes = {}
+        for n in (300, 3000):
+            ts = RoundTimeSeries(window=64, clock=clock)
+            for rnd in range(n):
+                ts.observe_round(summary(rnd))
+                clock.advance(1.0)
+            sizes[n] = ts.nbytes
+            assert ts.rounds_seen == n
+            assert len(ts._points) == 64  # deque pinned at the window
+        assert sizes[3000] < 2 * sizes[300]
+
+    def test_rate_uses_window_not_lifetime(self):
+        clock = FakeClock()
+        ts = RoundTimeSeries(window=4, clock=clock)
+        for rnd in range(10):
+            # early rounds slow, late rounds fast: the windowed rate must
+            # report the recent cadence, not the lifetime average
+            clock.advance(100.0 if rnd < 6 else 10.0)
+            k = ts.observe_round(summary(rnd))
+        assert k["rounds_per_hour"] == pytest.approx(3 / 30.0 * 3600.0)
+
+    def test_thread_safe_feed_and_read(self):
+        ts = RoundTimeSeries(window=32)
+        errs = []
+
+        def feed():
+            try:
+                for rnd in range(200):
+                    ts.observe_round(summary(rnd))
+                    ts.note_recovery("engage")
+                    ts.note_recovery("probation_passed")
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        def read():
+            try:
+                for _ in range(200):
+                    ts.kpis()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=f) for f in (feed, feed, read)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert ts.rounds_seen == 400

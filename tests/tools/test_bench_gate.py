@@ -176,3 +176,36 @@ class TestCli:
         assert out.returncode == 1
         assert "FAIL" in out.stdout
         assert "round_time_ratio_maxN_vs_minN" in out.stdout
+
+
+class TestOpsOverheadBand:
+    """The ops-plane ceiling: ``ops_overhead.overhead_pct`` must stay
+    under OPS_OVERHEAD_PCT_MAX — the operations plane is free against
+    the round, and the gate holds it there."""
+
+    def _record(self, overhead_pct):
+        record = _cohort_record()
+        record["ops_overhead"] = {
+            "round_s_plain": 0.01, "round_s_ops_plane": 0.0101,
+            "overhead_pct": overhead_pct, "rounds": 10,
+        }
+        return record
+
+    def test_in_band_overhead_passes(self):
+        assert bench_gate.check_artifact(self._record(1.0), ANCHOR) == []
+        # negative jitter (ops arm measured faster) is fine too
+        assert bench_gate.check_artifact(self._record(-9.9), ANCHOR) == []
+
+    def test_over_band_overhead_flagged(self):
+        fails = bench_gate.check_artifact(self._record(40.0), ANCHOR)
+        assert any("ops_overhead" in f and "no longer free" in f
+                   for f in fails)
+
+    def test_cpu_fallback_null_timing_skipped(self):
+        # CPU-fallback captures null the timing instead of lying with 0.0
+        assert bench_gate.check_artifact(self._record(None), ANCHOR) == []
+
+    def test_overhead_pct_outside_ops_block_unbanded(self):
+        record = _cohort_record()
+        record["other_block"] = {"overhead_pct": 40.0}
+        assert bench_gate.check_artifact(record, ANCHOR) == []

@@ -1139,3 +1139,95 @@ def test_load_stage_events_round_trips(tmp_path):
     path = _staged_log(tmp_path, [_stage_event("fit_round", "dp_clip")])
     stages = perf_report.load_stage_events(path)
     assert [s["stage"] for s in stages] == ["dp_clip"]
+
+
+# -- operations-plane columns (SLO engine + admin retune PR) ----------------
+
+def _ops_log(tmp_path, rounds, slo=(), admin=()):
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w") as f:
+        for r in rounds:
+            f.write(json.dumps({"ts": 0, "event": "round", **r}) + "\n")
+        for e in slo:
+            f.write(json.dumps({"ts": 0, "event": "slo", **e}) + "\n")
+        for e in admin:
+            f.write(json.dumps({"ts": 0, "event": "admin", **e}) + "\n")
+    return str(path)
+
+
+def test_slo_columns_render_and_forward_fill():
+    rounds = [_round(1), _round(2), _round(3)]
+    merged = perf_report.merge_slo_fields(
+        rounds, [{"round": 2, "slo": "eval_loss", "standing": "breach",
+                  "state": "breach", "burn_short": 2.0}])
+    table = perf_report.render_table(merged)
+    header = table.splitlines()[0].split()
+    assert "slo" in header and "burn" in header
+    # round 1 predates the first transition: untouched; the standing
+    # HOLDS from the transition round onward, burn only at the transition
+    assert "slo_state" not in merged[0]
+    assert merged[1]["slo_state"] == "breach"
+    assert merged[1]["slo_burn"] == 2.0
+    assert merged[2]["slo_state"] == "breach"
+    assert "slo_burn" not in merged[2]
+    assert "2.00" in table
+
+
+def test_admin_retune_markers_render():
+    rounds = [_round(1), _round(2)]
+    merged = perf_report.merge_admin_fields(
+        rounds, [{"round": 2, "scalars": {"server_lr": 0.02}}])
+    table = perf_report.render_table(merged)
+    assert "retune" in table.splitlines()[0].split()
+    assert "admin_retune" not in merged[0]
+    assert merged[1]["admin_retune"] == "server_lr=0.02"
+    assert "server_lr=0.02" in table
+
+
+def test_ops_fields_absent_keeps_legacy_table_byte_stable():
+    rounds = [_round(1), _round(2)]
+    assert perf_report.merge_slo_fields(rounds, []) is rounds
+    assert perf_report.merge_admin_fields(rounds, []) is rounds
+    header = perf_report.render_table(rounds).splitlines()[0].split()
+    assert "slo" not in header and "burn" not in header
+    assert "retune" not in header
+
+
+def test_cli_ops_log_renders_and_json_gains_keys(tmp_path):
+    path = _ops_log(
+        tmp_path, [_round(1), _round(2), _round(3)],
+        slo=[{"round": 2, "slo": "eval_loss", "standing": "breach",
+              "state": "breach", "burn_short": 2.0}],
+        admin=[{"round": 3, "scalars": {"server_lr": 0.02}}])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "breach" in out and "server_lr=0.02" in out
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), path,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert doc["slo"][0]["standing"] == "breach"
+    assert doc["admin"][0]["scalars"] == {"server_lr": 0.02}
+
+
+def test_cli_output_byte_stable_without_ops_events(tmp_path):
+    legacy = _log(tmp_path, [_round(1), _round(2)])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), legacy],
+        capture_output=True, text=True, check=True,
+    )
+    rounds = perf_report.load_round_events(legacy)
+    expected = perf_report.render_table(rounds) + "\n\n" + "\n".join(
+        f"{k}: {v}" for k, v in perf_report.summarize(rounds).items()
+    ) + "\n"
+    assert out.stdout == expected
+    assert "slo" not in out.stdout and "retune" not in out.stdout
+    doc = json.loads(subprocess.run(
+        [sys.executable, str(REPO / "tools" / "perf_report.py"), legacy,
+         "--json"],
+        capture_output=True, text=True, check=True,
+    ).stdout)
+    assert "slo" not in doc and "admin" not in doc

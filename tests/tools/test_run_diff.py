@@ -1,0 +1,258 @@
+"""tools/run_diff.py: run-to-run drift diffing over JSONL + manifest.
+
+The acceptance pins:
+- the exit-code trio is a stable house contract: 0 clean, 1 drift,
+  2 unreadable (garbage JSONL, missing file) — CI gates on it;
+- drift classification is three-way: **config** (manifest config_hash /
+  config.* keys / admin retune journal), **numeric** (per-round
+  bit-derived loss stats, round count, SLO verdict and admin retune
+  event sequences), **performance** (program FLOPs/HBM held tight at
+  1e-6 regardless of --perf-tol; median wall time at --perf-tol,
+  skippable with --no-wall for cross-machine diffs);
+- a same-seed re-run under the house determinism discipline diffs CLEAN
+  at the default rtol of 0.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import run_diff  # noqa: E402
+
+pytestmark = pytest.mark.ops
+
+
+def write_run(tmp_path, name, rounds=None, manifest=None, extra_events=()):
+    """Write a minimal metrics.jsonl (+ manifest.json) run directory."""
+    d = tmp_path / name
+    d.mkdir()
+    events = list(rounds if rounds is not None else default_rounds())
+    events.extend(extra_events)
+    with open(d / "metrics.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    if manifest is not None:
+        with open(d / "manifest.json", "w") as f:
+            json.dump(manifest, f)
+    return d
+
+
+def default_rounds(n=3, std=0.25, fit_s=2.0):
+    return [{"event": "round", "round": r, "fit_loss_std": std,
+             "fit_loss_spread": 2 * std, "participants": 4, "failures": 0,
+             "fit_s": fit_s, "eval_s": 0.5}
+            for r in range(1, n + 1)]
+
+
+MANIFEST = {"config_hash": "abc123", "config": {"seed": 0, "clients": 4}}
+
+
+class TestExitCodeTrio:
+    def run_cli(self, *argv):
+        proc = subprocess.run(
+            [sys.executable, "tools/run_diff.py", *map(str, argv)],
+            cwd=REPO, capture_output=True, text=True)
+        return proc
+
+    def test_exit_0_clean(self, tmp_path):
+        a = write_run(tmp_path, "a", manifest=MANIFEST)
+        b = write_run(tmp_path, "b", manifest=MANIFEST)
+        proc = self.run_cli(a, b)
+        assert proc.returncode == 0
+        assert "CLEAN" in proc.stdout
+
+    def test_exit_1_drift(self, tmp_path):
+        a = write_run(tmp_path, "a", manifest=MANIFEST)
+        b = write_run(tmp_path, "b", rounds=default_rounds(std=0.5),
+                      manifest=MANIFEST)
+        proc = self.run_cli(a, b)
+        assert proc.returncode == 1
+        assert "DRIFT: numeric" in proc.stdout
+
+    def test_exit_2_unreadable(self, tmp_path):
+        a = write_run(tmp_path, "a", manifest=MANIFEST)
+        garbage = tmp_path / "g"
+        garbage.mkdir()
+        (garbage / "metrics.jsonl").write_text("not json{\n")
+        assert self.run_cli(a, garbage).returncode == 2
+        # missing file is unreadable too, not a crash
+        assert self.run_cli(a, tmp_path / "nope").returncode == 2
+        # and so is an empty log
+        empty = tmp_path / "e"
+        empty.mkdir()
+        (empty / "metrics.jsonl").write_text("")
+        assert self.run_cli(a, empty).returncode == 2
+
+    def test_json_mode_emits_full_document(self, tmp_path):
+        a = write_run(tmp_path, "a", manifest=MANIFEST)
+        b = write_run(tmp_path, "b", manifest=MANIFEST)
+        proc = self.run_cli(a, b, "--json")
+        doc = json.loads(proc.stdout)
+        assert doc["clean"] is True
+        assert doc["classification"] == []
+
+
+class TestConfigDrift:
+    def diff(self, a, b, **kw):
+        return run_diff.diff_runs(run_diff.load_run(str(a)),
+                                  run_diff.load_run(str(b)), **kw)
+
+    def test_config_hash_and_keys(self, tmp_path):
+        a = write_run(tmp_path, "a", manifest=MANIFEST)
+        b = write_run(tmp_path, "b", manifest={
+            "config_hash": "zzz", "config": {"seed": 1, "clients": 4}})
+        doc = self.diff(a, b)
+        assert doc["classification"] == ["config"]
+        whats = {d["what"] for d in doc["config"]}
+        assert whats == {"config_hash", "config.seed"}
+
+    def test_admin_retune_journal_is_config_identity(self, tmp_path):
+        """Same config hash but one side was live-retuned: the runs were
+        DRIVEN differently — config drift, not numeric noise."""
+        retuned = dict(MANIFEST)
+        retuned["admin"] = {"enabled": True, "retunes": [
+            {"round": 3, "scalars": {"server_lr": 0.02}, "source": "live"}]}
+        a = write_run(tmp_path, "a", manifest=MANIFEST)
+        b = write_run(tmp_path, "b", manifest=retuned)
+        doc = self.diff(a, b)
+        assert [d["what"] for d in doc["config"]] == ["admin.retunes"]
+
+    def test_missing_manifest_is_noted_not_fatal(self, tmp_path):
+        a = write_run(tmp_path, "a", manifest=MANIFEST)
+        b = write_run(tmp_path, "b")  # no manifest.json
+        doc = self.diff(a, b)
+        assert doc["clean"] is True
+        assert doc["notes"] and "manifest missing" in doc["notes"][0]
+
+
+class TestNumericDrift:
+    def diff(self, a, b, **kw):
+        return run_diff.diff_runs(run_diff.load_run(str(a)),
+                                  run_diff.load_run(str(b)), **kw)
+
+    def test_per_round_fields_exact_by_default(self, tmp_path):
+        a = write_run(tmp_path, "a", manifest=MANIFEST)
+        rounds = default_rounds()
+        rounds[1]["fit_loss_std"] = 0.2500001
+        b = write_run(tmp_path, "b", rounds=rounds, manifest=MANIFEST)
+        doc = self.diff(a, b)
+        assert doc["classification"] == ["numeric"]
+        [d] = doc["numeric"]
+        assert (d["what"], d["round"]) == ("fit_loss_std", 2)
+        # rtol forgives the same delta
+        assert self.diff(a, b, rtol=1e-3)["clean"] is True
+
+    def test_round_count_and_slo_verdicts(self, tmp_path):
+        slo = {"event": "slo", "round": 2, "slo": "eval_loss",
+               "standing": "breach"}
+        a = write_run(tmp_path, "a", manifest=MANIFEST,
+                      extra_events=[slo])
+        b = write_run(tmp_path, "b", rounds=default_rounds(n=4),
+                      manifest=MANIFEST)
+        doc = self.diff(a, b)
+        whats = {d["what"] for d in doc["numeric"]}
+        assert whats == {"round_count", "slo_verdicts"}
+
+    def test_admin_event_sequences_compared(self, tmp_path):
+        adm = {"event": "admin", "round": 3,
+               "scalars": {"server_lr": 0.02}}
+        a = write_run(tmp_path, "a", manifest=MANIFEST,
+                      extra_events=[adm])
+        b = write_run(tmp_path, "b", manifest=MANIFEST)
+        doc = self.diff(a, b)
+        assert [d["what"] for d in doc["numeric"]] == ["admin_retunes"]
+
+
+class TestPerformanceDrift:
+    def diff(self, a, b, **kw):
+        return run_diff.diff_runs(run_diff.load_run(str(a)),
+                                  run_diff.load_run(str(b)), **kw)
+
+    def test_program_flops_held_tight_regardless_of_perf_tol(self, tmp_path):
+        prog = {"event": "program", "name": "fit_round", "flops": 1e9,
+                "peak_hbm_bytes": 1e6}
+        drifted = dict(prog, flops=1.01e9)  # 1% — way over 1e-6
+        a = write_run(tmp_path, "a", manifest=MANIFEST,
+                      extra_events=[prog])
+        b = write_run(tmp_path, "b", manifest=MANIFEST,
+                      extra_events=[drifted])
+        doc = self.diff(a, b, perf_tol=10.0)
+        assert [d["what"] for d in doc["performance"]] == ["fit_round.flops"]
+
+    def test_median_wall_time_at_perf_tol_and_no_wall_skip(self, tmp_path):
+        a = write_run(tmp_path, "a", manifest=MANIFEST)
+        b = write_run(tmp_path, "b", rounds=default_rounds(fit_s=4.0),
+                      manifest=MANIFEST)
+        doc = self.diff(a, b)  # 2x median fit_s over default 0.25
+        assert [d["what"] for d in doc["performance"]] == ["median_fit_s"]
+        # looser tolerance forgives, --no-wall skips entirely
+        assert self.diff(a, b, perf_tol=0.6)["clean"] is True
+        assert self.diff(a, b, wall=False)["clean"] is True
+
+
+class TestRealRuns:
+    """The acceptance trio against REAL artifacts: a same-seed re-run
+    diffs clean under the house determinism discipline; an injected lr
+    drift is flagged; garbage stays exit 2 (covered above)."""
+
+    def _run(self, out_dir, lr, seed=0):
+        import numpy as np
+        import optax
+        import jax
+
+        from fl4health_tpu.clients import engine
+        from fl4health_tpu.datasets.synthetic import synthetic_classification
+        from fl4health_tpu.metrics import efficient
+        from fl4health_tpu.metrics.base import MetricManager
+        from fl4health_tpu.models.cnn import Mlp
+        from fl4health_tpu.observability import (
+            MetricsRegistry, Observability, Tracer,
+        )
+        from fl4health_tpu.server.simulation import (
+            ClientDataset, FederatedSimulation,
+        )
+        from fl4health_tpu.strategies.fedavg import FedAvg
+
+        datasets = []
+        for i in range(2):
+            x, y = synthetic_classification(jax.random.PRNGKey(i), 48,
+                                            (4,), 2)
+            datasets.append(ClientDataset(
+                np.asarray(x[:32]), np.asarray(y[:32]),
+                np.asarray(x[32:]), np.asarray(y[32:])))
+        obs = Observability(enabled=True, tracer=Tracer(),
+                            registry=MetricsRegistry(), sync_device=False,
+                            flight_recorder=False, output_dir=str(out_dir))
+        sim = FederatedSimulation(
+            logic=engine.ClientLogic(
+                engine.from_flax(Mlp(features=(8,), n_outputs=2)),
+                engine.masked_cross_entropy),
+            tx=optax.sgd(lr), strategy=FedAvg(), datasets=datasets,
+            batch_size=8, metrics=MetricManager((efficient.accuracy(),)),
+            local_steps=2, seed=seed, execution_mode="pipelined",
+            observability=obs)
+        sim.fit(3)
+        return out_dir
+
+    def test_same_seed_rerun_clean_lr_drift_flagged(self, tmp_path):
+        a = self._run(tmp_path / "a", lr=0.05)
+        b = self._run(tmp_path / "b", lr=0.05)
+        c = self._run(tmp_path / "c", lr=0.08)
+        run = lambda x, y: subprocess.run(  # noqa: E731
+            [sys.executable, "tools/run_diff.py", str(x), str(y),
+             "--no-wall"],
+            cwd=REPO, capture_output=True, text=True)
+        # same seed, same config: clean at the default rtol of 0
+        proc = run(a, b)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "CLEAN" in proc.stdout
+        # injected lr drift: the trajectories disagree -> numeric drift
+        proc = run(a, c)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "numeric" in proc.stdout

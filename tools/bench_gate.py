@@ -20,6 +20,10 @@ recorded invariants:
   O(sampled-cohort)-not-O(registry) claim.
 - **chunked-dispatch floor** — ``roundtrip_reduction_at_max_r`` >= 32.0,
   the single-dispatch-per-fit fact the chunked-scan PR measured.
+- **ops-plane ceiling** — ``ops_overhead.overhead_pct`` (the
+  ``FL4HEALTH_BENCH_OPS=1`` block: SLO engine + admin endpoint armed vs
+  plain observability) must stay under a jitter allowance; the plane is
+  O(1) host epilogue work and must never show up against the round.
 - **metric/provenance consistency** — a metric named ``*_cpu_fallback``
   must come from a cpu backend and vice versa, and the ``provenance``
   block (bench.py writes one into every new artifact) must agree with
@@ -61,6 +65,11 @@ ROUND_TIME_RATIO_MAX = 1.0
 # Single-dispatch-per-fit floor measured by the chunked-scan PR: 32
 # rounds in one dispatch -> 32x fewer host roundtrips.
 ROUNDTRIP_REDUCTION_FLOOR = 32.0
+# Operations-plane fit() cost ceiling (ops-plane PR): the SLO engine +
+# admin endpoint are O(1) host work in the consumer epilogue, so the armed
+# arm must stay within measurement jitter of plain observability. 15% is
+# the jitter allowance on the small bench config, not a real budget.
+OPS_OVERHEAD_PCT_MAX = 15.0
 
 # Keys whose value is a semantic invariant wherever it appears.
 _BOOL_INVARIANTS = (
@@ -104,6 +113,13 @@ def check_artifact(record: dict, anchor: dict | None) -> list[str]:
                     f"{path} = {value} < {ROUNDTRIP_REDUCTION_FLOOR} — "
                     "chunked dispatch no longer amortizes host roundtrips"
                 )
+        if key == "overhead_pct" and ".ops_overhead" in path \
+                and value is not None \
+                and float(value) > OPS_OVERHEAD_PCT_MAX:
+            fails.append(
+                f"{path} = {value} > {OPS_OVERHEAD_PCT_MAX} — the "
+                "operations plane is no longer free against the round"
+            )
 
     # metric-name / platform consistency
     platform = record.get("platform")

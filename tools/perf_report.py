@@ -135,6 +135,76 @@ FLIGHT_COLUMNS = (
     ("eval_loss", "eval_loss", lambda v: f"{v:.4g}"),
 )
 
+# Operations-plane fields (observability/slo.py + adminplane.py): the SLO
+# standing forward-filled from `slo` transition events, the worst
+# short-window burn rate at each transition, and admin retune markers
+# folded in from `admin` events by round. Optional like the telemetry
+# columns — logs without an armed ops plane keep their exact old table
+# shape (byte-stable, tested).
+SLO_COLUMNS = (
+    ("slo", "slo_state", str),
+    ("burn", "slo_burn", lambda v: f"{v:.2f}"),
+)
+ADMIN_COLUMNS = (
+    ("retune", "admin_retune", str),
+)
+
+
+def merge_slo_fields(rounds: list[dict],
+                     slo_events: list[dict]) -> list[dict]:
+    """Fold ``slo`` transition events into the round rows: the overall
+    state forward-fills from each transition (the standing HOLDS between
+    transitions), the burn column shows the worst short-window burn at the
+    transition round itself. Rounds before the first transition stay
+    untouched, and logs without ``slo`` events are returned as-is."""
+    if not slo_events:
+        return rounds
+    by_round: dict[int, dict] = {}
+    for rec in slo_events:
+        r = rec.get("round")
+        if r is None:
+            continue
+        slot = by_round.setdefault(int(r), {})
+        if rec.get("state") is not None:
+            slot["slo_state"] = str(rec["state"])
+        if rec.get("burn_short") is not None:
+            slot["slo_burn"] = max(float(slot.get("slo_burn", 0.0)),
+                                   float(rec["burn_short"]))
+    out = []
+    state = None
+    for rec in sorted(rounds, key=lambda r: r.get("round", 0)):
+        rnd = int(rec.get("round", 0))
+        slot = by_round.get(rnd)
+        if slot is not None:
+            state = slot.get("slo_state", state)
+            rec = {**rec, **slot}
+        elif state is not None:
+            rec = {**rec, "slo_state": state}
+        out.append(rec)
+    return out
+
+
+def merge_admin_fields(rounds: list[dict],
+                       admin_events: list[dict]) -> list[dict]:
+    """Fold ``admin`` retune events into the matching round rows as a
+    compact ``name=value`` marker. Rounds without a retune keep no admin
+    field and render '-'; logs without ``admin`` events are returned
+    as-is."""
+    if not admin_events:
+        return rounds
+    by_round: dict[int, list[str]] = {}
+    for rec in admin_events:
+        r = rec.get("round")
+        if r is None:
+            continue
+        for name, value in sorted((rec.get("scalars") or {}).items()):
+            by_round.setdefault(int(r), []).append(f"{name}={value:g}")
+    return [
+        {**rec, "admin_retune": ",".join(by_round[int(rec.get("round", 0))])}
+        if int(rec.get("round", 0)) in by_round else rec
+        for rec in rounds
+    ]
+
 
 def merge_checkpoint_fields(rounds: list[dict],
                             ckpt_events: list[dict]) -> list[dict]:
@@ -213,7 +283,8 @@ def active_columns(rounds: list[dict]) -> tuple:
     extra = tuple(
         col for col in (TELEMETRY_COLUMNS + WIRE_COLUMNS + MESH_COLUMNS
                         + PRECISION_COLUMNS + ASYNC_COLUMNS + CKPT_COLUMNS
-                        + COHORT_COLUMNS + FLEET_COLUMNS + FLIGHT_COLUMNS)
+                        + COHORT_COLUMNS + FLEET_COLUMNS + FLIGHT_COLUMNS
+                        + SLO_COLUMNS + ADMIN_COLUMNS)
         if any(col[1] in rec for rec in rounds)
     )
     return COLUMNS + extra
@@ -694,7 +765,11 @@ def main(argv: list[str] | None = None) -> int:
         sweep_cells = _sorted_sweep_cells(events.get("sweep", []))
         sweep_summary = summarize_sweep(events.get("sweep_summary", []))
         checkpoints = _sorted_rounds(events.get("checkpoint", []))
+        slo_events = _sorted_rounds(events.get("slo", []))
+        admin_events = _sorted_rounds(events.get("admin", []))
         rounds = merge_checkpoint_fields(rounds, checkpoints)
+        rounds = merge_slo_fields(rounds, slo_events)
+        rounds = merge_admin_fields(rounds, admin_events)
     except OSError as e:
         # a missing/unreadable log is an error exit, not a traceback
         print(f"perf_report: cannot read {args.log}: {e}", file=sys.stderr)
@@ -744,6 +819,11 @@ def main(argv: list[str] | None = None) -> int:
             doc["sweep_summary"] = sweep_summary
         if checkpoints:
             doc["checkpoints"] = checkpoints
+        if slo_events:
+            # ops-plane runs only — legacy JSON keeps its exact shape
+            doc["slo"] = slo_events
+        if admin_events:
+            doc["admin"] = admin_events
         fleet = fleet_summary(rounds)
         if fleet:
             # fleet-ledger runs only — legacy JSON keeps its exact shape

@@ -1,0 +1,274 @@
+#!/usr/bin/env python3
+"""Diff two runs' observability artifacts and classify the drift.
+
+``bench_gate.py`` holds BENCH.md artifacts to recorded bands; nothing
+compared one *run* against another — yet "did anything change since
+yesterday's run?" is the first question an operator asks, and eyeballing
+two JSONL logs stops scaling long before the registry does. This tool
+diffs the artifacts every run already writes (``metrics.jsonl`` +
+``manifest.json`` under ``Observability(output_dir=...)``) and classifies
+what moved:
+
+- **config drift** — the manifest ``config_hash`` (or any manifest config
+  key) differs: the two runs are different experiments;
+- **numeric drift** — same config, different per-round trajectory: the
+  bit-derived loss statistics every round event carries
+  (``fit_loss_std``/``fit_loss_spread``), participants/failures, or the
+  SLO verdict sequence (``slo`` events) disagree beyond ``--rtol``.
+  A same-seed re-run on the house's determinism discipline must diff
+  clean at rtol 0;
+- **performance drift** — same math, different speed/footprint: the
+  program-report FLOPs/HBM (``program`` events), per-round wall time or
+  compile counts move beyond ``--perf-tol`` (relative). Perf drift is
+  advisory by default on wall-clock (machines differ) but structural on
+  flops/HBM (same config should compile the same program).
+
+Usage::
+
+    python tools/run_diff.py RUN_A RUN_B [--json] [--rtol X]
+        [--perf-tol X] [--no-wall]
+
+``RUN_X`` is a ``metrics.jsonl`` path or a directory containing one
+(``manifest.json`` is picked up alongside when present).
+
+Exit codes (house contract): 0 clean, 1 drift, 2 unreadable artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+# per-round fields compared under --rtol: bit-derived from the loss
+# trajectory (always present in round events) plus participation shape
+NUMERIC_FIELDS = ("fit_loss_std", "fit_loss_spread", "participants",
+                  "failures")
+# program-report fields: same config must report the same compiled program
+PROGRAM_FIELDS = ("flops", "peak_hbm_bytes", "bytes_accessed")
+
+
+class Unreadable(Exception):
+    pass
+
+
+def load_run(path: str) -> dict[str, Any]:
+    """{'events': {kind: [records]}, 'manifest': dict|None, 'path': str}"""
+    if os.path.isdir(path):
+        log = os.path.join(path, "metrics.jsonl")
+        mani_path = os.path.join(path, "manifest.json")
+    else:
+        log = path
+        mani_path = os.path.join(os.path.dirname(path) or ".",
+                                 "manifest.json")
+    if not os.path.exists(log):
+        raise Unreadable(f"{log}: no such file")
+    events: dict[str, list[dict]] = {}
+    try:
+        with open(log, "r", encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    raise Unreadable(f"{log}:{i + 1}: not valid JSON")
+                if not isinstance(rec, dict):
+                    raise Unreadable(f"{log}:{i + 1}: not a JSON object")
+                events.setdefault(rec.get("event", "?"), []).append(rec)
+    except OSError as e:
+        raise Unreadable(f"{log}: {e}") from None
+    if not events:
+        raise Unreadable(f"{log}: no events")
+    manifest = None
+    if os.path.exists(mani_path):
+        try:
+            with open(mani_path, "r", encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise Unreadable(f"{mani_path}: {e}") from None
+    return {"events": events, "manifest": manifest, "path": log}
+
+
+def _rel_delta(a: float, b: float) -> float:
+    denom = max(abs(a), abs(b))
+    return 0.0 if denom == 0.0 else abs(a - b) / denom
+
+
+def _close(a: Any, b: Any, rtol: float) -> bool:
+    if a is None or b is None:
+        return a is b
+    try:
+        fa, fb = float(a), float(b)
+    except (TypeError, ValueError):
+        return a == b
+    if rtol <= 0.0:
+        return fa == fb
+    return _rel_delta(fa, fb) <= rtol
+
+
+def diff_config(a: dict, b: dict) -> list[dict[str, Any]]:
+    """Manifest/config identity drift — different experiments."""
+    out: list[dict[str, Any]] = []
+    ma, mb = a["manifest"], b["manifest"]
+    if ma is None or mb is None:
+        return out  # nothing to compare; noted in the summary
+    if ma.get("config_hash") != mb.get("config_hash"):
+        out.append({"kind": "config", "what": "config_hash",
+                    "a": ma.get("config_hash"), "b": mb.get("config_hash")})
+    ca, cb = ma.get("config") or {}, mb.get("config") or {}
+    for key in sorted(set(ca) | set(cb)):
+        if ca.get(key) != cb.get(key):
+            out.append({"kind": "config", "what": f"config.{key}",
+                        "a": ca.get(key), "b": cb.get(key)})
+    # an admin retune journal on one side means the runs were DRIVEN
+    # differently even under the same config hash
+    ra = (ma.get("admin") or {}).get("retunes") or []
+    rb = (mb.get("admin") or {}).get("retunes") or []
+    if ra != rb:
+        out.append({"kind": "config", "what": "admin.retunes",
+                    "a": ra, "b": rb})
+    return out
+
+
+def diff_numeric(a: dict, b: dict, rtol: float) -> list[dict[str, Any]]:
+    """Trajectory drift over the common rounds + SLO verdict sequences."""
+    out: list[dict[str, Any]] = []
+    rounds_a = {r.get("round"): r for r in a["events"].get("round", [])}
+    rounds_b = {r.get("round"): r for r in b["events"].get("round", [])}
+    common = sorted(set(rounds_a) & set(rounds_b),
+                    key=lambda r: (r is None, r))
+    if len(rounds_a) != len(rounds_b):
+        out.append({"kind": "numeric", "what": "round_count",
+                    "a": len(rounds_a), "b": len(rounds_b)})
+    for rnd in common:
+        ra, rb = rounds_a[rnd], rounds_b[rnd]
+        for field in NUMERIC_FIELDS:
+            va, vb = ra.get(field), rb.get(field)
+            if not _close(va, vb, rtol):
+                out.append({"kind": "numeric", "round": rnd,
+                            "what": field, "a": va, "b": vb})
+    verdicts_a = [(e.get("round"), e.get("slo"), e.get("standing"))
+                  for e in a["events"].get("slo", [])]
+    verdicts_b = [(e.get("round"), e.get("slo"), e.get("standing"))
+                  for e in b["events"].get("slo", [])]
+    if verdicts_a != verdicts_b:
+        out.append({"kind": "numeric", "what": "slo_verdicts",
+                    "a": verdicts_a, "b": verdicts_b})
+    admin_a = [(e.get("round"), e.get("scalars"))
+               for e in a["events"].get("admin", [])]
+    admin_b = [(e.get("round"), e.get("scalars"))
+               for e in b["events"].get("admin", [])]
+    if admin_a != admin_b:
+        out.append({"kind": "numeric", "what": "admin_retunes",
+                    "a": admin_a, "b": admin_b})
+    return out
+
+
+def diff_performance(a: dict, b: dict, perf_tol: float,
+                     wall: bool = True) -> list[dict[str, Any]]:
+    """Program footprint + (optionally) wall-time drift."""
+    out: list[dict[str, Any]] = []
+    progs_a = {p.get("name"): p for p in a["events"].get("program", [])}
+    progs_b = {p.get("name"): p for p in b["events"].get("program", [])}
+    for name in sorted(set(progs_a) & set(progs_b)):
+        for field in PROGRAM_FIELDS:
+            va = progs_a[name].get(field)
+            vb = progs_b[name].get(field)
+            if va is None or vb is None:
+                continue
+            # identical configs compile identical programs — hold these
+            # tight regardless of perf_tol (1e-6 absorbs float repr noise)
+            if _rel_delta(float(va), float(vb)) > 1e-6:
+                out.append({"kind": "performance", "what": f"{name}.{field}",
+                            "a": va, "b": vb})
+    if wall:
+        for field in ("fit_s", "eval_s"):
+            wa = [r.get(field) for r in a["events"].get("round", [])
+                  if r.get(field) is not None]
+            wb = [r.get(field) for r in b["events"].get("round", [])
+                  if r.get(field) is not None]
+            if not wa or not wb:
+                continue
+            ma = sorted(wa)[len(wa) // 2]
+            mb = sorted(wb)[len(wb) // 2]
+            if _rel_delta(float(ma), float(mb)) > perf_tol:
+                out.append({"kind": "performance",
+                            "what": f"median_{field}", "a": ma, "b": mb})
+    return out
+
+
+def diff_runs(a: dict, b: dict, rtol: float = 0.0, perf_tol: float = 0.25,
+              wall: bool = True) -> dict[str, Any]:
+    config = diff_config(a, b)
+    numeric = diff_numeric(a, b, rtol)
+    performance = diff_performance(a, b, perf_tol, wall)
+    classes = [name for name, found in (
+        ("config", config), ("numeric", numeric),
+        ("performance", performance)) if found]
+    return {
+        "a": a["path"],
+        "b": b["path"],
+        "clean": not classes,
+        "classification": classes,
+        "config": config,
+        "numeric": numeric,
+        "performance": performance,
+        "notes": ([] if (a["manifest"] is not None
+                         and b["manifest"] is not None)
+                  else ["manifest missing on one side; "
+                        "config drift not checked"]),
+    }
+
+
+def render(doc: dict[str, Any]) -> str:
+    lines = [f"run A: {doc['a']}", f"run B: {doc['b']}"]
+    for note in doc["notes"]:
+        lines.append(f"note: {note}")
+    if doc["clean"]:
+        lines.append("CLEAN: no drift detected")
+        return "\n".join(lines)
+    lines.append(f"DRIFT: {', '.join(doc['classification'])}")
+    for bucket in ("config", "numeric", "performance"):
+        for d in doc[bucket]:
+            where = f" round {d['round']}" if "round" in d else ""
+            lines.append(
+                f"  [{d['kind']}]{where} {d['what']}: "
+                f"{d['a']!r} -> {d['b']!r}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("run_a", help="metrics.jsonl (or its directory) of run A")
+    ap.add_argument("run_b", help="metrics.jsonl (or its directory) of run B")
+    ap.add_argument("--rtol", type=float, default=0.0,
+                    help="relative tolerance for per-round numeric fields "
+                         "(default 0: exact — same-seed re-runs are "
+                         "bit-identical here)")
+    ap.add_argument("--perf-tol", type=float, default=0.25,
+                    help="relative tolerance for median wall-time drift "
+                         "(default 0.25; flops/HBM are always held tight)")
+    ap.add_argument("--no-wall", action="store_true",
+                    help="skip wall-clock comparison (cross-machine diffs)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full diff document as JSON")
+    args = ap.parse_args(argv)
+    try:
+        a = load_run(args.run_a)
+        b = load_run(args.run_b)
+    except Unreadable as e:
+        print(f"unreadable: {e}", file=sys.stderr)
+        return 2
+    doc = diff_runs(a, b, rtol=args.rtol, perf_tol=args.perf_tol,
+                    wall=not args.no_wall)
+    print(json.dumps(doc, indent=2, default=str) if args.json
+          else render(doc))
+    return 0 if doc["clean"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
